@@ -1,0 +1,66 @@
+"""Benchmark: LeNet/MNIST training throughput (samples/sec/chip).
+
+BASELINE.md metric: MNIST-LeNet samples/sec/chip (the reference publishes no
+numbers — `BASELINE.json "published": {}` — so vs_baseline is reported
+against the first recorded run of this framework, stored in
+`.bench_baseline.json`).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch_size = 512
+    warmup_batches = 5
+    bench_batches = 30
+
+    net = MultiLayerNetwork(lenet_configuration())
+    net.init()
+
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    it = MnistDataSetIterator(batch_size, num_examples=batch_size * (warmup_batches + bench_batches))
+    batches = list(it)
+
+    # warmup (compile)
+    net.fit(ListDataSetIterator(batches[:warmup_batches]))
+    jax.block_until_ready(net._params)
+
+    t0 = time.perf_counter()
+    net.fit(ListDataSetIterator(batches[warmup_batches:warmup_batches + bench_batches]))
+    jax.block_until_ready(net._params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = bench_batches * batch_size / dt
+
+    baseline_file = Path(__file__).parent / ".bench_baseline.json"
+    if baseline_file.exists():
+        baseline = json.loads(baseline_file.read_text())["value"]
+    else:
+        baseline = samples_per_sec
+        baseline_file.write_text(json.dumps({"value": samples_per_sec}))
+
+    print(json.dumps({
+        "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capabilities of Deeplearning4j (reference: leafyesy/deeplearning4j).
+
+Unlike the reference — whose math bottoms out in libnd4j/CUDA/cuDNN via JNI
+(reference `deeplearning4j-cuda/`, external nd4j) and whose distribution runs
+over Spark / an Aeron parameter server (`deeplearning4j-scaleout/`) — this
+implementation is TPU-first:
+
+- the whole fwd+bwd+update training iteration traces to ONE compiled XLA step
+  function with donated parameter buffers (vs. the reference's per-op JNI
+  dispatch, `MultiLayerNetwork.java:978` ff.);
+- layer math lowers to XLA HLO (conv_general_dilated, reduce_window, …) and
+  Pallas TPU kernels instead of cuDNN helpers
+  (`CudnnConvolutionHelper.java:49`);
+- data-parallel / model-parallel scaling uses `jax.sharding.Mesh` + ICI
+  collectives (psum / all_gather / ppermute) instead of
+  `Nd4j.averageAndPropagate` (`ParallelWrapper.java:179`) or Spark parameter
+  averaging (`ParameterAveragingTrainingMaster.java:75`).
+
+Public API mirrors the reference's surface: `NeuralNetConfiguration.Builder`
+→ `MultiLayerConfiguration` → `MultiLayerNetwork.fit(DataSetIterator)`, plus
+`ComputationGraph`, evaluation, early stopping, serialization, NLP & graph
+embeddings, and distributed wrappers.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+
+
+def __getattr__(name):
+    # lazy imports keep `import deeplearning4j_tpu` cheap and avoid cycles
+    if name == "MultiLayerNetwork":
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork
+    if name == "ComputationGraph":
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ComputationGraph
+    if name == "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+            ComputationGraphConfiguration,
+        )
+
+        return ComputationGraphConfiguration
+    raise AttributeError(name)

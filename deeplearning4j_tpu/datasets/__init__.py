@@ -1,0 +1,12 @@
+"""Data API: DataSet containers + iterators (TPU equivalent of ND4J
+`DataSet`/`DataSetIterator` surface + reference `deeplearning4j-core`
+dataset iterators)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)

@@ -1,0 +1,91 @@
+"""DataSet / MultiDataSet containers.
+
+Reference surface: ND4J `org.nd4j.linalg.dataset.DataSet` /`MultiDataSet`
+(features, labels, featuresMask, labelsMask), consumed throughout DL4J
+(`MultiLayerNetwork.fit(DataSetIterator)` etc.).
+
+Arrays are kept as numpy on the host; the jitted step function moves them to
+TPU HBM at dispatch (device transfer is the infeed boundary — see
+`AsyncDataSetIterator` for the prefetch overlap).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        def sl(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        n = self.num_examples()
+        return (
+            DataSet(self.features[:n_train], sl(self.labels, 0, n_train),
+                    sl(self.features_mask, 0, n_train), sl(self.labels_mask, 0, n_train)),
+            DataSet(self.features[n_train:], sl(self.labels, n_train, n),
+                    sl(self.features_mask, n_train, n), sl(self.labels_mask, n_train, n)),
+        )
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for lo in range(0, n, batch_size):
+            hi = min(lo + batch_size, n)
+
+            def sl(a):
+                return None if a is None else a[lo:hi]
+
+            out.append(DataSet(self.features[lo:hi], sl(self.labels),
+                               sl(self.features_mask), sl(self.labels_mask)))
+        return out
+
+    @staticmethod
+    def merge(sets: Sequence["DataSet"]) -> "DataSet":
+        def cat(xs):
+            if any(x is None for x in xs):
+                return None
+            return np.concatenate(xs, axis=0)
+
+        return DataSet(
+            np.concatenate([d.features for d in sets], axis=0),
+            cat([d.labels for d in sets]),
+            cat([d.features_mask for d in sets]),
+            cat([d.labels_mask for d in sets]),
+        )
+
+
+@dataclass
+class MultiDataSet:
+    """Multiple input/output arrays (reference ND4J MultiDataSet, used by
+    ComputationGraph)."""
+
+    features: List[np.ndarray] = field(default_factory=list)
+    labels: List[np.ndarray] = field(default_factory=list)
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

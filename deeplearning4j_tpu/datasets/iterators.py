@@ -1,0 +1,231 @@
+"""DataSetIterator plumbing, incl. background prefetch.
+
+Reference: `deeplearning4j-nn/.../datasets/iterator/` —
+`AsyncDataSetIterator.java:36` (background thread + LinkedBlockingDeque:68),
+`MultipleEpochsIterator`, `ExistingDataSetIterator`,
+`impl/ListDataSetIterator`.
+
+TPU note: AsyncDataSetIterator is the host-side half of the infeed pipeline —
+it overlaps host ETL with device compute, which is what hides HBM transfer
+latency behind the previous step's execution (the reference wraps every
+`fit()` iterator the same way, `MultiLayerNetwork.java:982`).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator contract (reference ND4J `DataSetIterator`)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-batched list (reference `impl/ListDataSetIterator`)."""
+
+    def __init__(self, data: List[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None and len(data) == 1:
+            data = data[0].batch_by(batch_size)
+        self._data = list(data)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._data)
+
+    def next(self):
+        d = self._data[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._data[0].num_examples() if self._data else 0
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any python iterable of DataSets (reference
+    `ExistingDataSetIterator.java`)."""
+
+    def __init__(self, iterable: Iterable[DataSet]):
+        self._iterable = iterable
+        # a one-shot iterator (generator) cannot be replayed by reset()
+        self._one_shot = iter(iterable) is iterable
+        self._consumed = False
+        self._it: Optional[Iterator[DataSet]] = None
+        self._peek: Optional[DataSet] = None
+
+    def reset(self):
+        if self._one_shot:
+            if self._consumed:
+                raise ValueError(
+                    "ExistingDataSetIterator wraps a one-shot iterator "
+                    "(generator) that has already been consumed; pass a list "
+                    "or a restartable iterable to train multiple epochs")
+            self._it = self._iterable  # type: ignore[assignment]
+        else:
+            self._it = iter(self._iterable)
+        self._peek = None
+
+    def has_next(self):
+        if self._it is None:
+            self.reset()
+        if self._peek is not None:
+            return True
+        try:
+            self._peek = next(self._it)  # type: ignore[arg-type]
+            self._consumed = True
+            return True
+        except StopIteration:
+            return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        d, self._peek = self._peek, None
+        return d
+
+    def batch(self):
+        return -1
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay an underlying iterator N times (reference
+    `MultipleEpochsIterator.java`)."""
+
+    def __init__(self, epochs: int, underlying: DataSetIterator):
+        self.epochs = epochs
+        self._under = underlying
+        self._epoch = 0
+
+    def reset(self):
+        self._under.reset()
+        self._epoch = 0
+
+    def has_next(self):
+        if self._under.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self._under.reset()
+            return self._under.has_next()
+        return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        return self._under.next()
+
+    def batch(self):
+        return self._under.batch()
+
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference `AsyncDataSetIterator.java:36`:
+    producer thread feeding a bounded blocking queue, default capacity 2 —
+    here `queue_size`). The producer runs host-side ETL while the device
+    executes the previous step."""
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+        self._under = underlying
+        self._queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._exhausted = False
+        # producer starts lazily on first has_next() so that the __iter__ →
+        # reset() handshake doesn't consume-and-discard a prefetch pass
+        # (load-bearing for one-shot generator sources)
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._exhausted = False
+        self._peek = None
+
+        def worker(q: queue.Queue, under: DataSetIterator):
+            try:
+                while under.has_next():
+                    q.put(under.next())
+            except Exception as e:  # surface producer errors to the consumer
+                q.put(e)
+                return
+            q.put(_SENTINEL)
+
+        self._thread = threading.Thread(
+            target=worker, args=(self._queue, self._under), daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        # drain + stop the producer; restart happens lazily on next pull
+        # (reference `AsyncDataSetIterator.reset`)
+        if self._thread is not None:
+            if not self._exhausted:  # sentinel not yet consumed: drain to it
+                while True:
+                    item = self._queue.get()
+                    if item is _SENTINEL or isinstance(item, Exception):
+                        break
+            self._thread.join()
+            self._thread = None
+        self._peek = None
+        self._exhausted = False
+        self._under.reset()
+
+    def has_next(self):
+        if self._peek is not None:
+            return True
+        if self._exhausted:
+            return False
+        if self._thread is None:
+            self._start()
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._exhausted = True
+            return False
+        if isinstance(item, Exception):
+            self._exhausted = True
+            raise item
+        self._peek = item
+        return True
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        d, self._peek = self._peek, None
+        return d
+
+    def batch(self):
+        return self._under.batch()

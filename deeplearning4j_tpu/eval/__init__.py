@@ -1,0 +1,5 @@
+"""Evaluation metrics — TPU equivalent of reference `eval/` package."""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass  # noqa: F401

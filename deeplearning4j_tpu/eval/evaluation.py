@@ -1,0 +1,100 @@
+"""Classification evaluation: accuracy/precision/recall/F1/confusion matrix.
+
+Reference: `deeplearning4j-nn/.../eval/Evaluation.java:46` (precision:454,
+recall:502, f1:645, accuracy:659, confusion matrix). Accumulation is
+host-side numpy (cheap vs. the model forward); the heavy part — the model
+forward producing predictions — runs on TPU.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None):
+        self.num_classes = num_classes or (len(labels) if labels else None)
+        self.label_names = labels
+        self._confusion: Optional[np.ndarray] = None  # [actual, predicted]
+
+    # ------------------------------------------------------------------ acc
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        """Accumulate a batch. labels/predictions: (N, C) one-hot/probs, or
+        (B, T, C) time series (flattened with mask)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            B, T, C = labels.shape
+            labels = labels.reshape(B * T, C)
+            predictions = predictions.reshape(B * T, C)
+            if mask is not None:
+                mask = np.asarray(mask).reshape(B * T)
+        if self.num_classes is None:
+            self.num_classes = labels.shape[-1]
+        if self._confusion is None:
+            self._confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        if mask is not None:
+            keep = np.asarray(mask).astype(bool).reshape(-1)
+            actual, pred = actual[keep], pred[keep]
+        np.add.at(self._confusion, (actual, pred), 1)
+
+    # -------------------------------------------------------------- metrics
+    @property
+    def confusion_matrix(self) -> np.ndarray:
+        return self._confusion if self._confusion is not None else np.zeros((0, 0))
+
+    def true_positives(self, cls: int) -> int:
+        return int(self._confusion[cls, cls])
+
+    def false_positives(self, cls: int) -> int:
+        return int(self._confusion[:, cls].sum() - self._confusion[cls, cls])
+
+    def false_negatives(self, cls: int) -> int:
+        return int(self._confusion[cls, :].sum() - self._confusion[cls, cls])
+
+    def accuracy(self) -> float:
+        if self._confusion is None:
+            return 0.0
+        c = self._confusion
+        total = c.sum()
+        return float(np.trace(c)) / total if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if self._confusion is None:
+            return 0.0
+        if cls is not None:
+            tp, fp = self.true_positives(cls), self.false_positives(cls)
+            return tp / (tp + fp) if (tp + fp) else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if self._confusion[:, i].sum() > 0 or self._confusion[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if self._confusion is None:
+            return 0.0
+        if cls is not None:
+            tp, fn = self.true_positives(cls), self.false_negatives(cls)
+            return tp / (tp + fn) if (tp + fn) else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if self._confusion[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            "==========================Scores========================================",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "========================================================================",
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,68 @@
+"""ROC / AUC, thresholded accumulation.
+
+Reference: `eval/ROC.java` (296 LoC, thresholded counts at K steps) and
+`ROCMultiClass.java` — same thresholded design so streaming batches
+accumulate O(K) state rather than storing every score.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ROC:
+    """Binary ROC. Labels: (N,) {0,1} or (N,2) one-hot; probs likewise."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.thresholds = np.linspace(0.0, 1.0, threshold_steps + 1)
+        self._tp = np.zeros(threshold_steps + 1, np.int64)
+        self._fp = np.zeros(threshold_steps + 1, np.int64)
+        self._pos = 0
+        self._neg = 0
+
+    def eval(self, labels: np.ndarray, probs: np.ndarray) -> None:
+        labels = np.asarray(labels)
+        probs = np.asarray(probs)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            labels = labels[:, 1]
+            probs = probs[:, 1]
+        labels = labels.reshape(-1).astype(bool)
+        probs = probs.reshape(-1)
+        for i, t in enumerate(self.thresholds):
+            pred = probs >= t
+            self._tp[i] += int(np.sum(pred & labels))
+            self._fp[i] += int(np.sum(pred & ~labels))
+        self._pos += int(labels.sum())
+        self._neg += int((~labels).sum())
+
+    def get_roc_curve(self):
+        tpr = self._tp / max(self._pos, 1)
+        fpr = self._fp / max(self._neg, 1)
+        return fpr, tpr
+
+    def calculate_auc(self) -> float:
+        fpr, tpr = self.get_roc_curve()
+        order = np.argsort(fpr, kind="stable")
+        return float(abs(np.trapezoid(tpr[order], fpr[order])))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference `eval/ROCMultiClass.java`)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self._rocs = {}
+
+    def eval(self, labels: np.ndarray, probs: np.ndarray) -> None:
+        labels = np.asarray(labels)
+        probs = np.asarray(probs)
+        for c in range(labels.shape[-1]):
+            self._rocs.setdefault(c, ROC(self.steps)).eval(labels[:, c], probs[:, c])
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs.values()]))

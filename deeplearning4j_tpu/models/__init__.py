@@ -1,0 +1,4 @@
+"""Model zoo: reference-benchmark architectures built on the config DSL
+(BASELINE.md configs: LeNet/MNIST, ResNet-50/CIFAR, char-RNN LSTM)."""
+
+from deeplearning4j_tpu.models.lenet import lenet_configuration  # noqa: F401

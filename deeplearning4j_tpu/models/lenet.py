@@ -1,0 +1,48 @@
+"""LeNet on MNIST — BASELINE config 1 (MultiLayerNetwork LeNet,
+Dense+Convolution, SGD family).
+
+Mirrors the canonical DL4J LeNet example wired through the reference path
+`MultiLayerNetwork.fit` (`MultiLayerNetwork.java:978`) with the conv helper
+(`ConvolutionLayer.java:158`); here the convs lower straight to XLA
+`conv_general_dilated` on the MXU.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.util.conv_utils import PoolingType
+
+
+def lenet_configuration(seed: int = 12345, learning_rate: float = 0.01,
+                        updater: Updater = Updater.NESTEROVS,
+                        n_classes: int = 10) -> MultiLayerConfiguration:
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .learning_rate(learning_rate)
+            .updater(updater)
+            .momentum(0.9)
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                    activation=Activation.IDENTITY))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                    activation=Activation.IDENTITY))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=n_classes, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())

@@ -1,0 +1,1 @@
+"""Neural-net core — TPU-native equivalent of reference `deeplearning4j-nn`."""

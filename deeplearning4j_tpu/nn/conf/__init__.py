@@ -1,0 +1,39 @@
+"""Configuration package — TPU equivalent of reference `nn/conf/`."""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
+    ActivationLayer,
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    Layer,
+    LocalResponseNormalization,
+    LossLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (  # noqa: F401
+    GlobalConf,
+    ListBuilder,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.util.conv_utils import ConvolutionMode, PoolingType  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: ComputationGraphConfiguration lives in its own module and is
+    # imported on demand to keep the MLN-only path light
+    if name in ("ComputationGraphConfiguration", "GraphBuilder"):
+        from deeplearning4j_tpu.nn.conf import computation_graph_configuration as m
+
+        return getattr(m, name)
+    raise AttributeError(name)

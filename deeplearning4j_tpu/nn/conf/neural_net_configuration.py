@@ -1,0 +1,480 @@
+"""Configuration DSL: fluent builders → serializable network configuration.
+
+Reference: `deeplearning4j-nn/.../nn/conf/NeuralNetConfiguration.java:478-514`
+(Builder fields: activation, weightInit, lr, l1/l2, dropout, updater +
+hyperparams, seed, optimizationAlgo, gradientNormalization, lrPolicy),
+`.list()` → `ListBuilder` (`:581,194`), `MultiLayerConfiguration.java`
+(JSON/YAML round-trip via Jackson — here: plain-dict JSON round-trip).
+
+The built `MultiLayerConfiguration` is the canonical model description — it
+is what checkpoints store (`ModelSerializer.java:93` `configuration.json`)
+and what distributed workers receive (reference `NetBroadcastTuple`).
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeConvolutionalFlat,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    FeedForwardLayer,
+    Layer,
+    SubsamplingLayer,
+    layer_from_json,
+    layer_to_json,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    InputPreProcessor,
+    preprocessor_from_json,
+    preprocessor_to_json,
+)
+from deeplearning4j_tpu.nn.updater import (
+    GradientNormalization,
+    LearningRatePolicy,
+    Updater,
+    UpdaterConfig,
+)
+from deeplearning4j_tpu.nn.weights import Distribution, WeightInit
+from deeplearning4j_tpu.ops.activations import Activation
+
+
+class OptimizationAlgorithm(str, enum.Enum):
+    """Reference `nn/api/OptimizationAlgorithm.java` — dispatch in
+    `optimize/Solver.java:58-68`."""
+
+    STOCHASTIC_GRADIENT_DESCENT = "stochastic_gradient_descent"
+    LINE_GRADIENT_DESCENT = "line_gradient_descent"
+    CONJUGATE_GRADIENT = "conjugate_gradient"
+    LBFGS = "lbfgs"
+
+
+@dataclass
+class GlobalConf:
+    """Resolved global hyperparameter defaults (the Builder's fields)."""
+
+    seed: int = 12345
+    activation: Activation = Activation.SIGMOID
+    weight_init: WeightInit = WeightInit.XAVIER
+    dist: Optional[Distribution] = None
+    bias_init: float = 0.0
+    learning_rate: float = 1e-1
+    bias_learning_rate: Optional[float] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    dropout: float = 0.0
+    updater: Updater = Updater.SGD
+    momentum: float = 0.9
+    rho: float = 0.95
+    rms_decay: float = 0.95
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    epsilon: float = 1e-8
+    lr_policy: LearningRatePolicy = LearningRatePolicy.NONE
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_power: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_schedule: Dict[int, float] = field(default_factory=dict)
+    gradient_normalization: GradientNormalization = GradientNormalization.NONE
+    gradient_normalization_threshold: float = 1.0
+    optimization_algo: OptimizationAlgorithm = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+    max_num_line_search_iterations: int = 5
+    mini_batch: bool = True
+    use_regularization: bool = False
+
+
+class NeuralNetConfiguration:
+    """Namespace mirroring the reference class; use
+    `NeuralNetConfiguration.Builder()`."""
+
+    class Builder:
+        def __init__(self):
+            self._g = GlobalConf()
+
+        # fluent setters (reference Builder method names, snake_cased) ------
+        def seed(self, s: int):
+            self._g.seed = int(s)
+            return self
+
+        def activation(self, a):
+            self._g.activation = Activation(a)
+            return self
+
+        def weight_init(self, w):
+            self._g.weight_init = WeightInit(w)
+            return self
+
+        def dist(self, d: Distribution):
+            self._g.dist = d
+            self._g.weight_init = WeightInit.DISTRIBUTION
+            return self
+
+        def bias_init(self, b: float):
+            self._g.bias_init = b
+            return self
+
+        def learning_rate(self, lr: float):
+            self._g.learning_rate = lr
+            return self
+
+        def bias_learning_rate(self, lr: float):
+            self._g.bias_learning_rate = lr
+            return self
+
+        def l1(self, v: float):
+            self._g.l1 = v
+            self._g.use_regularization = True
+            return self
+
+        def l2(self, v: float):
+            self._g.l2 = v
+            self._g.use_regularization = True
+            return self
+
+        def l1_bias(self, v: float):
+            self._g.l1_bias = v
+            return self
+
+        def l2_bias(self, v: float):
+            self._g.l2_bias = v
+            return self
+
+        def drop_out(self, p: float):
+            self._g.dropout = p
+            return self
+
+        def updater(self, u):
+            self._g.updater = Updater(u)
+            return self
+
+        def momentum(self, m: float):
+            self._g.momentum = m
+            return self
+
+        def rho(self, r: float):
+            self._g.rho = r
+            return self
+
+        def rms_decay(self, r: float):
+            self._g.rms_decay = r
+            return self
+
+        def adam_mean_decay(self, v: float):
+            self._g.adam_mean_decay = v
+            return self
+
+        def adam_var_decay(self, v: float):
+            self._g.adam_var_decay = v
+            return self
+
+        def epsilon(self, e: float):
+            self._g.epsilon = e
+            return self
+
+        def learning_rate_policy(self, p):
+            self._g.lr_policy = LearningRatePolicy(p)
+            return self
+
+        def lr_policy_decay_rate(self, r: float):
+            self._g.lr_policy_decay_rate = r
+            return self
+
+        def lr_policy_power(self, p: float):
+            self._g.lr_policy_power = p
+            return self
+
+        def lr_policy_steps(self, s: float):
+            self._g.lr_policy_steps = s
+            return self
+
+        def learning_rate_schedule(self, sched: Dict[int, float]):
+            self._g.lr_schedule = dict(sched)
+            self._g.lr_policy = LearningRatePolicy.SCHEDULE
+            return self
+
+        def gradient_normalization(self, gn):
+            self._g.gradient_normalization = GradientNormalization(gn)
+            return self
+
+        def gradient_normalization_threshold(self, t: float):
+            self._g.gradient_normalization_threshold = t
+            return self
+
+        def optimization_algo(self, o):
+            self._g.optimization_algo = OptimizationAlgorithm(o)
+            return self
+
+        def max_num_line_search_iterations(self, n: int):
+            self._g.max_num_line_search_iterations = n
+            return self
+
+        def mini_batch(self, b: bool):
+            self._g.mini_batch = b
+            return self
+
+        def regularization(self, use: bool):
+            self._g.use_regularization = use
+            return self
+
+        def list(self) -> "ListBuilder":
+            return ListBuilder(self._g)
+
+        def graph_builder(self):
+            from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+                GraphBuilder,
+            )
+
+            return GraphBuilder(self._g)
+
+
+class ListBuilder:
+    """Reference `NeuralNetConfiguration.ListBuilder` (`:581,194`)."""
+
+    def __init__(self, g: GlobalConf):
+        self._g = g
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop = True
+        self._pretrain = False
+        self._tbptt_fwd = -1
+        self._tbptt_bwd = -1
+
+    def layer(self, *args):
+        """.layer(conf) or .layer(index, conf) (reference allows both)."""
+        if len(args) == 1:
+            self._layers.append(args[0])
+        else:
+            idx, conf = args
+            while len(self._layers) <= idx:
+                self._layers.append(None)  # type: ignore
+            self._layers[idx] = conf
+        return self
+
+    def input_pre_processor(self, idx: int, p: InputPreProcessor):
+        self._preprocessors[idx] = p
+        return self
+
+    def set_input_type(self, it: InputType):
+        self._input_type = it
+        return self
+
+    def backprop(self, b: bool):
+        self._backprop = b
+        return self
+
+    def pretrain(self, p: bool):
+        self._pretrain = p
+        return self
+
+    def t_bptt_forward_length(self, n: int):
+        self._tbptt_fwd = n
+        return self
+
+    def t_bptt_backward_length(self, n: int):
+        self._tbptt_bwd = n
+        return self
+
+    def build(self) -> "MultiLayerConfiguration":
+        layers = [l for l in self._layers if l is not None]
+        merged = [_merge_layer_defaults(l, self._g) for l in layers]
+        pre = dict(self._preprocessors)
+        if self._input_type is not None:
+            _infer_shapes(merged, pre, self._input_type)
+        return MultiLayerConfiguration(
+            layers=merged,
+            preprocessors=pre,
+            global_conf=self._g,
+            input_type=self._input_type,
+            backprop=self._backprop,
+            pretrain=self._pretrain,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_bwd_length=self._tbptt_bwd,
+        )
+
+
+def _merge_layer_defaults(layer: Layer, g: GlobalConf) -> Layer:
+    """Fill layer Nones from the global builder (reference: ListBuilder.build
+    merging global NeuralNetConfiguration into each layer's conf)."""
+    l = replace(layer)
+    if l.activation is None:
+        l.activation = g.activation
+    if l.weight_init is None:
+        l.weight_init = g.weight_init
+    if l.dist is None:
+        l.dist = g.dist
+    if l.bias_init is None:
+        l.bias_init = g.bias_init
+    if l.dropout is None:
+        l.dropout = g.dropout
+    if l.l1 is None:
+        l.l1 = g.l1 if g.use_regularization else 0.0
+    if l.l2 is None:
+        l.l2 = g.l2 if g.use_regularization else 0.0
+    if l.l1_bias is None:
+        l.l1_bias = g.l1_bias if g.use_regularization else 0.0
+    if l.l2_bias is None:
+        l.l2_bias = g.l2_bias if g.use_regularization else 0.0
+    lr = l.learning_rate if l.learning_rate is not None else g.learning_rate
+    bias_lr = (
+        l.bias_learning_rate
+        if l.bias_learning_rate is not None
+        else (g.bias_learning_rate if g.bias_learning_rate is not None else lr)
+    )
+    if l.updater_cfg is None:
+        l.updater_cfg = UpdaterConfig(
+            updater=g.updater,
+            learning_rate=lr,
+            bias_learning_rate=bias_lr,
+            momentum=g.momentum,
+            rho=g.rho,
+            rms_decay=g.rms_decay,
+            adam_mean_decay=g.adam_mean_decay,
+            adam_var_decay=g.adam_var_decay,
+            epsilon=g.epsilon,
+            lr_policy=g.lr_policy,
+            lr_policy_decay_rate=g.lr_policy_decay_rate,
+            lr_policy_power=g.lr_policy_power,
+            lr_policy_steps=g.lr_policy_steps,
+            lr_schedule=dict(g.lr_schedule),
+            gradient_normalization=g.gradient_normalization,
+            gradient_normalization_threshold=g.gradient_normalization_threshold,
+        )
+    l.learning_rate = lr
+    l.bias_learning_rate = bias_lr
+    return l
+
+
+def _infer_shapes(layers: List[Layer], pre: Dict[int, InputPreProcessor],
+                  input_type: InputType) -> None:
+    """Walk the stack inferring nIn and auto-inserting preprocessors
+    (reference `MultiLayerConfiguration.Builder` + `InputType` inference +
+    `FeedForwardLayer.setNIn`)."""
+    it = input_type
+    for i, layer in enumerate(layers):
+        if i in pre:
+            it = pre[i].output_type(it)
+        else:
+            p = _auto_preprocessor(layer, it)
+            if p is not None:
+                pre[i] = p
+                it = p.output_type(it)
+        # nIn inference
+        if isinstance(layer, FeedForwardLayer) and getattr(layer, "n_in", 0) in (0, None):
+            if isinstance(it, InputTypeFeedForward):
+                layer.n_in = it.size
+            elif isinstance(it, InputTypeRecurrent):
+                layer.n_in = it.size
+            elif isinstance(it, InputTypeConvolutional):
+                if isinstance(layer, ConvolutionLayer):
+                    layer.n_in = it.channels
+                else:
+                    layer.n_in = it.height * it.width * it.channels
+            elif isinstance(it, InputTypeConvolutionalFlat):
+                layer.n_in = it.flattened_size
+        it = layer.output_type(it)
+
+
+def _auto_preprocessor(layer: Layer, it: InputType) -> Optional[InputPreProcessor]:
+    kind = layer.input_kind
+    if kind == "cnn" and isinstance(it, InputTypeConvolutionalFlat):
+        return FeedForwardToCnnPreProcessor(it.height, it.width, it.channels)
+    if kind == "ff" and isinstance(it, InputTypeConvolutional):
+        return CnnToFeedForwardPreProcessor(it.height, it.width, it.channels)
+    if kind == "cnn" and isinstance(it, InputTypeFeedForward):
+        raise ValueError(
+            f"cannot feed FeedForward({it.size}) into CNN layer {layer.TYPE}; "
+            "set an explicit input_pre_processor (reference: "
+            "MultiLayerConfiguration preprocessor validation)")
+    if kind == "rnn" and isinstance(it, InputTypeFeedForward):
+        raise ValueError(
+            f"cannot feed FeedForward({it.size}) into RNN layer {layer.TYPE} "
+            "without a FeedForwardToRnnPreProcessor")
+    return None
+
+
+@dataclass
+class MultiLayerConfiguration:
+    """Built, fully-resolved network config (reference
+    `nn/conf/MultiLayerConfiguration.java`)."""
+
+    layers: List[Layer]
+    preprocessors: Dict[int, InputPreProcessor] = field(default_factory=dict)
+    global_conf: GlobalConf = field(default_factory=GlobalConf)
+    input_type: Optional[InputType] = None
+    backprop: bool = True
+    pretrain: bool = False
+    tbptt_fwd_length: int = -1
+    tbptt_bwd_length: int = -1
+
+    @property
+    def seed(self) -> int:
+        return self.global_conf.seed
+
+    # -- serde (reference: Jackson JSON round-trip, `toJson`/`fromJson`) ----
+    def to_json(self) -> str:
+        import dataclasses as dc
+
+        g = dc.asdict(self.global_conf)
+        for k, v in list(g.items()):
+            if isinstance(v, enum.Enum):
+                g[k] = v.value
+            elif isinstance(v, Distribution):
+                g[k] = v.to_json()
+        if self.global_conf.dist is not None:
+            g["dist"] = self.global_conf.dist.to_json()
+        d = {
+            "format": "deeplearning4j_tpu/MultiLayerConfiguration/v1",
+            "global_conf": g,
+            "layers": [layer_to_json(l) for l in self.layers],
+            "preprocessors": {str(k): preprocessor_to_json(p)
+                              for k, p in self.preprocessors.items()},
+            "input_type": self.input_type.to_json() if self.input_type else None,
+            "backprop": self.backprop,
+            "pretrain": self.pretrain,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_bwd_length": self.tbptt_bwd_length,
+        }
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        g = GlobalConf()
+        gd = d.get("global_conf", {})
+        for k, v in gd.items():
+            if not hasattr(g, k) or v is None:
+                continue
+            cur = getattr(g, k)
+            if isinstance(cur, enum.Enum):
+                v = type(cur)(v)
+            elif k == "dist" and isinstance(v, dict):
+                v = Distribution.from_json(v)
+            elif k == "lr_schedule":
+                v = {int(kk): vv for kk, vv in v.items()}
+            setattr(g, k, v)
+        if isinstance(gd.get("dist"), dict):
+            g.dist = Distribution.from_json(gd["dist"])
+        return MultiLayerConfiguration(
+            layers=[layer_from_json(l) for l in d["layers"]],
+            preprocessors={int(k): preprocessor_from_json(p)
+                           for k, p in d.get("preprocessors", {}).items()},
+            global_conf=g,
+            input_type=InputType.from_json(d["input_type"]) if d.get("input_type") else None,
+            backprop=d.get("backprop", True),
+            pretrain=d.get("pretrain", False),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", -1),
+            tbptt_bwd_length=d.get("tbptt_bwd_length", -1),
+        )

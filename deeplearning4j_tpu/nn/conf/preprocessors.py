@@ -1,0 +1,182 @@
+"""Input preprocessors: shape adapters auto-inserted between layer kinds.
+
+Reference: `deeplearning4j-nn/.../nn/conf/preprocessor/` (13 classes:
+`CnnToFeedForwardPreProcessor`, `FeedForwardToCnnPreProcessor`,
+`FeedForwardToRnnPreProcessor`, `RnnToFeedForwardPreProcessor`,
+`CnnToRnnPreProcessor`, `RnnToCnnPreProcessor`, …) and the auto-insertion in
+`MultiLayerConfiguration.Builder`.
+
+Differences from the reference, driven by TPU-native layouts: CNN activations
+are NHWC (not NCHW) and RNN activations are (B, T, F) (not (B, F, T)).
+Dense layers broadcast over the time axis natively, so the reference's
+RnnToFF/FFToRnn reshape pair is rarely needed — it exists for API parity.
+All preprocessors are bijective reshapes, so `jax.grad` transposes them
+automatically (the reference hand-writes `backprop()` for each).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeConvolutionalFlat,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+
+_PRE_REGISTRY: Dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    _PRE_REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+def preprocessor_to_json(p) -> dict:
+    import dataclasses
+
+    return {"type": p.TYPE, **dataclasses.asdict(p)}
+
+
+def preprocessor_from_json(d: dict):
+    d = dict(d)
+    return _PRE_REGISTRY[d.pop("type")](**d)
+
+
+class InputPreProcessor:
+    def preprocess(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def output_type(self, it: InputType) -> InputType:
+        raise NotImplementedError
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(B, H, W, C) → (B, H*W*C). Reference
+    `preprocessor/CnnToFeedForwardPreProcessor.java`."""
+
+    TYPE = "cnn_to_ff"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, it):
+        assert isinstance(it, InputTypeConvolutional)
+        return InputType.feed_forward(it.height * it.width * it.channels)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """(B, H*W*C) → (B, H, W, C). Reference
+    `preprocessor/FeedForwardToCnnPreProcessor.java`."""
+
+    TYPE = "ff_to_cnn"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, it):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(B, T, F) → (B*T, F). Reference
+    `preprocessor/RnnToFeedForwardPreProcessor.java`."""
+
+    TYPE = "rnn_to_ff"
+
+    def preprocess(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, it):
+        assert isinstance(it, InputTypeRecurrent)
+        return InputType.feed_forward(it.size)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """(B*T, F) → (B, T, F). Reference
+    `preprocessor/FeedForwardToRnnPreProcessor.java`."""
+
+    TYPE = "ff_to_rnn"
+    timeseries_length: int = -1
+
+    def preprocess(self, x):
+        return x.reshape(-1, self.timeseries_length, x.shape[-1])
+
+    def output_type(self, it):
+        assert isinstance(it, InputTypeFeedForward)
+        return InputType.recurrent(it.size, self.timeseries_length)
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """(B, H, W, C) → (B, 1, H*W*C) — treat each image as a length-1 sequence
+    step; with time-stacked batches use RnnToCnn instead. Reference
+    `preprocessor/CnnToRnnPreProcessor.java`."""
+
+    TYPE = "cnn_to_rnn"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        return x.reshape(x.shape[0], 1, -1)
+
+    def output_type(self, it):
+        assert isinstance(it, InputTypeConvolutional)
+        return InputType.recurrent(it.height * it.width * it.channels, 1)
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """(B, T, H*W*C) → (B*T, H, W, C). Reference
+    `preprocessor/RnnToCnnPreProcessor.java`."""
+
+    TYPE = "rnn_to_cnn"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, it):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    """Generic static reshape (keeps batch dim)."""
+
+    TYPE = "reshape"
+    shape: tuple = ()
+
+    def preprocess(self, x):
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_type(self, it):
+        if len(self.shape) == 1:
+            return InputType.feed_forward(self.shape[0])
+        if len(self.shape) == 3:
+            return InputType.convolutional(*self.shape)
+        raise ValueError(self.shape)

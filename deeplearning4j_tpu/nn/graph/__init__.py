@@ -1,0 +1,3 @@
+"""ComputationGraph network — TPU equivalent of reference `nn/graph/`."""
+
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph  # noqa: F401

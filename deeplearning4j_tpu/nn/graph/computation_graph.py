@@ -1,0 +1,348 @@
+"""ComputationGraph: DAG network container (multi-input/multi-output).
+
+Reference: `deeplearning4j-nn/.../nn/graph/ComputationGraph.java` (2,280 LoC)
+— `topologicalSortOrder:849`, `fit(DataSetIterator):670`,
+`computeGradientAndScore():952`, `feedForward:1043` (topo-order vertex loop
+:1047-1069), `calcBackpropGradients:1174` (reverse topo).
+
+TPU-first: the topo-order vertex loop is unrolled at TRACE time into one XLA
+computation — the DAG structure is static, so the whole graph (all vertices,
+all output losses, backward pass, updater applies) compiles into a single
+fused step function with donated buffers. There is no reverse-topo backward
+code: `jax.grad` differentiates the traced forward.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    LastTimeStepVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.updater import (
+    apply_layer_update,
+    init_updater_state,
+)
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+LState = Dict[str, Dict[str, jnp.ndarray]]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration, dtype=jnp.float32):
+        self.conf = conf
+        self.dtype = dtype
+        self._params: Optional[Params] = None
+        self._upd_state = None
+        self._layer_state: Optional[LState] = None
+        self._unravel = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List = []
+        self.score_value: Optional[float] = None
+        self._jit_train = None
+        self._jit_output = None
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> None:
+        conf = self.conf
+        if not conf.resolved_types:
+            conf._resolve_types()
+        key = jax.random.PRNGKey(conf.seed)
+        params: Params = {}
+        upd = {}
+        lstate: LState = {}
+        for name in conf.topological_order:
+            node = conf.nodes[name]
+            if not node.is_layer:
+                params[name], upd[name], lstate[name] = {}, {}, {}
+                continue
+            it = conf.resolved_types.get(node.inputs[0]) if node.inputs else None
+            if node.preprocessor is not None and it is not None:
+                it = node.preprocessor.output_type(it)
+            key, sub = jax.random.split(key)
+            p = node.layer.init_params(sub, it, self.dtype) if node.layer.has_params else {}
+            params[name] = p
+            cfg = node.layer.updater_cfg
+            upd[name] = {pn: init_updater_state(cfg, v) for pn, v in p.items()} if cfg else {}
+            lstate[name] = node.layer.init_state(it)
+        self._params = params
+        self._upd_state = upd
+        self._layer_state = lstate
+        _, self._unravel = ravel_pytree(params)
+
+    def _ensure_init(self):
+        if self._params is None:
+            self.init()
+
+    # ------------------------------------------------------------- forward
+    def _forward_pure(self, params: Params, lstate: LState,
+                      inputs: Sequence[jnp.ndarray], *, train: bool,
+                      rng: Optional[jax.Array],
+                      fmasks: Optional[Sequence[Optional[jnp.ndarray]]] = None,
+                      ) -> Tuple[Dict[str, jnp.ndarray], LState]:
+        """Trace the DAG in topological order (reference `feedForward:1043`).
+        Returns all vertex activations + new layer states."""
+        conf = self.conf
+        acts: Dict[str, jnp.ndarray] = dict(zip(conf.network_inputs, inputs))
+        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        if fmasks is not None:
+            masks.update(dict(zip(conf.network_inputs, fmasks)))
+        new_state = dict(lstate)
+        for li, name in enumerate(conf.topological_order):
+            node = conf.nodes[name]
+            in_acts = [acts[i] for i in node.inputs]
+            in_mask = next((masks.get(i) for i in node.inputs
+                            if masks.get(i) is not None), None)
+            if node.is_layer:
+                x = in_acts[0]
+                if node.preprocessor is not None:
+                    x = node.preprocessor.preprocess(x)
+                lrng = None if rng is None else jax.random.fold_in(rng, li)
+                mask = in_mask if x.ndim == 3 else None
+                acts[name], new_state[name] = node.layer.forward(
+                    params[name], lstate[name], x, train=train, rng=lrng,
+                    mask=mask)
+                masks[name] = in_mask if acts[name].ndim == 3 else None
+            else:
+                v = node.vertex
+                if isinstance(v, LastTimeStepVertex):
+                    m = masks.get(v.mask_input) if v.mask_input else in_mask
+                    acts[name] = v.forward(in_acts, mask=m)
+                    masks[name] = None
+                elif isinstance(v, DuplicateToTimeSeriesVertex):
+                    ref = acts.get(v.reference_input)
+                    t = ref.shape[1] if (ref is not None and ref.ndim == 3) else None
+                    acts[name] = v.forward(in_acts, length=t)
+                    masks[name] = masks.get(v.reference_input)
+                else:
+                    acts[name] = v.forward(in_acts)
+                    masks[name] = in_mask if acts[name].ndim == 3 else None
+        return acts, new_state
+
+    def _loss_pure(self, params, lstate, inputs, labels, fmasks, lmasks, rng,
+                   train: bool = True):
+        conf = self.conf
+        acts, new_state = self._forward_pure(params, lstate, inputs,
+                                             train=train, rng=rng, fmasks=fmasks)
+        total = 0.0
+        for oi, oname in enumerate(conf.network_outputs):
+            node = conf.nodes[oname]
+            if not (node.is_layer and hasattr(node.layer, "loss_score")):
+                raise ValueError(f"output vertex {oname!r} is not a loss-bearing "
+                                 "output layer")
+            # recompute the output head's loss from its INPUT activation so
+            # the softmax+CE fuses stably (acts[oname] is post-activation)
+            x = acts[node.inputs[0]]
+            if node.preprocessor is not None:
+                x = node.preprocessor.preprocess(x)
+            li = conf.topological_order.index(oname)
+            lrng = None if rng is None else jax.random.fold_in(rng, li)
+            lmask = lmasks[oi] if lmasks is not None else None
+            total = total + node.layer.loss_score(params[oname], x, labels[oi],
+                                                  train=train, rng=lrng,
+                                                  mask=lmask)
+        total = total + self._reg_score(params)
+        return total, new_state
+
+    def _reg_score(self, params: Params):
+        from deeplearning4j_tpu.nn.updater import regularization_score
+
+        return regularization_score(
+            (node.layer, params[name]) for name, node in self.conf.nodes.items()
+            if node.is_layer)
+
+    # ---------------------------------------------------------- train step
+    def train_step_fn(self):
+        """Pure train step (same shape as MultiLayerNetwork.train_step_fn so
+        ParallelWrapper-style sharded jits can reuse it)."""
+
+        def step(params, upd, lstate, iteration, inputs, labels, fmasks, lmasks, rng):
+            (loss, new_lstate), grads = jax.value_and_grad(
+                self._loss_pure, has_aux=True)(params, lstate, inputs, labels,
+                                               fmasks, lmasks, rng, True)
+            new_params = dict(params)
+            new_upd = dict(upd)
+            for name, node in self.conf.nodes.items():
+                if not node.is_layer:
+                    continue
+                new_params[name], new_upd[name] = apply_layer_update(
+                    node.layer, upd[name], params[name], grads[name], iteration)
+            return new_params, new_upd, new_lstate, loss
+
+        return step
+
+    # ----------------------------------------------------------------- fit
+    def _to_mds(self, ds: Union[DataSet, MultiDataSet]) -> MultiDataSet:
+        if isinstance(ds, MultiDataSet):
+            return ds
+        return MultiDataSet(
+            features=[ds.features], labels=[ds.labels],
+            features_masks=[ds.features_mask] if ds.features_mask is not None else None,
+            labels_masks=[ds.labels_mask] if ds.labels_mask is not None else None)
+
+    def fit(self, data, epochs: int = 1) -> None:
+        """Train (reference `ComputationGraph.fit:670`)."""
+        self._ensure_init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            iterator = ListDataSetIterator([data])
+        else:
+            iterator = data
+        if isinstance(iterator, DataSetIterator) and iterator.async_supported \
+                and not isinstance(iterator, AsyncDataSetIterator):
+            iterator = AsyncDataSetIterator(iterator)
+        if self._jit_train is None:
+            self._jit_train = jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            n_batches = 0
+            for ds in iterator:
+                n_batches += 1
+                self._fit_batch(self._to_mds(ds))
+            if n_batches == 0:
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "fit(): iterator produced no batches this epoch — if it "
+                    "wraps a generator, it may already be exhausted")
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+
+    def _fit_batch(self, mds: MultiDataSet):
+        inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self._params, self._upd_state, self._layer_state, loss = self._jit_train(
+            self._params, self._upd_state, self._layer_state, it,
+            inputs, labels, fmasks, lmasks, rng)
+        self.score_value = float(loss)
+        self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "record_batch"):
+                listener.record_batch(int(mds.features[0].shape[0]))
+            listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------ inference
+    def output(self, *inputs: np.ndarray, train: bool = False) -> List[np.ndarray]:
+        """Forward returning the network outputs (reference
+        `ComputationGraph.output`)."""
+        self._ensure_init()
+        xs = tuple(jnp.asarray(x, self.dtype) for x in inputs)
+        if self._jit_output is None:
+            def fwd(p, s, xs, rng, train):
+                acts, _ = self._forward_pure(p, s, xs, train=train, rng=rng)
+                return tuple(acts[o] for o in self.conf.network_outputs)
+
+            self._jit_output = jax.jit(fwd, static_argnames=("train",))
+        rng = (jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+               if train else None)
+        outs = self._jit_output(self._params, self._layer_state, xs, rng, train)
+        return [np.asarray(o) for o in outs]
+
+    def _mds_arrays(self, mds: MultiDataSet):
+        inputs = tuple(jnp.asarray(f, self.dtype) for f in mds.features)
+        labels = tuple(jnp.asarray(l, self.dtype) for l in mds.labels)
+        fmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
+                        for m in mds.features_masks)
+                  if mds.features_masks is not None else None)
+        lmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
+                        for m in mds.labels_masks)
+                  if mds.labels_masks is not None else None)
+        return inputs, labels, fmasks, lmasks
+
+    def _batch_arrays(self, ds):
+        """(inputs, labels, fmasks, lmasks) tuples — same positional contract
+        as MultiLayerNetwork._batch_arrays so ParallelWrapper can drive either
+        network's train step."""
+        return self._mds_arrays(self._to_mds(ds))
+
+    def _validate_labels(self, ds) -> None:
+        mds = self._to_mds(ds)
+        if len(mds.labels) != len(self.conf.network_outputs):
+            raise ValueError(
+                f"got {len(mds.labels)} label arrays but graph has "
+                f"{len(self.conf.network_outputs)} outputs "
+                f"({self.conf.network_outputs})")
+
+    def score(self, ds: Union[DataSet, MultiDataSet], train: bool = False) -> float:
+        self._ensure_init()
+        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+        loss, _ = self._loss_pure(self._params, self._layer_state, inputs,
+                                  labels, fmasks, lmasks, None, train)
+        return float(loss)
+
+    def evaluate(self, iterator) -> "Evaluation":
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(iterator, (DataSet, MultiDataSet)):
+            iterator = ListDataSetIterator([iterator])
+        for ds in iterator:
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            ev.eval(mds.labels[0], out[0])
+        return ev
+
+    # ---------------------------------------------------- params / checks
+    def params(self) -> np.ndarray:
+        self._ensure_init()
+        flat, _ = ravel_pytree(self._params)
+        return np.asarray(flat)
+
+    def set_params(self, flat: np.ndarray) -> None:
+        self._ensure_init()
+        self._params = self._unravel(jnp.asarray(flat, self.dtype))
+
+    def num_params(self) -> int:
+        return int(self.params().shape[0])
+
+    def compute_gradient_and_score(self, ds) -> Tuple[np.ndarray, float]:
+        """For GradientCheckUtil parity (reference `GradientCheckUtil:194`
+        ComputationGraph variant)."""
+        self._ensure_init()
+        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+
+        def lf(p):
+            loss, _ = self._loss_pure(p, self._layer_state, inputs, labels,
+                                      fmasks, lmasks, None, True)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(self._params)
+        flat, _ = ravel_pytree(grads)
+        return np.asarray(flat), float(loss)
+
+    def score_function(self, ds):
+        """Jitted flat-params → loss closure for the gradient-check harness
+        (same contract as MultiLayerNetwork.score_function). Masks included
+        so numeric and analytic losses agree."""
+        self._ensure_init()
+        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+        _, unravel = ravel_pytree(self._params)
+
+        @jax.jit
+        def score_at(flat):
+            loss, _ = self._loss_pure(unravel(flat), self._layer_state,
+                                      inputs, labels, fmasks, lmasks, None, True)
+            return loss
+
+        return score_at
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)

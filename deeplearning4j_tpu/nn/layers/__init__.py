@@ -1,0 +1,8 @@
+"""Functional layer implementations (forward math).
+
+TPU-equivalent of reference `deeplearning4j-nn/.../nn/layers/` — but where
+the reference implements per-layer `activate`/`backpropGradient` pairs in
+Java calling ND4J ops one JNI dispatch at a time (`BaseLayer.java:144,354`),
+these are pure functions composed into one jitted fwd+bwd XLA computation;
+backprop comes from `jax.grad`, not hand-written adjoints.
+"""

@@ -1,0 +1,513 @@
+"""MultiLayerNetwork: sequential network container + training loop.
+
+Reference: `deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:80` —
+`init():386`, `fit(DataSetIterator):978`, `backprop():1049`,
+`doTruncatedBPTT:1140`, `output:1540`, `rnnTimeStep:2196`, `evaluate:2365` —
+plus the Solver/StochasticGradientDescent loop it drives
+(`optimize/solvers/StochasticGradientDescent.java:51-72`).
+
+TPU-first design decision (SURVEY §7.3): where the reference runs a Java
+training loop issuing one JNI op per ND4J call (per-layer activate →
+per-layer backpropGradient → updater → step), here the ENTIRE
+fwd+bwd+updater+apply iteration is traced once into a single XLA computation
+with donated parameter/optimizer buffers, so params update in-place in TPU
+HBM and the host loop only feeds batches and reads back the scalar score.
+
+Parameter view semantics: the reference exposes a flat parameter vector with
+per-layer views (`init():386`, `initGradientsView():475`) that optimizers and
+averaging mutate in place. The TPU equivalent keeps params as a pytree (the
+sharding/collective-friendly representation) and provides
+`params()`/`set_params()` flat-vector conversion via `ravel_pytree` for the
+serialization/averaging/gradient-check surfaces that need the flat view.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutionalFlat,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    AutoEncoder,
+    GravesLSTM,
+    Layer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.updater import (
+    apply_layer_update,
+    init_updater_state,
+)
+
+Params = List[Dict[str, jnp.ndarray]]
+LState = List[Dict[str, jnp.ndarray]]
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration, dtype=jnp.float32):
+        self.conf = conf
+        self.dtype = dtype
+        self.layers: List[Layer] = conf.layers
+        self._params: Optional[Params] = None
+        self._upd_state = None
+        self._layer_state: Optional[LState] = None
+        self._unravel: Optional[Callable] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self.score_value: Optional[float] = None
+        self._rnn_state: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        self._jit_train = None
+        self._jit_output = None
+        self._input_types = self._resolve_input_types()
+
+    # ------------------------------------------------------------------ init
+    def _resolve_input_types(self) -> List[InputType]:
+        """Per-layer input InputType (post-preprocessor), mirroring the
+        inference done at config build time."""
+        it = self.conf.input_type
+        if it is None:
+            l0 = self.layers[0]
+            n_in = getattr(l0, "n_in", 0)
+            if l0.input_kind == "rnn":
+                it = InputType.recurrent(n_in)
+            else:
+                it = InputType.feed_forward(n_in)
+        out = []
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                it = self.conf.preprocessors[i].output_type(it)
+            out.append(it)
+            it = layer.output_type(it)
+        return out
+
+    def init(self) -> None:
+        """Build parameter/updater/layer-state pytrees (reference
+        `MultiLayerNetwork.init():386`)."""
+        key = jax.random.PRNGKey(self.conf.seed)
+        params: Params = []
+        upd = []
+        lstate: LState = []
+        for i, layer in enumerate(self.layers):
+            key, sub = jax.random.split(key)
+            p = layer.init_params(sub, self._input_types[i], self.dtype) if layer.has_params else {}
+            params.append(p)
+            cfg = layer.updater_cfg
+            upd.append({name: init_updater_state(cfg, v) for name, v in p.items()}
+                       if cfg is not None else {})
+            lstate.append(layer.init_state(self._input_types[i]))
+        self._params = params
+        self._upd_state = upd
+        self._layer_state = lstate
+        flat, unravel = ravel_pytree(params)
+        self._unravel = unravel
+
+    def _ensure_init(self):
+        if self._params is None:
+            self.init()
+
+    # ------------------------------------------------------------- forward
+    def _forward_pure(self, params: Params, lstate: LState, x: jnp.ndarray, *,
+                      train: bool, rng: Optional[jax.Array],
+                      fmask: Optional[jnp.ndarray],
+                      upto: Optional[int] = None) -> Tuple[jnp.ndarray, LState]:
+        """Compose all layer forwards (reference `feedForwardToLayer`,
+        `MultiLayerNetwork.java:694`). Pure: jit-safe."""
+        n = len(self.layers) if upto is None else upto
+        new_state = list(lstate)
+        for i in range(n):
+            layer = self.layers[i]
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].preprocess(x)
+            lrng = None if rng is None else jax.random.fold_in(rng, i)
+            mask = fmask if x.ndim == 3 else None
+            x, new_state[i] = layer.forward(params[i], lstate[i], x,
+                                            train=train, rng=lrng, mask=mask)
+        return x, new_state
+
+    def _loss_pure(self, params: Params, lstate: LState, features, labels,
+                   fmask, lmask, rng, train: bool = True):
+        """Loss = output-layer score + L1/L2 penalties (reference
+        `computeGradientAndScore` + `calcL1/calcL2` in BaseLayer)."""
+        x, new_state = self._forward_pure(params, lstate, features, train=train,
+                                          rng=rng, fmask=fmask,
+                                          upto=len(self.layers) - 1)
+        out_layer = self.layers[-1]
+        if len(self.layers) - 1 in self.conf.preprocessors:
+            x = self.conf.preprocessors[len(self.layers) - 1].preprocess(x)
+        out_rng = None if rng is None else jax.random.fold_in(rng, len(self.layers) - 1)
+        mask = lmask if lmask is not None else (fmask if x.ndim == 3 else None)
+        loss = out_layer.loss_score(params[-1], x, labels, train=train,
+                                    rng=out_rng, mask=mask)
+        loss = loss + self._reg_score(params)
+        return loss, new_state
+
+    def _reg_score(self, params: Params):
+        from deeplearning4j_tpu.nn.updater import regularization_score
+
+        return regularization_score(zip(self.layers, params))
+
+    # ---------------------------------------------------------- train step
+    def train_step_fn(self):
+        """The pure (un-jitted) train-step function: one fwd+bwd+update.
+        Exposed so distributed wrappers can re-jit it with shardings over a
+        device mesh (parallel/ParallelWrapper — the reference's
+        `ParallelWrapper.java` seam, with ICI all-reduce instead of
+        `Nd4j.averageAndPropagate`)."""
+
+        def step(params, upd, lstate, iteration, features, labels, fmask, lmask, rng):
+            (loss, new_lstate), grads = jax.value_and_grad(
+                self._loss_pure, has_aux=True)(params, lstate, features, labels,
+                                               fmask, lmask, rng, True)
+            new_params = []
+            new_upd = []
+            for i, layer in enumerate(self.layers):
+                p_new, u_new = apply_layer_update(layer, upd[i], params[i],
+                                                  grads[i], iteration)
+                new_params.append(p_new)
+                new_upd.append(u_new)
+            return new_params, new_upd, new_lstate, loss
+
+        return step
+
+    def _make_train_step(self):
+        """Jit the train step with donated param/opt/state buffers — the ONE
+        compiled XLA computation per step (in-place update in HBM)."""
+        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _batch_arrays(self, ds: DataSet):
+        f = jnp.asarray(ds.features, self.dtype)
+        l = jnp.asarray(ds.labels, self.dtype) if ds.labels is not None else None
+        fm = jnp.asarray(ds.features_mask, self.dtype) if ds.features_mask is not None else None
+        lm = jnp.asarray(ds.labels_mask, self.dtype) if ds.labels_mask is not None else None
+        return f, l, fm, lm
+
+    def fit(self, data: Union[DataSet, DataSetIterator, np.ndarray],
+            labels: Optional[np.ndarray] = None, epochs: int = 1) -> None:
+        """Train (reference `fit(DataSetIterator)`,
+        `MultiLayerNetwork.java:978`; iterator wrapped in async prefetch at
+        `:982`)."""
+        self._ensure_init()
+        if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            iterator: DataSetIterator = ListDataSetIterator([data])
+        else:
+            iterator = data
+        if iterator.async_supported and not isinstance(iterator, AsyncDataSetIterator):
+            iterator = AsyncDataSetIterator(iterator)
+
+        if self._jit_train is None:
+            self._jit_train = self._make_train_step()
+
+        tbptt = (self.conf.tbptt_fwd_length > 0)
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            n_batches = 0
+            for ds in iterator:
+                n_batches += 1
+                if tbptt and ds.features.ndim == 3:
+                    self._fit_tbptt(ds)
+                else:
+                    self._fit_batch(ds)
+            if n_batches == 0:
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "fit(): iterator produced no batches this epoch — if it "
+                    "wraps a generator, it may already be exhausted")
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+
+    def _fit_batch(self, ds: DataSet):
+        self._validate_labels(ds)
+        f, l, fm, lm = self._batch_arrays(ds)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self._params, self._upd_state, self._layer_state, loss = self._jit_train(
+            self._params, self._upd_state, self._layer_state, it, f, l, fm, lm, rng)
+        self.score_value = float(loss)
+        self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "record_batch"):
+                listener.record_batch(ds.num_examples())
+            listener.iteration_done(self, self.iteration)
+
+    def _validate_labels(self, ds: DataSet) -> None:
+        """Informative input validation (reference analogue:
+        `exceptions/TestInvalidInput` error paths)."""
+        out_layer = self.layers[-1]
+        n_out = getattr(out_layer, "n_out", None)
+        if ds.labels is None:
+            raise ValueError("fit() requires labels; got DataSet with labels=None "
+                             "(use pretrain() for unsupervised training)")
+        if n_out and ds.labels.shape[-1] != n_out:
+            raise ValueError(
+                f"labels have width {ds.labels.shape[-1]} but output layer "
+                f"has n_out={n_out} (features shape {ds.features.shape}, "
+                f"labels shape {ds.labels.shape})")
+
+    def _fit_tbptt(self, ds: DataSet):
+        """Truncated BPTT (reference `doTruncatedBPTT`,
+        `MultiLayerNetwork.java:1140-1194`): slice the time axis into
+        tbptt_fwd_length windows, carrying LSTM (h, c) across windows; each
+        window is one jitted step (fixed window shape ⇒ one compilation)."""
+        fwd_len = self.conf.tbptt_fwd_length
+        if ds.labels is None or ds.labels.ndim != 3:
+            raise ValueError(
+                "truncated BPTT requires per-timestep labels of shape "
+                f"(batch, time, nOut); got labels shape "
+                f"{None if ds.labels is None else ds.labels.shape}. For "
+                "sequence-to-one models, train without tBPTT "
+                "(t_bptt_forward_length unset)")
+        T = ds.features.shape[1]
+        B = ds.features.shape[0]
+        # seed transient carries into the rnn layers' state slots
+        saved = list(self._layer_state)
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
+                n = layer.n_out
+                self._layer_state[i] = {"h": jnp.zeros((B, n), self.dtype),
+                                        "c": jnp.zeros((B, n), self.dtype)}
+        n_windows = (T + fwd_len - 1) // fwd_len
+        losses = []
+        for w in range(n_windows):
+            lo, hi = w * fwd_len, min((w + 1) * fwd_len, T)
+            if hi - lo < fwd_len and n_windows > 1:
+                # pad the tail window to fwd_len to avoid a recompilation;
+                # padded steps are masked out
+                pad = fwd_len - (hi - lo)
+                feats = np.concatenate(
+                    [ds.features[:, lo:hi], np.zeros_like(ds.features[:, :pad])], axis=1)
+                labs = np.concatenate(
+                    [ds.labels[:, lo:hi], np.zeros_like(ds.labels[:, :pad])], axis=1)
+                m = np.concatenate(
+                    [np.ones((B, hi - lo), np.float32), np.zeros((B, pad), np.float32)], axis=1)
+                fmask = m if ds.features_mask is None else np.concatenate(
+                    [ds.features_mask[:, lo:hi], np.zeros((B, pad), np.float32)], axis=1)
+                window = DataSet(feats, labs, fmask, m)
+            else:
+                window = DataSet(
+                    ds.features[:, lo:hi], ds.labels[:, lo:hi],
+                    None if ds.features_mask is None else ds.features_mask[:, lo:hi],
+                    None if ds.labels_mask is None else ds.labels_mask[:, lo:hi])
+            self._fit_batch(window)
+            losses.append(self.score_value)
+        self.score_value = float(np.mean(losses))
+        # rnn carries are per-batch transients; restore persistent state slots
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
+                self._layer_state[i] = saved[i]
+
+    # ------------------------------------------------------------ inference
+    def output(self, x: np.ndarray, train: bool = False) -> np.ndarray:
+        """Forward pass returning output activations (reference
+        `output:1540`). `train=True` uses batch statistics / dropout like the
+        reference's train-mode activations (dropout rng derives from the
+        current iteration)."""
+        self._ensure_init()
+        x = jnp.asarray(x, self.dtype)
+        if self._jit_output is None:
+            def fwd(p, s, xx, rng, train):
+                return self._forward_pure(p, s, xx, train=train, rng=rng,
+                                          fmask=None)[0]
+
+            self._jit_output = jax.jit(fwd, static_argnames=("train",))
+        rng = (jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+               if train else None)
+        return np.asarray(self._jit_output(self._params, self._layer_state, x,
+                                           rng, train))
+
+    def feed_forward(self, x: np.ndarray) -> List[np.ndarray]:
+        """All layer activations (reference `feedForward`)."""
+        self._ensure_init()
+        acts = []
+        xx = jnp.asarray(x, self.dtype)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                xx = self.conf.preprocessors[i].preprocess(xx)
+            xx, _ = layer.forward(self._params[i], self._layer_state[i], xx,
+                                  train=False, rng=None)
+            acts.append(np.asarray(xx))
+        return acts
+
+    def score(self, ds: DataSet, train: bool = False) -> float:
+        """Loss on a dataset without updating (reference `score(DataSet)`)."""
+        self._ensure_init()
+        f, l, fm, lm = self._batch_arrays(ds)
+        loss, _ = self._loss_pure(self._params, self._layer_state, f, l, fm, lm,
+                                  None, train)
+        return float(loss)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.argmax(self.output(x), axis=-1)
+
+    def evaluate(self, iterator: Union[DataSetIterator, DataSet]):
+        """Classification evaluation (reference `evaluate:2365`)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(iterator, DataSet):
+            iterator = ListDataSetIterator([iterator])
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # --------------------------------------------------------- rnn support
+    def rnn_time_step(self, x: np.ndarray) -> np.ndarray:
+        """Stateful single/multi-step inference (reference
+        `rnnTimeStep:2196`): carries (h, c) between calls for streaming
+        generation."""
+        self._ensure_init()
+        xx = jnp.asarray(x, self.dtype)
+        squeeze = False
+        if xx.ndim == 2:  # (B, F) -> single timestep
+            xx = xx[:, None, :]
+            squeeze = True
+        B, T, _ = xx.shape
+        outs = []
+        for t in range(T):
+            h = xx[:, t]
+            for i, layer in enumerate(self.layers):
+                if i in self.conf.preprocessors:
+                    h = self.conf.preprocessors[i].preprocess(h)
+                if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
+                    if i not in self._rnn_state:
+                        n = layer.n_out
+                        self._rnn_state[i] = (jnp.zeros((B, n), self.dtype),
+                                              jnp.zeros((B, n), self.dtype))
+                    hp, cp = self._rnn_state[i]
+                    h, (hn, cn) = layer.step(self._params[i], h, hp, cp)
+                    self._rnn_state[i] = (hn, cn)
+                else:
+                    if h.ndim == 2 and layer.input_kind == "rnn":
+                        h = h[:, None, :]
+                    h, _ = layer.forward(self._params[i], self._layer_state[i], h,
+                                         train=False, rng=None)
+                    if h.ndim == 3 and h.shape[1] == 1:
+                        h = h[:, 0]
+            outs.append(h)
+        out = jnp.stack(outs, axis=1)
+        if squeeze:
+            out = out[:, 0]
+        return np.asarray(out)
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    # ---------------------------------------------------- params / serde
+    def params(self) -> np.ndarray:
+        """Flat parameter vector (reference `Model.params()` — the flat view
+        from `init():386`)."""
+        self._ensure_init()
+        flat, _ = ravel_pytree(self._params)
+        return np.asarray(flat)
+
+    def set_params(self, flat: np.ndarray) -> None:
+        self._ensure_init()
+        self._params = self._unravel(jnp.asarray(flat, self.dtype))
+
+    def num_params(self) -> int:
+        return int(self.params().shape[0])
+
+    def compute_gradient_and_score(self, ds: DataSet) -> Tuple[np.ndarray, float]:
+        """Analytic flat gradient + score at current params (reference
+        `Model.computeGradientAndScore` / `gradient()` used by
+        `GradientCheckUtil.java:62`). Deterministic: no dropout rng."""
+        self._ensure_init()
+        f, l, fm, lm = self._batch_arrays(ds)
+
+        def lf(p):
+            loss, _ = self._loss_pure(p, self._layer_state, f, l, fm, lm, None, True)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(self._params)
+        flat, _ = ravel_pytree(grads)
+        return np.asarray(flat), float(loss)
+
+    def score_function(self, ds: DataSet):
+        """Jitted flat-params → loss closure over a fixed batch, for the
+        gradient-check harness (numeric central differences)."""
+        self._ensure_init()
+        f, l, fm, lm = self._batch_arrays(ds)
+        _, unravel = ravel_pytree(self._params)
+
+        @jax.jit
+        def score_at(flat):
+            loss, _ = self._loss_pure(unravel(flat), self._layer_state, f, l,
+                                      fm, lm, None, True)
+            return loss
+
+        return score_at
+
+    # ------------------------------------------------------------ pretrain
+    def pretrain(self, iterator: DataSetIterator, epochs: int = 1) -> None:
+        """Greedy layerwise unsupervised pretraining for AutoEncoder layers
+        (reference `MultiLayerNetwork.pretrain`, `:993`)."""
+        self._ensure_init()
+        for i, layer in enumerate(self.layers):
+            if not isinstance(layer, AutoEncoder):
+                continue
+            cfg = layer.updater_cfg
+
+            def step(p_i, u_i, feats, rng, iteration):
+                def lf(p):
+                    # encode input through the preceding (frozen) layers
+                    x, _ = self._forward_pure(self._params, self._layer_state,
+                                              feats, train=False, rng=None,
+                                              fmask=None, upto=i)
+                    return layer.pretrain_loss(p, x, rng)
+
+                loss, g = jax.value_and_grad(lf)(p_i)
+                p_new, u_new = apply_layer_update(layer, u_i, p_i, g, iteration)
+                return p_new, u_new, loss
+
+            jstep = jax.jit(step)
+            it_count = 0
+            for _ in range(epochs):
+                for ds in iterator:
+                    f = jnp.asarray(ds.features, self.dtype)
+                    rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed + i), it_count)
+                    p_new, u_new, loss = jstep(self._params[i], self._upd_state[i],
+                                               f, rng, jnp.asarray(it_count, jnp.int32))
+                    self._params[i] = p_new
+                    self._upd_state[i] = u_new
+                    self.score_value = float(loss)
+                    it_count += 1
+
+    # ------------------------------------------------------------- helpers
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def get_updater_state(self):
+        return self._upd_state
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf, self.dtype)
+        if self._params is not None:
+            net.init()
+            net.set_params(self.params())
+            # deep-copy: the jitted train step DONATES these buffers, so
+            # aliasing them between clones would let either net's step delete
+            # the other's arrays
+            net._upd_state = jax.tree.map(jnp.copy, self._upd_state)
+            net._layer_state = jax.tree.map(jnp.copy, self._layer_state)
+        return net

@@ -1,0 +1,111 @@
+"""Weight initialization.
+
+Reference: `deeplearning4j-nn/.../nn/weights/WeightInit.java` (enum: DISTRIBUTION,
+ZERO, SIGMOID_UNIFORM, UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN,
+XAVIER_LEGACY, RELU, RELU_UNIFORM …) + `WeightInitUtil.java` (fanIn/fanOut
+computation). Implemented on top of jax.random so initialization happens
+on-device and is reproducible from a single seed.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(str, enum.Enum):
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+    DISTRIBUTION = "distribution"
+
+
+@dataclass
+class Distribution:
+    """Serializable distribution for WeightInit.DISTRIBUTION
+    (reference `nn/conf/distribution/`: NormalDistribution,
+    UniformDistribution, GaussianDistribution, BinomialDistribution)."""
+
+    kind: str = "normal"  # normal | uniform | binomial
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n_trials: int = 1
+    prob: float = 0.5
+
+    def sample(self, key: jax.Array, shape: Sequence[int], dtype=jnp.float32) -> jnp.ndarray:
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, minval=self.lower, maxval=self.upper)
+        if self.kind == "binomial":
+            return jax.random.binomial(key, self.n_trials, self.prob, shape).astype(dtype)
+        raise ValueError(f"unknown distribution {self.kind}")
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "mean": self.mean, "std": self.std,
+                "lower": self.lower, "upper": self.upper,
+                "n_trials": self.n_trials, "prob": self.prob}
+
+    @staticmethod
+    def from_json(d: dict) -> "Distribution":
+        return Distribution(**d)
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    weight_init: WeightInit | str,
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Initialize a weight tensor (reference `WeightInitUtil.initWeights`)."""
+    wi = WeightInit(weight_init) if not isinstance(weight_init, WeightInit) else weight_init
+    if wi == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if wi == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if wi == WeightInit.UNIFORM:
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if wi == WeightInit.SIGMOID_UNIFORM:
+        r = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if wi == WeightInit.XAVIER:
+        return jnp.sqrt(2.0 / (fan_in + fan_out)) * jax.random.normal(key, shape, dtype)
+    if wi == WeightInit.XAVIER_UNIFORM:
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if wi == WeightInit.XAVIER_FAN_IN:
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if wi == WeightInit.RELU:
+        return jnp.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if wi == WeightInit.RELU_UNIFORM:
+        r = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if wi == WeightInit.LECUN_NORMAL:
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if wi == WeightInit.LECUN_UNIFORM:
+        r = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if wi == WeightInit.NORMAL:
+        return jax.random.normal(key, shape, dtype)
+    if wi == WeightInit.DISTRIBUTION:
+        dist = distribution or Distribution()
+        return dist.sample(key, shape, dtype)
+    raise ValueError(f"unknown weight init {wi}")

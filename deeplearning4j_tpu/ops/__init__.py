@@ -1,0 +1,10 @@
+"""Core math ops: activations, loss functions, learning-rate schedules.
+
+TPU-equivalent of the ND4J op surface the reference consumes
+(`org.nd4j.linalg.api.ops.*`, `Transforms`, `LossFunctions`, `IActivation`) —
+implemented as pure jax.numpy functions so XLA fuses them into the
+surrounding matmul/conv HLO instead of dispatching one JNI op at a time.
+"""
+
+from deeplearning4j_tpu.ops.activations import Activation, activation_fn  # noqa: F401
+from deeplearning4j_tpu.ops.losses import LossFunction, loss_fn  # noqa: F401

@@ -1,0 +1,89 @@
+"""Activation functions.
+
+Reference surface: DL4J's `Activation` enum / `IActivation` implementations
+(consumed via ND4J, e.g. `nn/conf/NeuralNetConfiguration.java:478` `activation`
+builder field). Here each activation is a pure jnp function; under `jax.jit`
+XLA fuses it into the producing GEMM/conv, which is the TPU analogue of the
+reference's fused cuDNN activation path
+(`CudnnConvolutionHelper.java` forward+activation fusion).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation(str, enum.Enum):
+    """Mirrors the reference's Activation enum values."""
+
+    IDENTITY = "identity"
+    RELU = "relu"
+    LEAKYRELU = "leakyrelu"
+    RELU6 = "relu6"
+    ELU = "elu"
+    SELU = "selu"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    HARDTANH = "hardtanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    SOFTMAX = "softmax"
+    LOGSOFTMAX = "logsoftmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    CUBE = "cube"
+    SWISH = "swish"
+    GELU = "gelu"
+    MISH = "mish"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return activation_fn(self)(x)
+
+
+def _rational_tanh(x):
+    # Padé-style tanh approximation used by the reference's RationalTanh
+    # (ND4J ActivationRationalTanh): 1.7159 * tanh_approx(2x/3).
+    a = 2.0 * x / 3.0
+    clamped = jnp.clip(a, -22.0, 22.0)
+    approx = jnp.sign(clamped) * (
+        1.0 - 1.0 / (1.0 + jnp.abs(clamped) + clamped**2 + 1.41645 * clamped**4)
+    )
+    return 1.7159 * approx
+
+
+_ACTIVATIONS: dict[Activation, Callable[[jnp.ndarray], jnp.ndarray]] = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.RELU: jax.nn.relu,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    Activation.RELU6: jax.nn.relu6,
+    Activation.ELU: jax.nn.elu,
+    Activation.SELU: jax.nn.selu,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    # reference ActivationHardSigmoid: clip(0.2x + 0.5, 0, 1) — NOT jax's
+    # relu6(x+3)/6 variant
+    Activation.HARDSIGMOID: lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    Activation.TANH: jnp.tanh,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.RATIONALTANH: _rational_tanh,
+    Activation.RECTIFIEDTANH: lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.LOGSOFTMAX: lambda x: jax.nn.log_softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.CUBE: lambda x: x**3,
+    Activation.SWISH: jax.nn.swish,
+    Activation.GELU: jax.nn.gelu,
+    Activation.MISH: jax.nn.mish,
+    Activation.THRESHOLDEDRELU: lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def activation_fn(act: Activation | str) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Resolve an activation enum/string to its jnp implementation."""
+    act = Activation(act.lower()) if isinstance(act, str) else act
+    return _ACTIVATIONS[act]

@@ -1,0 +1,146 @@
+"""Loss functions.
+
+Reference surface: ND4J `LossFunctions.LossFunction` enum + ILossFunction
+impls, consumed by DL4J output layers (`nn/conf/layers/OutputLayer` via
+`LossFunction` builder arg). Implemented as pure functions of
+(labels, pre-activation output) so the softmax+cross-entropy pair fuses into
+the numerically-stable log-softmax form under XLA — the reference gets the
+same stability via ILossFunction#computeGradient special-casing.
+
+Conventions (match the reference):
+- per-example score = sum over output dims of elementwise loss;
+- network score     = mean over (unmasked) examples;
+- masks broadcast over the feature dim (per-timestep masking for RNNs).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.activations import Activation, activation_fn
+
+_EPS = 1e-8
+
+
+class LossFunction(str, enum.Enum):
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    XENT = "xent"  # binary cross-entropy
+    MCXENT = "mcxent"  # multi-class cross-entropy
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    COSINE_PROXIMITY = "cosine_proximity"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mean_absolute_percentage_error"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "mean_squared_logarithmic_error"
+    POISSON = "poisson"
+
+
+def _elementwise_loss(loss: LossFunction, labels: jnp.ndarray, out: jnp.ndarray) -> jnp.ndarray:
+    """Per-element loss on post-activation outputs (non-fused generic path)."""
+    if loss in (LossFunction.MSE, LossFunction.L2):
+        # DL4J: L2 = sum squared error; MSE = L2 / nOut. Score-level scaling
+        # is applied in loss_score below.
+        return (out - labels) ** 2
+    if loss in (LossFunction.L1, LossFunction.MEAN_ABSOLUTE_ERROR):
+        return jnp.abs(out - labels)
+    if loss == LossFunction.XENT:
+        o = jnp.clip(out, _EPS, 1.0 - _EPS)
+        return -(labels * jnp.log(o) + (1.0 - labels) * jnp.log(1.0 - o))
+    if loss in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        return -labels * jnp.log(jnp.clip(out, _EPS, None))
+    if loss == LossFunction.COSINE_PROXIMITY:
+        # handled at the row level in loss_score
+        raise ValueError("cosine proximity is row-level")
+    if loss == LossFunction.HINGE:
+        return jnp.maximum(0.0, 1.0 - labels * out)
+    if loss == LossFunction.SQUARED_HINGE:
+        return jnp.maximum(0.0, 1.0 - labels * out) ** 2
+    if loss == LossFunction.KL_DIVERGENCE:
+        l = jnp.clip(labels, _EPS, None)
+        o = jnp.clip(out, _EPS, None)
+        return labels * (jnp.log(l) - jnp.log(o))
+    if loss == LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR:
+        return 100.0 * jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), _EPS, None))
+    if loss == LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR:
+        return (jnp.log1p(jnp.clip(out, -1 + _EPS, None)) - jnp.log1p(jnp.clip(labels, -1 + _EPS, None))) ** 2
+    if loss == LossFunction.POISSON:
+        return out - labels * jnp.log(jnp.clip(out, _EPS, None))
+    raise ValueError(f"unknown loss {loss}")
+
+
+def loss_score(
+    loss: LossFunction | str,
+    activation: Activation | str,
+    labels: jnp.ndarray,
+    preout: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean-per-example loss from PRE-activation outputs.
+
+    Fuses softmax+MCXENT / sigmoid+XENT into numerically-stable forms — the
+    TPU/XLA analogue of the reference's ILossFunction computeGradient
+    shortcuts for the softmax and sigmoid output-activation cases.
+    Returns a scalar: sum over output dims, mean over (unmasked) rows.
+    """
+    loss = LossFunction(loss) if not isinstance(loss, LossFunction) else loss
+    activation = Activation(activation) if not isinstance(activation, Activation) else activation
+
+    if loss in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD) and activation == Activation.SOFTMAX:
+        per_elem = -labels * jax.nn.log_softmax(preout, axis=-1)
+    elif loss == LossFunction.XENT and activation == Activation.SIGMOID:
+        # stable BCE-with-logits
+        per_elem = jnp.maximum(preout, 0.0) - preout * labels + jnp.log1p(jnp.exp(-jnp.abs(preout)))
+    elif loss == LossFunction.COSINE_PROXIMITY:
+        out = activation_fn(activation)(preout)
+        num = jnp.sum(labels * out, axis=-1)
+        den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+        per_row = -num / jnp.clip(den, _EPS, None)
+        return _masked_row_mean(per_row, mask)
+    else:
+        out = activation_fn(activation)(preout)
+        per_elem = _elementwise_loss(loss, labels, out)
+
+    if loss == LossFunction.MSE:
+        per_row = jnp.mean(per_elem, axis=-1)
+    else:
+        per_row = jnp.sum(per_elem, axis=-1)
+    return _masked_row_mean(per_row, mask)
+
+
+def _masked_row_mean(per_row: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Mean over rows; with a mask, masked rows contribute 0 and the divisor
+    is the unmasked count (reference: per-example masking in
+    `BaseOutputLayer.computeScore` / `GradientCheckTestsMasking`)."""
+    if mask is None:
+        return jnp.mean(per_row)
+    mask = jnp.reshape(mask, per_row.shape)
+    total = jnp.sum(per_row * mask)
+    count = jnp.clip(jnp.sum(mask), 1.0, None)
+    return total / count
+
+
+def loss_fn(loss: LossFunction | str):
+    """Convenience: (labels, postactivation_out, mask) -> scalar.
+
+    Generic (non-fused) path used by evaluation code; training uses
+    loss_score on pre-activations for stability.
+    """
+    loss = LossFunction(loss) if not isinstance(loss, LossFunction) else loss
+
+    def f(labels, out, mask=None):
+        if loss == LossFunction.COSINE_PROXIMITY:
+            num = jnp.sum(labels * out, axis=-1)
+            den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+            return _masked_row_mean(-num / jnp.clip(den, _EPS, None), mask)
+        per_elem = _elementwise_loss(loss, labels, out)
+        per_row = jnp.mean(per_elem, axis=-1) if loss == LossFunction.MSE else jnp.sum(per_elem, axis=-1)
+        return _masked_row_mean(per_row, mask)
+
+    return f

@@ -1,0 +1,8 @@
+"""Training loop support: listeners + gradient checking."""
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CollectScoresIterationListener,
+    IterationListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)

@@ -1,0 +1,90 @@
+"""Training listeners (telemetry hooks).
+
+Reference: `optimize/api/IterationListener.java`, `TrainingListener.java`
+(onEpochStart/onEpochEnd hooks), impls in `optimize/listeners/`:
+`ScoreIterationListener`, `PerformanceListener` (samples/sec, batches/sec),
+`CollectScoresIterationListener`.
+
+TPU note: listeners read `model.score_value` which is the host-transferred
+scalar loss; anything heavier (param histograms etc. — see ui/stats) should
+sample every N iterations to avoid forcing device→host syncs each step.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """Base hook interface (reference `IterationListener.java`)."""
+
+    def iteration_done(self, model, iteration: int) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference
+    `ScoreIterationListener.java`)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d is %s", iteration, model.score_value)
+
+
+class PerformanceListener(IterationListener):
+    """Throughput telemetry (reference `PerformanceListener.java`:
+    samples/sec and batches/sec every N iterations)."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_samples = report_samples
+        self._last_time = None
+        self._last_iter = 0
+        self._samples_since = 0
+        self.last_samples_per_sec = 0.0
+        self.last_batches_per_sec = 0.0
+
+    def record_batch(self, num_samples: int) -> None:
+        self._samples_since += num_samples
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            batches = iteration - self._last_iter
+            self.last_batches_per_sec = batches / dt
+            self.last_samples_per_sec = self._samples_since / dt if dt > 0 else 0.0
+            logger.info("iteration %d: %.1f batches/sec, %.1f samples/sec",
+                        iteration, self.last_batches_per_sec, self.last_samples_per_sec)
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples_since = 0
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs (reference
+    `CollectScoresIterationListener.java`)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(model.score_value)))

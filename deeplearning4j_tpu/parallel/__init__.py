@@ -1,0 +1,19 @@
+"""Distributed training — TPU equivalent of reference
+`deeplearning4j-scaleout/`.
+
+The reference's entire parallelism inventory is data parallelism over three
+transports (SURVEY §2.4): in-process multi-GPU averaging
+(`ParallelWrapper.java:179` `Nd4j.averageAndPropagate`), Spark parameter
+averaging (`ParameterAveragingTrainingMaster.java:75`), and an Aeron UDP
+parameter server (`ParameterServerParallelWrapper.java:39`).
+
+Here all of it maps onto ONE mechanism: `jax.sharding.Mesh` + jit with
+sharding annotations, letting XLA insert ICI collectives (psum all-reduce)
+inside the compiled step — gradient averaging costs one fused all-reduce
+instead of a host-mediated parameter ship. The same wrapper also supports
+tensor-parallel parameter shardings (beyond the reference's capabilities),
+which is the foundation the long-context/sequence-parallel modules build on.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

@@ -1,0 +1,36 @@
+"""Device-mesh construction helpers.
+
+TPU equivalent of the reference's device enumeration in `ParallelWrapper`
+(one CUDA device per worker thread). Here: an N-d logical mesh over the
+chips; shardings name mesh axes and XLA routes the collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the
+    device count; a single -1 axis absorbs the remainder (numpy reshape
+    convention)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {"data": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n_neg = sizes.count(-1)
+    if n_neg > 1:
+        raise ValueError("at most one -1 axis")
+    if n_neg == 1:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {n} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))

@@ -1,0 +1,1 @@
+"""Utilities: conv shape math, serialization, time-series helpers."""

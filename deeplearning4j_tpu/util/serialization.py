@@ -1,0 +1,97 @@
+"""Model checkpoint save/restore.
+
+Reference: `deeplearning4j-nn/.../util/ModelSerializer.java:82` — a zip
+containing `configuration.json` (:93), `coefficients.bin` (:98, flat param
+vector), `updaterState.bin` (:120-134, flat optimizer-state view),
+`normalizer.bin`. Same layout here (npy instead of Nd4j binary), plus
+`layerState.npy` for batch-norm running statistics and `meta.json`
+(iteration/epoch) so resume continues schedules and Adam moments exactly —
+the key round-trip property called out in SURVEY §5 (checkpoint/resume).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+CONFIG_JSON = "configuration.json"
+COEFFICIENTS = "coefficients.npy"
+UPDATER_STATE = "updaterState.npy"
+LAYER_STATE = "layerState.npy"
+META_JSON = "meta.json"
+
+
+def write_model(net, path: Union[str, Path], save_updater: bool = True) -> None:
+    """Save a MultiLayerNetwork (reference `ModelSerializer.writeModel`)."""
+    net._ensure_init()
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(CONFIG_JSON, net.conf.to_json())
+        z.writestr(COEFFICIENTS, _np_bytes(net.params()))
+        if save_updater and net._upd_state is not None:
+            flat, _ = ravel_pytree(net._upd_state)
+            z.writestr(UPDATER_STATE, _np_bytes(np.asarray(flat)))
+        if net._layer_state is not None:
+            flat, _ = ravel_pytree(net._layer_state)
+            z.writestr(LAYER_STATE, _np_bytes(np.asarray(flat)))
+        z.writestr(META_JSON, json.dumps({
+            "iteration": net.iteration,
+            "epoch": net.epoch,
+            "dtype": str(np.dtype(net.dtype)),
+            "format": "deeplearning4j_tpu/model/v1",
+        }))
+
+
+def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = True):
+    """Restore (reference `ModelSerializer.restoreMultiLayerNetwork`)."""
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as z:
+        conf = MultiLayerConfiguration.from_json(z.read(CONFIG_JSON).decode())
+        meta = json.loads(z.read(META_JSON).decode())
+        dtype = jnp.dtype(meta.get("dtype", "float32"))
+        net = MultiLayerNetwork(conf, dtype=dtype)
+        net.init()
+        net.set_params(_np_load(z.read(COEFFICIENTS)))
+        if load_updater and UPDATER_STATE in z.namelist():
+            flat_now, unravel = ravel_pytree(net._upd_state)
+            saved = _np_load(z.read(UPDATER_STATE))
+            if saved.shape != flat_now.shape:
+                raise ValueError(
+                    f"checkpoint updater state has {saved.shape[0]} values "
+                    f"but the rebuilt network expects {flat_now.shape[0]} — "
+                    "corrupted checkpoint or config drift (pass "
+                    "load_updater=False to restore params only)")
+            net._upd_state = unravel(jnp.asarray(saved))
+        if LAYER_STATE in z.namelist():
+            flat_now, unravel = ravel_pytree(net._layer_state)
+            saved = _np_load(z.read(LAYER_STATE))
+            if flat_now.size:
+                if saved.shape != flat_now.shape:
+                    raise ValueError(
+                        f"checkpoint layer state has {saved.shape[0]} values "
+                        f"but the rebuilt network expects {flat_now.shape[0]}")
+                net._layer_state = unravel(jnp.asarray(saved))
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def _np_bytes(a: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, a)
+    return buf.getvalue()
+
+
+def _np_load(b: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(b))

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Sharding/parallelism tests run against a virtual 8-device CPU topology
+(`xla_force_host_platform_device_count=8`) — the reference's analogous trick
+is running Spark tests with `setMaster("local[N]")` in-JVM
+(`BaseSparkTest.java:89-90`): validate the distributed path without a
+cluster. fp64 is enabled for gradient checks (reference forces DOUBLE in
+`GradientCheckTests.java:46-48`).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize registers the TPU backend at interpreter start, so
+# the env var alone is not enough — force the platform via config too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,168 @@
+"""ComputationGraph tests (reference analogues: `ComputationGraphTestRNN`,
+`TestComputationGraphNetwork`, `GradientCheckTestsComputationGraph`)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+    ComputationGraphConfiguration,
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    MergeVertex,
+    SubsetVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _blobs(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    return X, np.eye(2, dtype=np.float32)[y]
+
+
+def test_simple_graph_trains():
+    X, labels = _blobs()
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1).updater(Updater.NESTEROVS)
+            .activation(Activation.TANH)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                          activation=Activation.SOFTMAX), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    ds = DataSet(X, labels)
+    initial = g.score(ds)
+    g.fit(ListDataSetIterator([ds], batch_size=32), epochs=20)
+    assert g.score(ds) < initial * 0.5
+    ev = g.evaluate(ds)
+    assert ev.accuracy() > 0.9
+
+
+def test_residual_and_merge_vertices():
+    """Skip connection (ElementWiseVertex ADD) + MergeVertex — the ResNet
+    building blocks."""
+    X, labels = _blobs()
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(2).learning_rate(0.1).activation(Activation.RELU)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=4), "in")
+            .add_layer("d2", DenseLayer(n_out=4), "d1")
+            .add_vertex("residual", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_vertex("merged", MergeVertex(), "residual", "d1")
+            .add_layer("out", OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                          activation=Activation.SOFTMAX), "merged")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    # merge of two 4-wide inputs -> nIn 8
+    assert conf.nodes["out"].layer.n_in == 8
+    g = ComputationGraph(conf)
+    g.init()
+    ds = DataSet(X, labels)
+    g.fit(ListDataSetIterator([ds], batch_size=48), epochs=40)
+    assert g.evaluate(ds).accuracy() > 0.8
+
+
+def test_multi_input_multi_output():
+    rng = np.random.default_rng(3)
+    Xa = rng.normal(size=(64, 3)).astype(np.float32)
+    Xb = rng.normal(size=(64, 5)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[(Xa[:, 0] > 0).astype(int)]
+    y2 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(4).learning_rate(0.05).updater(Updater.ADAM)
+            .activation(Activation.TANH)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_vertex("ab", MergeVertex(), "a", "b")
+            .add_layer("h", DenseLayer(n_out=12), "ab")
+            .add_layer("out1", OutputLayer(n_out=2, activation=Activation.SOFTMAX), "h")
+            .add_layer("out2", OutputLayer(n_out=3, activation=Activation.SOFTMAX), "h")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    mds = MultiDataSet(features=[Xa, Xb], labels=[y1, y2])
+    initial = g.score(mds)
+    g.fit(mds, epochs=30)
+    assert g.score(mds) < initial
+    outs = g.output(Xa, Xb)
+    assert outs[0].shape == (64, 2) and outs[1].shape == (64, 3)
+
+
+def test_graph_json_round_trip():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=6, activation=Activation.RELU), "in")
+            .add_vertex("sub", SubsetVertex(from_idx=0, to_idx=2), "d1")
+            .add_vertex("norm", L2NormalizeVertex(), "sub")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX), "norm")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    s = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(s)
+    assert conf2.topological_order == conf.topological_order
+    assert conf2.nodes["out"].layer.n_in == 3  # subset 0..2
+    assert conf2.to_json() == s
+    # restored graph runs
+    g = ComputationGraph(conf2)
+    g.init()
+    out = g.output(np.zeros((2, 4), np.float32))
+    assert out[0].shape == (2, 2)
+
+
+def test_graph_cycle_detection():
+    b = (NeuralNetConfiguration.Builder().graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=4), "b")
+         .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+         .add_layer("out", OutputLayer(n_in=4, n_out=2), "b")
+         .set_outputs("out"))
+    with pytest.raises(ValueError, match="cycle"):
+        b.build()
+
+
+def test_graph_gradient_check():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(6, 4))
+    labels = np.eye(2)[rng.integers(0, 2, 6)]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(8).updater(Updater.NONE).activation(Activation.TANH)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=5), "in")
+            .add_layer("d2", DenseLayer(n_out=5), "d1")
+            .add_vertex("res", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                          activation=Activation.SOFTMAX), "res")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf, dtype=jnp.float64)
+    g.init()
+    assert check_gradients(g, DataSet(X, labels), print_results=True)

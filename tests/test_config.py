@@ -1,0 +1,103 @@
+"""Config DSL tests: builder merging, shape inference, preprocessor
+auto-insertion, JSON round-trip (reference analogues:
+`LayerConfigValidationTest`, `MultiLayerNeuralNetConfigurationTest`,
+JSON round-trip tests)."""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+)
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.01)
+            .updater(Updater.NESTEROVS)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+
+def test_global_defaults_merge_into_layers():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.05).updater(Updater.ADAM)
+            .activation(Activation.TANH)
+            .l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=3))
+            .layer(OutputLayer(n_in=3, n_out=2, activation=Activation.SOFTMAX))
+            .build())
+    d = conf.layers[0]
+    assert d.activation == Activation.TANH  # inherited
+    assert d.l2 == 1e-4
+    assert d.updater_cfg.updater == Updater.ADAM
+    assert d.updater_cfg.learning_rate == 0.05
+    # explicit layer override wins
+    assert conf.layers[1].activation == Activation.SOFTMAX
+
+
+def test_lenet_shape_inference_and_preprocessors():
+    conf = lenet_conf()
+    # flat input -> auto FeedForwardToCnn on layer 0
+    assert isinstance(conf.preprocessors[0], FeedForwardToCnnPreProcessor)
+    # conv stack -> dense: auto CnnToFeedForward on layer 4
+    assert isinstance(conf.preprocessors[4], CnnToFeedForwardPreProcessor)
+    # nIn inference: 28x28 -> conv5x5 -> 24x24 -> pool2 -> 12x12 -> conv5x5
+    # -> 8x8 -> pool2 -> 4x4 @ 50ch -> dense nIn = 800
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    assert conf.layers[4].n_in == 4 * 4 * 50
+    assert conf.layers[5].n_in == 500
+
+
+def test_json_round_trip():
+    conf = lenet_conf()
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert len(conf2.layers) == len(conf.layers)
+    assert conf2.seed == conf.seed
+    assert conf2.layers[0].kernel == (5, 5)
+    assert conf2.layers[0].activation == Activation.RELU
+    assert conf2.layers[5].loss == LossFunction.MCXENT
+    assert conf2.layers[4].updater_cfg.updater == Updater.NESTEROVS
+    assert isinstance(conf2.preprocessors[0], FeedForwardToCnnPreProcessor)
+    # round-trip is a fixed point
+    assert conf2.to_json() == s
+
+
+def test_strict_mode_invalid_size_raises():
+    import pytest
+
+    from deeplearning4j_tpu.util.conv_utils import ConvolutionMode
+
+    with pytest.raises(ValueError):
+        (NeuralNetConfiguration.Builder().list()
+         .layer(ConvolutionLayer(n_out=3, kernel=(2, 2), stride=(2, 2),
+                                 convolution_mode=ConvolutionMode.STRICT))
+         .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+         .set_input_type(InputType.convolutional(5, 5, 1))
+         .build())

@@ -1,0 +1,57 @@
+"""Dataset iterator tests (reference analogues: MNIST/Iris iterator tests in
+`deeplearning4j-core`, `AsyncDataSetIteratorTest`)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)
+
+
+def test_mnist_iterator_shapes():
+    it = MnistDataSetIterator(batch_size=32, num_examples=100)
+    batches = list(it)
+    assert len(batches) == 4  # 32+32+32+4
+    assert batches[0].features.shape == (32, 784)
+    assert batches[0].labels.shape == (32, 10)
+    assert batches[0].features.min() >= 0 and batches[0].features.max() <= 1
+    # deterministic
+    it2 = MnistDataSetIterator(batch_size=32, num_examples=100)
+    np.testing.assert_array_equal(batches[0].features, next(iter(it2)).features)
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch_size=150, num_examples=150)
+    ds = next(iter(it))
+    assert ds.features.shape == (150, 4)
+    assert ds.labels.shape == (150, 3)
+    assert np.allclose(ds.labels.sum(axis=1), 1.0)
+
+
+def test_cifar_iterator_nhwc():
+    it = CifarDataSetIterator(batch_size=16, num_examples=32)
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 32, 32, 3)
+
+
+def test_async_iterator_delivers_everything_in_order():
+    data = [DataSet(np.full((2, 3), i, np.float32)) for i in range(20)]
+    it = AsyncDataSetIterator(ListDataSetIterator(data), queue_size=3)
+    got = [int(ds.features[0, 0]) for ds in it]
+    assert got == list(range(20))
+    # reset works
+    got2 = [int(ds.features[0, 0]) for ds in it]
+    assert got2 == list(range(20))
+
+
+def test_multiple_epochs_iterator():
+    data = [DataSet(np.zeros((1, 1), np.float32)) for _ in range(3)]
+    it = MultipleEpochsIterator(4, ListDataSetIterator(data))
+    assert sum(1 for _ in it) == 12

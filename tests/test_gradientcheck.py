@@ -1,0 +1,144 @@
+"""Gradient checks — the core correctness strategy (reference:
+`deeplearning4j-core/src/test/.../gradientcheck/GradientCheckTests.java`:
+fp64, eps=1e-6, maxRelError=1e-3, sweeps over activation x loss x
+regularization)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def small_ds(n=8, nin=4, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nin))
+    labels = np.eye(nout)[rng.integers(0, nout, n)]
+    return DataSet(X, labels)
+
+
+@pytest.mark.parametrize("act,loss,out_act", [
+    (Activation.TANH, LossFunction.MCXENT, Activation.SOFTMAX),
+    (Activation.RELU, LossFunction.MCXENT, Activation.SOFTMAX),
+    (Activation.SIGMOID, LossFunction.MSE, Activation.IDENTITY),
+    (Activation.ELU, LossFunction.XENT, Activation.SIGMOID),
+    (Activation.SOFTPLUS, LossFunction.NEGATIVELOGLIKELIHOOD, Activation.SOFTMAX),
+])
+def test_mlp_gradients(act, loss, out_act):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE).activation(act)
+            .list()
+            .layer(DenseLayer(n_out=6))
+            .layer(OutputLayer(n_out=3, loss=loss, activation=out_act))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, small_ds(), print_results=True)
+
+
+@pytest.mark.parametrize("l1,l2", [(0.0, 0.0), (0.01, 0.0), (0.0, 0.01), (0.01, 0.02)])
+def test_mlp_gradients_regularization(l1, l2):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(42).updater(Updater.NONE).activation(Activation.TANH))
+    if l1:
+        b.l1(l1)
+    if l2:
+        b.l2(l2)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=5))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, small_ds())
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(4, 6 * 6))
+    labels = np.eye(2)[rng.integers(0, 2, 4)]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE)
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel=(3, 3), stride=(1, 1),
+                                    activation=Activation.TANH))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional_flat(6, 6, 1))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, DataSet(X, labels), print_results=True)
+
+
+def test_batchnorm_gradients():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE).activation(Activation.TANH)
+            .list()
+            .layer(DenseLayer(n_out=5))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, small_ds(), print_results=True)
+
+
+def test_lstm_gradients():
+    rng = np.random.default_rng(5)
+    B, T, nin, nout = 3, 4, 3, 2
+    X = rng.normal(size=(B, T, nin))
+    labels = np.eye(nout)[rng.integers(0, nout, (B, T))]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE)
+            .list()
+            .layer(GravesLSTM(n_out=4, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=nout, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(nin))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, DataSet(X, labels), print_results=True)
+
+
+def test_lstm_gradients_masked():
+    rng = np.random.default_rng(6)
+    B, T, nin, nout = 3, 5, 3, 2
+    X = rng.normal(size=(B, T, nin))
+    labels = np.eye(nout)[rng.integers(0, nout, (B, T))]
+    mask = np.ones((B, T), np.float64)
+    mask[0, 3:] = 0  # variable-length series (reference GradientCheckTestsMasking)
+    mask[2, 2:] = 0
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE)
+            .list()
+            .layer(GravesLSTM(n_out=4, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=nout, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(nin))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, DataSet(X, labels, mask, mask), print_results=True)

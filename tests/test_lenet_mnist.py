@@ -1,0 +1,26 @@
+"""End-to-end slice: LeNet on (synthetic) MNIST — BASELINE config 1,
+SURVEY §7 stage 4 exit criterion (LeNet trains to accuracy with zero CUDA)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet_configuration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+
+def test_lenet_trains_on_mnist():
+    train = MnistDataSetIterator(batch_size=64, num_examples=1024, train=True)
+    test = MnistDataSetIterator(batch_size=256, num_examples=512, train=False)
+
+    net = MultiLayerNetwork(lenet_configuration(learning_rate=0.02))
+    net.init()
+    scores = CollectScoresIterationListener()
+    net.set_listeners(scores)
+    net.fit(train, epochs=3)
+
+    first = scores.scores[0][1]
+    last = scores.scores[-1][1]
+    assert last < first * 0.5, (first, last)
+
+    ev = net.evaluate(test)
+    assert ev.accuracy() > 0.85, ev.stats()

@@ -1,0 +1,119 @@
+"""MultiLayerNetwork integration tests (reference analogues:
+`MultiLayerTest.java`, `BackPropMLPTest.java`: small nets trained to
+convergence; score decreases; shapes/param counts correct)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def three_class_blobs(n=150, seed=0):
+    """Synthetic 3-class separable data (stands in for the Iris fixture the
+    reference uses — no dataset download in this environment)."""
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[0, 0, 2, 2], [2, 2, 0, 0], [-2, 2, -2, 2]], np.float32)
+    X, y = [], []
+    for c in range(3):
+        X.append(centers[c] + 0.35 * rng.normal(size=(n // 3, 4)))
+        y.append(np.full(n // 3, c))
+    X = np.concatenate(X).astype(np.float32)
+    y = np.concatenate(y)
+    labels = np.eye(3, dtype=np.float32)[y]
+    idx = rng.permutation(len(X))
+    return X[idx], labels[idx]
+
+
+def mlp_conf(updater=Updater.SGD, lr=0.5):
+    return (NeuralNetConfiguration.Builder()
+            .seed(12345).learning_rate(lr).updater(updater)
+            .activation(Activation.TANH)
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_param_count():
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    assert net.num_params() == (4 * 16 + 16) + (16 * 3 + 3)
+
+
+def test_output_shape():
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    X, _ = three_class_blobs()
+    out = net.output(X[:10])
+    assert out.shape == (10, 3)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(10), rtol=1e-5)
+
+
+def test_training_reduces_score_and_learns():
+    X, labels = three_class_blobs()
+    ds = DataSet(X, labels)
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    initial = net.score(ds)
+    it = ListDataSetIterator([ds], batch_size=32)
+    net.fit(it, epochs=30)
+    final = net.score(ds)
+    assert final < initial * 0.5, (initial, final)
+    ev = net.evaluate(ds)
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+@pytest.mark.parametrize("updater", [Updater.ADAM, Updater.NESTEROVS,
+                                     Updater.RMSPROP, Updater.ADAGRAD])
+def test_training_with_updaters(updater):
+    X, labels = three_class_blobs()
+    ds = DataSet(X, labels)
+    lr = 0.05 if updater in (Updater.ADAM, Updater.RMSPROP) else 0.2
+    net = MultiLayerNetwork(mlp_conf(updater, lr))
+    net.init()
+    initial = net.score(ds)
+    net.fit(ListDataSetIterator([ds], batch_size=32), epochs=20)
+    assert net.score(ds) < initial * 0.7
+
+
+def test_set_params_round_trip():
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    p = net.params()
+    p2 = p + 0.1
+    net.set_params(p2)
+    np.testing.assert_allclose(net.params(), p2, rtol=1e-6)
+
+
+def test_clone_produces_identical_outputs():
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    X, _ = three_class_blobs()
+    c = net.clone()
+    np.testing.assert_allclose(net.output(X[:5]), c.output(X[:5]), rtol=1e-6)
+
+
+def test_listener_called():
+    from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+    X, labels = three_class_blobs()
+    ds = DataSet(X, labels)
+    net = MultiLayerNetwork(mlp_conf())
+    net.init()
+    lst = CollectScoresIterationListener()
+    net.set_listeners(lst)
+    net.fit(ListDataSetIterator([ds], batch_size=50), epochs=2)
+    assert len(lst.scores) == 6  # 150/50 * 2

@@ -1,0 +1,67 @@
+"""Unit tests for activations and loss functions (reference analogue:
+ND4J transform op tests + `LossFunctionGradientCheck` score paths)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.activations import Activation, activation_fn
+from deeplearning4j_tpu.ops.losses import LossFunction, loss_fn, loss_score
+
+
+def test_relu_sigmoid_tanh_values():
+    x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(activation_fn(Activation.RELU)(x),
+                               [0, 0, 0, 0.5, 2.0])
+    np.testing.assert_allclose(activation_fn(Activation.SIGMOID)(x),
+                               1 / (1 + np.exp(-np.asarray(x))), rtol=1e-6)
+    np.testing.assert_allclose(activation_fn(Activation.TANH)(x),
+                               np.tanh(np.asarray(x)), rtol=1e-6)
+
+
+def test_softmax_rows_sum_to_one():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 7)))
+    s = activation_fn(Activation.SOFTMAX)(x)
+    np.testing.assert_allclose(np.sum(np.asarray(s), axis=-1), np.ones(4), rtol=1e-6)
+
+
+@pytest.mark.parametrize("act", list(Activation))
+def test_all_activations_finite(act):
+    x = jnp.asarray(np.linspace(-3, 3, 31))
+    y = activation_fn(act)(x)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_mcxent_softmax_fused_matches_generic():
+    rng = np.random.default_rng(1)
+    pre = jnp.asarray(rng.normal(size=(8, 5)))
+    labels = jnp.asarray(np.eye(5)[rng.integers(0, 5, 8)])
+    fused = loss_score(LossFunction.MCXENT, Activation.SOFTMAX, labels, pre)
+    probs = activation_fn(Activation.SOFTMAX)(pre)
+    generic = loss_fn(LossFunction.MCXENT)(labels, probs)
+    np.testing.assert_allclose(float(fused), float(generic), rtol=1e-5)
+
+
+def test_xent_sigmoid_fused_matches_generic():
+    rng = np.random.default_rng(2)
+    pre = jnp.asarray(rng.normal(size=(8, 3)))
+    labels = jnp.asarray(rng.integers(0, 2, (8, 3)).astype(float))
+    fused = loss_score(LossFunction.XENT, Activation.SIGMOID, labels, pre)
+    probs = activation_fn(Activation.SIGMOID)(pre)
+    generic = loss_fn(LossFunction.XENT)(labels, probs)
+    np.testing.assert_allclose(float(fused), float(generic), rtol=1e-5)
+
+
+def test_mse_loss_masked():
+    labels = jnp.asarray([[1.0], [2.0], [3.0]])
+    out = jnp.asarray([[1.0], [0.0], [3.0]])
+    mask = jnp.asarray([1.0, 0.0, 1.0])
+    # masked row (the wrong one) excluded -> loss 0
+    assert float(loss_fn(LossFunction.MSE)(labels, out, mask)) == pytest.approx(0.0)
+    assert float(loss_fn(LossFunction.MSE)(labels, out)) == pytest.approx(4.0 / 3.0)
+
+
+def test_mcxent_extreme_logits_stable():
+    pre = jnp.asarray([[1000.0, -1000.0], [-1000.0, 1000.0]])
+    labels = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    v = float(loss_score(LossFunction.MCXENT, Activation.SOFTMAX, labels, pre))
+    assert np.isfinite(v) and v < 1e-3
