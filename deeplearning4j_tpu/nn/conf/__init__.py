@@ -16,8 +16,18 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     LocalResponseNormalization,
     LossLayer,
     OutputLayer,
+    RBM,
     RnnOutputLayer,
     SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.variational import (  # noqa: F401
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    ReconstructionDistribution,
+    VariationalAutoencoder,
 )
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import (  # noqa: F401
     GlobalConf,

@@ -41,7 +41,6 @@ from deeplearning4j_tpu.nn.conf.inputs import (
     InputTypeRecurrent,
 )
 from deeplearning4j_tpu.nn.conf.layers import (
-    AutoEncoder,
     GravesLSTM,
     Layer,
     OutputLayer,
@@ -460,11 +459,12 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------ pretrain
     def pretrain(self, iterator: DataSetIterator, epochs: int = 1) -> None:
-        """Greedy layerwise unsupervised pretraining for AutoEncoder layers
+        """Greedy layerwise unsupervised pretraining for any layer exposing
+        `pretrain_loss` — AutoEncoder, RBM (CD-k surrogate), VAE (neg-ELBO)
         (reference `MultiLayerNetwork.pretrain`, `:993`)."""
         self._ensure_init()
         for i, layer in enumerate(self.layers):
-            if not isinstance(layer, AutoEncoder):
+            if not hasattr(layer, "pretrain_loss"):
                 continue
             cfg = layer.updater_cfg
 

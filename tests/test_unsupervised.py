@@ -1,0 +1,227 @@
+"""VAE + RBM tests (reference analogues:
+`gradientcheck/VaeGradientCheckTests.java` — VAE fwd/pretrain gradient
+checks over reconstruction distributions — and `nn/layers/RBMTests.java`
+style CD pretraining sanity)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    DenseLayer,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    InputType,
+    LossFunctionWrapper,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RBM,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.conf.layers import HiddenUnit, VisibleUnit
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def small_ds(n=8, nin=6, nout=3, seed=0, positive=False):
+    rng = np.random.default_rng(seed)
+    X = rng.random(size=(n, nin)) if positive else rng.normal(size=(n, nin))
+    labels = np.eye(nout)[rng.integers(0, nout, n)]
+    return DataSet(X, labels)
+
+
+def vae_conf(recon, nin=6, latent=3, act=Activation.TANH):
+    return (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE).activation(act)
+            .list()
+            .layer(VariationalAutoencoder(
+                n_out=latent, encoder_layer_sizes=(7,), decoder_layer_sizes=(7,),
+                reconstruction_distribution=recon))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(nin))
+            .build())
+
+
+def test_vae_supervised_gradients():
+    """VAE used as a feedforward layer in a supervised net (reference
+    `VaeGradientCheckTests.testVaeAsMLP`)."""
+    net = MultiLayerNetwork(vae_conf(GaussianReconstructionDistribution()),
+                            dtype=jnp.float64)
+    net.init()
+    assert check_gradients(net, small_ds(), print_results=True)
+
+
+@pytest.mark.parametrize("recon,positive", [
+    (GaussianReconstructionDistribution(), False),
+    (GaussianReconstructionDistribution(activation=Activation.TANH), False),
+    (BernoulliReconstructionDistribution(), True),
+    (ExponentialReconstructionDistribution(), True),
+    (LossFunctionWrapper(loss=LossFunction.MSE), False),
+])
+def test_vae_pretrain_gradients(recon, positive):
+    """Numeric-vs-analytic gradients of the ELBO itself (reference
+    `VaeGradientCheckTests.testVaePretrain`), deterministic eps=0 draw."""
+    conf = vae_conf(recon)
+    net = MultiLayerNetwork(conf, dtype=jnp.float64)
+    net.init()
+    vae = net.layers[0]
+    params = net._params[0]
+    x = jnp.asarray(small_ds(positive=positive).features, jnp.float64)
+
+    loss_f = lambda p: vae.pretrain_loss(p, x, None)
+    an = jax.grad(loss_f)(params)
+    from jax.flatten_util import ravel_pytree
+    flat, unravel = ravel_pytree(params)
+    eps = 1e-6
+    num = np.zeros_like(np.asarray(flat))
+    f = lambda v: float(loss_f(unravel(v)))
+    for i in range(len(flat)):
+        e = np.zeros(len(flat)); e[i] = eps
+        num[i] = (f(flat + e) - f(flat - e)) / (2 * eps)
+    an_flat = np.asarray(ravel_pytree(an)[0])
+    denom = np.maximum(np.abs(an_flat) + np.abs(num), 1e-8)
+    rel = np.abs(an_flat - num) / denom
+    assert rel.max() < 1e-3, f"max rel err {rel.max()}"
+
+
+def test_vae_composite_distribution():
+    recon = CompositeReconstructionDistribution(parts=[
+        (3, GaussianReconstructionDistribution()),
+        (3, BernoulliReconstructionDistribution()),
+    ])
+    assert recon.distribution_input_size(6) == 9
+    net = MultiLayerNetwork(vae_conf(recon), dtype=jnp.float64)
+    net.init()
+    x = jnp.asarray(small_ds(positive=True).features, jnp.float64)
+    loss = net.layers[0].pretrain_loss(net._params[0], x, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+def test_vae_pretrain_improves_elbo():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Updater.ADAM).learning_rate(1e-2)
+            .activation(Activation.TANH)
+            .list()
+            .layer(VariationalAutoencoder(
+                n_out=2, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+                reconstruction_distribution=BernoulliReconstructionDistribution()))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    X = (rng.random((64, 8)) > 0.5).astype(np.float32)
+    ds = DataSet(X, np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)])
+    vae = net.layers[0]
+    before = float(vae.pretrain_loss(net._params[0], jnp.asarray(X), None))
+    net.pretrain(ListDataSetIterator([ds]), epochs=40)
+    after = float(vae.pretrain_loss(net._params[0], jnp.asarray(X), None))
+    assert after < before, f"ELBO did not improve: {before} -> {after}"
+
+
+def test_vae_reconstruction_and_generation():
+    net = MultiLayerNetwork(vae_conf(BernoulliReconstructionDistribution()))
+    net.init()
+    vae, params = net.layers[0], net._params[0]
+    x = jnp.asarray(small_ds(positive=True).features, jnp.float32)
+    lp = vae.reconstruction_probability(params, x, 5, jax.random.PRNGKey(0))
+    assert lp.shape == (8,) and np.all(np.isfinite(np.asarray(lp)))
+    gen = vae.generate_at_mean_given_z(params, jnp.zeros((4, 3)))
+    assert gen.shape == (4, 6)
+    assert np.all((np.asarray(gen) >= 0) & (np.asarray(gen) <= 1))
+
+
+def test_vae_serde_roundtrip():
+    recon = CompositeReconstructionDistribution(parts=[
+        (2, GaussianReconstructionDistribution(activation=Activation.TANH)),
+        (4, BernoulliReconstructionDistribution()),
+    ])
+    conf = vae_conf(recon)
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    vae2 = conf2.layers[0]
+    assert isinstance(vae2, VariationalAutoencoder)
+    assert vae2.encoder_layer_sizes == (7,)
+    rd = vae2.reconstruction_distribution
+    assert isinstance(rd, CompositeReconstructionDistribution)
+    assert rd.parts[0][0] == 2
+    assert isinstance(rd.parts[1][1], BernoulliReconstructionDistribution)
+    net = MultiLayerNetwork(conf2)
+    net.init()  # params build fine from the deserialized config
+
+
+# ---------------------------------------------------------------------------
+# RBM
+
+
+def test_rbm_forward_shapes_and_serde():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Updater.SGD).learning_rate(0.1)
+            .list()
+            .layer(RBM(n_out=5, hidden_unit=HiddenUnit.BINARY,
+                       visible_unit=VisibleUnit.BINARY, k=2))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    rbm = conf2.layers[0]
+    assert isinstance(rbm, RBM) and rbm.k == 2
+    assert rbm.hidden_unit == HiddenUnit.BINARY
+    net = MultiLayerNetwork(conf2)
+    net.init()
+    out = net.output(np.random.default_rng(0).random((4, 6)).astype(np.float32))
+    assert out.shape == (4, 3)
+
+
+def test_rbm_cd_pretrain_reduces_reconstruction_error():
+    """CD-k on a bimodal binary dataset: reconstruction error must drop
+    (reference RBM learning tests train on MNIST digits subset)."""
+    rng = np.random.default_rng(0)
+    proto = np.array([[1, 1, 1, 0, 0, 0, 1, 0], [0, 0, 0, 1, 1, 1, 0, 1]], np.float32)
+    X = proto[rng.integers(0, 2, 128)]
+    flip = rng.random(X.shape) < 0.05
+    X = np.where(flip, 1 - X, X).astype(np.float32)
+    ds = DataSet(X, np.zeros((128, 1), np.float32))
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Updater.SGD).learning_rate(0.2)
+            .list()
+            .layer(RBM(n_out=4, k=1))
+            .layer(OutputLayer(n_out=1, loss=LossFunction.MSE,
+                               activation=Activation.IDENTITY))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rbm = net.layers[0]
+    before = rbm.reconstruction_error(net._params[0], jnp.asarray(X))
+    net.pretrain(ListDataSetIterator([ds]), epochs=60)
+    after = rbm.reconstruction_error(net._params[0], jnp.asarray(X))
+    assert after < before * 0.7, f"reconstruction error {before} -> {after}"
+
+
+def test_rbm_gaussian_visible():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 5)).astype(np.float32)
+    rbm = RBM(n_in=5, n_out=3, visible_unit=VisibleUnit.GAUSSIAN,
+              hidden_unit=HiddenUnit.RECTIFIED, k=1)
+    import jax as _jax
+    params = rbm.init_params(_jax.random.PRNGKey(0), None)
+    loss = rbm.pretrain_loss(params, jnp.asarray(X), _jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    fe = rbm.free_energy(params, jnp.asarray(X))
+    assert fe.shape == (32,)
