@@ -1,0 +1,240 @@
+"""Data normalizers (preprocessors) with checkpoint serde.
+
+Reference: ND4J's `DataNormalization` surface consumed throughout DL4J —
+`NormalizerStandardize`, `NormalizerMinMaxScaler`,
+`ImagePreProcessingScaler` — persisted as `normalizer.bin` inside model
+checkpoints (`util/ModelSerializer.java:43`). Statistics are computed on
+host in fp64 (one pass, Welford-free since datasets fit streaming sums) and
+applied as cheap elementwise ops that XLA fuses into the step function when
+the iterator pre-applies them.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+_NORMALIZER_REGISTRY = {}
+
+
+def register_normalizer(cls):
+    _NORMALIZER_REGISTRY[cls.KIND] = cls
+    return cls
+
+
+class DataNormalization:
+    """fit(data) → transform(ds) in place (reference `DataNormalization`:
+    `fit(DataSetIterator)` + `preProcess(DataSet)`)."""
+
+    KIND = "base"
+
+    def fit(self, data) -> "DataNormalization":
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def revert_features(self, features: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # reference naming
+    def pre_process(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    def __call__(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    # -- serde (normalizer.bin in checkpoints) ------------------------------
+    def _arrays(self) -> dict:
+        raise NotImplementedError
+
+    def _meta(self) -> dict:
+        return {}
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        arrays = {k: v for k, v in self._arrays().items() if v is not None}
+        np.savez(buf, __kind__=np.frombuffer(
+            json.dumps({"kind": self.KIND, **self._meta()}).encode(), np.uint8),
+            **arrays)
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "DataNormalization":
+        data = np.load(io.BytesIO(b))
+        meta = json.loads(bytes(data["__kind__"]).decode())
+        cls = _NORMALIZER_REGISTRY[meta.pop("kind")]
+        obj = cls(**meta)
+        for k in data.files:
+            if k != "__kind__":
+                setattr(obj, k, data[k])
+        return obj
+
+
+def _iter_batches(data):
+    if isinstance(data, DataSet):
+        yield data
+        return
+    data.reset()
+    for ds in data:
+        yield ds
+    data.reset()
+
+
+@register_normalizer
+class NormalizerStandardize(DataNormalization):
+    """Zero-mean unit-variance per feature column (reference ND4J
+    `NormalizerStandardize`), optional label normalization for regression."""
+
+    KIND = "standardize"
+
+    def __init__(self, fit_label: bool = False):
+        self.fit_label = bool(fit_label)
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+        self.label_mean: Optional[np.ndarray] = None
+        self.label_std: Optional[np.ndarray] = None
+
+    def _meta(self):
+        return {"fit_label": self.fit_label}
+
+    def _arrays(self):
+        return {"mean": self.mean, "std": self.std,
+                "label_mean": self.label_mean, "label_std": self.label_std}
+
+    def fit(self, data):
+        n = 0
+        s = ss = ls = lss = None
+        for ds in _iter_batches(data):
+            f = np.asarray(ds.features, np.float64).reshape(ds.features.shape[0], -1)
+            if s is None:
+                s, ss = f.sum(0), (f ** 2).sum(0)
+            else:
+                s += f.sum(0); ss += (f ** 2).sum(0)
+            if self.fit_label:
+                l = np.asarray(ds.labels, np.float64).reshape(ds.labels.shape[0], -1)
+                if ls is None:
+                    ls, lss = l.sum(0), (l ** 2).sum(0)
+                else:
+                    ls += l.sum(0); lss += (l ** 2).sum(0)
+            n += f.shape[0]
+        if n == 0:
+            raise ValueError("NormalizerStandardize.fit: no data")
+        self.mean = (s / n).astype(np.float32)
+        self.std = np.sqrt(np.maximum(ss / n - (s / n) ** 2, 1e-12)).astype(np.float32)
+        if self.fit_label:
+            self.label_mean = (ls / n).astype(np.float32)
+            self.label_std = np.sqrt(np.maximum(lss / n - (ls / n) ** 2, 1e-12)).astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        if self.mean is None:
+            raise ValueError("normalizer not fitted")
+        shp = ds.features.shape
+        f = np.asarray(ds.features, np.float32).reshape(shp[0], -1)
+        ds.features = ((f - self.mean) / self.std).reshape(shp)
+        if self.fit_label and self.label_mean is not None:
+            lshp = ds.labels.shape
+            l = np.asarray(ds.labels, np.float32).reshape(lshp[0], -1)
+            ds.labels = ((l - self.label_mean) / self.label_std).reshape(lshp)
+        return ds
+
+    def revert_features(self, features: np.ndarray) -> np.ndarray:
+        shp = features.shape
+        f = np.asarray(features, np.float32).reshape(shp[0], -1)
+        return (f * self.std + self.mean).reshape(shp)
+
+    def revert_labels(self, labels: np.ndarray) -> np.ndarray:
+        if not self.fit_label:
+            return labels
+        shp = labels.shape
+        l = np.asarray(labels, np.float32).reshape(shp[0], -1)
+        return (l * self.label_std + self.label_mean).reshape(shp)
+
+
+@register_normalizer
+class NormalizerMinMaxScaler(DataNormalization):
+    """Scale each feature column to [min_range, max_range] (reference ND4J
+    `NormalizerMinMaxScaler`)."""
+
+    KIND = "minmax"
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.fmin: Optional[np.ndarray] = None
+        self.fmax: Optional[np.ndarray] = None
+
+    def _meta(self):
+        return {"min_range": self.min_range, "max_range": self.max_range}
+
+    def _arrays(self):
+        return {"fmin": self.fmin, "fmax": self.fmax}
+
+    def fit(self, data):
+        fmin = fmax = None
+        for ds in _iter_batches(data):
+            f = np.asarray(ds.features, np.float64).reshape(ds.features.shape[0], -1)
+            bmin, bmax = f.min(0), f.max(0)
+            fmin = bmin if fmin is None else np.minimum(fmin, bmin)
+            fmax = bmax if fmax is None else np.maximum(fmax, bmax)
+        if fmin is None:
+            raise ValueError("NormalizerMinMaxScaler.fit: no data")
+        self.fmin = fmin.astype(np.float32)
+        self.fmax = fmax.astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        if self.fmin is None:
+            raise ValueError("normalizer not fitted")
+        shp = ds.features.shape
+        f = np.asarray(ds.features, np.float32).reshape(shp[0], -1)
+        rng = np.maximum(self.fmax - self.fmin, 1e-12)
+        scaled = (f - self.fmin) / rng * (self.max_range - self.min_range) + self.min_range
+        ds.features = scaled.reshape(shp)
+        return ds
+
+    def revert_features(self, features: np.ndarray) -> np.ndarray:
+        shp = features.shape
+        f = np.asarray(features, np.float32).reshape(shp[0], -1)
+        rng = np.maximum(self.fmax - self.fmin, 1e-12)
+        return ((f - self.min_range) / (self.max_range - self.min_range) * rng
+                + self.fmin).reshape(shp)
+
+
+@register_normalizer
+class ImagePreProcessingScaler(DataNormalization):
+    """Pixel range scaler: x/255 → [a, b] (reference ND4J
+    `ImagePreProcessingScaler`). Stateless — fit is a no-op."""
+
+    KIND = "image_scaler"
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.max_pixel = float(max_pixel)
+
+    def _meta(self):
+        return {"min_range": self.min_range, "max_range": self.max_range,
+                "max_pixel": self.max_pixel}
+
+    def _arrays(self):
+        return {}
+
+    def fit(self, data):
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features, np.float32)
+        ds.features = f / self.max_pixel * (self.max_range - self.min_range) + self.min_range
+        return ds
+
+    def revert_features(self, features: np.ndarray) -> np.ndarray:
+        f = np.asarray(features, np.float32)
+        return (f - self.min_range) / (self.max_range - self.min_range) * self.max_pixel
+

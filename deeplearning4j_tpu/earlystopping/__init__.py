@@ -1,0 +1,25 @@
+"""Early stopping (reference `deeplearning4j-nn/.../earlystopping/`)."""
+
+from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration  # noqa: F401
+from deeplearning4j_tpu.earlystopping.result import (  # noqa: F401
+    EarlyStoppingResult,
+    TerminationReason,
+)
+from deeplearning4j_tpu.earlystopping.saver import (  # noqa: F401
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.score_calc import DataSetLossCalculator  # noqa: F401
+from deeplearning4j_tpu.earlystopping.termination import (  # noqa: F401
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer  # noqa: F401
+
+# reference has a separate EarlyStoppingGraphTrainer; here the one trainer
+# handles both MultiLayerNetwork and ComputationGraph (same fit surface)
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

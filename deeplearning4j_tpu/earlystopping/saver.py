@@ -1,0 +1,74 @@
+"""Model savers (reference `earlystopping/saver/`): persist best/latest
+models during early-stopping training."""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """Keep clones in memory (reference `InMemoryModelSaver`)."""
+
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best_model(self, net, score):
+        self.best = net.clone()
+
+    def save_latest_model(self, net, score):
+        self.latest = net.clone()
+
+    def get_best_model(self):
+        return self.best
+
+    def get_latest_model(self):
+        return self.latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Checkpoint zips under a directory (reference `LocalFileModelSaver`:
+    bestModel.bin / latestModel.bin)."""
+
+    def __init__(self, directory):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.best_path = self.directory / "bestModel.bin"
+        self.latest_path = self.directory / "latestModel.bin"
+
+    def save_best_model(self, net, score):
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        write_model(net, self.best_path)
+
+    def save_latest_model(self, net, score):
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        write_model(net, self.latest_path)
+
+    def _load(self, path) -> Optional[object]:
+        if not path.exists():
+            return None
+        from deeplearning4j_tpu.util.serialization import restore_model
+
+        return restore_model(path)
+
+    def get_best_model(self):
+        return self._load(self.best_path)
+
+    def get_latest_model(self):
+        return self._load(self.latest_path)

@@ -346,3 +346,19 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
+
+    def clone(self) -> "ComputationGraph":
+        net = ComputationGraph(self.conf, self.dtype)
+        if self._params is not None:
+            net.init()
+            net.set_params(self.params())
+            # deep-copy: the jitted train step DONATES these buffers (same
+            # aliasing hazard as MultiLayerNetwork.clone)
+            net._upd_state = jax.tree.map(jnp.copy, self._upd_state)
+            net._layer_state = jax.tree.map(jnp.copy, self._layer_state)
+        # clock travels with the optimizer state (Adam bias correction,
+        # LR schedules)
+        net.iteration = self.iteration
+        net.epoch = self.epoch
+        net.score_value = self.score_value
+        return net

@@ -212,6 +212,12 @@ class MultiLayerNetwork:
         if self._jit_train is None:
             self._jit_train = self._make_train_step()
 
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+            OptimizationAlgorithm,
+        )
+
+        line_search_algo = (self.conf.global_conf.optimization_algo
+                            != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT)
         tbptt = (self.conf.tbptt_fwd_length > 0)
         for _ in range(epochs):
             for listener in self.listeners:
@@ -220,7 +226,9 @@ class MultiLayerNetwork:
             n_batches = 0
             for ds in iterator:
                 n_batches += 1
-                if tbptt and ds.features.ndim == 3:
+                if line_search_algo:
+                    self._fit_batch_solver(ds)
+                elif tbptt and ds.features.ndim == 3:
                     self._fit_tbptt(ds)
                 else:
                     self._fit_batch(ds)
@@ -243,6 +251,19 @@ class MultiLayerNetwork:
         self._params, self._upd_state, self._layer_state, loss = self._jit_train(
             self._params, self._upd_state, self._layer_state, it, f, l, fm, lm, rng)
         self.score_value = float(loss)
+        self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "record_batch"):
+                listener.record_batch(ds.num_examples())
+            listener.iteration_done(self, self.iteration)
+
+    def _fit_batch_solver(self, ds: DataSet):
+        """Line-search solver path (reference `Solver.java:58-68` dispatch for
+        LINE_GRADIENT_DESCENT / CONJUGATE_GRADIENT / LBFGS)."""
+        from deeplearning4j_tpu.optimize.solvers import Solver
+
+        self._validate_labels(ds)
+        Solver(self).optimize(ds)
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):
@@ -510,4 +531,9 @@ class MultiLayerNetwork:
             # the other's arrays
             net._upd_state = jax.tree.map(jnp.copy, self._upd_state)
             net._layer_state = jax.tree.map(jnp.copy, self._layer_state)
+        # clock must travel with the optimizer state, or resumed training
+        # restarts Adam bias correction / LR schedules at t=0
+        net.iteration = self.iteration
+        net.epoch = self.epoch
+        net.score_value = self.score_value
         return net

@@ -3,10 +3,12 @@
 Reference: `deeplearning4j-nn/.../util/ModelSerializer.java:82` — a zip
 containing `configuration.json` (:93), `coefficients.bin` (:98, flat param
 vector), `updaterState.bin` (:120-134, flat optimizer-state view),
-`normalizer.bin`. Same layout here (npy instead of Nd4j binary), plus
+`normalizer.bin` (:43). Same layout here (npy instead of Nd4j binary), plus
 `layerState.npy` for batch-norm running statistics and `meta.json`
-(iteration/epoch) so resume continues schedules and Adam moments exactly —
-the key round-trip property called out in SURVEY §5 (checkpoint/resume).
+(iteration/epoch/model type) so resume continues schedules and Adam moments
+exactly — the key round-trip property called out in SURVEY §5
+(checkpoint/resume). Works for both MultiLayerNetwork and ComputationGraph
+(reference `restoreMultiLayerNetwork` / `restoreComputationGraph`).
 """
 from __future__ import annotations
 
@@ -14,7 +16,7 @@ import io
 import json
 import zipfile
 from pathlib import Path
-from typing import Union
+from typing import Optional, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,14 +26,18 @@ CONFIG_JSON = "configuration.json"
 COEFFICIENTS = "coefficients.npy"
 UPDATER_STATE = "updaterState.npy"
 LAYER_STATE = "layerState.npy"
+NORMALIZER = "normalizer.bin"
 META_JSON = "meta.json"
 
 
-def write_model(net, path: Union[str, Path], save_updater: bool = True) -> None:
-    """Save a MultiLayerNetwork (reference `ModelSerializer.writeModel`)."""
+def write_model(net, path: Union[str, Path], save_updater: bool = True,
+                normalizer=None) -> None:
+    """Save a MultiLayerNetwork or ComputationGraph (reference
+    `ModelSerializer.writeModel`; `normalizer` → `normalizer.bin`:43)."""
     net._ensure_init()
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    model_type = type(net).__name__
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(CONFIG_JSON, net.conf.to_json())
         z.writestr(COEFFICIENTS, _np_bytes(net.params()))
@@ -41,26 +47,43 @@ def write_model(net, path: Union[str, Path], save_updater: bool = True) -> None:
         if net._layer_state is not None:
             flat, _ = ravel_pytree(net._layer_state)
             z.writestr(LAYER_STATE, _np_bytes(np.asarray(flat)))
+        if normalizer is not None:
+            z.writestr(NORMALIZER, normalizer.to_bytes())
         z.writestr(META_JSON, json.dumps({
             "iteration": net.iteration,
             "epoch": net.epoch,
             "dtype": str(np.dtype(net.dtype)),
+            "model_type": model_type,
             "format": "deeplearning4j_tpu/model/v1",
         }))
 
 
-def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = True):
-    """Restore (reference `ModelSerializer.restoreMultiLayerNetwork`)."""
-    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
-        MultiLayerConfiguration,
-    )
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-
+def _restore(path, load_updater: bool, expect_type: Optional[str]):
     with zipfile.ZipFile(path, "r") as z:
-        conf = MultiLayerConfiguration.from_json(z.read(CONFIG_JSON).decode())
         meta = json.loads(z.read(META_JSON).decode())
+        model_type = meta.get("model_type", "MultiLayerNetwork")
+        if expect_type is not None and model_type != expect_type:
+            raise ValueError(
+                f"checkpoint holds a {model_type}, not a {expect_type} — "
+                f"use restore_{'computation_graph' if model_type == 'ComputationGraph' else 'multi_layer_network'}()")
         dtype = jnp.dtype(meta.get("dtype", "float32"))
-        net = MultiLayerNetwork(conf, dtype=dtype)
+        cfg_json = z.read(CONFIG_JSON).decode()
+        if model_type == "ComputationGraph":
+            from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+                ComputationGraphConfiguration,
+            )
+            from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+            net = ComputationGraph(ComputationGraphConfiguration.from_json(cfg_json),
+                                   dtype=dtype)
+        else:
+            from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+                MultiLayerConfiguration,
+            )
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            net = MultiLayerNetwork(MultiLayerConfiguration.from_json(cfg_json),
+                                    dtype=dtype)
         net.init()
         net.set_params(_np_load(z.read(COEFFICIENTS)))
         if load_updater and UPDATER_STATE in z.namelist():
@@ -85,6 +108,32 @@ def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = Tru
         net.iteration = meta.get("iteration", 0)
         net.epoch = meta.get("epoch", 0)
     return net
+
+
+def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = True):
+    """Restore (reference `ModelSerializer.restoreMultiLayerNetwork`)."""
+    return _restore(path, load_updater, "MultiLayerNetwork")
+
+
+def restore_computation_graph(path: Union[str, Path], load_updater: bool = True):
+    """Restore (reference `ModelSerializer.restoreComputationGraph`)."""
+    return _restore(path, load_updater, "ComputationGraph")
+
+
+def restore_model(path: Union[str, Path], load_updater: bool = True):
+    """Type-sniffing restore (reference `util/ModelGuesser`)."""
+    return _restore(path, load_updater, None)
+
+
+def restore_normalizer(path: Union[str, Path]):
+    """Read `normalizer.bin` back (reference
+    `ModelSerializer.restoreNormalizerFromFile`); None if absent."""
+    from deeplearning4j_tpu.datasets.normalizers import DataNormalization
+
+    with zipfile.ZipFile(path, "r") as z:
+        if NORMALIZER not in z.namelist():
+            return None
+        return DataNormalization.from_bytes(z.read(NORMALIZER))
 
 
 def _np_bytes(a: np.ndarray) -> bytes:

@@ -1,0 +1,228 @@
+"""Early stopping + normalizer tests (reference analogues:
+`earlystopping/TestEarlyStopping.java`, normalizer round-trip in
+`ModelSerializer` tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import (
+    DataNormalization,
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def blobs_iterator(n=90, batch=30, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[0, 0, 2, 2], [2, 2, 0, 0], [-2, 2, -2, 2]], np.float32)
+    X = np.concatenate([centers[c] + 0.3 * rng.normal(size=(n // 3, 4))
+                        for c in range(3)]).astype(np.float32)
+    y = np.concatenate([np.full(n // 3, c) for c in range(3)])
+    labels = np.eye(3, dtype=np.float32)[y]
+    idx = rng.permutation(n)
+    return ListDataSetIterator(DataSet(X[idx], labels[idx]).batch_by(batch))
+
+
+def small_net(lr=0.3, updater=Updater.SGD):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345).learning_rate(lr).updater(updater)
+            .activation(Activation.TANH)
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def test_max_epochs_termination():
+    net = small_net()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+           .score_calculator(DataSetLossCalculator(blobs_iterator(seed=1)))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, blobs_iterator()).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 5
+    assert "MaxEpochs" in result.termination_details
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 5
+    # scores should broadly improve on this separable problem
+    assert result.best_model_score < list(result.score_vs_epoch.values())[0]
+
+
+def test_score_improvement_termination():
+    net = small_net(lr=0.0)  # lr=0 → no improvement ever
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(
+               MaxEpochsTerminationCondition(50),
+               ScoreImprovementEpochTerminationCondition(2))
+           .score_calculator(DataSetLossCalculator(blobs_iterator(seed=1)))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, blobs_iterator()).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 5
+
+
+def test_max_score_iteration_termination():
+    net = small_net(lr=1e4)  # diverges fast
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(100))
+           .iteration_termination_conditions(
+               MaxScoreIterationTerminationCondition(20.0),
+               InvalidScoreIterationTerminationCondition())
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, blobs_iterator()).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+
+
+def test_max_time_termination():
+    net = small_net()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(100000))
+           .iteration_termination_conditions(MaxTimeIterationTerminationCondition(1.5))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, blobs_iterator()).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+    assert "MaxTime" in result.termination_details
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    net = small_net()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(blobs_iterator(seed=1)))
+           .model_saver(LocalFileModelSaver(tmp_path))
+           .save_last_model()
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, blobs_iterator()).fit()
+    assert (tmp_path / "bestModel.bin").exists()
+    assert (tmp_path / "latestModel.bin").exists()
+    best = result.best_model
+    np.testing.assert_allclose(best.params(), cfg.model_saver.get_best_model().params())
+    # restored best model still predicts
+    out = best.output(np.zeros((2, 4), np.float32))
+    assert out.shape == (2, 3)
+
+
+# ---------------------------------------------------------------------------
+# normalizers
+
+
+def test_standardize_fit_transform_revert():
+    rng = np.random.default_rng(0)
+    X = rng.normal(3.0, 2.5, size=(200, 6)).astype(np.float32)
+    it = ListDataSetIterator(DataSet(X, np.zeros((200, 1), np.float32)).batch_by(64))
+    norm = NormalizerStandardize().fit(it)
+    ds = DataSet(X.copy(), np.zeros((200, 1), np.float32))
+    norm.transform(ds)
+    assert abs(ds.features.mean()) < 1e-2
+    assert abs(ds.features.std() - 1.0) < 1e-2
+    back = norm.revert_features(ds.features)
+    np.testing.assert_allclose(back, X, rtol=1e-4, atol=1e-4)
+
+
+def test_standardize_labels_for_regression():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    Y = rng.normal(10.0, 5.0, size=(100, 2)).astype(np.float32)
+    norm = NormalizerStandardize(fit_label=True).fit(DataSet(X, Y))
+    ds = DataSet(X.copy(), Y.copy())
+    norm.transform(ds)
+    assert abs(ds.labels.mean()) < 1e-2
+    np.testing.assert_allclose(norm.revert_labels(ds.labels), Y, rtol=1e-3, atol=1e-3)
+
+
+def test_minmax_scaler():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-7, 13, size=(150, 4)).astype(np.float32)
+    norm = NormalizerMinMaxScaler().fit(DataSet(X, np.zeros((150, 1))))
+    ds = DataSet(X.copy(), np.zeros((150, 1)))
+    norm.transform(ds)
+    assert ds.features.min() >= -1e-6 and ds.features.max() <= 1 + 1e-6
+    np.testing.assert_allclose(norm.revert_features(ds.features), X, rtol=1e-4, atol=1e-4)
+
+
+def test_image_scaler():
+    X = np.arange(0, 256, dtype=np.float32).reshape(1, -1)
+    ds = DataSet(X.copy(), np.zeros((1, 1)))
+    ImagePreProcessingScaler().transform(ds)
+    assert ds.features.min() == 0.0 and abs(ds.features.max() - 1.0) < 1e-6
+
+
+@pytest.mark.parametrize("norm_factory", [
+    lambda: NormalizerStandardize(fit_label=True),
+    lambda: NormalizerMinMaxScaler(-1.0, 1.0),
+    lambda: ImagePreProcessingScaler(0.0, 1.0),
+])
+def test_normalizer_serde_roundtrip(norm_factory):
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 255, size=(50, 5)).astype(np.float32)
+    Y = rng.normal(size=(50, 2)).astype(np.float32)
+    norm = norm_factory().fit(DataSet(X, Y))
+    norm2 = DataNormalization.from_bytes(norm.to_bytes())
+    ds1, ds2 = DataSet(X.copy(), Y.copy()), DataSet(X.copy(), Y.copy())
+    norm.transform(ds1)
+    norm2.transform(ds2)
+    np.testing.assert_allclose(ds1.features, ds2.features)
+    np.testing.assert_allclose(ds1.labels, ds2.labels)
+
+
+def test_checkpoint_with_normalizer(tmp_path):
+    from deeplearning4j_tpu.util.serialization import (
+        restore_multi_layer_network,
+        restore_normalizer,
+        write_model,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(5, 3, size=(60, 4)).astype(np.float32)
+    norm = NormalizerStandardize().fit(DataSet(X, np.zeros((60, 1))))
+    net = small_net()
+    p = tmp_path / "model.zip"
+    write_model(net, p, normalizer=norm)
+    net2 = restore_multi_layer_network(p)
+    norm2 = restore_normalizer(p)
+    np.testing.assert_allclose(net.params(), net2.params())
+    np.testing.assert_allclose(norm2.mean, norm.mean)
+    assert restore_normalizer_missing(tmp_path) is None
+
+
+def restore_normalizer_missing(tmp_path):
+    from deeplearning4j_tpu.util.serialization import restore_normalizer, write_model
+
+    net = small_net()
+    p = tmp_path / "model_nonorm.zip"
+    write_model(net, p)
+    return restore_normalizer(p)
