@@ -91,6 +91,9 @@ class GlobalConf:
     gradient_normalization_threshold: float = 1.0
     optimization_algo: OptimizationAlgorithm = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
     max_num_line_search_iterations: int = 5
+    # optimizer iterations per minibatch for line-search solvers (reference
+    # `NeuralNetConfiguration.Builder.iterations`)
+    iterations: int = 1
     mini_batch: bool = True
     use_regularization: bool = False
 
@@ -218,6 +221,10 @@ class NeuralNetConfiguration:
 
         def max_num_line_search_iterations(self, n: int):
             self._g.max_num_line_search_iterations = n
+            return self
+
+        def iterations(self, n: int):
+            self._g.iterations = int(n)
             return self
 
         def mini_batch(self, b: bool):

@@ -1,0 +1,224 @@
+"""Solvers beyond plain SGD: line search + CG + LBFGS.
+
+Reference: `optimize/Solver.java:41` (dispatch by `OptimizationAlgorithm`,
+lines 58-68), `optimize/solvers/BaseOptimizer.java:51`,
+`ConjugateGradient.java`, `LBFGS.java`, `LineGradientDescent.java`,
+`BackTrackLineSearch.java` (354 LoC).
+
+TPU-native design: the loss/gradient closure over the minibatch is ONE
+jitted XLA computation on the flat parameter vector (via
+`net.score_function`-style ravel), so each optimizer iteration costs one
+device round-trip; the light scalar bookkeeping (Armijo backtracking, CG
+beta, LBFGS two-loop over an m-deep history) runs on host between launches
+— that control flow is data-dependent and tiny, exactly what should NOT be
+traced (SURVEY §7 'compiler-friendly control flow').
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    OptimizationAlgorithm,
+)
+
+log = logging.getLogger(__name__)
+
+
+def backtrack_line_search(
+    f: Callable[[jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    direction: jnp.ndarray,
+    value0: float,
+    grad0: jnp.ndarray,
+    max_iterations: int = 5,
+    initial_step: float = 1.0,
+    c1: float = 1e-4,
+    rho: float = 0.5,
+) -> Tuple[float, float]:
+    """Armijo backtracking (reference `BackTrackLineSearch.java`): shrink
+    step until f(x + αd) ≤ f(x) + c1·α·gᵀd. Returns (step, new_value);
+    step=0.0 if no decrease found."""
+    slope = float(grad0 @ direction)
+    if slope >= 0:
+        log.debug("line search: non-descent direction (slope=%g)", slope)
+        return 0.0, value0
+    alpha = initial_step
+    for _ in range(max_iterations):
+        v = float(f(x + alpha * direction))
+        if np.isfinite(v) and v <= value0 + c1 * alpha * slope:
+            return alpha, v
+        alpha *= rho
+    return 0.0, value0
+
+
+class Solver:
+    """Per-minibatch optimizer dispatch (reference `optimize/Solver.java`).
+
+    For SGD the network's own fused train step is the fast path; this class
+    covers the line-search family on a fixed batch.
+    """
+
+    def __init__(self, net):
+        self.net = net
+        self.algo = net.conf.global_conf.optimization_algo
+        self.max_ls = net.conf.global_conf.max_num_line_search_iterations
+        # ONE jitted (flat, lstate, batch…) → (value, grad) computation per
+        # network, cached on the net — batches are traced ARGUMENTS, so
+        # training over many minibatches reuses the same executable instead
+        # of recompiling a fresh closure per batch
+        if getattr(net, "_solver_jit", None) is None:
+            from jax.flatten_util import ravel_pytree
+
+            _, unravel = ravel_pytree(net._params)
+
+            def loss_flat(flat, lstate, feats, labels, fmask, lmask):
+                loss, _ = net._loss_pure(unravel(flat), lstate, feats, labels,
+                                         fmask, lmask, None, True)
+                return loss
+
+            net._solver_jit = (jax.jit(jax.value_and_grad(loss_flat)),
+                               jax.jit(loss_flat))
+        self._vg_jit, self._val_jit = net._solver_jit
+
+    def optimize(self, ds, iterations: Optional[int] = None) -> float:
+        """Run `iterations` optimizer steps on this batch; updates the
+        network parameters in place and returns the final score."""
+        net = self.net
+        iterations = iterations if iterations is not None else \
+            net.conf.global_conf.iterations
+        feats, labels, fm, lm = net._batch_arrays(ds)
+        lstate = net._layer_state
+        vg = lambda x: self._vg_jit(x, lstate, feats, labels, fm, lm)
+        f = lambda x: self._val_jit(x, lstate, feats, labels, fm, lm)
+        x = jnp.asarray(net.params())
+
+        if self.algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            lr = net.conf.global_conf.learning_rate
+            v = None
+            for _ in range(iterations):
+                v, g = vg(x)
+                x = x - lr * g
+            final = float(v) if v is not None else float(f(x))
+        elif self.algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
+            final = self._line_gd(vg, f, x, iterations)
+            return final  # params set inside
+        elif self.algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
+            final = self._cg(vg, f, x, iterations)
+            return final
+        elif self.algo == OptimizationAlgorithm.LBFGS:
+            final = self._lbfgs(vg, f, x, iterations)
+            return final
+        else:
+            raise ValueError(f"unknown optimization algorithm {self.algo}")
+        net.set_params(np.asarray(x))
+        net.score_value = final
+        return final
+
+    # -- steepest descent + line search ------------------------------------
+    def _line_gd(self, vg, f, x, iterations) -> float:
+        """Reference `LineGradientDescent.java`: d = −g, Armijo step."""
+        v, g = vg(x)
+        v = float(v)
+        for _ in range(iterations):
+            d = -g
+            step0 = 1.0 / max(1.0, float(jnp.linalg.norm(g)))
+            alpha, v_new = backtrack_line_search(f, x, d, v, g, self.max_ls,
+                                                 initial_step=step0)
+            if alpha == 0.0:
+                break
+            x = x + alpha * d
+            v, g = vg(x)
+            v = float(v)
+        self._commit(x, v)
+        return v
+
+    # -- nonlinear conjugate gradient --------------------------------------
+    def _cg(self, vg, f, x, iterations) -> float:
+        """Polak-Ribière+ CG with automatic restart (reference
+        `ConjugateGradient.java`)."""
+        v, g = vg(x)
+        v = float(v)
+        d = -g
+        for _ in range(iterations):
+            step0 = 1.0 / max(1.0, float(jnp.linalg.norm(g)))
+            alpha, _ = backtrack_line_search(f, x, d, v, g, self.max_ls,
+                                             initial_step=step0)
+            if alpha == 0.0:
+                # restart along steepest descent; if that fails too, stop
+                d = -g
+                alpha, _ = backtrack_line_search(f, x, d, v, g, self.max_ls,
+                                                 initial_step=step0)
+                if alpha == 0.0:
+                    break
+            x_new = x + alpha * d
+            v_new, g_new = vg(x_new)
+            v_new = float(v_new)
+            # PR+ beta, restart on non-positivity
+            denom = float(g @ g)
+            beta = max(0.0, float(g_new @ (g_new - g)) / max(denom, 1e-30))
+            d = -g_new + beta * d
+            x, v, g = x_new, v_new, g_new
+        self._commit(x, v)
+        return v
+
+    # -- LBFGS --------------------------------------------------------------
+    def _lbfgs(self, vg, f, x, iterations, m: int = 10) -> float:
+        """Two-loop-recursion LBFGS (reference `LBFGS.java`, history m=10)."""
+        v, g = vg(x)
+        v = float(v)
+        s_hist: List[jnp.ndarray] = []
+        y_hist: List[jnp.ndarray] = []
+        for _ in range(iterations):
+            d = -self._lbfgs_direction(g, s_hist, y_hist)
+            alpha, _ = backtrack_line_search(f, x, d, v, g, self.max_ls,
+                                             initial_step=1.0)
+            if alpha == 0.0:
+                # fall back to steepest descent once, else stop
+                d = -g
+                step0 = 1.0 / max(1.0, float(jnp.linalg.norm(g)))
+                alpha, _ = backtrack_line_search(f, x, d, v, g, self.max_ls,
+                                                 initial_step=step0)
+                if alpha == 0.0:
+                    break
+                s_hist.clear()
+                y_hist.clear()
+            x_new = x + alpha * d
+            v_new, g_new = vg(x_new)
+            v_new = float(v_new)
+            s, y = x_new - x, g_new - g
+            if float(s @ y) > 1e-10:  # curvature condition
+                s_hist.append(s)
+                y_hist.append(y)
+                if len(s_hist) > m:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            x, v, g = x_new, v_new, g_new
+        self._commit(x, v)
+        return v
+
+    @staticmethod
+    def _lbfgs_direction(g, s_hist, y_hist):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(s_hist), reversed(y_hist)):
+            rho_i = 1.0 / float(y @ s)
+            a = rho_i * float(s @ q)
+            alphas.append((a, rho_i))
+            q = q - a * y
+        if s_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            gamma = float(s @ y) / max(float(y @ y), 1e-30)
+            q = gamma * q
+        for (a, rho_i), s, y in zip(reversed(alphas), s_hist, y_hist):
+            b = rho_i * float(y @ q)
+            q = q + (a - b) * s
+        return q
+
+    def _commit(self, x, v):
+        self.net.set_params(np.asarray(x))
+        self.net.score_value = v

@@ -1,0 +1,99 @@
+"""Line-search solver tests (reference analogues: `BackTrackLineSearchTest`,
+`TestOptimizers.java` — each OptimizationAlgorithm converges on a small
+problem)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.solvers import Solver, backtrack_line_search
+
+
+def blobs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[0, 0, 2, 2], [2, 2, 0, 0], [-2, 2, -2, 2]], np.float32)
+    X = np.concatenate([centers[c] + 0.3 * rng.normal(size=(n // 3, 4))
+                        for c in range(3)]).astype(np.float32)
+    y = np.concatenate([np.full(n // 3, c) for c in range(3)])
+    return DataSet(X, np.eye(3, dtype=np.float32)[y])
+
+
+def make_net(algo, iterations=15):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345).updater(Updater.NONE).learning_rate(0.1)
+            .optimization_algo(algo).iterations(iterations)
+            .activation(Activation.TANH)
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def test_backtrack_line_search_finds_descent_step():
+    f = lambda x: jnp.sum((x - 1.0) ** 2)
+    x = jnp.zeros(3)
+    g = jax.grad(f)(x)
+    step, v = backtrack_line_search(f, x, -g, float(f(x)), g, max_iterations=10)
+    assert step > 0
+    assert v < float(f(x))
+
+
+def test_backtrack_line_search_rejects_ascent_direction():
+    f = lambda x: jnp.sum(x ** 2)
+    x = jnp.ones(3)
+    g = jax.grad(f)(x)
+    step, v = backtrack_line_search(f, x, +g, float(f(x)), g)  # ascent dir
+    assert step == 0.0
+
+
+@pytest.mark.parametrize("algo", [
+    OptimizationAlgorithm.LINE_GRADIENT_DESCENT,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT,
+    OptimizationAlgorithm.LBFGS,
+])
+def test_solver_reduces_score(algo):
+    net = make_net(algo)
+    ds = blobs()
+    before = net.score(ds)
+    final = Solver(net).optimize(ds, iterations=15)
+    assert final < before * 0.7, f"{algo}: {before} -> {final}"
+    assert abs(net.score(ds) - final) < 1e-5  # params actually committed
+
+
+def test_lbfgs_beats_line_gd_iteration_for_iteration():
+    ds = blobs()
+    net_gd = make_net(OptimizationAlgorithm.LINE_GRADIENT_DESCENT)
+    net_lb = make_net(OptimizationAlgorithm.LBFGS)
+    f_gd = Solver(net_gd).optimize(ds, iterations=20)
+    f_lb = Solver(net_lb).optimize(ds, iterations=20)
+    assert f_lb <= f_gd * 1.05  # second-order info shouldn't lose badly
+
+
+def test_fit_dispatches_to_solver():
+    """MultiLayerNetwork.fit with a line-search algo trains via Solver."""
+    net = make_net(OptimizationAlgorithm.LBFGS, iterations=10)
+    ds = blobs()
+    before = net.score(ds)
+    net.fit(ListDataSetIterator([ds]), epochs=3)
+    assert net.score_value < before * 0.5
+    assert net.iteration == 3
+    ev = net.evaluate(ListDataSetIterator([ds]))
+    assert ev.accuracy() > 0.9
