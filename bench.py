@@ -1,61 +1,122 @@
-"""Benchmark: LeNet/MNIST training throughput (samples/sec/chip).
+"""Benchmark: training throughput (samples/sec/chip).
 
-BASELINE.md metric: MNIST-LeNet samples/sec/chip (the reference publishes no
-numbers — `BASELINE.json "published": {}` — so vs_baseline is reported
-against the first recorded run of this framework, stored in
+BASELINE.md metric: MNIST-LeNet + ResNet50 samples/sec/chip (the reference
+publishes no numbers — `BASELINE.json "published": {}` — so vs_baseline is
+reported against the first recorded run of this framework, stored in
 `.bench_baseline.json`).
 
-Prints ONE JSON line:
+Usage: `python bench.py [lenet|resnet50|lstm]` (default: lenet — the
+driver-run config). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
 from pathlib import Path
 
 import numpy as np
 
 
-def main() -> None:
+def _throughput(net, batches, warmup, bench):
     import jax
 
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    net.fit(ListDataSetIterator(batches[:warmup]))
+    jax.block_until_ready(net._params)
+    t0 = time.perf_counter()
+    net.fit(ListDataSetIterator(batches[warmup:warmup + bench]))
+    jax.block_until_ready(net._params)
+    return time.perf_counter() - t0
+
+
+def bench_lenet():
     from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
     from deeplearning4j_tpu.models.lenet import lenet_configuration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    batch_size = 512
-    warmup_batches = 5
-    bench_batches = 30
-
+    batch_size, warmup, bench = 512, 5, 30
     net = MultiLayerNetwork(lenet_configuration())
     net.init()
+    it = MnistDataSetIterator(batch_size, num_examples=batch_size * (warmup + bench))
+    dt = _throughput(net, list(it), warmup, bench)
+    return "lenet_mnist_train_samples_per_sec_per_chip", bench * batch_size / dt
 
-    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 
-    it = MnistDataSetIterator(batch_size, num_examples=batch_size * (warmup_batches + bench_batches))
-    batches = list(it)
+def bench_resnet50():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import resnet_configuration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    # warmup (compile)
-    net.fit(ListDataSetIterator(batches[:warmup_batches]))
-    jax.block_until_ready(net._params)
+    batch_size, warmup, bench = 256, 3, 10
+    net = ComputationGraph(resnet_configuration(depth=50, n_classes=10))
+    net.init()
+    rng = np.random.default_rng(0)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch_size)]
+    batches = [DataSet(rng.normal(size=(batch_size, 32, 32, 3)).astype(np.float32), y)
+               for _ in range(warmup + bench)]
+    dt = _throughput(net, batches, warmup, bench)
+    return "resnet50_cifar10_train_samples_per_sec_per_chip", bench * batch_size / dt
 
-    t0 = time.perf_counter()
-    net.fit(ListDataSetIterator(batches[warmup_batches:warmup_batches + bench_batches]))
-    jax.block_until_ready(net._params)
-    dt = time.perf_counter() - t0
 
-    samples_per_sec = bench_batches * batch_size / dt
+def bench_lstm():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (
+        GravesLSTM,
+        InputType,
+        NeuralNetConfiguration,
+        RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    vocab, hidden, T, batch_size, warmup, bench = 64, 256, 64, 64, 3, 10
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1).updater(Updater.RMSPROP)
+            .list()
+            .layer(GravesLSTM(n_in=vocab, n_out=hidden, activation=Activation.TANH))
+            .layer(GravesLSTM(n_out=hidden, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=vocab, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(vocab))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (warmup + bench, batch_size, T + 1))
+    eye = np.eye(vocab, dtype=np.float32)
+    batches = [DataSet(eye[ids[i, :, :-1]], eye[ids[i, :, 1:]])
+               for i in range(warmup + bench)]
+    dt = _throughput(net, batches, warmup, bench)
+    return "lstm_charrnn_train_samples_per_sec_per_chip", bench * batch_size / dt
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "lenet"
+    metric, samples_per_sec = {"lenet": bench_lenet,
+                               "resnet50": bench_resnet50,
+                               "lstm": bench_lstm}[which]()
 
     baseline_file = Path(__file__).parent / ".bench_baseline.json"
-    if baseline_file.exists():
-        baseline = json.loads(baseline_file.read_text())["value"]
-    else:
-        baseline = samples_per_sec
-        baseline_file.write_text(json.dumps({"value": samples_per_sec}))
+    baselines = (json.loads(baseline_file.read_text())
+                 if baseline_file.exists() else {})
+    if "value" in baselines:  # migrate pre-multi-config format (lenet only)
+        baselines = {"lenet_mnist_train_samples_per_sec_per_chip": baselines["value"]}
+    baseline = baselines.get(metric, samples_per_sec)
+    import jax
+
+    if metric not in baselines and jax.default_backend() != "cpu":
+        # only a real-chip run may set the recorded baseline; CPU smoke runs
+        # report vs_baseline=1.0 without persisting
+        baselines[metric] = samples_per_sec
+        baseline_file.write_text(json.dumps(baselines))
 
     print(json.dumps({
-        "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(samples_per_sec / baseline, 3),
