@@ -96,22 +96,25 @@ def bench_lstm():
 
 
 def main() -> None:
+    configs = {"lenet": bench_lenet, "resnet50": bench_resnet50,
+               "lstm": bench_lstm}
     which = sys.argv[1] if len(sys.argv) > 1 else "lenet"
-    metric, samples_per_sec = {"lenet": bench_lenet,
-                               "resnet50": bench_resnet50,
-                               "lstm": bench_lstm}[which]()
+    if which not in configs:
+        sys.exit(f"unknown bench config {which!r}; choose from {sorted(configs)}")
+    metric, samples_per_sec = configs[which]()
 
     baseline_file = Path(__file__).parent / ".bench_baseline.json"
     baselines = (json.loads(baseline_file.read_text())
                  if baseline_file.exists() else {})
     if "value" in baselines:  # migrate pre-multi-config format (lenet only)
         baselines = {"lenet_mnist_train_samples_per_sec_per_chip": baselines["value"]}
-    baseline = baselines.get(metric, samples_per_sec)
     import jax
 
-    if metric not in baselines and jax.default_backend() != "cpu":
-        # only a real-chip run may set the recorded baseline; CPU smoke runs
-        # report vs_baseline=1.0 without persisting
+    on_chip = jax.default_backend() != "cpu"
+    # baselines are chip numbers: only a real-chip run may set or be compared
+    # against one; CPU smoke runs report vs_baseline=1.0
+    baseline = baselines.get(metric, samples_per_sec) if on_chip else samples_per_sec
+    if metric not in baselines and on_chip:
         baselines[metric] = samples_per_sec
         baseline_file.write_text(json.dumps(baselines))
 

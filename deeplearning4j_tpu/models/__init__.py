@@ -2,3 +2,7 @@
 (BASELINE.md configs: LeNet/MNIST, ResNet-50/CIFAR, char-RNN LSTM)."""
 
 from deeplearning4j_tpu.models.lenet import lenet_configuration  # noqa: F401
+from deeplearning4j_tpu.models.resnet import (  # noqa: F401
+    resnet_configuration,
+    resnet_tiny_configuration,
+)

@@ -58,45 +58,34 @@ def _conv_bn(b, name: str, inp: str, n_out: int, kernel: Tuple[int, int],
     return f"{name}_bn"
 
 
-def _basic_block(b, name: str, inp: str, filters: int, stride: int) -> str:
+def _basic_block(b, name: str, inp: str, in_ch: int, filters: int,
+                 stride: int) -> Tuple[str, int]:
     x = _conv_bn(b, f"{name}_a", inp, filters, (3, 3), (stride, stride), relu=True)
     x = _conv_bn(b, f"{name}_b", x, filters, (3, 3), (1, 1), relu=False)
     shortcut = inp
-    if stride != 1 or _needs_projection(b, inp, filters):
+    if stride != 1 or in_ch != filters:
         shortcut = _conv_bn(b, f"{name}_proj", inp, filters, (1, 1),
                             (stride, stride), relu=False)
     b.add_vertex(f"{name}_add", ElementWiseVertex(), x, shortcut)
     b.add_layer(f"{name}_relu", ActivationLayer(activation=Activation.RELU),
                 f"{name}_add")
-    return f"{name}_relu"
+    return f"{name}_relu", filters
 
 
-def _bottleneck_block(b, name: str, inp: str, filters: int, stride: int) -> str:
+def _bottleneck_block(b, name: str, inp: str, in_ch: int, filters: int,
+                      stride: int) -> Tuple[str, int]:
     out_ch = filters * 4
     x = _conv_bn(b, f"{name}_a", inp, filters, (1, 1), (1, 1), relu=True)
     x = _conv_bn(b, f"{name}_b", x, filters, (3, 3), (stride, stride), relu=True)
     x = _conv_bn(b, f"{name}_c", x, out_ch, (1, 1), (1, 1), relu=False)
     shortcut = inp
-    if stride != 1 or _needs_projection(b, inp, out_ch):
+    if stride != 1 or in_ch != out_ch:
         shortcut = _conv_bn(b, f"{name}_proj", inp, out_ch, (1, 1),
                             (stride, stride), relu=False)
     b.add_vertex(f"{name}_add", ElementWiseVertex(), x, shortcut)
     b.add_layer(f"{name}_relu", ActivationLayer(activation=Activation.RELU),
                 f"{name}_add")
-    return f"{name}_relu"
-
-
-def _needs_projection(b, inp: str, out_ch: int) -> bool:
-    """True when the incoming channel count differs from the block output
-    (first unit of each stage)."""
-    node = b._nodes.get(inp)
-    while node is not None:
-        layer = node.layer
-        if isinstance(layer, ConvolutionLayer):
-            return layer.n_out != out_ch
-        inp = node.inputs[0]
-        node = b._nodes.get(inp)
-    return True  # stem input
+    return f"{name}_relu", out_ch
 
 
 def resnet_configuration(depth: int = 50, n_classes: int = 10,
@@ -113,6 +102,9 @@ def resnet_configuration(depth: int = 50, n_classes: int = 10,
     if depth not in _DEPTHS:
         raise ValueError(f"unsupported resnet depth {depth}; choose from {sorted(_DEPTHS)}")
     kind, units = _DEPTHS[depth]
+    if len(stage_filters) != len(units):
+        raise ValueError(f"stage_filters must have {len(units)} entries, "
+                         f"got {len(stage_filters)}")
     block = _basic_block if kind == "basic" else _bottleneck_block
 
     b = (NeuralNetConfiguration.Builder()
@@ -136,10 +128,11 @@ def resnet_configuration(depth: int = 50, n_classes: int = 10,
                     x)
         x = "stem_pool"
 
+    ch = stage_filters[0]
     for stage, (n_units, filters) in enumerate(zip(units, stage_filters)):
         for unit in range(n_units):
             stride = 2 if (unit == 0 and stage > 0) else 1
-            x = block(b, f"s{stage}u{unit}", x, filters, stride)
+            x, ch = block(b, f"s{stage}u{unit}", x, ch, filters, stride)
 
     b.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
     b.add_layer("out", OutputLayer(n_out=n_classes, loss=LossFunction.MCXENT,
@@ -164,8 +157,8 @@ def resnet_tiny_configuration(n_classes: int = 10, height: int = 8,
          .graph_builder()
          .add_inputs("in"))
     x = _conv_bn(b, "stem", "in", 8, (3, 3), (1, 1), relu=True)
-    x = _basic_block(b, "s0u0", x, 8, 1)
-    x = _basic_block(b, "s1u0", x, 16, 2)
+    x, ch = _basic_block(b, "s0u0", x, 8, 8, 1)
+    x, ch = _basic_block(b, "s1u0", x, ch, 16, 2)
     b.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
     b.add_layer("out", OutputLayer(n_out=n_classes, loss=LossFunction.MCXENT,
                                    activation=Activation.SOFTMAX,
