@@ -1,0 +1,28 @@
+"""NLP: embedding models + text pipeline (reference
+`deeplearning4j-nlp-parent/`, §2.5 of SURVEY.md).
+
+Host/device split (TPU-first): tokenization, vocab construction, Huffman
+coding, and training-pair generation are host-side (pure Python/numpy, like
+the reference's producer threads `SequenceVectors.java:246-260`); the
+skip-gram/CBOW/GloVe inner loops — the reference's native `AggregateSkipGram`
+/ `AggregateCBOW` C++ ops (`SkipGram.java:258`) — are single jitted XLA
+computations over large batched pair arrays with scatter-add parameter
+updates, so the MXU/VPU sees one big segment of work per batch instead of
+per-word JNI calls.
+"""
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
+    BasicLineIterator,
+    CollectionSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor, VocabWord  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer  # noqa: F401
+from deeplearning4j_tpu.nlp.bagofwords import BagOfWordsVectorizer, TfidfVectorizer  # noqa: F401

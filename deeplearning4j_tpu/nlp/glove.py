@@ -1,0 +1,111 @@
+"""GloVe: co-occurrence counting + AdaGrad factorization (reference
+`models/glove/Glove.java` (438 LoC) and the co-occurrence pipeline
+`models/glove/count/` — the spill-file machinery is replaced by an in-memory
+dict; the AdaGrad inner loop is the jitted `glove_step` scatter kernel)."""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.kernels import glove_step
+from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+class Glove:
+    def __init__(self,
+                 layer_size: int = 100,
+                 window: int = 5,
+                 min_word_frequency: float = 1.0,
+                 learning_rate: float = 0.05,
+                 epochs: int = 25,
+                 batch_size: int = 4096,
+                 x_max: float = 100.0,
+                 alpha: float = 0.75,
+                 symmetric: bool = True,
+                 seed: int = 42):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.seed = seed
+        self.vocab: Optional[AbstractCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self.mean_loss = 0.0
+
+    def fit(self, sequences: Iterable[Sequence[str]]) -> None:
+        seqs = [list(s) for s in sequences]
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(seqs)
+        V, D = self.vocab.num_words(), self.layer_size
+
+        # ---- co-occurrence counting (host; reference glove/count/) --------
+        cooc: Dict[Tuple[int, int], float] = {}
+        for seq in seqs:
+            ids = [self.vocab.index_of(t) for t in seq]
+            ids = [i for i in ids if i >= 0]
+            for pos, wi in enumerate(ids):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(ids):
+                        break
+                    w = 1.0 / off  # distance weighting, as in GloVe
+                    cooc[(wi, ids[j])] = cooc.get((wi, ids[j]), 0.0) + w
+                    if self.symmetric:
+                        cooc[(ids[j], wi)] = cooc.get((ids[j], wi), 0.0) + w
+
+        if not cooc:
+            raise ValueError("empty co-occurrence matrix (corpus too small?)")
+        rows = np.array([k[0] for k in cooc], np.int32)
+        cols = np.array([k[1] for k in cooc], np.int32)
+        logX = np.log(np.array(list(cooc.values()), np.float32))
+        fX = np.minimum(
+            (np.array(list(cooc.values()), np.float32) / self.x_max) ** self.alpha,
+            1.0)
+
+        # ---- AdaGrad factorization (device) -------------------------------
+        rng = np.random.default_rng(self.seed)
+        def init(shape):
+            return jnp.asarray((rng.random(shape) - 0.5) / D, jnp.float32)
+
+        W, Wc = init((V, D)), init((V, D))
+        b, bc = jnp.zeros(V, jnp.float32), jnp.zeros(V, jnp.float32)
+        hW, hWc = jnp.ones((V, D), jnp.float32), jnp.ones((V, D), jnp.float32)
+        hb, hbc = jnp.ones(V, jnp.float32), jnp.ones(V, jnp.float32)
+
+        n = len(rows)
+        B = min(self.batch_size, n)
+        lr = jnp.float32(self.learning_rate)
+        epoch_losses = []
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            epoch_losses = []
+            for s in range(0, n - B + 1, B):  # drop ragged tail (reshuffled next epoch)
+                idx = order[s:s + B]
+                W, b, hW, hb, Wc, bc, hWc, hbc, loss = glove_step(
+                    W, b, hW, hb, Wc, bc, hWc, hbc,
+                    jnp.asarray(rows[idx]), jnp.asarray(cols[idx]),
+                    jnp.asarray(logX[idx]), jnp.asarray(fX[idx]), lr)
+                epoch_losses.append(float(loss))
+        # mean objective over the final epoch's batches
+        self.mean_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
+
+        # final embedding = W + Wc (standard GloVe practice)
+        self.lookup_table = InMemoryLookupTable(self.vocab, D, seed=self.seed)
+        self.lookup_table.syn0 = W + Wc
+
+    # -- query passthrough --------------------------------------------------
+    def words_nearest(self, word, top_n: int = 10):
+        return self.lookup_table.words_nearest(word, top_n)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        return self.lookup_table.similarity(w1, w2)
+
+    def get_word_vector(self, word: str):
+        return self.lookup_table.vector(word)

@@ -1,0 +1,143 @@
+"""Jitted embedding-training kernels — the TPU replacement for the
+reference's native `AggregateSkipGram` / `AggregateCBOW` ops
+(`models/embeddings/learning/impl/elements/SkipGram.java:258`,
+`CBOW.java`; C++ in external libnd4j).
+
+Where the reference updates one word pair per native call inside Java
+producer threads, each function here consumes a BATCH of pairs as dense
+int32 arrays and applies all updates with XLA scatter-adds in one compiled
+computation (buffers donated, params stay in HBM). Negative sampling and
+hierarchical softmax share the same kernel shape: a (B, K) target matrix
+with per-target binary labels and a validity mask.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+_ROW_CLIP = 1.0  # max L2 norm of one row's aggregated per-batch update
+
+
+def _scatter_clipped(table, idx, upd):
+    """table[idx] += upd with the AGGREGATE per-row update clipped to
+    `_ROW_CLIP`. A batch may hit one row hundreds of times (tiny vocabs,
+    stop words); plain summed scatter then applies an effective lr of
+    lr×count, which diverges. Clipping the aggregate keeps faithful
+    minibatch-SGD semantics in the normal regime (update norms ≪ 1) while
+    bounding the pathological one.
+
+    Cost is bounded by the BATCH (sort + compact segment-sum), not the
+    table: duplicate indices are grouped by sort, aggregated into a
+    batch-sized buffer, clipped, and written back once per unique row."""
+    flat_idx = idx.reshape(-1)
+    flat_upd = upd.reshape(-1, upd.shape[-1])
+    order = jnp.argsort(flat_idx)
+    si = flat_idx[order]
+    su = flat_upd[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), si[1:] != si[:-1]])
+    ranks = jnp.cumsum(first) - 1                      # compact segment ids
+    agg = jnp.zeros_like(su).at[ranks].add(su)         # (B·K, D) compact
+    norms = jnp.linalg.norm(agg, axis=-1, keepdims=True)
+    scale = jnp.minimum(1.0, _ROW_CLIP / jnp.maximum(norms, 1e-12))
+    contrib = agg[ranks] * scale[ranks] * first[:, None]
+    return table.at[si].add(contrib)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_step(syn0, syn1, center, targets, labels, mask, lr):
+    """One batched skip-gram update (negative sampling OR hierarchical
+    softmax — the label/target semantics differ, the math is identical).
+
+    syn0: (V, D) input vectors; syn1: (V', D) output weights
+    center (B,) int32; targets (B, K) int32 rows of syn1
+    labels (B, K) float 1/0; mask (B, K) float validity
+    """
+    v = syn0[center]                                   # (B, D)
+    u = syn1[targets]                                  # (B, K, D)
+    logits = jnp.einsum("bd,bkd->bk", v, u)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * mask * lr                       # (B, K)
+    dv = jnp.einsum("bk,bkd->bd", g, u)                # (B, D)
+    du = g[..., None] * v[:, None, :]                  # (B, K, D)
+    syn0 = _scatter_clipped(syn0, center, dv)
+    syn1 = _scatter_clipped(syn1, targets, du)
+    ll = jnp.where(labels > 0, jax.nn.log_sigmoid(logits),
+                   jax.nn.log_sigmoid(-logits))
+    loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return syn0, syn1, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_step(syn0, syn1, context, cmask, targets, labels, tmask, lr):
+    """One batched CBOW update: mean of context vectors predicts targets.
+
+    context (B, W) int32 padded context windows; cmask (B, W) validity
+    targets/labels/tmask as in skipgram_step
+    """
+    cm = cmask[..., None]
+    cv = syn0[context] * cm                            # (B, W, D)
+    denom = jnp.maximum(jnp.sum(cmask, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(cv, axis=1) / denom                    # (B, D)
+    u = syn1[targets]
+    logits = jnp.einsum("bd,bkd->bk", h, u)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * tmask * lr
+    dh = jnp.einsum("bk,bkd->bd", g, u)                # (B, D)
+    du = g[..., None] * h[:, None, :]
+    # word2vec.c adds the FULL hidden error to every context word; the
+    # exact mean-pool gradient is 1/|ctx| of that, which batches better
+    dctx = jnp.broadcast_to(dh[:, None, :], cv.shape) * cm / denom[..., None]
+    syn0 = _scatter_clipped(syn0, context, dctx)
+    syn1 = _scatter_clipped(syn1, targets, du)
+    ll = jnp.where(labels > 0, jax.nn.log_sigmoid(logits),
+                   jax.nn.log_sigmoid(-logits))
+    loss = -jnp.sum(ll * tmask) / jnp.maximum(jnp.sum(tmask), 1.0)
+    return syn0, syn1, loss
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def infer_step(vec, syn1, targets, labels, mask, lr):
+    """ParagraphVectors inference: update ONLY the inferred doc vector
+    against frozen output weights (reference
+    `ParagraphVectors.inferVector`)."""
+    u = syn1[targets]                                  # (B, K, D)
+    logits = jnp.einsum("d,bkd->bk", vec, u)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * mask * lr
+    dv = jnp.einsum("bk,bkd->d", g, u)
+    ll = jnp.where(labels > 0, jax.nn.log_sigmoid(logits),
+                   jax.nn.log_sigmoid(-logits))
+    loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return vec + dv, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def glove_step(W, b, hW, hb, Wc, bc, hWc, hbc, rows, cols, logX, fX, lr):
+    """One batched GloVe AdaGrad update (reference `models/glove/Glove.java`
+    + external `nd4j` AdaGrad; co-occurrence factorization
+    J = Σ f(X) (w_i·w̃_j + b_i + b̃_j − log X)²).
+
+    W/b + history hW/hb: main vectors; Wc/bc + hWc/hbc: context vectors.
+    rows/cols (B,) int32; logX/fX (B,) float.
+    """
+    wi, wj = W[rows], Wc[cols]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - logX
+    wdiff = fX * diff                                   # (B,)
+    gWi = wdiff[:, None] * wj
+    gWj = wdiff[:, None] * wi
+    gb = wdiff
+
+    hW = hW.at[rows].add(gWi ** 2)
+    hWc = hWc.at[cols].add(gWj ** 2)
+    hb = hb.at[rows].add(gb ** 2)
+    hbc = hbc.at[cols].add(gb ** 2)
+    eps = 1e-8
+    W = W.at[rows].add(-lr * gWi / jnp.sqrt(hW[rows] + eps))
+    Wc = Wc.at[cols].add(-lr * gWj / jnp.sqrt(hWc[cols] + eps))
+    b = b.at[rows].add(-lr * gb / jnp.sqrt(hb[rows] + eps))
+    bc = bc.at[cols].add(-lr * gb / jnp.sqrt(hbc[cols] + eps))
+    loss = 0.5 * jnp.mean(fX * diff ** 2)
+    return W, b, hW, hb, Wc, bc, hWc, hbc, loss

@@ -1,0 +1,86 @@
+"""Weight storage + nearest-neighbor query surface (reference
+`models/embeddings/inmemory/InMemoryLookupTable.java` — syn0/syn1/syn1Neg —
+and the `WordVectors` query interface
+`models/embeddings/wordvectors/WordVectorsImpl.java`)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+
+class InMemoryLookupTable:
+    """syn0 (input vectors), syn1 (HS weights), syn1neg (negative-sampling
+    weights) as device arrays; all training kernels mutate them via donated
+    jit buffers."""
+
+    def __init__(self, cache: AbstractCache, vector_length: int,
+                 seed: int = 42, use_hs: bool = False, negative: int = 0,
+                 dtype=jnp.float32):
+        self.vocab = cache
+        self.vector_length = vector_length
+        n = cache.num_words()
+        rng = np.random.default_rng(seed)
+        # word2vec init: U(-0.5, 0.5)/D
+        self.syn0 = jnp.asarray(
+            (rng.random((n, vector_length)) - 0.5) / vector_length, dtype)
+        self.syn1 = (jnp.zeros((max(n - 1, 1), vector_length), dtype)
+                     if use_hs else None)
+        self.syn1neg = (jnp.zeros((n, vector_length), dtype)
+                        if negative > 0 else None)
+
+    # -- query surface ------------------------------------------------------
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return None
+        return np.asarray(self.syn0[i])
+
+    def put_vector(self, word: str, vec: np.ndarray) -> None:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            raise KeyError(word)
+        self.syn0 = self.syn0.at[i].set(jnp.asarray(vec, self.syn0.dtype))
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.vector(w1), self.vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def words_nearest(self, word_or_vec, top_n: int = 10,
+                      exclude: Sequence[str] = ()) -> List[Tuple[str, float]]:
+        """Cosine top-N over the whole vocab — one device matmul (the
+        reference's `wordsNearest` loops in Java; here it is a single
+        (V, D) @ (D,) on the MXU)."""
+        if isinstance(word_or_vec, str):
+            v = self.vector(word_or_vec)
+            if v is None:
+                return []
+            exclude = tuple(exclude) + (word_or_vec,)
+        else:
+            v = np.asarray(word_or_vec)
+        sims = np.asarray(_cosine_scores(self.syn0, jnp.asarray(v, self.syn0.dtype)))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w in exclude:
+                continue
+            out.append((w, float(sims[i])))
+            if len(out) >= top_n:
+                break
+        return out
+
+
+@jax.jit
+def _cosine_scores(syn0, v):
+    norms = jnp.linalg.norm(syn0, axis=1) * jnp.maximum(jnp.linalg.norm(v), 1e-12)
+    return syn0 @ v / jnp.maximum(norms, 1e-12)

@@ -1,0 +1,285 @@
+"""SequenceVectors: the generic embedding-training engine (reference
+`models/sequencevectors/SequenceVectors.java:50`, `fit():161`; learning
+algorithms SPI `models/embeddings/learning/` — `SkipGram.java`, `CBOW.java`).
+
+TPU-first pipeline: the host walks sequences, applies subsampling and the
+shrinking window, and packs (center, targets, labels, mask) int32 batches;
+every full batch is one donated-buffer jitted scatter step
+(`nlp/kernels.py`). Learning rate decays linearly with words processed, as
+in the reference (`SequenceVectors.java:260` alpha handling).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import kernels
+from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import (
+    AbstractCache,
+    VocabConstructor,
+    build_huffman_tree,
+)
+
+
+class SequenceVectors:
+    """Train element embeddings over sequences of tokens.
+
+    elements_learning_algorithm: 'skipgram' | 'cbow'
+    (reference `ElementsLearningAlgorithm` SPI).
+    """
+
+    def __init__(self,
+                 layer_size: int = 100,
+                 window: int = 5,
+                 min_word_frequency: float = 1.0,
+                 negative: int = 5,
+                 use_hierarchic_softmax: bool = False,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 epochs: int = 1,
+                 iterations: int = 1,
+                 batch_size: int = 1024,
+                 sampling: float = 0.0,
+                 seed: int = 42,
+                 elements_learning_algorithm: str = "skipgram"):
+        if negative <= 0 and not use_hierarchic_softmax:
+            raise ValueError("need negative sampling (negative>0) and/or "
+                             "hierarchical softmax")
+        if negative > 0 and use_hierarchic_softmax and \
+                elements_learning_algorithm == "cbow":
+            raise NotImplementedError(
+                "mixed HS+negative-sampling is only supported for skipgram")
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.iterations = iterations
+        self.batch_size = batch_size
+        self.sampling = sampling
+        self.seed = seed
+        self.algorithm = elements_learning_algorithm
+        self.vocab: Optional[AbstractCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._rng = np.random.default_rng(seed)
+        self._unigram: Optional[np.ndarray] = None
+        self._loss_sum = 0.0
+        self._loss_batches = 0
+
+    # -- vocab/init ---------------------------------------------------------
+    def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(sequences)
+        if self.use_hs:
+            build_huffman_tree(self.vocab)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, seed=self.seed, use_hs=self.use_hs,
+            negative=self.negative)
+        if self.negative > 0:
+            self._unigram = self.vocab.unigram_table()
+
+    # -- training -----------------------------------------------------------
+    def fit(self, sequences: Iterable[Sequence[str]]) -> None:
+        seqs = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        total_words = max(
+            1.0, self.vocab.total_word_occurrences * self.epochs * self.iterations)
+        words_seen = 0.0
+        self._loss_sum, self._loss_batches = 0.0, 0
+        batch = _PairBatcher(self)
+        for _ in range(self.epochs * self.iterations):
+            for seq in seqs:
+                ids = self._to_ids(seq)
+                if len(ids) < 2:
+                    continue
+                alpha = max(self.min_learning_rate,
+                            self.learning_rate * (1.0 - words_seen / total_words))
+                self._train_sequence(ids, alpha, batch)
+                words_seen += len(ids)
+        batch.flush()
+
+    def _to_ids(self, seq: Sequence[str]) -> List[int]:
+        ids = []
+        for tok in seq:
+            i = self.vocab.index_of(tok)
+            if i < 0:
+                continue
+            if self.sampling > 0:
+                # word2vec subsampling: P(keep) = sqrt(t/f) + t/f
+                f = (self.vocab.element_at_index(i).count
+                     / self.vocab.total_word_occurrences)
+                keep = min(1.0, np.sqrt(self.sampling / f) + self.sampling / f)
+                if self._rng.random() > keep:
+                    continue
+            ids.append(i)
+        return ids
+
+    def _train_sequence(self, ids: List[int], alpha: float, batch: "_PairBatcher"):
+        window = self.window
+        for pos, center in enumerate(ids):
+            b = int(self._rng.integers(1, window + 1))  # shrinking window
+            lo, hi = max(0, pos - b), min(len(ids), pos + b + 1)
+            context = [ids[j] for j in range(lo, hi) if j != pos]
+            if not context:
+                continue
+            if self.algorithm == "skipgram":
+                for c in context:
+                    batch.add_pair(center, c, alpha)
+            elif self.algorithm == "cbow":
+                batch.add_cbow(context, center, alpha)
+            else:
+                raise ValueError(self.algorithm)
+
+    # hooks used by _PairBatcher ------------------------------------------
+    def _sample_negatives(self, n: int) -> np.ndarray:
+        return self._rng.choice(len(self._unigram), size=n, p=self._unigram)
+
+    def _record_loss(self, loss: float) -> None:
+        self._loss_sum += loss
+        self._loss_batches += 1
+
+    @property
+    def mean_loss(self) -> float:
+        return self._loss_sum / max(self._loss_batches, 1)
+
+    # -- query passthrough --------------------------------------------------
+    def words_nearest(self, word, top_n: int = 10):
+        return self.lookup_table.words_nearest(word, top_n)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        return self.lookup_table.similarity(w1, w2)
+
+    def get_word_vector(self, word: str):
+        return self.lookup_table.vector(word)
+
+
+class _PairBatcher:
+    """Accumulates training examples into fixed-shape arrays and flushes
+    them through the jitted kernels (fixed batch shape ⇒ one XLA
+    compilation; the tail batch is mask-padded)."""
+
+    def __init__(self, sv: SequenceVectors):
+        self.sv = sv
+        B = sv.batch_size
+        # target row count: negatives+1 (NS) and/or max code length (HS)
+        self._max_codes = 0
+        if sv.use_hs:
+            self._max_codes = max((len(vw.codes)
+                                   for vw in sv.vocab.vocab_words()), default=0)
+        self.K = (sv.negative + 1 if sv.negative > 0 else 0) + self._max_codes
+        self.W = 2 * sv.window
+        self.center = np.zeros(B, np.int32)
+        self.targets = np.zeros((B, self.K), np.int32)
+        self.labels = np.zeros((B, self.K), np.float32)
+        self.mask = np.zeros((B, self.K), np.float32)
+        self.context = np.zeros((B, self.W), np.int32)
+        self.cmask = np.zeros((B, self.W), np.float32)
+        self.alpha = 0.025
+        self.n = 0
+
+    def _fill_targets(self, row: int, predicted: int):
+        """Targets for predicting word id `predicted`: NS = [pos, negs];
+        HS = its Huffman path (labels = 1 - code)."""
+        sv = self.sv
+        k = 0
+        if sv.negative > 0:
+            self.targets[row, 0] = predicted
+            self.labels[row, 0] = 1.0
+            self.mask[row, 0] = 1.0
+            negs = sv._sample_negatives(sv.negative)
+            for ng in negs:
+                k += 1
+                self.targets[row, k] = ng
+                self.labels[row, k] = 0.0
+                # word2vec skips a negative that equals the positive
+                self.mask[row, k] = 0.0 if ng == predicted else 1.0
+            k += 1
+        if sv.use_hs:
+            vw = sv.vocab.element_at_index(predicted)
+            for code, point in zip(vw.codes, vw.points):
+                self.targets[row, k] = point
+                self.labels[row, k] = 1.0 - code
+                self.mask[row, k] = 1.0
+                k += 1
+
+    def add_pair(self, center: int, context: int, alpha: float):
+        """Skip-gram: center predicts context."""
+        row = self.n
+        self.center[row] = center
+        self.targets[row] = 0
+        self.labels[row] = 0
+        self.mask[row] = 0
+        self._fill_targets(row, context)
+        self.alpha = alpha
+        self.n += 1
+        if self.n == len(self.center):
+            self.flush()
+
+    def add_cbow(self, context: List[int], center: int, alpha: float):
+        row = self.n
+        self.context[row] = 0
+        self.cmask[row] = 0
+        w = min(len(context), self.W)
+        self.context[row, :w] = context[:w]
+        self.cmask[row, :w] = 1.0
+        self.targets[row] = 0
+        self.labels[row] = 0
+        self.mask[row] = 0
+        self._fill_targets(row, center)
+        self.alpha = alpha
+        self.n += 1
+        if self.n == len(self.center):
+            self.flush()
+
+    def flush(self):
+        if self.n == 0:
+            return
+        sv = self.sv
+        lt = sv.lookup_table
+        self.mask[self.n:] = 0.0
+        self.cmask[self.n:] = 0.0
+        lr = jnp.float32(self.alpha)
+        syn1 = lt.syn1neg if sv.negative > 0 else lt.syn1
+        if sv.use_hs and sv.negative > 0:
+            # mixed mode: split columns — NS rows live in syn1neg, HS rows
+            # in syn1; run two steps on the column slices
+            ns_cols = sv.negative + 1
+            lt.syn0, lt.syn1neg, loss1 = kernels.skipgram_step(
+                lt.syn0, lt.syn1neg, jnp.asarray(self.center),
+                jnp.asarray(self.targets[:, :ns_cols]),
+                jnp.asarray(self.labels[:, :ns_cols]),
+                jnp.asarray(self.mask[:, :ns_cols]), lr)
+            lt.syn0, lt.syn1, loss2 = kernels.skipgram_step(
+                lt.syn0, lt.syn1, jnp.asarray(self.center),
+                jnp.asarray(self.targets[:, ns_cols:]),
+                jnp.asarray(self.labels[:, ns_cols:]),
+                jnp.asarray(self.mask[:, ns_cols:]), lr)
+            sv._record_loss(float(loss1) + float(loss2))
+        elif sv.algorithm == "cbow":
+            lt.syn0, new_syn1, loss = kernels.cbow_step(
+                lt.syn0, syn1, jnp.asarray(self.context),
+                jnp.asarray(self.cmask), jnp.asarray(self.targets),
+                jnp.asarray(self.labels), jnp.asarray(self.mask), lr)
+            self._store_syn1(new_syn1)
+            sv._record_loss(float(loss))
+        else:
+            lt.syn0, new_syn1, loss = kernels.skipgram_step(
+                lt.syn0, syn1, jnp.asarray(self.center),
+                jnp.asarray(self.targets), jnp.asarray(self.labels),
+                jnp.asarray(self.mask), lr)
+            self._store_syn1(new_syn1)
+            sv._record_loss(float(loss))
+        self.n = 0
+
+    def _store_syn1(self, new_syn1):
+        lt = self.sv.lookup_table
+        if self.sv.negative > 0:
+            lt.syn1neg = new_syn1
+        else:
+            lt.syn1 = new_syn1

@@ -1,0 +1,84 @@
+"""Word-vector persistence (reference
+`models/embeddings/loader/WordVectorSerializer.java`): the classic word2vec
+text format (header 'V D', one word + vector per line) plus a binary npz
+round-trip that preserves counts."""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word_vectors(table: InMemoryLookupTable,
+                           path: Union[str, Path]) -> None:
+        """word2vec .txt format (`WordVectorSerializer.writeWordVectors`)."""
+        syn0 = np.asarray(table.syn0)[:table.vocab.num_words()]
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n")
+            for i in range(syn0.shape[0]):
+                vec = " ".join(f"{x:.6f}" for x in syn0[i])
+                f.write(f"{table.vocab.word_at_index(i)} {vec}\n")
+
+    @staticmethod
+    def read_word_vectors(path: Union[str, Path]) -> InMemoryLookupTable:
+        """Load word2vec .txt (`WordVectorSerializer.loadTxtVectors`)."""
+        words = []
+        with open(path, encoding="utf-8") as f:
+            header = f.readline().split()
+            n, d = int(header[0]), int(header[1])
+            cache = AbstractCache()
+            vecs = np.zeros((n, d), np.float32)
+            for i in range(n):
+                parts = f.readline().rstrip("\n").split(" ")
+                cache.add_token(VocabWord(parts[0], 1.0))
+                words.append(parts[0])
+                vecs[i] = [float(x) for x in parts[1:d + 1]]
+        # preserve file order (txt format has no counts)
+        cache._by_index = [cache.word_for(w) for w in words]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        cache.total_word_occurrences = float(n)
+        table = InMemoryLookupTable(cache, d)
+        table.syn0 = jnp.asarray(vecs)
+        return table
+
+    @staticmethod
+    def write_lookup_table(table: InMemoryLookupTable,
+                           path: Union[str, Path]) -> None:
+        """Binary npz with counts + output weights — the analogue of the
+        reference's full zip serde (`WordVectorSerializer.writeFullModel`)."""
+        vocab = table.vocab
+        np.savez_compressed(
+            path,
+            words=np.array(vocab.words(), dtype=object),
+            counts=np.array([vw.count for vw in vocab.vocab_words()], np.float64),
+            syn0=np.asarray(table.syn0),
+            syn1=(np.asarray(table.syn1) if table.syn1 is not None
+                  else np.zeros((0, 0), np.float32)),
+            syn1neg=(np.asarray(table.syn1neg) if table.syn1neg is not None
+                     else np.zeros((0, 0), np.float32)))
+
+    @staticmethod
+    def read_lookup_table(path: Union[str, Path]) -> InMemoryLookupTable:
+        z = np.load(path if str(path).endswith(".npz") else f"{path}.npz",
+                    allow_pickle=True)
+        cache = AbstractCache()
+        for w, c in zip(z["words"], z["counts"]):
+            cache.add_token(VocabWord(str(w), float(c)))
+        cache.update_indices()
+        d = z["syn0"].shape[1]
+        table = InMemoryLookupTable(cache, d)
+        # npz stores rows in the saved index order == sorted-by-count order
+        table.syn0 = jnp.asarray(z["syn0"])
+        if z["syn1"].size:
+            table.syn1 = jnp.asarray(z["syn1"])
+        if z["syn1neg"].size:
+            table.syn1neg = jnp.asarray(z["syn1neg"])
+        return table

@@ -1,0 +1,216 @@
+"""NLP tests (reference analogues: `deeplearning4j-nlp/src/test/...`
+Word2Vec nearest-neighbor sanity checks, tokenizer tests, serializer
+round-trips)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    SequenceVectors,
+    TfidfVectorizer,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.vocab import build_huffman_tree
+
+
+def _topic_corpus(n_sentences=300, seed=0):
+    """Two disjoint topic vocabularies — words inside a topic co-occur,
+    words across topics never do. Embeddings must reflect that."""
+    rng = np.random.default_rng(seed)
+    topics = [["cat", "dog", "pet", "fur", "paw", "tail"],
+              ["sun", "moon", "star", "sky", "orbit", "comet"]]
+    out = []
+    for _ in range(n_sentences):
+        words = topics[int(rng.integers(0, 2))]
+        out.append(list(rng.choice(words, size=8)))
+    return out
+
+
+# ---------------------------------------------------------------- tokenizers
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("The Quick, Brown FOX!! 123 jumps.").get_tokens()
+    assert toks == ["the", "quick", "brown", "fox", "jumps"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(min_n=1, max_n=2)
+    toks = tf.create("a b c").get_tokens()
+    assert toks == ["a", "b", "c", "a_b", "b_c"]
+
+
+# --------------------------------------------------------------------- vocab
+
+def test_vocab_constructor_min_frequency():
+    seqs = [["a", "a", "a", "b", "b", "c"]]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert "c" not in cache
+    assert cache.word_frequency("a") == 3
+    assert cache.index_of("a") == 0  # most frequent first
+
+
+def test_huffman_codes_prefix_free():
+    seqs = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+    cache = VocabConstructor().build_vocab(seqs)
+    build_huffman_tree(cache)
+    codes = {vw.word: "".join(map(str, vw.codes)) for vw in cache.vocab_words()}
+    # frequent words get shorter codes
+    assert len(codes["a"]) <= len(codes["d"])
+    # prefix-free
+    for w1, c1 in codes.items():
+        for w2, c2 in codes.items():
+            if w1 != w2:
+                assert not c2.startswith(c1)
+
+
+# ------------------------------------------------------------------ word2vec
+
+@pytest.mark.parametrize("mode", ["ns", "hs", "cbow"])
+def test_word2vec_topic_separation(mode):
+    corpus = _topic_corpus()
+    kwargs = dict(layer_size=24, window=3, epochs=3, batch_size=256,
+                  learning_rate=0.05, seed=7)
+    if mode == "ns":
+        w2v = Word2Vec(negative=5, **kwargs)
+    elif mode == "hs":
+        w2v = Word2Vec(negative=0, use_hierarchic_softmax=True, **kwargs)
+    else:
+        kwargs.update(epochs=10, learning_rate=0.1)  # cbow averages contexts — slower signal on a tiny corpus
+        w2v = Word2Vec(negative=5, elements_learning_algorithm="cbow", **kwargs)
+    w2v.fit(corpus)
+    # in-topic similarity must beat cross-topic
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "moon")
+    nearest = [w for w, _ in w2v.words_nearest("sun", 3)]
+    topic2 = {"moon", "star", "sky", "orbit", "comet"}
+    assert len(set(nearest) & topic2) >= 2
+
+
+def test_word2vec_from_sentence_iterator():
+    sentences = [" ".join(s) for s in _topic_corpus(100)]
+    w2v = Word2Vec(layer_size=16, window=3, epochs=2, negative=3,
+                   batch_size=128, seed=3)
+    w2v.fit(CollectionSentenceIterator(sentences))
+    assert w2v.get_word_vector("cat") is not None
+    assert w2v.mean_loss > 0
+
+
+# ------------------------------------------------------------------- doc2vec
+
+@pytest.mark.parametrize("algo", ["dbow", "dm"])
+def test_paragraph_vectors(algo):
+    rng = np.random.default_rng(1)
+    docs = []
+    for i in range(40):
+        topic = i % 2
+        words = (["cat", "dog", "pet", "fur", "paw", "tail"] if topic == 0
+                 else ["sun", "moon", "star", "sky", "orbit", "comet"])
+        docs.append((f"doc_{i}", list(rng.choice(words, size=12))))
+    # dm: the doc row is one of ~2w+1 mean-pooled context slots, so its
+    # per-word gradient is diluted — give it more passes
+    epochs = 5 if algo == "dbow" else 15
+    pv = ParagraphVectors(layer_size=24, window=3, epochs=epochs, negative=5,
+                          batch_size=256, learning_rate=0.05, seed=5,
+                          sequence_learning_algorithm=algo)
+    pv.fit(docs)
+    # doc vectors of same-topic docs are closer than cross-topic
+    v0, v2, v1 = pv.doc_vector("doc_0"), pv.doc_vector("doc_2"), pv.doc_vector("doc_1")
+    cos = lambda a, b: a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+    assert cos(v0, v2) > cos(v0, v1)
+    nearest = [l for l, _ in pv.docs_nearest("doc_0", 5)]
+    same_topic = sum(1 for l in nearest if int(l.split("_")[1]) % 2 == 0)
+    assert same_topic >= 3
+
+
+def test_paragraph_vectors_incremental_fit():
+    docs_a = [("a0", ["cat", "dog", "pet"] * 4), ("a1", ["sun", "moon", "sky"] * 4)]
+    pv = ParagraphVectors(layer_size=8, window=2, epochs=2, negative=3,
+                          batch_size=64, seed=5)
+    pv.fit(docs_a)
+    docs_b = [("b0", ["cat", "pet", "fur", "dog"] * 4)]
+    pv.fit(docs_b)  # new label appended, word vocab fixed
+    assert pv.doc_vector("b0") is not None
+    assert pv.doc_vector("a0") is not None
+
+
+def test_infer_vector_close_to_trained():
+    docs = [(f"doc_{i}", t) for i, t in enumerate(_topic_corpus(40))]
+    pv = ParagraphVectors(layer_size=24, window=3, epochs=5, negative=5,
+                          batch_size=256, learning_rate=0.05, seed=5)
+    pv.fit(docs)
+    inferred = pv.infer_vector(["cat", "dog", "pet", "fur", "paw", "tail"] * 2)
+    nearest = pv.docs_nearest(inferred, 5)
+    topics = [int(l.split("_")[1]) % 2 for l, _ in nearest]
+    # doc_0's corpus: topic assignment comes from _topic_corpus rng; just
+    # check the inferred vector lands near SOME docs with cat-topic words
+    cat_docs = {f"doc_{i}" for i, t in enumerate(_topic_corpus(40))
+                if "cat" in t or "dog" in t or "pet" in t}
+    assert sum(1 for l, _ in nearest if l in cat_docs) >= 3
+
+
+# --------------------------------------------------------------------- glove
+
+def test_glove_topic_separation():
+    corpus = _topic_corpus(200)
+    gl = Glove(layer_size=16, window=3, epochs=30, learning_rate=0.05,
+               batch_size=512, seed=11)
+    gl.fit(corpus)
+    assert gl.similarity("cat", "dog") > gl.similarity("cat", "moon")
+
+
+# ---------------------------------------------------------------- serializer
+
+def test_word_vector_txt_roundtrip(tmp_path):
+    corpus = _topic_corpus(50)
+    w2v = Word2Vec(layer_size=12, window=2, epochs=1, negative=3,
+                   batch_size=128, seed=3)
+    w2v.fit(corpus)
+    p = tmp_path / "vecs.txt"
+    WordVectorSerializer.write_word_vectors(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_word_vectors(p)
+    for w in ["cat", "sun"]:
+        np.testing.assert_allclose(table.vector(w), w2v.get_word_vector(w),
+                                   atol=1e-5)
+
+
+def test_lookup_table_npz_roundtrip(tmp_path):
+    corpus = _topic_corpus(50)
+    w2v = Word2Vec(layer_size=12, window=2, epochs=1, negative=3,
+                   batch_size=128, seed=3)
+    w2v.fit(corpus)
+    p = tmp_path / "table.npz"
+    WordVectorSerializer.write_lookup_table(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_lookup_table(p)
+    np.testing.assert_allclose(np.asarray(table.syn0),
+                               np.asarray(w2v.lookup_table.syn0), atol=1e-6)
+    assert table.vocab.word_frequency("cat") == w2v.vocab.word_frequency("cat")
+
+
+# ----------------------------------------------------------------- BoW/tfidf
+
+def test_bag_of_words():
+    docs = ["cat dog cat", "dog fish"]
+    v = BagOfWordsVectorizer()
+    X = v.fit_transform(docs)
+    assert X.shape == (2, 3)
+    i_cat = v.vocab.index_of("cat")
+    assert X[0, i_cat] == 2.0
+
+
+def test_tfidf():
+    docs = ["cat dog", "cat fish", "cat bird"]
+    v = TfidfVectorizer()
+    X = v.fit_transform(docs)
+    i_cat = v.vocab.index_of("cat")
+    i_dog = v.vocab.index_of("dog")
+    assert X[0, i_cat] == pytest.approx(0.0)  # appears in all docs → idf 0
+    assert X[0, i_dog] > 0
