@@ -1,0 +1,9 @@
+"""Graph embeddings (reference `deeplearning4j-graph/`, §2.6 of SURVEY.md):
+in-memory graph, random walks, DeepWalk skip-gram over walks."""
+from deeplearning4j_tpu.graph.graph import Graph, Vertex, Edge  # noqa: F401
+from deeplearning4j_tpu.graph.walks import (  # noqa: F401
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk  # noqa: F401
+from deeplearning4j_tpu.graph.serializer import GraphVectorSerializer  # noqa: F401

@@ -1,0 +1,75 @@
+"""Graph-embedding tests (reference analogues:
+`deeplearning4j-graph/src/test/.../TestGraph.java`, `DeepWalkTests`)."""
+import numpy as np
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    GraphVectorSerializer,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by a single bridge edge — embeddings must
+    separate the cliques."""
+    edges = []
+    for a in range(k):
+        for b in range(a + 1, k):
+            edges.append((a, b))
+            edges.append((k + a, k + b))
+    edges.append((0, k))  # bridge
+    return Graph.from_edge_list(edges, n_vertices=2 * k)
+
+
+def test_graph_basics():
+    g = Graph.from_edge_list([(0, 1), (1, 2)], n_vertices=4)
+    assert g.num_vertices() == 4
+    assert set(g.get_connected_vertices(1)) == {0, 2}
+    assert g.degree(3) == 0
+
+
+def test_random_walks_cover_length_and_vertices():
+    g = _two_cliques()
+    walks = list(RandomWalkIterator(g, walk_length=10, seed=1))
+    assert len(walks) == g.num_vertices()
+    assert all(len(w) == 10 for w in walks)
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertices(a) or a == b
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3, directed=True)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.001)
+    g.add_edge(1, 0, weight=1.0)
+    g.add_edge(2, 0, weight=1.0)
+    walks = list(WeightedRandomWalkIterator(g, walk_length=30, seed=2))
+    visits_1 = sum(w.count(1) for w in walks)
+    visits_2 = sum(w.count(2) for w in walks)
+    assert visits_1 > visits_2 * 3
+
+
+def test_deepwalk_separates_cliques():
+    g = _two_cliques()
+    dw = DeepWalk(vector_size=16, window_size=3, walk_length=20,
+                  walks_per_vertex=8, negative=5, batch_size=256, seed=3)
+    dw.fit(g)
+    # in-clique similarity beats cross-clique (excluding bridge vertices)
+    assert dw.similarity(1, 2) > dw.similarity(1, 7)
+    nearest = [v for v, _ in dw.verts_nearest(2, 4)]
+    assert sum(1 for v in nearest if v < 6) >= 3
+
+
+def test_graph_vector_serializer_roundtrip(tmp_path):
+    g = _two_cliques()
+    dw = DeepWalk(vector_size=8, window_size=2, walk_length=10,
+                  walks_per_vertex=2, negative=3, batch_size=128, seed=4)
+    dw.fit(g)
+    p = tmp_path / "gv.txt"
+    GraphVectorSerializer.write_graph_vectors(dw, p)
+    vecs, ids = GraphVectorSerializer.read_graph_vectors(p)
+    assert ids == list(range(12))
+    np.testing.assert_allclose(vecs[3], dw.vertex_vector(3), atol=1e-5)
