@@ -1,0 +1,15 @@
+"""Clustering + spatial index structures (reference
+`deeplearning4j-core/.../clustering/` — kmeans, kd-tree, vp-tree, quadtree,
+sp-tree — and t-SNE `plot/BarnesHutTsne.java` / `plot/Tsne.java`).
+
+TPU-first split: k-means Lloyd iterations and exact t-SNE run as jitted XLA
+computations (the O(N²) distance matrix is an MXU matmul — on TPU this beats
+host-side Barnes-Hut well past the N this library historically targeted);
+the tree structures are host-side index helpers (nearest-neighbor queries,
+Barnes-Hut approximation for CPU parity)."""
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.quadtree import QuadTree  # noqa: F401
+from deeplearning4j_tpu.clustering.sptree import SpTree  # noqa: F401
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne  # noqa: F401

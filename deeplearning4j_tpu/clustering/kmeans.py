@@ -1,0 +1,95 @@
+"""K-means (reference `deeplearning4j-core/.../clustering/kmeans/
+KMeansClustering.java` + the `clustering/algorithm/BaseClusteringAlgorithm`
+iteration loop).
+
+TPU-first: each Lloyd iteration is one jitted XLA computation — the N×K
+distance matrix comes from a single matmul (MXU), assignment is an argmin,
+and the centroid update is a masked segment mean. k-means++ seeding runs
+host-side (sequential by nature)."""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _lloyd_step(X, centroids):
+    # |x-c|² = |x|² - 2 x·c + |c|²; the cross term is the MXU matmul
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    d2 = x2 - 2.0 * (X @ centroids.T) + c2            # (N, K)
+    assign = jnp.argmin(d2, axis=1)                    # (N,)
+    onehot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype)  # (N, K)
+    counts = jnp.sum(onehot, axis=0)                   # (K,)
+    sums = onehot.T @ X                                # (K, D)
+    new_c = jnp.where(counts[:, None] > 0,
+                      sums / jnp.maximum(counts[:, None], 1.0),
+                      centroids)
+    cost = jnp.sum(jnp.min(d2, axis=1))
+    return new_c, assign, cost
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 init: str = "kmeans++", seed: int = 0):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.init = init
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+        self.cost: float = float("inf")
+
+    # -- seeding ------------------------------------------------------------
+    def _seed_centroids(self, X: np.ndarray) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        n = X.shape[0]
+        if self.init == "random":
+            return X[rng.choice(n, self.k, replace=False)].copy()
+        # k-means++
+        cents = [X[int(rng.integers(0, n))]]
+        d2 = np.full(n, np.inf)
+        for _ in range(1, self.k):
+            d2 = np.minimum(d2, np.sum((X - cents[-1]) ** 2, axis=1))
+            p = d2 / d2.sum()
+            cents.append(X[int(rng.choice(n, p=p))])
+        return np.stack(cents)
+
+    # -- API ----------------------------------------------------------------
+    def fit(self, X: np.ndarray) -> "KMeansClustering":
+        X = np.asarray(X, np.float32)
+        if X.shape[0] < self.k:
+            raise ValueError(f"need at least k={self.k} points, got {X.shape[0]}")
+        Xd = jnp.asarray(X)
+        c = jnp.asarray(self._seed_centroids(X))
+        prev_cost = np.inf
+        for _ in range(self.max_iterations):
+            c, assign, cost = _lloyd_step(Xd, c)
+            cost = float(cost)
+            if abs(prev_cost - cost) <= self.tol * max(abs(prev_cost), 1.0):
+                break
+            prev_cost = cost
+        # _lloyd_step's assign/cost are measured against its INPUT centroids;
+        # one final evaluation makes labels_/cost consistent with the stored
+        # (post-update) centroids
+        self.centroids = np.asarray(c)
+        _, assign, cost = _lloyd_step(Xd, jnp.asarray(self.centroids))
+        self.cost = float(cost)
+        self._assign = np.asarray(assign)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        assert self.centroids is not None, "call fit() first"
+        X = np.asarray(X, np.float32)
+        d2 = (np.sum(X * X, axis=1, keepdims=True)
+              - 2.0 * X @ self.centroids.T
+              + np.sum(self.centroids ** 2, axis=1))
+        return np.argmin(d2, axis=1)
+
+    @property
+    def labels_(self) -> np.ndarray:
+        return self._assign

@@ -1,0 +1,215 @@
+"""t-SNE (reference `deeplearning4j-core/.../plot/Tsne.java` +
+`plot/BarnesHutTsne.java` 848 LoC).
+
+Two implementations, mirroring the reference pair but TPU-first:
+
+- `Tsne` — EXACT t-SNE where the per-iteration O(N²) kernel (pairwise
+  student-t affinities + gradient) is a single jitted XLA computation; the
+  distance matrix is an MXU matmul. On TPU this is the fast path well past
+  N=10⁴, which is why it is the default here even though the reference
+  treats exact as the slow legacy path.
+- `BarnesHutTsne` — the θ-approximate host algorithm (VP-tree sparse input
+  similarities + SpTree repulsion), kept for CPU parity and very large N.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SpTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+# ---------------------------------------------------------------- shared: P
+
+def _binary_search_sigmas(D2: np.ndarray, perplexity: float,
+                          tol: float = 1e-5, max_iter: int = 50) -> np.ndarray:
+    """Per-point precision (beta) search so that H(P_i) = log(perplexity)
+    (the same search as `Tsne.java` hBeta loop). D2: (N, M) squared
+    distances to each point's candidate neighbors (self excluded)."""
+    n = D2.shape[0]
+    target = np.log(perplexity)
+    betas = np.ones(n)
+    P = np.zeros_like(D2)
+    for i in range(n):
+        lo, hi = -np.inf, np.inf
+        beta = 1.0
+        d = D2[i]
+        for _ in range(max_iter):
+            p = np.exp(-d * beta)
+            s = p.sum()
+            if s <= 0:
+                H, p = 0.0, np.zeros_like(p)
+            else:
+                # d may contain inf (masked self-distance) where p == 0;
+                # inf·0 must count as 0 in the entropy sum
+                with np.errstate(invalid="ignore"):
+                    dp = np.where(p > 0, d * p, 0.0)
+                H = np.log(s) + beta * dp.sum() / s
+                p = p / s
+            if abs(H - target) < tol:
+                break
+            if H > target:
+                lo = beta
+                beta = beta * 2 if hi == np.inf else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo == -np.inf else (beta + lo) / 2
+        P[i] = p
+        betas[i] = beta
+    return P
+
+
+# ----------------------------------------------------------------- exact/XLA
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _tsne_step(Y, velocity, gains, P, momentum, lr):
+    n = Y.shape[0]
+    y2 = jnp.sum(Y * Y, axis=1)
+    d2 = y2[:, None] - 2.0 * (Y @ Y.T) + y2[None, :]
+    num = 1.0 / (1.0 + d2)
+    num = num * (1.0 - jnp.eye(n, dtype=Y.dtype))
+    Q = num / jnp.maximum(jnp.sum(num), 1e-12)
+    PQ = (P - jnp.maximum(Q, 1e-12)) * num               # (N, N)
+    grad = 4.0 * ((jnp.diag(jnp.sum(PQ, axis=1)) - PQ) @ Y)
+    cost = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12)
+                               / jnp.maximum(Q, 1e-12)))
+    same_sign = (grad * velocity) > 0
+    gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
+    velocity = momentum * velocity - lr * gains * grad
+    Y = Y + velocity
+    Y = Y - jnp.mean(Y, axis=0)
+    return Y, velocity, gains, cost
+
+
+class Tsne:
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 1000,
+                 early_exaggeration: float = 12.0, seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.early_exaggeration = early_exaggeration
+        self.seed = seed
+        self.kl_divergence_: float = float("nan")
+
+    def _input_probabilities(self, X: np.ndarray) -> np.ndarray:
+        x2 = np.sum(X * X, axis=1)
+        D2 = np.maximum(x2[:, None] - 2.0 * X @ X.T + x2[None, :], 0.0)
+        np.fill_diagonal(D2, np.inf)  # exclude self
+        P = _binary_search_sigmas(D2, self.perplexity)
+        P = P + P.T
+        return P / np.maximum(P.sum(), 1e-12)
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        P = self._input_probabilities(X).astype(np.float32)
+        rng = np.random.default_rng(self.seed)
+        Y = jnp.asarray(rng.normal(scale=1e-4, size=(n, self.n_components)),
+                        jnp.float32)
+        vel = jnp.zeros_like(Y)
+        gains = jnp.ones_like(Y)
+        Pd = jnp.asarray(P)
+        stop_exag = min(250, self.n_iter // 4)
+        cost = float("nan")  # n_iter=0: no iterations, no KL
+        for it in range(self.n_iter):
+            exag = self.early_exaggeration if it < stop_exag else 1.0
+            momentum = 0.5 if it < 250 else 0.8
+            Y, vel, gains, cost = _tsne_step(
+                Y, vel, gains, Pd * exag, jnp.float32(momentum),
+                jnp.float32(self.learning_rate))
+        self.kl_divergence_ = float(cost)
+        return np.asarray(Y)
+
+
+# ------------------------------------------------------------- Barnes-Hut
+
+class BarnesHutTsne(Tsne):
+    """θ-approximate t-SNE (reference `plot/BarnesHutTsne.java`): sparse
+    kNN input similarities (VP-tree, 3·perplexity neighbors) + SpTree
+    repulsion. Host-side; prefer `Tsne` on TPU."""
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(X)
+        nbr_idx = np.zeros((n, k), np.int64)
+        nbr_d2 = np.zeros((n, k))
+        for i in range(n):
+            res = tree.knn(X[i], k + 1)          # includes self at d=0
+            res = [(j, d) for j, d in res if j != i][:k]
+            nbr_idx[i] = [j for j, _ in res]
+            nbr_d2[i] = [d * d for _, d in res]
+        cond = _binary_search_sigmas(nbr_d2, min(self.perplexity, k / 3.0))
+        # symmetrized sparse P as a dict-of-rows dense matrix is avoided:
+        # accumulate COO triplets
+        rows = np.repeat(np.arange(n), k)
+        cols = nbr_idx.reshape(-1)
+        vals = cond.reshape(-1)
+        # symmetrize: P_ij = (P_j|i + P_i|j) / 2N — merge duplicates
+        all_rows = np.concatenate([rows, cols])
+        all_cols = np.concatenate([cols, rows])
+        all_vals = np.concatenate([vals, vals])
+        key = all_rows * n + all_cols
+        order = np.argsort(key)
+        key, all_rows, all_cols, all_vals = (key[order], all_rows[order],
+                                             all_cols[order], all_vals[order])
+        uniq, starts = np.unique(key, return_index=True)
+        merged = np.add.reduceat(all_vals, starts)
+        rows_u, cols_u = uniq // n, uniq % n
+        Psum = merged.sum()
+        Pv = merged / max(Psum, 1e-12)
+
+        rng = np.random.default_rng(self.seed)
+        Y = rng.normal(scale=1e-4, size=(n, self.n_components))
+        vel = np.zeros_like(Y)
+        gains = np.ones_like(Y)
+        stop_exag = min(250, self.n_iter // 4)
+        for it in range(self.n_iter):
+            exag = self.early_exaggeration if it < stop_exag else 1.0
+            momentum = 0.5 if it < 250 else 0.8
+            # attractive forces (sparse)
+            diff = Y[rows_u] - Y[cols_u]
+            q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            attr = np.zeros_like(Y)
+            w = (exag * Pv * q)[:, None] * diff
+            np.add.at(attr, rows_u, w)
+            # repulsive forces (Barnes-Hut)
+            sp = SpTree.build(Y)
+            rep = np.zeros_like(Y)
+            Z = 0.0
+            for i in range(n):
+                negf = np.zeros(self.n_components)
+                Z += sp.compute_non_edge_forces(Y[i], self.theta, negf)
+                rep[i] = negf
+            grad = 4.0 * (attr - rep / max(Z, 1e-12))
+            same_sign = (grad * vel) > 0
+            gains = np.clip(np.where(same_sign, gains * 0.8, gains + 0.2),
+                            0.01, None)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            Y = Y + vel
+            Y = Y - Y.mean(axis=0)
+        # final KL on the sparse support, with Z recomputed at the FINAL
+        # positions (the in-loop Z predates the last Y update)
+        sp = SpTree.build(Y)
+        Z = 0.0
+        for i in range(n):
+            Z += sp.compute_non_edge_forces(Y[i], self.theta,
+                                            np.zeros(self.n_components))
+        diff = Y[rows_u] - Y[cols_u]
+        qn = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        Q = qn / max(Z, 1e-12)
+        self.kl_divergence_ = float(np.sum(
+            Pv * np.log(np.maximum(Pv, 1e-12) / np.maximum(Q, 1e-12))))
+        return Y

@@ -1,0 +1,154 @@
+"""Clustering / spatial-index / t-SNE tests (reference analogues:
+`deeplearning4j-core/src/test/.../clustering/`, `plot/Test*Tsne*`)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BarnesHutTsne,
+    KDTree,
+    KMeansClustering,
+    QuadTree,
+    SpTree,
+    Tsne,
+    VPTree,
+)
+
+
+def _blobs(n_per=60, centers=((0, 0, 0), (10, 10, 10), (-10, 10, -10)), seed=0):
+    rng = np.random.default_rng(seed)
+    X, y = [], []
+    for c, mu in enumerate(centers):
+        X.append(rng.normal(size=(n_per, len(mu))) + np.asarray(mu))
+        y += [c] * n_per
+    return np.concatenate(X).astype(np.float32), np.array(y)
+
+
+# ------------------------------------------------------------------- kmeans
+
+def test_kmeans_recovers_blobs():
+    X, y = _blobs()
+    km = KMeansClustering(k=3, seed=1).fit(X)
+    labels = km.labels_
+    # cluster purity: every true blob maps to one dominant cluster
+    for c in range(3):
+        counts = np.bincount(labels[y == c], minlength=3)
+        assert counts.max() / counts.sum() > 0.95
+    assert km.predict(X[:5]).shape == (5,)
+
+
+def test_kmeans_too_few_points():
+    with pytest.raises(ValueError):
+        KMeansClustering(k=5).fit(np.zeros((3, 2), np.float32))
+
+
+# ------------------------------------------------------------------- kdtree
+
+def test_kdtree_matches_bruteforce():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(200, 4))
+    tree = KDTree(X)
+    q = rng.normal(size=4)
+    d = np.linalg.norm(X - q, axis=1)
+    order = np.argsort(d)
+    knn = tree.knn(q, 5)
+    assert [i for i, _ in knn] == list(order[:5])
+    nn_i, nn_d = tree.nn(q)
+    assert nn_i == order[0]
+    assert nn_d == pytest.approx(d[order[0]])
+
+
+def test_kdtree_range_query():
+    X = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0], [5.0, 5.0]])
+    tree = KDTree(X)
+    assert tree.range([0.5, 0.5], [2.5, 2.5]) == [1, 2]
+
+
+# ------------------------------------------------------------------- vptree
+
+def test_vptree_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 6))
+    tree = VPTree(X)
+    for qi in range(3):
+        q = rng.normal(size=6)
+        d = np.linalg.norm(X - q, axis=1)
+        order = np.argsort(d)
+        knn = tree.knn(q, 8)
+        assert [i for i, _ in knn] == list(order[:8])
+
+
+# ---------------------------------------------------------------- BH trees
+
+def test_quadtree_com_and_counts():
+    pts = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+    qt = QuadTree.build(pts)
+    assert qt.n_points == 4
+    np.testing.assert_allclose(qt.com, [0.5, 0.5])
+
+
+def test_sptree_barnes_hut_matches_exact_at_theta_zero():
+    rng = np.random.default_rng(4)
+    Y = rng.normal(size=(50, 2))
+    sp = SpTree.build(Y)
+    i = 7
+    neg = np.zeros(2)
+    Z = sp.compute_non_edge_forces(Y[i], 0.0, neg)  # theta=0 → exact
+    # exact repulsion
+    diff = Y[i] - Y
+    d2 = np.sum(diff * diff, axis=1)
+    q = 1.0 / (1.0 + d2)
+    mask = np.arange(50) != i
+    Z_exact = np.sum(q[mask])
+    neg_exact = np.sum((q[mask] ** 2)[:, None] * diff[mask], axis=0)
+    assert Z == pytest.approx(Z_exact, rel=1e-9)
+    np.testing.assert_allclose(neg, neg_exact, rtol=1e-9)
+
+
+def test_sptree_duplicate_points():
+    pts = np.zeros((10, 3))
+    sp = SpTree.build(pts)  # must not recurse forever
+    assert sp.n_points == 10
+
+
+def test_sptree_stacked_duplicates_subdivide_correctly():
+    # a leaf holding stacked duplicates must move ALL copies down when it
+    # subdivides, or Barnes-Hut forces undercount
+    pts = np.array([[0.0, 0.0], [0.0, 0.0], [0.0, 0.0], [5.0, 5.0]])
+    sp = SpTree.build(pts)
+    q = np.array([1.0, 1.0])
+    neg = np.zeros(2)
+    Z = sp.compute_non_edge_forces(q, 0.0, neg)
+    d2 = np.sum((q - pts) ** 2, axis=1)
+    qk = 1.0 / (1.0 + d2)
+    assert Z == pytest.approx(np.sum(qk), rel=1e-9)
+
+
+def test_kmeans_labels_consistent_with_predict():
+    X, _ = _blobs()
+    km = KMeansClustering(k=3, seed=1).fit(X)
+    np.testing.assert_array_equal(km.labels_, km.predict(X))
+
+
+# --------------------------------------------------------------------- tsne
+
+def test_exact_tsne_separates_blobs():
+    X, y = _blobs(n_per=40)
+    ts = Tsne(perplexity=15.0, n_iter=300, learning_rate=100.0, seed=5)
+    Y = ts.fit_transform(X)
+    assert Y.shape == (120, 2)
+    assert np.isfinite(ts.kl_divergence_)
+    # same-blob points are closer than cross-blob on average
+    d01 = np.linalg.norm(Y[y == 0].mean(0) - Y[y == 1].mean(0))
+    spread0 = np.linalg.norm(Y[y == 0] - Y[y == 0].mean(0), axis=1).mean()
+    assert d01 > 2 * spread0
+
+
+def test_barnes_hut_tsne_runs_and_separates():
+    X, y = _blobs(n_per=25)
+    ts = BarnesHutTsne(theta=0.5, perplexity=10.0, n_iter=150,
+                       learning_rate=100.0, seed=6)
+    Y = ts.fit_transform(X)
+    assert Y.shape == (75, 2)
+    d01 = np.linalg.norm(Y[y == 0].mean(0) - Y[y == 1].mean(0))
+    spread0 = np.linalg.norm(Y[y == 0] - Y[y == 0].mean(0), axis=1).mean()
+    assert d01 > 2 * spread0
