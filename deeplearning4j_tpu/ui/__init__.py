@@ -1,0 +1,12 @@
+"""Observability / UI (reference `deeplearning4j-ui-parent/`, §2.7 of
+SURVEY.md): StatsListener capture → StatsStorage (in-memory / file) →
+web UI server + remote HTTP routing."""
+from deeplearning4j_tpu.ui.storage import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsRecord,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.stats_listener import StatsListener  # noqa: F401
+from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401
+from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter  # noqa: F401

@@ -1,0 +1,57 @@
+"""Remote stats routing (reference
+`deeplearning4j-core/.../api/storage/impl/RemoteUIStatsStorageRouter.java`:
+HTTP POSTs stats records to a remote UI's receiver module
+`ui/module/remote/RemoteReceiverModule.java`)."""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+from typing import Optional
+
+from deeplearning4j_tpu.ui.storage import StatsRecord, StatsStorageRouter
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """Asynchronously POSTs records to `<url>/remote/receive` (background
+    thread + bounded queue, mirroring the reference's async posting with
+    retry backoff)."""
+
+    def __init__(self, url: str, queue_size: int = 1000,
+                 retries: int = 3, timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/remote/receive"
+        self.retries = retries
+        self.timeout = timeout
+        self._q: "queue.Queue[Optional[StatsRecord]]" = queue.Queue(queue_size)
+        self._dropped = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def put_record(self, record: StatsRecord) -> None:
+        try:
+            self._q.put_nowait(record)
+        except queue.Full:
+            self._dropped += 1
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._q.put(None)
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                return
+            body = rec.to_json().encode()
+            for attempt in range(self.retries):
+                try:
+                    req = urllib.request.Request(
+                        self.url, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                        r.read()
+                    break
+                except Exception:
+                    if attempt == self.retries - 1:
+                        self._dropped += 1

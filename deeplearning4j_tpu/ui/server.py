@@ -1,0 +1,192 @@
+"""UI web server (reference `deeplearning4j-play/.../PlayUIServer.java:51`:
+`UIServer.getInstance().attach(statsStorage)`, default port 9000, train
+module pages `module/train/TrainModule.java:53` overview/model/system +
+remote receiver `RemoteReceiverModule`).
+
+Implemented on the stdlib ThreadingHTTPServer: JSON endpoints + a
+self-contained HTML dashboard (inline SVG chart, no external assets — the
+container has zero egress)."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.storage import StatsRecord, StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ .chart {{ border: 1px solid #ccc; margin-bottom: 1.5em; }}
+ h2 {{ margin-bottom: 0.2em; }}
+</style></head>
+<body>
+<h1>Training overview</h1>
+<div id="meta"></div>
+<h2>Score vs iteration</h2>
+<svg id="score" class="chart" width="800" height="300"></svg>
+<h2>Parameter mean magnitudes</h2>
+<svg id="params" class="chart" width="800" height="300"></svg>
+<script>
+function poly(svg, xs, ys, color) {{
+  if (xs.length < 2) return;
+  const W = svg.clientWidth || 800, H = svg.clientHeight || 300, pad = 30;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => pad + (x - xmin) / Math.max(xmax - xmin, 1e-9) * (W - 2 * pad);
+  const sy = y => H - pad - (y - ymin) / Math.max(ymax - ymin, 1e-9) * (H - 2 * pad);
+  const pts = xs.map((x, i) => sx(x) + ',' + sy(ys[i])).join(' ');
+  const p = document.createElementNS('http://www.w3.org/2000/svg', 'polyline');
+  p.setAttribute('points', pts);
+  p.setAttribute('fill', 'none');
+  p.setAttribute('stroke', color);
+  svg.appendChild(p);
+}}
+async function refresh() {{
+  const r = await fetch('/train/overview/data');
+  const d = await r.json();
+  document.getElementById('meta').textContent =
+    'session: ' + d.session_id + '  iterations: ' + d.iterations.length;
+  const svg = document.getElementById('score');
+  svg.innerHTML = '';
+  poly(svg, d.iterations, d.scores, '#1f77b4');
+  const ps = document.getElementById('params');
+  ps.innerHTML = '';
+  const colors = ['#d62728', '#2ca02c', '#9467bd', '#ff7f0e', '#17becf'];
+  let ci = 0;
+  for (const [name, series] of Object.entries(d.param_mean_magnitudes)) {{
+    poly(ps, d.iterations.slice(-series.length), series, colors[ci++ % colors.length]);
+  }}
+}}
+refresh(); setInterval(refresh, 5000);
+</script>
+</body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/1.0"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _html(self, text: str):
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- GET ----------------------------------------------------------------
+    def do_GET(self):
+        ui: "UIServer" = self.server.ui  # type: ignore[attr-defined]
+        if self.path in ("/", "/train", "/train/overview"):
+            return self._html(_PAGE)
+        if self.path == "/train/overview/data":
+            return self._json(ui._overview_data())
+        if self.path == "/train/sessions":
+            return self._json({"sessions": ui._session_ids()})
+        if self.path == "/train/model":
+            return self._json(ui._model_data())
+        return self._json({"error": f"unknown path {self.path}"}, 404)
+
+    # -- POST (remote stats receiver) ---------------------------------------
+    def do_POST(self):
+        ui: "UIServer" = self.server.ui  # type: ignore[attr-defined]
+        if self.path != "/remote/receive":
+            return self._json({"error": f"unknown path {self.path}"}, 404)
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            rec = StatsRecord.from_json(self.rfile.read(n).decode())
+        except Exception as e:  # malformed post
+            return self._json({"error": str(e)}, 400)
+        if ui._storages:
+            ui._storages[0].put_record(rec)
+            return self._json({"ok": True})
+        return self._json({"error": "no storage attached"}, 503)
+
+
+class UIServer:
+    """`UIServer().attach(storage)` then browse http://localhost:<port>/
+    (reference `PlayUIServer.attach:247`; default port 9000 as at `:58`)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self._storages: List[StatsStorage] = []
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.ui = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    # -- data assembly ------------------------------------------------------
+    def _session_ids(self) -> List[str]:
+        out: List[str] = []
+        for s in self._storages:
+            out.extend(s.list_session_ids())
+        return sorted(set(out))
+
+    def _latest_session(self):
+        for s in self._storages:
+            ids = s.list_session_ids()
+            if ids:
+                return s, ids[-1]
+        return None, None
+
+    def _overview_data(self):
+        storage, sid = self._latest_session()
+        if storage is None:
+            return {"session_id": None, "iterations": [], "scores": [],
+                    "param_mean_magnitudes": {}}
+        recs = storage.get_records(sid, type_id="stats")
+        iterations = [r.data.get("iteration") for r in recs]
+        scores = [r.data.get("score") for r in recs]
+        pmm: dict = {}
+        for r in recs:
+            for name, st in (r.data.get("parameters") or {}).items():
+                pmm.setdefault(name, []).append(st.get("mean_magnitude"))
+        return {"session_id": sid, "iterations": iterations, "scores": scores,
+                "param_mean_magnitudes": pmm}
+
+    def _model_data(self):
+        storage, sid = self._latest_session()
+        if storage is None:
+            return {"session_id": None}
+        static = storage.get_records(sid, type_id="static_info")
+        latest = storage.get_latest_record(sid, type_id="stats")
+        return {"session_id": sid,
+                "static": static[-1].data if static else {},
+                "latest": latest.data if latest else {}}

@@ -1,0 +1,115 @@
+"""StatsListener: training telemetry capture (reference
+`deeplearning4j-ui-model/.../ui/stats/BaseStatsListener.java:273`
+`iterationDone` — score, timings, memory, param/gradient/update histograms
+and mean magnitudes, learning rates — encoded there via Agrona SBE
+flyweights; here as plain JSON records into a StatsStorageRouter).
+
+Device note: histogram/magnitude summaries pull parameters to host, so they
+run every `report_frequency` iterations only (score/timing is free — it is
+already host-side after the jitted step)."""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.storage import StatsRecord, StatsStorageRouter
+
+
+def _array_stats(arr, n_bins: int = 20) -> Dict[str, Any]:
+    a = np.asarray(arr).ravel()
+    if a.size == 0:
+        return {}
+    hist, edges = np.histogram(a, bins=n_bins)
+    return {
+        "mean_magnitude": float(np.mean(np.abs(a))),
+        "mean": float(np.mean(a)),
+        "stdev": float(np.std(a)),
+        "min": float(np.min(a)),
+        "max": float(np.max(a)),
+        "histogram_counts": hist.tolist(),
+        "histogram_min": float(edges[0]),
+        "histogram_max": float(edges[-1]),
+    }
+
+
+class StatsListener:
+    """Attach with `net.set_listeners(StatsListener(storage))`."""
+
+    def __init__(self, router: StatsStorageRouter,
+                 report_frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "worker-0",
+                 collect_histograms: bool = True):
+        self.router = router
+        self.report_frequency = max(1, report_frequency)
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:12]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self._last_time: Optional[float] = None
+        self._examples = 0
+        self._static_sent = False
+
+    # listener SPI ----------------------------------------------------------
+    def record_batch(self, n_examples: int) -> None:
+        self._examples += n_examples
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if not self._static_sent:
+            self._send_static(model)
+        if iteration % self.report_frequency != 0:
+            return
+        now = time.time()
+        dt_ms = ((now - self._last_time) * 1000.0 / self.report_frequency
+                 if self._last_time is not None else None)
+        self._last_time = now
+        data: Dict[str, Any] = {
+            "iteration": iteration,
+            "score": model.score_value,
+            "iteration_ms": dt_ms,
+            "examples_seen": self._examples,
+        }
+        if self.collect_histograms and getattr(model, "_params", None) is not None:
+            params: Dict[str, Any] = {}
+            for i, p in enumerate(self._named_params(model)):
+                name, arr = p
+                params[name] = _array_stats(arr)
+            data["parameters"] = params
+        self.router.put_record(StatsRecord(
+            session_id=self.session_id, type_id="stats",
+            worker_id=self.worker_id, timestamp=now, data=data))
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    # helpers ---------------------------------------------------------------
+    def _named_params(self, model):
+        ps = model._params
+        if isinstance(ps, dict):  # ComputationGraph: name → {param: arr}
+            for vname, d in ps.items():
+                for pname, arr in d.items():
+                    yield f"{vname}_{pname}", arr
+        else:                     # MultiLayerNetwork: list of dicts
+            for i, d in enumerate(ps):
+                for pname, arr in d.items():
+                    yield f"{i}_{pname}", arr
+
+    def _send_static(self, model) -> None:
+        """Session metadata (reference sends model config/class/param count
+        as the init report)."""
+        self._static_sent = True
+        try:
+            n_params = int(model.num_params())
+        except Exception:
+            n_params = -1
+        self.router.put_record(StatsRecord(
+            session_id=self.session_id, type_id="static_info",
+            worker_id=self.worker_id, timestamp=time.time(),
+            data={"model_class": type(model).__name__,
+                  "n_params": n_params,
+                  "n_layers": len(getattr(model, "layers", []) or [])}))

@@ -1,0 +1,136 @@
+"""StatsStorage: pub/sub persistence for training stats (reference
+`deeplearning4j-core/.../api/storage/StatsStorage.java`,
+`StatsStorageRouter.java`, `Persistable.java`; backends
+`deeplearning4j-ui-model/.../ui/storage/InMemoryStatsStorage.java` and
+`FileStatsStorage.java` (MapDB) — the file backend here is append-only
+JSONL, which serves the same durability role without a MapDB dependency)."""
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Union
+
+
+@dataclass
+class StatsRecord:
+    """One persisted stats update (reference `Persistable` +
+    `StatsReport`): arbitrary JSON-serializable `data`."""
+
+    session_id: str
+    type_id: str          # e.g. 'stats', 'static_info'
+    worker_id: str
+    timestamp: float
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "StatsRecord":
+        return StatsRecord(**json.loads(s))
+
+
+class StatsStorageRouter:
+    """Write-side interface (reference `StatsStorageRouter.java`)."""
+
+    def put_record(self, record: StatsRecord) -> None:
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Read+write+listen interface (reference `StatsStorage.java`)."""
+
+    def __init__(self) -> None:
+        self._listeners: List[Callable[[StatsRecord], None]] = []
+        self._lock = threading.Lock()
+
+    # -- write --------------------------------------------------------------
+    def put_record(self, record: StatsRecord) -> None:
+        with self._lock:
+            self._store(record)
+        for cb in list(self._listeners):
+            cb(record)
+
+    def _store(self, record: StatsRecord) -> None:
+        raise NotImplementedError
+
+    # -- read ---------------------------------------------------------------
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_workers(self, session_id: str) -> List[str]:
+        return sorted({r.worker_id for r in self.get_records(session_id)})
+
+    def get_records(self, session_id: str,
+                    type_id: Optional[str] = None,
+                    worker_id: Optional[str] = None) -> List[StatsRecord]:
+        raise NotImplementedError
+
+    def get_latest_record(self, session_id: str,
+                          type_id: Optional[str] = None) -> Optional[StatsRecord]:
+        recs = self.get_records(session_id, type_id)
+        return recs[-1] if recs else None
+
+    # -- listen -------------------------------------------------------------
+    def register_stats_listener(self, cb: Callable[[StatsRecord], None]) -> None:
+        self._listeners.append(cb)
+
+    def deregister_stats_listener(self, cb: Callable[[StatsRecord], None]) -> None:
+        if cb in self._listeners:
+            self._listeners.remove(cb)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference `ui/storage/InMemoryStatsStorage.java`."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._records: List[StatsRecord] = []
+
+    def _store(self, record: StatsRecord) -> None:
+        self._records.append(record)
+
+    def list_session_ids(self) -> List[str]:
+        return sorted({r.session_id for r in self._records})
+
+    def get_records(self, session_id: str, type_id: Optional[str] = None,
+                    worker_id: Optional[str] = None) -> List[StatsRecord]:
+        return [r for r in self._records
+                if r.session_id == session_id
+                and (type_id is None or r.type_id == type_id)
+                and (worker_id is None or r.worker_id == worker_id)]
+
+
+class FileStatsStorage(StatsStorage):
+    """Durable append-only JSONL storage (role of
+    `ui/storage/FileStatsStorage.java`); readable cross-process."""
+
+    def __init__(self, path: Union[str, Path]):
+        super().__init__()
+        self._path = Path(path)
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        if not self._path.exists():
+            self._path.touch()
+
+    def _store(self, record: StatsRecord) -> None:
+        with open(self._path, "a", encoding="utf-8") as f:
+            f.write(record.to_json() + "\n")
+
+    def _load(self) -> List[StatsRecord]:
+        out = []
+        for line in self._path.read_text(encoding="utf-8").splitlines():
+            if line.strip():
+                out.append(StatsRecord.from_json(line))
+        return out
+
+    def list_session_ids(self) -> List[str]:
+        return sorted({r.session_id for r in self._load()})
+
+    def get_records(self, session_id: str, type_id: Optional[str] = None,
+                    worker_id: Optional[str] = None) -> List[StatsRecord]:
+        return [r for r in self._load()
+                if r.session_id == session_id
+                and (type_id is None or r.type_id == type_id)
+                and (worker_id is None or r.worker_id == worker_id)]

@@ -1,0 +1,133 @@
+"""Observability tests (reference analogues:
+`deeplearning4j-ui-parent` stats/storage tests + `TestRemoteReceiver`)."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.evaluation_tools import EvaluationTools
+from deeplearning4j_tpu.eval.roc import ROC
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    StatsRecord,
+    UIServer,
+)
+
+
+def _train_with_listener(storage, n_iters=5):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    listener = StatsListener(storage, session_id="sess-test")
+    net.set_listeners(listener)
+    ds = DataSet(X, y)
+    net.fit(ListDataSetIterator([ds] * n_iters, batch_size=64))
+    return net
+
+
+def test_stats_listener_populates_storage():
+    storage = InMemoryStatsStorage()
+    _train_with_listener(storage)
+    assert storage.list_session_ids() == ["sess-test"]
+    stats = storage.get_records("sess-test", type_id="stats")
+    assert len(stats) == 5
+    assert all(np.isfinite(r.data["score"]) for r in stats)
+    # histograms + mean magnitudes captured
+    p = stats[-1].data["parameters"]
+    assert "0_W" in p and "mean_magnitude" in p["0_W"]
+    assert len(p["0_W"]["histogram_counts"]) == 20
+    static = storage.get_records("sess-test", type_id="static_info")
+    assert static[0].data["n_params"] == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    path = tmp_path / "stats.jsonl"
+    storage = FileStatsStorage(path)
+    _train_with_listener(storage, n_iters=3)
+    # fresh handle reads the same records (cross-process durability)
+    reread = FileStatsStorage(path)
+    recs = reread.get_records("sess-test", type_id="stats")
+    assert len(recs) == 3
+    assert recs[0].data["iteration"] == 1
+
+
+def test_storage_listener_callbacks():
+    storage = InMemoryStatsStorage()
+    seen = []
+    storage.register_stats_listener(seen.append)
+    storage.put_record(StatsRecord("s", "stats", "w", time.time(), {"x": 1}))
+    assert len(seen) == 1 and seen[0].data == {"x": 1}
+
+
+def test_ui_server_endpoints_and_remote_post():
+    server = UIServer(port=0)  # ephemeral port
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        _train_with_listener(storage, n_iters=4)
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/train/overview") as r:
+            page = r.read().decode()
+        assert "Score vs iteration" in page
+        with urllib.request.urlopen(f"{base}/train/overview/data") as r:
+            data = json.loads(r.read())
+        assert data["session_id"] == "sess-test"
+        assert len(data["iterations"]) == 4
+        assert any(data["param_mean_magnitudes"])
+        with urllib.request.urlopen(f"{base}/train/model") as r:
+            model = json.loads(r.read())
+        assert model["static"]["model_class"] == "MultiLayerNetwork"
+
+        # remote router → server → storage
+        router = RemoteUIStatsStorageRouter(base)
+        router.put_record(StatsRecord("remote-sess", "stats", "w0",
+                                      time.time(), {"iteration": 1, "score": 0.5}))
+        router.shutdown()
+        assert "remote-sess" in storage.list_session_ids()
+    finally:
+        server.stop()
+
+
+def test_evaluation_tools_html(tmp_path):
+    rng = np.random.default_rng(0)
+    probs = rng.random(200)
+    labels = (probs + rng.normal(scale=0.3, size=200) > 0.5).astype(float)
+    roc = ROC(threshold_steps=50)
+    roc.eval(labels, probs)
+    p = tmp_path / "roc.html"
+    EvaluationTools.export_roc_charts_to_html_file(roc, p)
+    html = p.read_text()
+    assert "AUC" in html and "polyline" in html
+
+    ev = Evaluation()
+    ev.eval(np.eye(2)[[0, 1, 1, 0]], np.eye(2)[[0, 1, 0, 0]])
+    p2 = tmp_path / "eval.html"
+    EvaluationTools.export_evaluation_to_html_file(ev, p2)
+    assert "Confusion matrix" in p2.read_text()
