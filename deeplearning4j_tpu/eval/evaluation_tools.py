@@ -55,7 +55,7 @@ class EvaluationTools:
 
     @staticmethod
     def evaluation_html(ev: Evaluation) -> str:
-        cm = ev.confusion_matrix()
+        cm = ev.confusion_matrix
         n = cm.shape[0]
         rows = "".join(
             "<tr><th>{}</th>{}</tr>".format(

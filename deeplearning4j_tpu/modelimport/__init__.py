@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.modelimport.keras import (
+    InvalidKerasConfigurationException,
+    KerasModelImport,
+    UnsupportedKerasConfigurationException,
+)
+
+__all__ = [
+    "KerasModelImport",
+    "InvalidKerasConfigurationException",
+    "UnsupportedKerasConfigurationException",
+]

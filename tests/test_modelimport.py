@@ -1,0 +1,343 @@
+"""Keras model import tests.
+
+Mirrors the reference's modelimport tests (`deeplearning4j-modelimport/src/
+test/.../ModelConfigurationTest.java`, `ModelTest.java`) but builds fixture
+HDF5 files in-test with h5py instead of shipping binary resources: write a
+Keras-format file, import, check structure + numeric forward parity against
+a hand-rolled numpy forward pass of the same weights.
+"""
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.modelimport import (  # noqa: E402
+    InvalidKerasConfigurationException,
+    KerasModelImport,
+    UnsupportedKerasConfigurationException,
+)
+from deeplearning4j_tpu.nn.conf.layers import (  # noqa: E402
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.ops.activations import Activation  # noqa: E402
+from deeplearning4j_tpu.ops.losses import LossFunction  # noqa: E402
+
+
+def _write_keras_h5(path, model_config, layer_weights, loss="categorical_crossentropy"):
+    """layer_weights: [(layer_name, [(weight_name, array), ...]), ...]"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        f.attrs["training_config"] = json.dumps(
+            {"loss": loss, "optimizer": {"class_name": "SGD"}}).encode()
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [n.encode() for n, _ in layer_weights])
+        for lname, ws in layer_weights:
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [wn.encode() for wn, _ in ws])
+            for wn, arr in ws:
+                g.create_dataset(wn, data=arr)
+
+
+def _seq_cfg_k1(layers):
+    """Keras 1.x sequential config: bare list."""
+    return {"class_name": "Sequential",
+            "config": [{"class_name": c, "config": cfg} for c, cfg in layers]}
+
+
+def _seq_cfg_k2(layers):
+    return {"class_name": "Sequential",
+            "config": {"name": "sequential",
+                       "layers": [{"class_name": c, "config": cfg}
+                                  for c, cfg in layers]}}
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_sequential_mlp_config_json():
+    cfg = _seq_cfg_k1([
+        ("Dense", {"name": "d1", "output_dim": 16, "activation": "relu",
+                   "batch_input_shape": [None, 8]}),
+        ("Dropout", {"name": "do", "p": 0.5}),
+        ("Dense", {"name": "d2", "output_dim": 3, "activation": "softmax"}),
+    ])
+    mlc = KerasModelImport.import_keras_sequential_configuration(json.dumps(cfg))
+    assert isinstance(mlc.layers[0], DenseLayer)
+    assert mlc.layers[0].n_in == 8 and mlc.layers[0].n_out == 16
+    assert isinstance(mlc.layers[-1], OutputLayer)
+    assert mlc.layers[-1].activation == Activation.SOFTMAX
+
+
+def test_sequential_mlp_weights_forward_parity(tmp_path):
+    rng = np.random.RandomState(0)
+    W1 = rng.randn(8, 16).astype(np.float32)
+    b1 = rng.randn(16).astype(np.float32)
+    W2 = rng.randn(16, 3).astype(np.float32)
+    b2 = rng.randn(3).astype(np.float32)
+    cfg = _seq_cfg_k1([
+        ("Dense", {"name": "dense_1", "output_dim": 16, "activation": "relu",
+                   "batch_input_shape": [None, 8]}),
+        ("Dense", {"name": "dense_2", "output_dim": 3,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "mlp.h5"
+    _write_keras_h5(p, cfg, [
+        ("dense_1", [("dense_1_W", W1), ("dense_1_b", b1)]),
+        ("dense_2", [("dense_2_W", W2), ("dense_2_b", b2)]),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert net.layers[-1].loss == LossFunction.MCXENT
+
+    x = rng.randn(4, 8).astype(np.float32)
+    got = net.output(x)
+    h = np.maximum(x @ W1 + b1, 0.0)
+    logits = h @ W2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_cnn_th_ordering_forward_parity(tmp_path):
+    """Keras 1.x channels_first CNN: kernel transpose + dense-after-flatten
+    row permutation must both be applied."""
+    rng = np.random.RandomState(1)
+    # conv: 2 filters, 3x3, on 1x8x8 (th) input
+    Wc_th = rng.randn(2, 1, 3, 3).astype(np.float32)  # (out,in,kh,kw)
+    bc = rng.randn(2).astype(np.float32)
+    # after conv (valid): (2,6,6) th → flatten CHW = 72
+    Wd_th = rng.randn(72, 4).astype(np.float32)
+    bd = rng.randn(4).astype(np.float32)
+    cfg = _seq_cfg_k1([
+        ("Convolution2D", {"name": "conv", "nb_filter": 2, "nb_row": 3,
+                           "nb_col": 3, "activation": "relu",
+                           "border_mode": "valid", "dim_ordering": "th",
+                           "batch_input_shape": [None, 1, 8, 8]}),
+        ("Flatten", {"name": "flat"}),
+        ("Dense", {"name": "dense", "output_dim": 4,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "cnn.h5"
+    _write_keras_h5(p, cfg, [
+        ("conv", [("conv_W", Wc_th), ("conv_b", bc)]),
+        ("dense", [("dense_W", Wd_th), ("dense_b", bd)]),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert isinstance(net.layers[0], ConvolutionLayer)
+
+    x_th = rng.randn(2, 1, 8, 8).astype(np.float32)  # NCHW reference input
+    # numpy reference forward in th layout
+    def conv2d_th(x, W, b):
+        N, C, H, Wd = x.shape
+        O, _, kh, kw = W.shape
+        out = np.zeros((N, O, H - kh + 1, Wd - kw + 1), np.float32)
+        for n in range(N):
+            for o in range(O):
+                for i in range(H - kh + 1):
+                    for j in range(Wd - kw + 1):
+                        out[n, o, i, j] = np.sum(
+                            x[n, :, i:i + kh, j:j + kw] * W[o]) + b[o]
+        return out
+    a = np.maximum(conv2d_th(x_th, Wc_th, bc), 0.0)  # (N,2,6,6)
+    logits = a.reshape(2, -1) @ Wd_th + bd  # CHW flatten
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+
+    x_tf = np.transpose(x_th, (0, 2, 3, 1))  # our net takes NHWC
+    got = net.output(x_tf)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_sequential_k2_lstm_weights(tmp_path):
+    """Keras 2.x fused LSTM kernel maps into [i,f,o,g] gate order."""
+    rng = np.random.RandomState(2)
+    n_in, n_out, T = 5, 7, 6
+    K = rng.randn(n_in, 4 * n_out).astype(np.float32)
+    R = rng.randn(n_out, 4 * n_out).astype(np.float32)
+    b = rng.randn(4 * n_out).astype(np.float32)
+    Wd = rng.randn(n_out, 3).astype(np.float32)
+    bd = rng.randn(3).astype(np.float32)
+    cfg = _seq_cfg_k2([
+        ("LSTM", {"name": "lstm", "units": n_out, "activation": "tanh",
+                  "recurrent_activation": "sigmoid",
+                  "return_sequences": True,
+                  "batch_input_shape": [None, T, n_in]}),
+        ("Dense", {"name": "dense", "units": 3, "activation": "softmax"}),
+    ])
+    p = tmp_path / "lstm.h5"
+    _write_keras_h5(p, cfg, [
+        ("lstm", [("kernel", K), ("recurrent_kernel", R), ("bias", b)]),
+        ("dense", [("kernel", Wd), ("bias", bd)]),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert isinstance(net.layers[0], GravesLSTM)
+
+    # Keras LSTM (no peepholes) numpy reference, gate order (i,f,c,o)
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+    x = rng.randn(2, T, n_in).astype(np.float32)
+    h = np.zeros((2, n_out), np.float32)
+    c = np.zeros((2, n_out), np.float32)
+    outs = []
+    for t in range(T):
+        z = x[:, t] @ K + h @ R + b
+        zi, zf, zc, zo = np.split(z, 4, axis=1)
+        i, f, o = sigmoid(zi), sigmoid(zf), sigmoid(zo)
+        c = f * c + i * np.tanh(zc)
+        h = o * np.tanh(c)
+        outs.append(h)
+    seq = np.stack(outs, axis=1)  # (2, T, n_out)
+    logits = seq @ Wd + bd
+    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    want = e / e.sum(axis=-1, keepdims=True)
+
+    got = net.output(x)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_flatten_dropout_dense_th_row_permutation(tmp_path):
+    """pending-Flatten tracking must survive pass-through layers (Dropout)
+    between Flatten and Dense in channels_first models."""
+    rng = np.random.RandomState(7)
+    Wc_th = rng.randn(2, 1, 3, 3).astype(np.float32)
+    bc = rng.randn(2).astype(np.float32)
+    Wd_th = rng.randn(72, 4).astype(np.float32)
+    bd = rng.randn(4).astype(np.float32)
+    cfg = _seq_cfg_k1([
+        ("Convolution2D", {"name": "conv", "nb_filter": 2, "nb_row": 3,
+                           "nb_col": 3, "activation": "relu",
+                           "border_mode": "valid", "dim_ordering": "th",
+                           "batch_input_shape": [None, 1, 8, 8]}),
+        ("Flatten", {"name": "flat"}),
+        ("Dropout", {"name": "drop", "p": 0.25}),
+        ("Dense", {"name": "dense", "output_dim": 4,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "cnn_do.h5"
+    _write_keras_h5(p, cfg, [
+        ("conv", [("conv_W", Wc_th), ("conv_b", bc)]),
+        ("dense", [("dense_W", Wd_th), ("dense_b", bd)]),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+
+    x_th = rng.randn(2, 1, 8, 8).astype(np.float32)
+    def conv2d_th(x, W, b):
+        N, C, H, Wd_ = x.shape
+        O, _, kh, kw = W.shape
+        out = np.zeros((N, O, H - kh + 1, Wd_ - kw + 1), np.float32)
+        for n in range(N):
+            for o in range(O):
+                for i in range(H - kh + 1):
+                    for j in range(Wd_ - kw + 1):
+                        out[n, o, i, j] = np.sum(
+                            x[n, :, i:i + kh, j:j + kw] * W[o]) + b[o]
+        return out
+    a = np.maximum(conv2d_th(x_th, Wc_th, bc), 0.0)
+    logits = a.reshape(2, -1) @ Wd_th + bd
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    got = net.output(np.transpose(x_th, (0, 2, 3, 1)))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_lstm_default_cell_activation_is_tanh():
+    layer = pytest.importorskip(
+        "deeplearning4j_tpu.modelimport.keras").map_keras_layer(
+        "LSTM", {"name": "l", "units": 4, "return_sequences": True})
+    assert layer.activation == Activation.TANH
+
+
+def test_functional_model_merge(tmp_path):
+    """Two-branch functional model with concat merge → ComputationGraph."""
+    rng = np.random.RandomState(3)
+    Wa = rng.randn(4, 6).astype(np.float32)
+    ba = rng.randn(6).astype(np.float32)
+    Wb = rng.randn(4, 6).astype(np.float32)
+    bb = rng.randn(6).astype(np.float32)
+    Wo = rng.randn(12, 2).astype(np.float32)
+    bo = rng.randn(2).astype(np.float32)
+    cfg = {"class_name": "Model", "config": {
+        "name": "model",
+        "layers": [
+            {"class_name": "InputLayer", "name": "in",
+             "config": {"name": "in", "batch_input_shape": [None, 4]},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "name": "a",
+             "config": {"name": "a", "units": 6, "activation": "relu"},
+             "inbound_nodes": [[["in", 0, 0, {}]]]},
+            {"class_name": "Dense", "name": "b",
+             "config": {"name": "b", "units": 6, "activation": "tanh"},
+             "inbound_nodes": [[["in", 0, 0, {}]]]},
+            {"class_name": "Concatenate", "name": "merge",
+             "config": {"name": "merge"},
+             "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+            {"class_name": "Dense", "name": "out",
+             "config": {"name": "out", "units": 2, "activation": "softmax"},
+             "inbound_nodes": [[["merge", 0, 0, {}]]]},
+        ],
+        "input_layers": [["in", 0, 0]],
+        "output_layers": [["out", 0, 0]],
+    }}
+    p = tmp_path / "func.h5"
+    _write_keras_h5(p, cfg, [
+        ("a", [("kernel", Wa), ("bias", ba)]),
+        ("b", [("kernel", Wb), ("bias", bb)]),
+        ("out", [("kernel", Wo), ("bias", bo)]),
+    ])
+    net = KerasModelImport.import_keras_model_and_weights(p)
+
+    x = rng.randn(3, 4).astype(np.float32)
+    ha = np.maximum(x @ Wa + ba, 0.0)
+    hb = np.tanh(x @ Wb + bb)
+    logits = np.concatenate([ha, hb], axis=1) @ Wo + bo
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    got = net.output(x)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_and_loss_mapping():
+    cfg = _seq_cfg_k1([
+        ("Convolution2D", {"name": "c", "nb_filter": 3, "nb_row": 2,
+                           "nb_col": 2, "activation": "relu",
+                           "border_mode": "same", "dim_ordering": "tf",
+                           "batch_input_shape": [None, 8, 8, 1]}),
+        ("MaxPooling2D", {"name": "p", "pool_size": [2, 2],
+                          "border_mode": "valid"}),
+        ("Flatten", {"name": "f"}),
+        ("Dense", {"name": "d", "output_dim": 2, "activation": "softmax"}),
+    ])
+    mlc = KerasModelImport.import_keras_sequential_configuration(json.dumps(cfg))
+    assert isinstance(mlc.layers[1], SubsamplingLayer)
+    assert mlc.layers[1].kernel == (2, 2)
+
+
+def test_invalid_and_unsupported():
+    with pytest.raises(InvalidKerasConfigurationException):
+        KerasModelImport.import_keras_sequential_configuration(
+            json.dumps({"class_name": "Model", "config": {}}))
+    with pytest.raises(UnsupportedKerasConfigurationException):
+        KerasModelImport.import_keras_sequential_configuration(
+            json.dumps(_seq_cfg_k1([
+                ("Lambda", {"name": "l", "batch_input_shape": [None, 4]}),
+            ])))
+
+
+def test_trailing_activation_folds_into_output():
+    cfg = _seq_cfg_k1([
+        ("Dense", {"name": "d1", "output_dim": 8, "activation": "relu",
+                   "batch_input_shape": [None, 4]}),
+        ("Dense", {"name": "d2", "output_dim": 3, "activation": "linear"}),
+        ("Activation", {"name": "act", "activation": "softmax"}),
+    ])
+    mlc = KerasModelImport.import_keras_sequential_configuration(json.dumps(cfg))
+    assert isinstance(mlc.layers[-1], OutputLayer)
+    assert mlc.layers[-1].activation == Activation.SOFTMAX
+    assert len(mlc.layers) == 2
