@@ -17,3 +17,20 @@ which is the foundation the long-context/sequence-parallel modules build on.
 
 from deeplearning4j_tpu.parallel.mesh import make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.early_stopping import (  # noqa: F401
+    EarlyStoppingParallelTrainer,
+)
+from deeplearning4j_tpu.parallel.parameter_server import (  # noqa: F401
+    ParameterServer,
+    ParameterServerParallelWrapper,
+)
+from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
+from deeplearning4j_tpu.parallel.training_master import (  # noqa: F401
+    DistributedComputationGraph,
+    DistributedMultiLayer,
+    ParameterAveragingTrainingMaster,
+    ParameterAveragingTrainingWorker,
+    TrainingMaster,
+    TrainingResult,
+    TrainingWorker,
+)

