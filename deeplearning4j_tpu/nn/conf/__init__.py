@@ -18,6 +18,7 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     OutputLayer,
     RBM,
     RnnOutputLayer,
+    SelfAttention,
     SubsamplingLayer,
 )
 from deeplearning4j_tpu.nn.conf.variational import (  # noqa: F401

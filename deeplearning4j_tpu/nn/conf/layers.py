@@ -610,6 +610,87 @@ class GravesBidirectionalLSTM(GravesLSTM):
         return out_f + out_b, state
 
 
+@register_layer
+@dataclass
+class SelfAttention(FeedForwardLayer):
+    """Multi-head self-attention over a sequence (B, T, n_in) → (B, T, n_out).
+
+    No counterpart in the reference (its sequence toolbox is LSTM-only,
+    `SURVEY.md` §5 long-context note); included because long-context is
+    first-class in this build. Math is `ops/attention.py`: full softmax
+    attention for short sequences, flash-style blockwise (O(T) memory) when
+    T > block_size, and — when the network is jitted over a mesh with a
+    `seq` axis by a distributed wrapper — ring attention
+    (`parallel/sequence.py`) via the same online-softmax accumulator.
+    """
+
+    TYPE = "self_attention"
+    input_kind = "rnn"
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    causal: bool = False
+    # blockwise path kicks in beyond this length; None = always full attention
+    block_size: Optional[int] = 1024
+    project_input: bool = True
+
+    def __post_init__(self):
+        if not self.project_input and self.n_out not in (0, self.n_in):
+            raise ValueError(
+                f"project_input=False requires n_out == n_in (or 0); got "
+                f"n_in={self.n_in}, n_out={self.n_out}")
+        qkv = self.n_in if not self.project_input else (self.n_out or self.n_in)
+        if qkv % self.n_heads != 0:
+            raise ValueError(
+                f"attention width {qkv} not divisible by n_heads={self.n_heads}")
+
+    @property
+    def _width(self) -> int:
+        return self.n_out or self.n_in
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length if isinstance(it, InputTypeRecurrent) else -1
+        return InputType.recurrent(self._width, t)
+
+    def init_params(self, key, it, dtype=jnp.float32) -> Params:
+        w = self._width
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        p = {}
+        if self.project_input:
+            for name, kk_ in (("Wq", kq), ("Wk", kk), ("Wv", kv)):
+                p[name] = self._winit(kk_, (self.n_in, w), self.n_in, w, dtype)
+            p["bq"] = jnp.zeros((w,), dtype)
+            p["bk"] = jnp.zeros((w,), dtype)
+            p["bv"] = jnp.zeros((w,), dtype)
+        p["Wo"] = self._winit(ko, (w, w), w, w, dtype)
+        p["bo"] = jnp.zeros((w,), dtype)
+        return p
+
+    def param_flags(self, name):
+        is_bias = name.startswith("b")
+        return {"is_bias": is_bias, "regularizable": not is_bias}
+
+    def _heads(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.n_heads, -1)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.attention import multi_head_attention
+
+        x = self._maybe_dropout(x, train, rng)
+        if self.project_input:
+            q = self._heads(x @ params["Wq"] + params["bq"])
+            k = self._heads(x @ params["Wk"] + params["bk"])
+            v = self._heads(x @ params["Wv"] + params["bv"])
+        else:
+            q = k = v = self._heads(x)
+        out = multi_head_attention(q, k, v, causal=self.causal, key_mask=mask,
+                                   block_size=self.block_size)
+        B, T = out.shape[:2]
+        out = out.reshape(B, T, -1) @ params["Wo"] + params["bo"]
+        return self._act()(out), state
+
+
 # ---------------------------------------------------------------------------
 # embedding / dropout / activation / pooling
 

@@ -1,0 +1,156 @@
+"""Scaled-dot-product attention primitives.
+
+The reference (DL4J 0.7.3 era) has no attention — its sequence toolbox is
+LSTM+tBPTT (`LSTMHelpers.java:58`, `MultiLayerNetwork.doTruncatedBPTT:1140`)
+and its only long-sequence mechanism is window slicing. This build treats
+long-context as first-class: the core primitive here is **blockwise
+(flash-style) attention** — an online-softmax accumulation over KV chunks via
+`lax.scan` — which gives O(T) memory on one chip and is the per-device inner
+loop of ring attention (`parallel/sequence.py`) when the sequence axis is
+sharded across chips.
+
+Layout: (B, T, H, D) for q/k/v — batch, time, heads, head_dim. The matmuls
+are einsums over (T, D)×(D, T') per head: large, batched, MXU-friendly.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/where() NaN-free
+
+
+def mask_bias(key_mask: jnp.ndarray) -> jnp.ndarray:
+    """(B, Tk) 1=valid key mask → additive (B, 1, 1, Tk) attention bias."""
+    return jnp.where(key_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   bias: Optional[jnp.ndarray] = None,
+                   causal: bool = False) -> jnp.ndarray:
+    """Plain softmax(QKᵀ/√d + bias)·V. q/k/v: (B, T, H, D); bias broadcastable
+    to (B, H, Tq, Tk). Reference semantics for the blockwise/ring variants'
+    parity tests (the cuDNN-vs-builtin parity pattern,
+    `deeplearning4j-cuda/src/test/.../TestConvolution.java`)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if bias is not None:
+        s = s + bias
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        iq = jnp.arange(Tq)[:, None]
+        ik = jnp.arange(Tk)[None, :]
+        s = jnp.where(ik <= iq + (Tk - Tq), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def attention_block_accum(carry: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                          q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          bias: Optional[jnp.ndarray]):
+    """One online-softmax accumulation step against a KV block.
+
+    carry = (o, l, m): running un-normalised output (B, Tq, H, D), running
+    softmax denominator (B, H, Tq) and running row max (B, H, Tq). The final
+    attention output is o / l. This is the flash-attention recurrence; it is
+    exact (not an approximation) for any KV block order.
+    """
+    o, l, m = carry
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if bias is not None:
+        s = s + bias
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    # masked scores sit near NEG_INF; exp(s - m_new) does NOT underflow to 0
+    # when the whole row is masked (m_new is then ~NEG_INF too), so zero them
+    # explicitly — this keeps l == 0 for fully-masked rows, which
+    # attention_finalize maps to output 0 instead of softmax-over-garbage
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * jnp.transpose(corr, (0, 2, 1))[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return o_new, l_new, m_new
+
+
+def _accum_init(q: jnp.ndarray):
+    B, Tq, H, D = q.shape
+    o = jnp.zeros((B, Tq, H, D), q.dtype)
+    l = jnp.zeros((B, H, Tq), q.dtype)
+    m = jnp.full((B, H, Tq), NEG_INF, q.dtype)
+    return o, l, m
+
+
+def attention_finalize(o: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
+    """o / l with fully-masked rows (l == 0) mapped to 0, not NaN."""
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]
+    return jnp.where(l_t > 0, o / jnp.where(l_t > 0, l_t, 1.0), 0.0)
+
+
+def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        *, causal: bool = False,
+                        key_mask: Optional[jnp.ndarray] = None,
+                        block_size: int = 512) -> jnp.ndarray:
+    """Memory-efficient exact attention: scan over KV blocks with the
+    online-softmax recurrence. Peak memory is O(Tq·block) for scores instead
+    of O(Tq·Tk). q/k/v: (B, T, H, D); key_mask: (B, Tk) with 1=valid.
+
+    Under jit the scan compiles to a single XLA while-loop — static shapes,
+    no data-dependent Python control flow.
+    """
+    B, Tk, H, D = k.shape
+    Tq = q.shape[1]
+    Tk_orig = Tk
+    blk = min(block_size, Tk)
+    if Tk % blk != 0:  # pad keys to a block multiple; padded keys masked off
+        pad = blk - Tk % blk
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        km = key_mask if key_mask is not None else jnp.ones((B, Tk), q.dtype)
+        key_mask = jnp.pad(km, ((0, 0), (0, pad)))
+        Tk = Tk + pad
+    n_blocks = Tk // blk
+    # (n_blocks, B, blk, H, D) for scan
+    ks = jnp.moveaxis(k.reshape(B, n_blocks, blk, H, D), 1, 0)
+    vs = jnp.moveaxis(v.reshape(B, n_blocks, blk, H, D), 1, 0)
+    if key_mask is not None:
+        ms = jnp.moveaxis(key_mask.reshape(B, n_blocks, blk), 1, 0)
+    else:
+        ms = jnp.ones((n_blocks, B, blk), q.dtype)
+    iq = jnp.arange(Tq)
+    # Tq != Tk: align queries to the END of the keys (decode-style), matching
+    # full_attention's `ik <= iq + (Tk - Tq)` — offset uses the UNPADDED Tk
+    causal_off = Tk_orig - Tq
+
+    def body(carry, xs):
+        k_blk, v_blk, m_blk, blk_idx = xs
+        bias = mask_bias(m_blk)
+        if causal:
+            ik = blk_idx * blk + jnp.arange(blk)
+            cb = jnp.where(ik[None, :] <= iq[:, None] + causal_off, 0.0, NEG_INF)
+            bias = bias + cb[None, None, :, :]
+        carry = attention_block_accum(carry, q, k_blk, v_blk, bias)
+        return carry, None
+
+    init = _accum_init(q)
+    (o, l, _), _ = lax.scan(body, init,
+                            (ks, vs, ms, jnp.arange(n_blocks)))
+    return attention_finalize(o, l)
+
+
+def multi_head_attention(q, k, v, *, causal=False, key_mask=None,
+                         block_size: Optional[int] = None):
+    """Dispatch: full attention for short sequences, blockwise beyond
+    `block_size` (the cuDNN-helper dispatch pattern: same contract, faster
+    path picked when available)."""
+    if block_size is not None and k.shape[1] > block_size:
+        return blockwise_attention(q, k, v, causal=causal, key_mask=key_mask,
+                                   block_size=block_size)
+    bias = None if key_mask is None else mask_bias(key_mask)
+    return full_attention(q, k, v, bias=bias, causal=causal)

@@ -1,0 +1,152 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context mechanism beyond truncated BPTT
+(`MultiLayerNetwork.doTruncatedBPTT`, `MultiLayerNetwork.java:1140-1194`) and
+no parallelism besides data-parallel (`SURVEY.md` §2.4) — the model and the
+full sequence must fit on one device. This module is the TPU-native answer:
+shard the TIME axis of attention across a `seq` mesh axis so context length
+scales with chip count.
+
+Two strategies, both built on `shard_map` + XLA collectives over ICI:
+
+- **Ring attention** (`ring_attention`): each device keeps its Q shard
+  resident and rotates K/V shards around the ring with `lax.ppermute`,
+  folding each visiting block into the flash-attention online-softmax
+  accumulator (`ops/attention.py`). Communication is neighbor-to-neighbor —
+  exactly the ICI topology — and each hop's transfer overlaps the matmul on
+  the block already in hand (the ppermute for step i+1 is issued before the
+  step-i compute, letting XLA run the DMA concurrently).
+
+- **Ulysses all-to-all** (`ulysses_attention`): `lax.all_to_all` reshards
+  (T/n, H) → (T, H/n), runs full attention on complete sequences for the
+  local head subset, then reshards back. Two all-to-alls per call; wins when
+  H ≥ n_devices and per-device memory fits T·H/n.
+
+Both are exact: parity with single-device full attention is tested on the
+virtual 8-device CPU mesh (`tests/test_attention.py`), the same
+validate-distributed-without-a-cluster strategy the reference uses for Spark
+(`BaseSparkTest.java:89-90`).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.ops.attention import (
+    NEG_INF,
+    _accum_init,
+    attention_block_accum,
+    attention_finalize,
+    mask_bias,
+)
+
+
+def _ring_attention_local(q, k, v, key_mask, *, axis_name: str, n_shards: int,
+                          causal: bool):
+    """Per-device body under shard_map. q/k/v: the LOCAL time shard
+    (B, T_local, H, D); key_mask: (B, T_local) or None. Device i owns global
+    positions [i·T_local, (i+1)·T_local)."""
+    idx = lax.axis_index(axis_name)
+    Tl = q.shape[1]
+    iq_local = jnp.arange(Tl)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    carry = _accum_init(q)
+    kv = (k, v, key_mask if key_mask is not None
+          else jnp.ones(k.shape[:2], q.dtype))
+    for step in range(n_shards):
+        # block currently held arrived from device (idx - step): issue the
+        # rotation for the NEXT step first so the ppermute DMA overlaps the
+        # block matmul below
+        kv_next = jax.tree.map(
+            lambda a: lax.ppermute(a, axis_name, perm), kv) \
+            if step < n_shards - 1 else kv
+        k_blk, v_blk, m_blk = kv
+        src = (idx - step) % n_shards
+        bias = mask_bias(m_blk)
+        if causal:
+            q_pos = idx * Tl + iq_local  # global query positions
+            k_pos = src * Tl + jnp.arange(Tl)
+            cb = jnp.where(k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)
+            bias = bias + cb[None, None, :, :]
+        carry = attention_block_accum(carry, q, k_blk, v_blk, bias)
+        kv = kv_next
+    o, l, _ = carry
+    return attention_finalize(o, l)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, *, axis_name: str = "seq",
+                   causal: bool = False,
+                   key_mask: Optional[jnp.ndarray] = None,
+                   batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """Exact attention with the time axis sharded over `axis_name`.
+
+    q/k/v are GLOBAL arrays (B, T, H, D); T must divide by the axis size.
+    Returns the global (B, T, H, D) output (sharding propagated by jit when
+    called inside a pjit-ted step). `batch_axis` optionally also shards B
+    (dp × sp meshes).
+    """
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by "
+                         f"mesh axis '{axis_name}' size {n}")
+    bspec = batch_axis
+    spec = P(bspec, axis_name, None, None)
+    mask_spec = P(bspec, axis_name)
+    if key_mask is None:
+        key_mask = jnp.ones(k.shape[:2], q.dtype)
+    fn = partial(_ring_attention_local, axis_name=axis_name, n_shards=n,
+                 causal=causal)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, mask_spec),
+                     out_specs=spec, check_vma=False)(q, k, v, key_mask)
+
+
+def _ulysses_local(q, k, v, key_mask, *, axis_name: str, causal: bool):
+    """Per-device body: all-to-all from time-sharded to head-sharded, full
+    attention over the complete sequence for H/n heads, all-to-all back."""
+    from deeplearning4j_tpu.ops.attention import full_attention
+
+    # (B, T/n, H, D) → (B, T, H/n, D): split heads across devices, gather time
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    mask_g = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
+    bias = mask_bias(mask_g)
+    out = full_attention(qg, kg, vg, bias=bias, causal=causal)
+    # back: (B, T, H/n, D) → (B, T/n, H, D)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, *, axis_name: str = "seq",
+                      causal: bool = False,
+                      key_mask: Optional[jnp.ndarray] = None,
+                      batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism via two all-to-alls.
+    Requires n_heads % axis_size == 0."""
+    n = mesh.shape[axis_name]
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(f"n_heads {H} not divisible by mesh axis "
+                         f"'{axis_name}' size {n} (use ring_attention)")
+    if q.shape[1] % n != 0:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by "
+                         f"mesh axis '{axis_name}' size {n}")
+    bspec = batch_axis
+    spec = P(bspec, axis_name, None, None)
+    mask_spec = P(bspec, axis_name)
+    if key_mask is None:
+        key_mask = jnp.ones(k.shape[:2], q.dtype)
+    fn = partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, mask_spec),
+                     out_specs=spec, check_vma=False)(q, k, v, key_mask)

@@ -1,0 +1,263 @@
+"""Attention + sequence-parallelism tests.
+
+Strategies mirror the reference's validation patterns: (a) helper-vs-
+reference parity (the cuDNN-vs-builtin pattern, `TestConvolution.java`) —
+blockwise/ring/Ulysses must match full attention bit-for-bit-ish in fp64;
+(b) distributed-without-a-cluster (`BaseSparkTest.java:89-90`) — sequence
+parallelism runs on the virtual 8-device CPU mesh; (c) gradient checks
+(`GradientCheckUtil.java:62`) for the SelfAttention layer.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import (
+    GlobalPoolingLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SelfAttention,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.attention import (
+    blockwise_attention,
+    full_attention,
+    multi_head_attention,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
+
+
+def qkv(B=2, T=32, H=4, D=8, seed=0, dtype=jnp.float64):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)), dtype)
+    return mk(), mk(), mk()
+
+
+class TestBlockwise:
+    def test_matches_full(self):
+        q, k, v = qkv()
+        ref = full_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_size=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_matches_full_causal(self):
+        q, k, v = qkv()
+        ref = full_attention(q, k, v, causal=True)
+        out = blockwise_attention(q, k, v, causal=True, block_size=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_non_multiple_block(self):
+        q, k, v = qkv(T=30)
+        ref = full_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_size=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_key_mask(self):
+        q, k, v = qkv()
+        B, T = q.shape[:2]
+        mask = np.ones((B, T)); mask[:, T // 2:] = 0
+        mask = jnp.asarray(mask, q.dtype)
+        ref = full_attention(q[:, :, :, :], k[:, :T // 2], v[:, :T // 2])
+        out = blockwise_attention(q, k, v, key_mask=mask, block_size=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_fully_masked_row_is_zero(self):
+        """A sample whose keys are ALL masked must produce 0 output, not a
+        softmax over masked keys."""
+        q, k, v = qkv(B=2, T=16)
+        mask = np.ones((2, 16)); mask[1, :] = 0
+        mask = jnp.asarray(mask, q.dtype)
+        out = blockwise_attention(q, k, v, key_mask=mask, block_size=4)
+        assert float(jnp.max(jnp.abs(out[1]))) == 0.0
+        ref = full_attention(q[:1], k[:1], v[:1])
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_causal_decode_alignment(self):
+        """Tq != Tk causal: queries align to the END of the keys in both the
+        full and blockwise paths (decode-style cross attention)."""
+        q, k, v = qkv(T=16)
+        q = q[:, :4]
+        ref = full_attention(q, k, v, causal=True)
+        out = blockwise_attention(q, k, v, causal=True, block_size=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_causal_no_future_leak(self):
+        """Perturbing future positions must not change past outputs."""
+        q, k, v = qkv(T=16)
+        out1 = multi_head_attention(q, k, v, causal=True)
+        k2 = k.at[:, 10:].set(99.0)
+        v2 = v.at[:, 10:].set(-7.0)
+        q2 = q.at[:, 10:].set(3.0)
+        out2 = multi_head_attention(q2, k2, v2, causal=True)
+        np.testing.assert_allclose(np.asarray(out1[:, :10]),
+                                   np.asarray(out2[:, :10]),
+                                   rtol=1e-10, atol=1e-12)
+
+
+class TestSequenceParallel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_matches_full(self, causal):
+        assert len(jax.devices()) >= 8
+        mesh = make_mesh({"seq": 8})
+        q, k, v = qkv(B=2, T=64, H=4, D=8)
+        ref = full_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_ring_key_mask(self):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = qkv(B=2, T=64, H=4, D=8)
+        B, T = q.shape[:2]
+        mask = np.ones((B, T)); mask[0, 40:] = 0; mask[1, 17:] = 0
+        mask = jnp.asarray(mask, q.dtype)
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
+        ref = full_attention(q, k, v, bias=bias)
+        out = ring_attention(q, k, v, mesh, key_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_matches_full(self, causal):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = qkv(B=2, T=32, H=4, D=8)
+        ref = full_attention(q, k, v, causal=causal)
+        out = ulysses_attention(q, k, v, mesh, axis_name="seq", causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_ring_dp_sp_mesh(self):
+        """dp × sp: batch on 'data', time on 'seq' — composite mesh."""
+        mesh = make_mesh({"data": 2, "seq": 4})
+        q, k, v = qkv(B=4, T=32, H=2, D=4)
+        ref = full_attention(q, k, v, causal=True)
+        out = ring_attention(q, k, v, mesh, causal=True, batch_axis="data")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_ring_jit_long_context(self):
+        """Ring attention inside jit over the mesh: the long-context training
+        configuration — T=512 over 8 shards."""
+        mesh = make_mesh({"seq": 8})
+        q, k, v = qkv(B=1, T=512, H=2, D=4, dtype=jnp.float32)
+
+        @jax.jit
+        def f(q, k, v):
+            return ring_attention(q, k, v, mesh, causal=True)
+
+        out = f(q, k, v)
+        ref = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestSelfAttentionLayer:
+    def _net(self, causal=False, block_size=None):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Updater.NONE)
+                .list()
+                .layer(SelfAttention(n_in=6, n_out=8, n_heads=2, causal=causal,
+                                     block_size=block_size))
+                .layer(RnnOutputLayer(n_in=8, n_out=3, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        net = MultiLayerNetwork(conf, dtype=jnp.float64)
+        net.init()
+        return net
+
+    def _seq_ds(self, B=4, T=5, nin=6, nout=3, seed=3):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(B, T, nin))
+        y = np.eye(nout)[rng.integers(0, nout, (B, T))]
+        return DataSet(X, y)
+
+    def test_forward_shape(self):
+        net = self._net()
+        out = net.output(np.zeros((4, 5, 6)))
+        assert out.shape == (4, 5, 3)
+
+    def test_gradients(self):
+        net = self._net()
+        assert check_gradients(net, self._seq_ds(), subset=120)
+
+    def test_gradients_causal_blockwise(self):
+        net = self._net(causal=True, block_size=2)
+        assert check_gradients(net, self._seq_ds(), subset=120)
+
+    def test_masked_sequence(self):
+        """Key-masked positions must not affect valid outputs."""
+        net = self._net()
+        X = np.random.default_rng(0).normal(size=(2, 6, 6))
+        mask = np.ones((2, 6)); mask[:, 4:] = 0
+        y = np.eye(3)[np.zeros((2, 6), int)]
+        ds1 = DataSet(X, y, features_mask=mask, labels_mask=mask)
+        X2 = X.copy(); X2[:, 4:] = 123.0
+        ds2 = DataSet(X2, y, features_mask=mask, labels_mask=mask)
+        s1 = net.score(ds1); s2 = net.score(ds2)
+        assert abs(s1 - s2) < 1e-9
+
+    def test_trains(self):
+        net = self._net()
+        conf_net = net
+        ds = self._seq_ds(B=8)
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration as NNC
+        s0 = conf_net.score(ds)
+        conf2 = (NNC.Builder().seed(7).learning_rate(0.1)
+                 .list()
+                 .layer(SelfAttention(n_in=6, n_out=8, n_heads=2))
+                 .layer(RnnOutputLayer(n_in=8, n_out=3,
+                                       loss=LossFunction.MCXENT,
+                                       activation=Activation.SOFTMAX))
+                 .set_input_type(InputType.recurrent(6))
+                 .build())
+        net2 = MultiLayerNetwork(conf2)
+        net2.init()
+        for _ in range(30):
+            net2.fit(ds)
+        assert net2.score_value < s0
+
+    def test_no_projection_width_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="project_input"):
+            SelfAttention(n_in=6, n_out=8, n_heads=2, project_input=False)
+
+    def test_no_projection_forward(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .list()
+                .layer(SelfAttention(n_in=6, n_heads=2, project_input=False))
+                .layer(RnnOutputLayer(n_in=6, n_out=3))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        assert net.output(np.zeros((2, 4, 6), np.float32)).shape == (2, 4, 3)
+
+    def test_serde_roundtrip(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .list()
+                .layer(SelfAttention(n_in=6, n_out=8, n_heads=2, causal=True))
+                .layer(RnnOutputLayer(n_in=8, n_out=3))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+            MultiLayerConfiguration,
+        )
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        l0 = conf2.layers[0]
+        assert isinstance(l0, SelfAttention)
+        assert l0.n_heads == 2 and l0.causal is True
