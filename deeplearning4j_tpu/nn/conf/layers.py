@@ -619,9 +619,10 @@ class SelfAttention(FeedForwardLayer):
     `SURVEY.md` §5 long-context note); included because long-context is
     first-class in this build. Math is `ops/attention.py`: full softmax
     attention for short sequences, flash-style blockwise (O(T) memory) when
-    T > block_size, and — when the network is jitted over a mesh with a
-    `seq` axis by a distributed wrapper — ring attention
-    (`parallel/sequence.py`) via the same online-softmax accumulator.
+    T > block_size. Sequence-parallel attention over a sharded time axis is
+    a separate, manual API — `parallel/sequence.py` `ring_attention` /
+    `ulysses_attention` (same online-softmax accumulator); this layer always
+    computes over the full local sequence.
     """
 
     TYPE = "self_attention"

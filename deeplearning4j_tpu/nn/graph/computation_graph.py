@@ -52,9 +52,24 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self.listeners: List = []
-        self.score_value: Optional[float] = None
+        self._score = None
+        self._it_device: Optional[jnp.ndarray] = None
         self._jit_train = None
         self._jit_output = None
+
+    @property
+    def score_value(self) -> Optional[float]:
+        """Most recent loss; stored as a device array by the train loop and
+        synced to a Python float only when read (see
+        MultiLayerNetwork.score_value)."""
+        if self._score is None or isinstance(self._score, float):
+            return self._score
+        self._score = float(self._score)
+        return self._score
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score = v if (v is None or isinstance(v, float)) else float(v)
 
     # ------------------------------------------------------------------ init
     def init(self) -> None:
@@ -170,7 +185,10 @@ class ComputationGraph:
         """Pure train step (same shape as MultiLayerNetwork.train_step_fn so
         ParallelWrapper-style sharded jits can reuse it)."""
 
-        def step(params, upd, lstate, iteration, inputs, labels, fmasks, lmasks, rng):
+        seed = self.conf.seed
+
+        def step(params, upd, lstate, iteration, inputs, labels, fmasks, lmasks):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), iteration)
             (loss, new_lstate), grads = jax.value_and_grad(
                 self._loss_pure, has_aux=True)(params, lstate, inputs, labels,
                                                fmasks, lmasks, rng, True)
@@ -181,7 +199,7 @@ class ComputationGraph:
                     continue
                 new_params[name], new_upd[name] = apply_layer_update(
                     node.layer, upd[name], params[name], grads[name], iteration)
-            return new_params, new_upd, new_lstate, loss
+            return new_params, new_upd, new_lstate, iteration + 1, loss
 
         return step
 
@@ -205,7 +223,9 @@ class ComputationGraph:
                 and not isinstance(iterator, AsyncDataSetIterator):
             iterator = AsyncDataSetIterator(iterator)
         if self._jit_train is None:
-            self._jit_train = jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+            self._jit_train = jax.jit(self.train_step_fn(),
+                                      donate_argnums=(0, 1, 2, 3))
+        self._it_device = jnp.asarray(self.iteration, jnp.int32)
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -227,12 +247,13 @@ class ComputationGraph:
 
     def _fit_batch(self, mds: MultiDataSet):
         inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
-        it = jnp.asarray(self.iteration, jnp.int32)
-        self._params, self._upd_state, self._layer_state, loss = self._jit_train(
-            self._params, self._upd_state, self._layer_state, it,
-            inputs, labels, fmasks, lmasks, rng)
-        self.score_value = float(loss)
+        if self._it_device is None:
+            self._it_device = jnp.asarray(self.iteration, jnp.int32)
+        (self._params, self._upd_state, self._layer_state, self._it_device,
+         loss) = self._jit_train(
+            self._params, self._upd_state, self._layer_state, self._it_device,
+            inputs, labels, fmasks, lmasks)
+        self._score = loss  # device array; score_value property syncs lazily
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):

@@ -67,11 +67,30 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         self.listeners: List[Any] = []
-        self.score_value: Optional[float] = None
+        self._score: Optional[Any] = None
         self._rnn_state: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        self._it_device: Optional[jnp.ndarray] = None
         self._jit_train = None
         self._jit_output = None
         self._input_types = self._resolve_input_types()
+
+    # ----------------------------------------------------------------- score
+    @property
+    def score_value(self) -> Optional[float]:
+        """Loss of the most recent iteration (reference `Model.score()`).
+
+        Stored as a device array by the hot training loop and converted to a
+        Python float only on first read — reading the score forces a device
+        sync, and doing that every step would serialize the step pipeline
+        (each dispatch over the remote-TPU tunnel costs a round trip)."""
+        if self._score is None or isinstance(self._score, float):
+            return self._score
+        self._score = float(self._score)
+        return self._score
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score = v if (v is None or isinstance(v, float)) else float(v)
 
     # ------------------------------------------------------------------ init
     def _resolve_input_types(self) -> List[InputType]:
@@ -165,9 +184,17 @@ class MultiLayerNetwork:
         Exposed so distributed wrappers can re-jit it with shardings over a
         device mesh (parallel/ParallelWrapper — the reference's
         `ParallelWrapper.java` seam, with ICI all-reduce instead of
-        `Nd4j.averageAndPropagate`)."""
+        `Nd4j.averageAndPropagate`).
 
-        def step(params, upd, lstate, iteration, features, labels, fmask, lmask, rng):
+        The iteration counter is a DEVICE scalar carried (donated) through
+        the step, and the dropout rng is derived from it inside the trace —
+        so the host loop issues exactly one dispatch per step with no
+        host->device transfers besides the batch itself, and steps pipeline
+        without any synchronisation."""
+        seed = self.conf.seed
+
+        def step(params, upd, lstate, iteration, features, labels, fmask, lmask):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), iteration)
             (loss, new_lstate), grads = jax.value_and_grad(
                 self._loss_pure, has_aux=True)(params, lstate, features, labels,
                                                fmask, lmask, rng, True)
@@ -178,14 +205,14 @@ class MultiLayerNetwork:
                                                   grads[i], iteration)
                 new_params.append(p_new)
                 new_upd.append(u_new)
-            return new_params, new_upd, new_lstate, loss
+            return new_params, new_upd, new_lstate, iteration + 1, loss
 
         return step
 
     def _make_train_step(self):
         """Jit the train step with donated param/opt/state buffers — the ONE
         compiled XLA computation per step (in-place update in HBM)."""
-        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2))
+        return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 3))
 
     def _batch_arrays(self, ds: DataSet):
         f = jnp.asarray(ds.features, self.dtype)
@@ -211,6 +238,9 @@ class MultiLayerNetwork:
 
         if self._jit_train is None:
             self._jit_train = self._make_train_step()
+        # (re)sync the device-side iteration counter with the host counter
+        # once per fit() call, not per step
+        self._it_device = jnp.asarray(self.iteration, jnp.int32)
 
         from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
             OptimizationAlgorithm,
@@ -246,11 +276,13 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet):
         self._validate_labels(ds)
         f, l, fm, lm = self._batch_arrays(ds)
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
-        it = jnp.asarray(self.iteration, jnp.int32)
-        self._params, self._upd_state, self._layer_state, loss = self._jit_train(
-            self._params, self._upd_state, self._layer_state, it, f, l, fm, lm, rng)
-        self.score_value = float(loss)
+        if getattr(self, "_it_device", None) is None:
+            self._it_device = jnp.asarray(self.iteration, jnp.int32)
+        (self._params, self._upd_state, self._layer_state, self._it_device,
+         loss) = self._jit_train(
+            self._params, self._upd_state, self._layer_state, self._it_device,
+            f, l, fm, lm)
+        self._score = loss  # device array; score_value property syncs lazily
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):
@@ -329,8 +361,8 @@ class MultiLayerNetwork:
                     None if ds.features_mask is None else ds.features_mask[:, lo:hi],
                     None if ds.labels_mask is None else ds.labels_mask[:, lo:hi])
             self._fit_batch(window)
-            losses.append(self.score_value)
-        self.score_value = float(np.mean(losses))
+            losses.append(self._score)
+        self.score_value = float(np.mean([np.asarray(l) for l in losses]))
         # rnn carries are per-batch transients; restore persistent state slots
         for i, layer in enumerate(self.layers):
             if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:

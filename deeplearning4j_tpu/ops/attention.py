@@ -46,6 +46,11 @@ def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         ik = jnp.arange(Tk)[None, :]
         s = jnp.where(ik <= iq + (Tk - Tq), s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows: softmax over all-NEG_INF is uniform garbage — zero
+    # masked positions so such rows produce output 0, matching
+    # blockwise_attention's l == 0 finalisation (the two dispatch paths must
+    # agree for any mask)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 

@@ -58,8 +58,8 @@ def _ring_attention_local(q, k, v, key_mask, *, axis_name: str, n_shards: int,
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     carry = _accum_init(q)
-    kv = (k, v, key_mask if key_mask is not None
-          else jnp.ones(k.shape[:2], q.dtype))
+    # no mask → don't rotate a dummy mask through every ppermute hop
+    kv = (k, v) if key_mask is None else (k, v, key_mask)
     for step in range(n_shards):
         # block currently held arrived from device (idx - step): issue the
         # rotation for the NEXT step first so the ppermute DMA overlaps the
@@ -67,14 +67,15 @@ def _ring_attention_local(q, k, v, key_mask, *, axis_name: str, n_shards: int,
         kv_next = jax.tree.map(
             lambda a: lax.ppermute(a, axis_name, perm), kv) \
             if step < n_shards - 1 else kv
-        k_blk, v_blk, m_blk = kv
+        k_blk, v_blk = kv[0], kv[1]
         src = (idx - step) % n_shards
-        bias = mask_bias(m_blk)
+        bias = None if key_mask is None else mask_bias(kv[2])
         if causal:
             q_pos = idx * Tl + iq_local  # global query positions
             k_pos = src * Tl + jnp.arange(Tl)
             cb = jnp.where(k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)
-            bias = bias + cb[None, None, :, :]
+            cb = cb[None, None, :, :]
+            bias = cb if bias is None else bias + cb
         carry = attention_block_accum(carry, q, k_blk, v_blk, bias)
         kv = kv_next
     o, l, _ = carry
@@ -99,11 +100,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          f"mesh axis '{axis_name}' size {n}")
     bspec = batch_axis
     spec = P(bspec, axis_name, None, None)
-    mask_spec = P(bspec, axis_name)
-    if key_mask is None:
-        key_mask = jnp.ones(k.shape[:2], q.dtype)
     fn = partial(_ring_attention_local, axis_name=axis_name, n_shards=n,
                  causal=causal)
+    if key_mask is None:
+        return shard_map(lambda qq, kk, vv: fn(qq, kk, vv, None), mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    mask_spec = P(bspec, axis_name)
     return shard_map(fn, mesh=mesh,
                      in_specs=(spec, spec, spec, mask_spec),
                      out_specs=spec, check_vma=False)(q, k, v, key_mask)
@@ -118,8 +121,11 @@ def _ulysses_local(q, k, v, key_mask, *, axis_name: str, causal: bool):
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    mask_g = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
-    bias = mask_bias(mask_g)
+    if key_mask is None:  # skip the mask all-gather + zero bias entirely
+        bias = None
+    else:
+        mask_g = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
+        bias = mask_bias(mask_g)
     out = full_attention(qg, kg, vg, bias=bias, causal=causal)
     # back: (B, T, H/n, D) → (B, T/n, H, D)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
@@ -143,10 +149,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          f"mesh axis '{axis_name}' size {n}")
     bspec = batch_axis
     spec = P(bspec, axis_name, None, None)
-    mask_spec = P(bspec, axis_name)
-    if key_mask is None:
-        key_mask = jnp.ones(k.shape[:2], q.dtype)
     fn = partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    if key_mask is None:
+        return shard_map(lambda qq, kk, vv: fn(qq, kk, vv, None), mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    mask_spec = P(bspec, axis_name)
     return shard_map(fn, mesh=mesh,
                      in_specs=(spec, spec, spec, mask_spec),
                      out_specs=spec, check_vma=False)(q, k, v, key_mask)

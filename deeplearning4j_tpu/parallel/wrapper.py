@@ -96,10 +96,10 @@ class ParallelWrapper:
             step,
             in_shardings=(self._param_sh, self._upd_sh, self._lstate_sh,
                           self._repl, self._batch_sh, self._batch_sh,
-                          self._batch_sh, self._batch_sh, self._repl),
+                          self._batch_sh, self._batch_sh),
             out_shardings=(self._param_sh, self._upd_sh, self._lstate_sh,
-                           self._repl),
-            donate_argnums=(0, 1, 2),
+                           self._repl, self._repl),
+            donate_argnums=(0, 1, 2, 3),
         )
 
     @property
@@ -150,6 +150,8 @@ class ParallelWrapper:
             raise NotImplementedError(
                 "truncated BPTT under ParallelWrapper is not supported yet; "
                 "train tBPTT models single-chip via MultiLayerNetwork.fit")
+        net._it_device = jax.device_put(
+            jnp.asarray(net.iteration, jnp.int32), self._repl)
         for _ in range(epochs):
             for listener in net.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -160,13 +162,11 @@ class ParallelWrapper:
                     continue
                 net._validate_labels(ds)
                 f, l, fm, lm = net._batch_arrays(ds)
-                rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed),
-                                         net.iteration)
-                it = jnp.asarray(net.iteration, jnp.int32)
-                net._params, net._upd_state, net._layer_state, loss = self._jit_step(
-                    net._params, net._upd_state, net._layer_state, it,
-                    f, l, fm, lm, rng)
-                net.score_value = float(loss)
+                (net._params, net._upd_state, net._layer_state, net._it_device,
+                 loss) = self._jit_step(
+                    net._params, net._upd_state, net._layer_state,
+                    net._it_device, f, l, fm, lm)
+                net._score = loss  # device array; synced lazily on read
                 net.iteration += 1
                 for listener in net.listeners:
                     if hasattr(listener, "record_batch"):
