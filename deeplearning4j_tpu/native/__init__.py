@@ -1,0 +1,19 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime around the JVM is native: libnd4j (C++) for tensor
+storage/ops and DataVec's native-backed ETL (SURVEY §2.9). In this build the
+device compute path is XLA; the native seam that remains hot on the HOST is
+the input pipeline — parsing and staging batches fast enough to keep the
+chip fed. Those pieces are implemented in C++ (`deeplearning4j_tpu/native/
+src/`), compiled on first use with g++ into `_dl4jtpu_native.so`, and loaded
+here through ctypes. Every entry point has a pure-Python fallback: the
+framework works without a compiler; with one, the hot host paths go native.
+"""
+from deeplearning4j_tpu.native.loader import (
+    count_words,
+    csv_parse_numeric,
+    native_available,
+    native_lib,
+)
+
+__all__ = ["count_words", "csv_parse_numeric", "native_available", "native_lib"]

@@ -115,6 +115,29 @@ class VocabConstructor:
         cache.update_indices()
         return cache
 
+    def build_vocab_from_files(self, paths, lowercase: bool = True) -> AbstractCache:
+        """Whitespace-tokenized corpus files → vocab. The count pass — the
+        hot loop of `VocabConstructor.buildJointVocabulary` — runs in the
+        C++ native counter when available (`native/src/dl4jtpu_native.cpp`),
+        with a line-splitting Python fallback."""
+        from deeplearning4j_tpu.native import count_words
+
+        counts = count_words(list(paths), lowercase=lowercase)
+        if counts is None:
+            def sequences():
+                for p in paths:
+                    with open(p, "r") as f:
+                        for line in f:
+                            yield (line.lower() if lowercase else line).split()
+
+            return self.build_vocab(sequences())
+        cache = AbstractCache()
+        for w, c in counts.items():
+            cache.add_token(VocabWord(w, float(c)))
+        cache.remove_below(self.min_word_frequency)
+        cache.update_indices()
+        return cache
+
 
 def build_huffman_tree(cache: AbstractCache, max_code_length: int = 40) -> None:
     """Assign Huffman codes/points to every vocab word for hierarchical

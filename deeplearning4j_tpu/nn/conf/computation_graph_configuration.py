@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
     GlobalConf,
     _auto_preprocessor,
     _merge_layer_defaults,
+    _warn_loss_activation_mismatch,
 )
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     InputPreProcessor,
@@ -530,9 +531,10 @@ class GraphBuilder:
 
         topo = self._topological_sort()
         # merge hyperparameter defaults into each layer
-        for node in self._nodes.values():
+        for name, node in self._nodes.items():
             if node.is_layer:
                 node.layer = _merge_layer_defaults(node.layer, self._g)
+                _warn_loss_activation_mismatch(node.layer, name)
 
         conf = ComputationGraphConfiguration(
             network_inputs=list(self._inputs),

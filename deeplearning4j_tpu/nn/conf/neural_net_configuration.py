@@ -297,6 +297,8 @@ class ListBuilder:
     def build(self) -> "MultiLayerConfiguration":
         layers = [l for l in self._layers if l is not None]
         merged = [_merge_layer_defaults(l, self._g) for l in layers]
+        for i, l in enumerate(merged):
+            _warn_loss_activation_mismatch(l, i)
         pre = dict(self._preprocessors)
         if self._input_type is not None:
             _infer_shapes(merged, pre, self._input_type)
@@ -310,6 +312,38 @@ class ListBuilder:
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
         )
+
+
+def _warn_loss_activation_mismatch(layer: Layer, idx) -> None:
+    """Config sanity warning (reference `util/LayerValidation.java` role):
+    cross-entropy losses over a non-probability activation train silently to
+    garbage — the default global activation (tanh) reaching an output layer
+    is almost always a config mistake."""
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    loss = getattr(layer, "loss", None)
+    if loss is None:
+        return
+    act = layer.activation
+    # MCXENT/NLL = -Σ y·log(p): nothing pushes non-target outputs DOWN unless
+    # the activation normalizes across classes, so only softmax trains
+    # correctly; XENT (binary CE) has the (1-y)·log(1-p) term and wants an
+    # independent per-unit probability
+    ok_by_loss = {
+        LossFunction.MCXENT: (Activation.SOFTMAX,),
+        LossFunction.XENT: (Activation.SIGMOID,),
+        LossFunction.NEGATIVELOGLIKELIHOOD: (Activation.SOFTMAX,),
+    }
+    allowed = ok_by_loss.get(loss)
+    if allowed is not None and act is not None and act not in allowed:
+        import logging
+
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "layer %s: loss %s over activation %s — cross-entropy expects a "
+            "probability output (%s); set the output layer's activation "
+            "explicitly (the global default activation was applied)",
+            idx, loss.value, act.value, "/".join(a.value for a in allowed))
 
 
 def _merge_layer_defaults(layer: Layer, g: GlobalConf) -> Layer:
