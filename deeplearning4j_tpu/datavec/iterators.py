@@ -96,6 +96,20 @@ class RecordReaderDataSetIterator(_GeneratorIterator):
         self.num_classes = num_classes
         self.regression = regression
         self.label_index_to = label_index_to if label_index_to is not None else label_index
+        # string class labels are mapped to indices in first-seen order
+        # (stable across epochs: readers restart deterministically)
+        self._label_map: Dict[str, int] = {}
+
+    def _class_index(self, v) -> float:
+        if isinstance(v, str):
+            idx = self._label_map.setdefault(v, len(self._label_map))
+            if idx >= self.num_classes:
+                raise ValueError(
+                    f"found {len(self._label_map)} distinct string labels "
+                    f"({sorted(self._label_map)}) but num_classes="
+                    f"{self.num_classes}")
+            return float(idx)
+        return float(v)
 
     def _convert(self, rec: Record) -> Tuple[List[float], Optional[np.ndarray]]:
         li = self.label_index
@@ -110,7 +124,7 @@ class RecordReaderDataSetIterator(_GeneratorIterator):
         if self.regression:
             label = np.asarray([float(v) for v in rec[li:hi + 1]], np.float32)
         else:
-            label = _one_hot(float(rec[li]), self.num_classes)
+            label = _one_hot(self._class_index(rec[li]), self.num_classes)
         return feats, label
 
     def _generate(self):
@@ -181,7 +195,17 @@ class SequenceRecordReaderDataSetIterator(_GeneratorIterator):
                                   else _one_hot(float(rec[li]), self.num_classes))
                 yield np.asarray(f_rows, np.float32), np.stack(l_rows)
         else:
-            for seq, lseq in zip(self.reader, self.label_reader):
+            import itertools
+
+            _END = object()
+            for seq, lseq in itertools.zip_longest(
+                    self.reader, self.label_reader, fillvalue=_END):
+                if seq is _END or lseq is _END:
+                    which = "label" if seq is _END else "feature"
+                    raise ValueError(
+                        f"{which} reader ran out of sequences before the "
+                        "other — the two readers must yield the same number "
+                        "of sequences")
                 f = np.asarray([_num(r, 0, len(r)) for r in seq], np.float32)
                 if self.regression:
                     l = np.asarray([[float(v) for v in r] for r in lseq], np.float32)

@@ -166,7 +166,9 @@ class ImageRecordReader(RecordReader):
                 raise ValueError(
                     f"{p}: image has {img.shape[0]} values, expected "
                     f"{self.height}x{self.width}x{self.channels}={expect}")
-            rec: Record = list(img)
+            # tolist() unboxes to plain Python floats in one C call (list()
+            # would create one np.float32 object per pixel)
+            rec: Record = img.tolist()
             rec.append(float(self.labels.index(p.parent.name)))
             yield rec
 

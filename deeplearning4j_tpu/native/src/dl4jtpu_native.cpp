@@ -68,23 +68,31 @@ void* dl4j_csv_parse(const char* path, int skip_lines, char delim) {
   while (p < end) {
     const char* line_end = p;
     while (line_end < end && *line_end != '\n') ++line_end;
-    // skip blank lines (incl. trailing newline at EOF)
-    bool blank = true;
+    // skip truly empty lines only (the Python path does the same);
+    // whitespace-only lines are NOT numeric CSV -> bail to the fallback so
+    // both paths agree on them
+    bool empty = (p == line_end);
+    bool ws_only = !empty;
     for (const char* q = p; q < line_end; ++q)
-      if (!std::isspace(static_cast<unsigned char>(*q))) { blank = false; break; }
-    if (!blank) {
+      if (!std::isspace(static_cast<unsigned char>(*q))) { ws_only = false; break; }
+    if (ws_only) { delete res; return new CsvResult(); }
+    if (!empty) {
       row.clear();
       const char* q = p;
       while (q <= line_end) {
         const char* tok_end = q;
         while (tok_end < line_end && *tok_end != delim) ++tok_end;
+        // strtod accepts hex floats ("0x1F") that Python's float() rejects:
+        // any x/X in the token means this is not plain-decimal CSV -> bail
+        for (const char* r = q; r < tok_end; ++r)
+          if (*r == 'x' || *r == 'X') { delete res; return new CsvResult(); }
         char* conv_end = nullptr;
         // strtod stops at delim/newline; ensure token non-empty
         double v = std::strtod(q, &conv_end);
-        if (conv_end == q || conv_end > tok_end) { delete res; res = new CsvResult(); return res; }
+        if (conv_end == q || conv_end > tok_end) { delete res; return new CsvResult(); }
         // only whitespace may remain between number and delimiter
         for (const char* r = conv_end; r < tok_end; ++r)
-          if (!std::isspace(static_cast<unsigned char>(*r))) { delete res; res = new CsvResult(); return res; }
+          if (!std::isspace(static_cast<unsigned char>(*r))) { delete res; return new CsvResult(); }
         row.push_back(v);
         if (tok_end >= line_end) break;
         q = tok_end + 1;

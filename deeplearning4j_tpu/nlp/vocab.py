@@ -124,11 +124,19 @@ class VocabConstructor:
 
         counts = count_words(list(paths), lowercase=lowercase)
         if counts is None:
+            # byte-level split (ASCII whitespace), NOT str.split(): the
+            # native counter tokenizes on C isspace, and the two paths must
+            # produce the same vocab for the same corpus (str.split would
+            # additionally break on U+00A0/U+2028 etc.)
             def sequences():
                 for p in paths:
-                    with open(p, "r") as f:
-                        for line in f:
-                            yield (line.lower() if lowercase else line).split()
+                    with open(p, "rb") as f:
+                        for raw in f:
+                            toks = [t.decode("utf-8", errors="replace")
+                                    for t in raw.split()]
+                            if lowercase:
+                                toks = [t.lower() for t in toks]
+                            yield toks
 
             return self.build_vocab(sequences())
         cache = AbstractCache()

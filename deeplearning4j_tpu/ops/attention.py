@@ -151,10 +151,17 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def multi_head_attention(q, k, v, *, causal=False, key_mask=None,
                          block_size: Optional[int] = None):
-    """Dispatch: full attention for short sequences, blockwise beyond
-    `block_size` (the cuDNN-helper dispatch pattern: same contract, faster
-    path picked when available)."""
-    if block_size is not None and k.shape[1] > block_size:
+    """Dispatch (the cuDNN-helper pattern: same contract, fastest available
+    path picked): pallas flash kernel for long unmasked sequences, XLA
+    blockwise beyond `block_size`, full attention otherwise."""
+    long_seq = block_size is not None and k.shape[1] > block_size
+    if long_seq and key_mask is None:
+        from deeplearning4j_tpu.ops.pallas_attention import flash_attention_or_none
+
+        out = flash_attention_or_none(q, k, v, causal=causal)
+        if out is not None:
+            return out
+    if long_seq:
         return blockwise_attention(q, k, v, causal=causal, key_mask=key_mask,
                                    block_size=block_size)
     bias = None if key_mask is None else mask_bias(key_mask)

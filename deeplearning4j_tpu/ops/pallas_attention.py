@@ -1,0 +1,179 @@
+"""Pallas TPU flash-attention forward kernel.
+
+The role of `deeplearning4j-cuda`'s helpers in the reference (SURVEY §2.3):
+a hand-written accelerator kernel behind the same contract as the built-in
+path, picked when available, falling through silently otherwise
+(`ConvolutionLayer.initializeHelper`, `ConvolutionLayer.java:69-79`). Here
+the built-in paths are `ops/attention.py` full/blockwise attention (XLA);
+this module is the Mosaic/Pallas fast path for the no-mask case.
+
+Kernel shape: grid (B·H, Tq/block_q, Tk/block_k), innermost KV dimension
+sequential so the online-softmax accumulator lives in VMEM scratch across
+KV steps (m/l/acc — the flash recurrence). Q·Kᵀ and P·V hit the MXU; the
+rescale/exp traffic stays in VMEM, so HBM sees each K/V tile exactly once.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # with causal masking, KV blocks strictly above the diagonal contribute
+    # nothing — skip their compute entirely
+    needed = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _step():
+        # bf16 operands into the MXU (its native feed width), f32 accumulate
+        q = q_ref[0].astype(jnp.bfloat16)  # (block_q, D)
+        k = k_ref[0].astype(jnp.bfloat16)  # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]                                 # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        # rows fully masked so far sit at m ~ NEG_INF: zero their weights so
+        # l stays 0 (finalize maps them to output 0, matching
+        # attention.attention_finalize)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(jnp.bfloat16), v_ref[0].astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o = jnp.where(l > 0, acc_scr[:] / jnp.where(l > 0, l, 1.0), 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Exact attention, (B, T, H, D) layout, no key mask. Requires Tq/Tk
+    divisible by the block sizes (callers pad or fall back)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(f"Tq={Tq}/Tk={Tk} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if causal and Tq != Tk:
+        raise ValueError("causal flash path requires Tq == Tk")
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+
+    # (B, T, H, D) -> (B*H, T, D): head-major rows so each grid program owns
+    # one contiguous (T, D) slab
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, sm_scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+
+    # NOTE: clamping the KV index map for skipped causal blocks (so they
+    # issue no DMA) was measured SLOWER on v5e — the skipped steps leave no
+    # compute to hide the next real tile's DMA behind. Plain indexing + the
+    # kernel-side compute skip wins.
+    def kv_index(b, i, j):
+        return (b, j, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, D), jnp.float32),    # unnormalised output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+_probe_ok: Optional[bool] = None
+
+
+def _platform_supported() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def flash_attention_or_none(q, k, v, *,
+                            causal: bool = False) -> Optional[jnp.ndarray]:
+    """Dispatch probe (the reflective cuDNN-helper load): returns None when
+    the kernel can't serve this call — wrong platform, non-divisible shapes,
+    tiny sequences — or when a first-call compile probe failed. Block sizes:
+    largest of 512/256/128 dividing the sequence (bigger tiles amortise the
+    per-grid-step overhead that dominates this kernel on v5e)."""
+    global _probe_ok
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block = next((b for b in (512, 256, 128) if Tq % b == 0 and Tk % b == 0),
+                 None)
+    if (_probe_ok is False or block is None or not _platform_supported()
+            or (causal and Tq != Tk)
+            or D % 128 or q.dtype not in (jnp.float32, jnp.bfloat16)):
+        return None
+    try:
+        out = flash_attention(q, k, v, causal=causal, block_q=block,
+                              block_k=block)
+        _probe_ok = True
+        return out
+    except Exception as e:  # Mosaic/compile failure: remember and fall back
+        if _probe_ok is None:
+            logger.warning(
+                "pallas flash-attention unavailable (%s); using XLA "
+                "blockwise path", e)
+        _probe_ok = False
+        return None

@@ -204,3 +204,29 @@ def test_feeds_network_end_to_end(csv_file):
     it = RecordReaderDataSetIterator(rr, batch_size=5, label_index=3, num_classes=3)
     net.fit(it, epochs=2)
     assert np.isfinite(net.score_value)
+
+
+def test_string_class_labels():
+    """String label columns one-hot via a first-seen label map (the use the
+    reader layer advertises for string columns)."""
+    recs = [[1.0, 2.0, "cat"], [3.0, 4.0, "dog"], [5.0, 6.0, "cat"]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(recs),
+                                     batch_size=3, label_index=2, num_classes=2)
+    b = next(iter(it))
+    assert np.argmax(b.labels, axis=1).tolist() == [0, 1, 0]
+    # too many distinct labels -> informative error
+    bad = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs + [[7.0, 8.0, "bird"]]),
+        batch_size=4, label_index=2, num_classes=2)
+    with pytest.raises(ValueError, match="distinct string labels"):
+        list(bad)
+
+
+def test_two_reader_count_mismatch_raises():
+    feats = CollectionSequenceRecordReader([[[1.0]], [[2.0]]])
+    labs = CollectionSequenceRecordReader([[[0.0]]])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, label_reader=labs,
+        alignment=AlignmentMode.ALIGN_END)
+    with pytest.raises(ValueError, match="same number"):
+        list(it)

@@ -142,3 +142,17 @@ def test_sequence_iterator_requires_num_classes():
     with pytest.raises(ValueError, match="num_classes"):
         SequenceRecordReaderDataSetIterator(
             CollectionSequenceRecordReader([[[1.0, 0.0]]]), 2, label_index=1)
+
+
+def test_csv_native_rejects_hex_and_ws_only_lines(tmp_path):
+    """strtod accepts hex floats and the C loop would skip whitespace-only
+    lines — both must bail to the Python fallback for parity."""
+    p = tmp_path / "hex.csv"
+    p.write_text("1,0x1F,3\n")
+    assert csv_parse_numeric(p) is None
+    from deeplearning4j_tpu.datavec import CSVRecordReader
+    assert list(CSVRecordReader(p)) == [[1.0, "0x1F", 3.0]]
+
+    w = tmp_path / "ws.csv"
+    w.write_text("1,2\n   \n3,4\n")
+    assert csv_parse_numeric(w) is None  # fallback decides ws-only semantics

@@ -1,0 +1,61 @@
+"""Pallas flash-attention kernel parity tests.
+
+Runs the kernel in interpreter mode (tests execute on the virtual CPU mesh,
+conftest.py) against the XLA full-attention reference — the accelerated-path
+parity strategy of the reference's cuDNN tests
+(`deeplearning4j-cuda/src/test/.../TestConvolution.java`). A real-TPU
+compile/run of the same kernel happens via bench.py / the driver.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.attention import full_attention
+from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+
+def _qkv(B=2, T=256, H=2, D=128, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_full(causal):
+    q, k, v = _qkv()
+    ref = full_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    # kernel feeds the MXU bf16 operands (f32 accumulate) — tolerance is
+    # bf16 mantissa granularity, matching the on-device error vs XLA f32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_flash_multiple_kv_blocks():
+    # Tk spans 4 KV blocks: exercises the online-softmax rescale chain
+    q, k, v = _qkv(B=1, T=512, H=1, D=128, seed=1)
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_flash_rejects_unaligned():
+    q, k, v = _qkv(T=200)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_dispatch_probe_declines_on_cpu():
+    """On the CPU test platform the probe must decline (compiled Mosaic
+    kernels are TPU-only) and multi_head_attention must fall back to the
+    XLA blockwise path with identical results."""
+    from deeplearning4j_tpu.ops.attention import multi_head_attention
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention_or_none
+
+    q, k, v = _qkv(B=1, T=256, H=1, D=128)
+    assert flash_attention_or_none(q, k, v) is None
+    out = multi_head_attention(q, k, v, block_size=128)
+    ref = full_attention(q, k, v)
+    # probe declined -> XLA blockwise path: exact-math parity applies
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
