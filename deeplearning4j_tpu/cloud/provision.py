@@ -1,0 +1,68 @@
+"""TPU pod provisioning descriptor (reference `aws/ec2/provision/
+ClusterSetup.java` role, SURVEY §2.4).
+
+The reference shells out to the AWS SDK to stand up EC2 workers. The TPU
+equivalent is a TPU-VM/pod slice; actually creating one is an infra action
+this environment cannot perform (no egress), so the descriptor renders the
+exact `gcloud` commands — scriptable, reviewable, testable."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class TpuPodSpec:
+    """Describes a TPU pod slice for a training job."""
+
+    name: str
+    accelerator_type: str = "v5litepod-8"  # e.g. v5litepod-8, v4-32
+    zone: str = "us-central1-a"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: str = ""
+    preemptible: bool = False
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def create_command(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "create", self.name,
+               f"--zone={self.zone}",
+               f"--accelerator-type={self.accelerator_type}",
+               f"--version={self.runtime_version}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        if self.preemptible:
+            cmd.append("--preemptible")
+        if self.labels:
+            cmd.append("--labels=" + ",".join(
+                f"{k}={v}" for k, v in sorted(self.labels.items())))
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "delete", self.name,
+               f"--zone={self.zone}", "--quiet"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def ssh_command(self, worker: str = "all", command: str = "") -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.name,
+               f"--zone={self.zone}", f"--worker={worker}"]
+        if command:
+            cmd.append(f"--command={command}")
+        return cmd
+
+    @property
+    def num_chips(self) -> int:
+        """Chip count from the accelerator type. The numeric suffix counts
+        CHIPS for v5e/v5p/v6e-style names (v5litepod-8 → 8) but TENSORCORES
+        for v2/v3/v4 (v4-32 → 16 chips: 2 cores per chip)."""
+        gen, _, suffix = self.accelerator_type.rpartition("-")
+        try:
+            n = int(suffix)
+        except ValueError:
+            raise ValueError(
+                f"cannot parse chip count from accelerator type "
+                f"{self.accelerator_type!r}")
+        if gen in ("v2", "v3", "v4"):
+            return n // 2
+        return n

@@ -1,0 +1,173 @@
+"""Object-store dataset/model IO (reference `aws/s3/` role, SURVEY §2.4)."""
+from __future__ import annotations
+
+import io
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def _ds_to_bytes(ds: DataSet) -> bytes:
+    buf = io.BytesIO()
+    arrays = {"features": ds.features}
+    if ds.labels is not None:
+        arrays["labels"] = ds.labels
+    if ds.features_mask is not None:
+        arrays["features_mask"] = ds.features_mask
+    if ds.labels_mask is not None:
+        arrays["labels_mask"] = ds.labels_mask
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _ds_from_bytes(raw: bytes) -> DataSet:
+    z = np.load(io.BytesIO(raw), allow_pickle=False)
+    return DataSet(z["features"],
+                   z["labels"] if "labels" in z else None,
+                   z["features_mask"] if "features_mask" in z else None,
+                   z["labels_mask"] if "labels_mask" in z else None)
+
+
+class DataSetStorage:
+    """Key → bytes object store with DataSet/model helpers (reference
+    `S3Uploader` / `BaseS3DataSetIterator` surface)."""
+
+    # -- raw object contract (backends implement) -------------------------
+    def put_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    # -- dataset/model helpers -------------------------------------------
+    def put_dataset(self, key: str, ds: DataSet) -> None:
+        self.put_bytes(key, _ds_to_bytes(ds))
+
+    def get_dataset(self, key: str) -> DataSet:
+        return _ds_from_bytes(self.get_bytes(key))
+
+    def put_model(self, key: str, net) -> None:
+        import tempfile
+
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        with tempfile.NamedTemporaryFile(suffix=".zip") as f:
+            write_model(net, f.name)
+            f.seek(0)
+            self.put_bytes(key, Path(f.name).read_bytes())
+
+    def get_model(self, key: str):
+        import tempfile
+
+        from deeplearning4j_tpu.util.serialization import restore_model
+
+        with tempfile.NamedTemporaryFile(suffix=".zip") as f:
+            f.write(self.get_bytes(key))
+            f.flush()
+            return restore_model(f.name)
+
+
+class LocalStorage(DataSetStorage):
+    """Filesystem backend — always available; also the test double for the
+    gated cloud backends (the reference tests S3 paths against local files
+    the same way)."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        p = (self.root / key).resolve()
+        # Path.is_relative_to, not a string prefix compare: "/data/bucket"
+        # must not admit "/data/bucket-evil"
+        if not p.is_relative_to(self.root.resolve()):
+            raise ValueError(f"key {key!r} escapes the storage root")
+        return p
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._path(key).read_bytes()
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        return sorted(str(f.relative_to(self.root))
+                      for f in self.root.rglob("*")
+                      if f.is_file() and str(f.relative_to(self.root)).startswith(prefix))
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+
+class GCSStorage(DataSetStorage):
+    """Google Cloud Storage backend. Gated: requires google-cloud-storage
+    (not bundled; this environment has no egress)."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            from google.cloud import storage  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "GCSStorage requires the google-cloud-storage package; use "
+                "LocalStorage in this environment") from e
+        self._bucket = storage.Client().bucket(bucket)
+        self._prefix = prefix.rstrip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._bucket.blob(self._key(key)).upload_from_string(data)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._bucket.blob(self._key(key)).download_as_bytes()
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        full = self._key(prefix)
+        skip = len(self._prefix) + 1 if self._prefix else 0
+        return sorted(b.name[skip:] for b in self._bucket.list_blobs(prefix=full))
+
+    def exists(self, key: str) -> bool:
+        return self._bucket.blob(self._key(key)).exists()
+
+
+class StorageDataSetIterator(DataSetIterator):
+    """Iterate DataSets stored under a key prefix (reference
+    `BaseS3DataSetIterator.java`)."""
+
+    def __init__(self, storage: DataSetStorage, prefix: str = ""):
+        self.storage = storage
+        self.prefix = prefix
+        self._keys: Optional[List[str]] = None
+        self._pos = 0
+
+    def reset(self) -> None:
+        self._keys = self.storage.list_keys(self.prefix)
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        if self._keys is None:
+            self.reset()
+        return self._pos < len(self._keys)
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds = self.storage.get_dataset(self._keys[self._pos])
+        self._pos += 1
+        return ds
+
+    def batch(self) -> int:
+        return -1

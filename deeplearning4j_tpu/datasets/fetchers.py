@@ -217,3 +217,78 @@ class CifarDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self.batch_size
+
+
+class LFWDataSetIterator(DataSetIterator):
+    """LFW-shaped iterator (reference `LFWDataSetIterator.java`: Labeled
+    Faces in the Wild — face images labeled by identity). Reads a cached
+    `lfw/data.npz` (images uint8 NHWC + integer labels) when present;
+    otherwise generates deterministic synthetic faces (per-identity facial
+    geometry + lighting/pose jitter) — the zero-egress stand-in pattern all
+    fetchers here share."""
+
+    def __init__(self, batch_size: int, num_examples: int = 1000,
+                 image_shape: Tuple[int, int, int] = (40, 40, 3),
+                 num_labels: int = 10, seed: int = 6, flatten: bool = False):
+        self.batch_size = batch_size
+        self.flatten = flatten
+        H, W, C = image_shape
+        npz = DATA_DIR / "lfw" / "data.npz"
+        if npz.exists():
+            d = np.load(npz)
+            imgs = d["images"].astype(np.float32) / 255.0
+            y = d["labels"].astype(np.int64)
+            num_labels = int(y.max()) + 1
+            n = min(num_examples, len(imgs))
+            imgs, y = imgs[:n], y[:n]
+        else:
+            n = num_examples
+            rng = np.random.default_rng(seed)
+            y = rng.integers(0, num_labels, n)
+            # per-identity facial geometry (stable across examples)
+            id_rng = np.random.default_rng(seed + 1)
+            face_w = id_rng.uniform(0.55, 0.8, num_labels)
+            face_h = id_rng.uniform(0.6, 0.85, num_labels)
+            eye_dx = id_rng.uniform(0.12, 0.22, num_labels)
+            eye_y = id_rng.uniform(0.35, 0.45, num_labels)
+            mouth_y = id_rng.uniform(0.65, 0.75, num_labels)
+            skin = id_rng.uniform(0.4, 0.9, (num_labels, C))
+            xs, ys = np.meshgrid(np.linspace(-1, 1, W), np.linspace(-1, 1, H))
+            imgs = np.empty((n, H, W, C), np.float32)
+            jx = rng.uniform(-0.08, 0.08, n)
+            jy = rng.uniform(-0.08, 0.08, n)
+            light = rng.uniform(0.75, 1.1, n)
+            for i in range(n):
+                c = y[i]
+                ex, ey = xs - jx[i], ys - jy[i]
+                face = ((ex / face_w[c]) ** 2 + (ey / face_h[c]) ** 2) < 1.0
+                img = np.full((H, W), 0.08, np.float32)
+                img[face] = 0.75
+                for sx in (-1, 1):  # eyes
+                    eye = ((ex - sx * eye_dx[c] * 2) ** 2
+                           + (ey + (1 - 2 * eye_y[c])) ** 2) < 0.015
+                    img[eye] = 0.1
+                mouth = (np.abs(ey - (2 * mouth_y[c] - 1)) < 0.05) & (np.abs(ex) < 0.25)
+                img[mouth] = 0.25
+                imgs[i] = (img[..., None] * skin[c] * light[i])
+            imgs = np.clip(imgs + 0.04 * rng.standard_normal(imgs.shape), 0, 1
+                           ).astype(np.float32)
+        self.num_labels = num_labels
+        self.features = imgs.reshape(len(imgs), -1) if flatten else imgs
+        self.labels = np.eye(num_labels, dtype=np.float32)[y]
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.features)
+
+    def next(self):
+        lo = self._pos
+        hi = min(lo + self.batch_size, len(self.features))
+        self._pos = hi
+        return DataSet(self.features[lo:hi], self.labels[lo:hi])
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self.batch_size

@@ -1,0 +1,29 @@
+"""Streaming train/serve pipelines.
+
+Reference: `dl4j-streaming` (SURVEY §2.4) — Kafka/Camel routes feeding
+online training and model serving (`DL4jServeRouteBuilder.java`,
+`SparkStreamingPipeline.java`). TPU-native redesign: sources/sinks are plain
+Python callables/iterables bridged through a bounded queue; the train route
+feeds the SAME jitted step as offline `fit()` (one compiled step, batches
+stream through it), and the serve route runs the jitted `output()`.
+Kafka transport is a thin gated adapter (`KafkaSource`/`KafkaSink`) so the
+pipeline logic is testable in-process — the reference tests do the same
+with an embedded Kafka fake (`EmbeddedKafkaCluster.java`).
+"""
+from deeplearning4j_tpu.streaming.pipeline import (
+    KafkaSink,
+    KafkaSource,
+    QueueSink,
+    QueueSource,
+    ServeRoute,
+    StreamingTrainPipeline,
+)
+
+__all__ = [
+    "KafkaSink",
+    "KafkaSource",
+    "QueueSink",
+    "QueueSource",
+    "ServeRoute",
+    "StreamingTrainPipeline",
+]

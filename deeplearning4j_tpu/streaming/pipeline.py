@@ -1,0 +1,175 @@
+"""Streaming pipeline implementation (reference `dl4j-streaming`, §2.4)."""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Callable, Iterable, Optional, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_STOP = object()
+
+
+class QueueSource:
+    """In-process source: producers `put()` items, the pipeline consumes.
+    `close()` ends the stream."""
+
+    def __init__(self, maxsize: int = 64):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def put(self, item, timeout: Optional[float] = None) -> None:
+        self._q.put(item, timeout=timeout)
+
+    def close(self) -> None:
+        self._q.put(_STOP)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            yield item
+
+
+class QueueSink:
+    """In-process sink collecting emitted items."""
+
+    def __init__(self):
+        self.items = []
+        self._lock = threading.Lock()
+
+    def __call__(self, item) -> None:
+        with self._lock:
+            self.items.append(item)
+
+
+class KafkaSource:
+    """Kafka topic → DataSet stream (reference `NDArrayKafkaClient.java`).
+    Gated: requires the `kafka-python` package (not bundled in this image)."""
+
+    def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
+                 **consumer_kwargs):
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "KafkaSource requires the kafka-python package; in this "
+                "environment use QueueSource or any iterable of DataSets "
+                "instead") from e
+        self._consumer = KafkaConsumer(topic,
+                                       bootstrap_servers=bootstrap_servers,
+                                       **consumer_kwargs)
+
+    def __iter__(self):
+        import io
+
+        for msg in self._consumer:
+            buf = io.BytesIO(msg.value)
+            feats = np.load(buf, allow_pickle=False)
+            labels = np.load(buf, allow_pickle=False)
+            yield DataSet(feats, labels)
+
+
+class KafkaSink:
+    """Prediction stream → Kafka topic. Gated like KafkaSource."""
+
+    def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
+                 **producer_kwargs):
+        try:
+            from kafka import KafkaProducer  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "KafkaSink requires the kafka-python package; in this "
+                "environment use QueueSink or any callable instead") from e
+        self._producer = KafkaProducer(bootstrap_servers=bootstrap_servers,
+                                       **producer_kwargs)
+        self._topic = topic
+
+    def __call__(self, item) -> None:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(item), allow_pickle=False)
+        self._producer.send(self._topic, buf.getvalue())
+
+
+Source = Iterable
+Sink = Callable[[Any], None]
+
+
+class StreamingTrainPipeline:
+    """Online training route: DataSet stream → `net.fit` per batch
+    (reference `SparkStreamingPipeline.java` train role). Runs inline with
+    `run()` or in the background with `start()`/`join()`."""
+
+    def __init__(self, net, source: Source, on_batch: Optional[Sink] = None):
+        self.net = net
+        self.source = source
+        self.on_batch = on_batch
+        self.batches_seen = 0
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        for item in self.source:
+            ds = item if isinstance(item, DataSet) else DataSet(*item)
+            self.net.fit(ds)
+            self.batches_seen += 1
+            if self.on_batch is not None:
+                self.on_batch({"batch": self.batches_seen,
+                               "score": self.net.score_value})
+
+    def start(self) -> "StreamingTrainPipeline":
+        def _guard():
+            try:
+                self.run()
+            except BaseException as e:  # surfaced via .error / join()
+                self.error = e
+
+        self._thread = threading.Thread(target=_guard, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self.error is not None:
+                raise self.error
+
+
+class ServeRoute:
+    """Model-serving route: feature stream → predictions → sink (reference
+    `DL4jServeRouteBuilder.java`)."""
+
+    def __init__(self, net, source: Source, sink: Sink):
+        self.net = net
+        self.source = source
+        self.sink = sink
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        for feats in self.source:
+            self.sink(self.net.output(np.asarray(feats, np.float32)))
+
+    def start(self) -> "ServeRoute":
+        def _guard():
+            try:
+                self.run()
+            except BaseException as e:
+                self.error = e
+
+        self._thread = threading.Thread(target=_guard, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self.error is not None:
+                raise self.error
