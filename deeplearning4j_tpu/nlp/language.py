@@ -1,0 +1,181 @@
+"""Language-specific tokenization (reference `deeplearning4j-nlp-japanese`
+— a vendored Kuromoji fork, 6,920 LoC of dictionary-based morphological
+analysis — `deeplearning4j-nlp-korean` and `deeplearning4j-nlp-uima`,
+SURVEY §2.5).
+
+Dictionary assets can't ship in this environment (zero egress), so:
+- `JapaneseTokenizerFactory`: script-run segmentation (kanji / hiragana /
+  katakana / latin / digit runs) — the dictionary-free core of Japanese
+  tokenization; a real morphological analyzer plugs in via `analyzer=`.
+- `KoreanTokenizerFactory`: whitespace eojeol segmentation with optional
+  trailing-particle stripping (the role of the reference's KoreanTwitterText
+  tokenizer); a real analyzer plugs in the same way.
+- `UimaTokenizerFactory` / `UimaSentenceIterator`: the reference uses UIMA
+  for sentence segmentation + tokenization; here the same surface backed by
+  rule-based segmentation, gated on an optional analyzer callable.
+"""
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+
+def _script(ch: str) -> str:
+    """Coarse script class for a character (CJK segmentation)."""
+    o = ord(ch)
+    if 0x3040 <= o <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= o <= 0x30FF or 0x31F0 <= o <= 0x31FF:
+        return "katakana"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "kanji"
+    if 0xAC00 <= o <= 0xD7AF:
+        return "hangul"
+    if ch.isdigit():
+        return "digit"
+    if ch.isalpha():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "other"
+
+
+def segment_by_script(text: str) -> List[str]:
+    """Split into runs of the same script class, dropping whitespace and
+    punctuation. 'JAXは速い123' → ['JAX', 'は', '速い', '123'] (well — 速
+    and い split only if scripts differ; kanji+kana runs stay separate)."""
+    out: List[str] = []
+    cur = ""
+    cur_script = None
+    for ch in text:
+        s = _script(ch)
+        if s in ("space", "other"):
+            if cur:
+                out.append(cur)
+            cur, cur_script = "", None
+            continue
+        if s != cur_script and cur:
+            out.append(cur)
+            cur = ""
+        cur += ch
+        cur_script = s
+    if cur:
+        out.append(cur)
+    return out
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Script-run tokenizer for Japanese text (reference
+    `deeplearning4j-nlp-japanese`'s Kuromoji `JapaneseTokenizerFactory`).
+    Pass `analyzer=` (a `str -> List[str]` callable, e.g. a MeCab/Kuromoji
+    binding) to use dictionary-based morphological analysis instead."""
+
+    def __init__(self, analyzer: Optional[Callable[[str], List[str]]] = None):
+        super().__init__()
+        self.analyzer = analyzer
+
+    def create(self, text: str) -> Tokenizer:
+        norm = unicodedata.normalize("NFKC", text)
+        tokens = self.analyzer(norm) if self.analyzer else segment_by_script(norm)
+        return Tokenizer(tokens, self._pre)
+
+
+_KOREAN_PARTICLES = (
+    "은", "는", "이", "가", "을", "를", "에", "의", "와", "과", "도",
+    "로", "으로", "에서", "부터", "까지", "에게", "한테", "처럼",
+)
+# longest-first so compound particles ("에서") win over prefixes ("에");
+# sorted once — _strip runs per token on the tokenization hot path
+_PARTICLES_BY_LEN = tuple(sorted(_KOREAN_PARTICLES, key=len, reverse=True))
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Eojeol (whitespace) tokenizer with optional trailing-particle
+    stripping (reference `deeplearning4j-nlp-korean`'s Twitter-text
+    tokenizer role). `analyzer=` plugs in a real morphological analyzer."""
+
+    def __init__(self, strip_particles: bool = True,
+                 analyzer: Optional[Callable[[str], List[str]]] = None):
+        super().__init__()
+        self.strip_particles = strip_particles
+        self.analyzer = analyzer
+
+    def _strip(self, token: str) -> str:
+        if len(token) < 2:
+            return token
+        for p in _PARTICLES_BY_LEN:
+            if token.endswith(p) and len(token) > len(p):
+                stem = token[:-len(p)]
+                if all(_script(c) == "hangul" for c in stem):
+                    return stem
+        return token
+
+    def create(self, text: str) -> Tokenizer:
+        norm = unicodedata.normalize("NFKC", text)
+        if self.analyzer:
+            tokens = self.analyzer(norm)
+        else:
+            tokens = [t for raw in norm.split()
+                      for t in segment_by_script(raw)]
+            if self.strip_particles:
+                tokens = [self._strip(t) for t in tokens]
+        return Tokenizer(tokens, self._pre)
+
+
+# latin sentence enders need trailing whitespace (protects "U.S."-style
+# abbreviations mid-token); CJK enders split with or without a space
+_SENTENCE_RE = re.compile(r"(?<=[。！？])\s*|(?<=[.!?])\s+")
+
+
+class UimaSentenceIterator(SentenceIterator):
+    """Sentence segmentation over documents (reference
+    `deeplearning4j-nlp-uima`'s `UimaSentenceIterator` — UIMA
+    SentenceAnnotator role). Rule-based splitter on sentence-final
+    punctuation, incl. CJK 。！？."""
+
+    def __init__(self, documents: List[str],
+                 segmenter: Optional[Callable[[str], List[str]]] = None):
+        super().__init__()
+        self.documents = list(documents)
+        self.segmenter = segmenter
+        self._sentences: List[str] = []
+        self._pos = 0
+        self.reset()
+
+    def reset(self) -> None:
+        self._sentences = []
+        for doc in self.documents:
+            if self.segmenter:
+                self._sentences.extend(self.segmenter(doc))
+            else:
+                self._sentences.extend(
+                    s.strip() for s in _SENTENCE_RE.split(doc) if s.strip())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._sentences)
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._pos]
+        self._pos += 1
+        return self._apply(s)
+
+
+class UimaTokenizerFactory(TokenizerFactory):
+    """Tokenizer over UIMA-style analysis (reference `deeplearning4j-nlp-
+    uima`'s `UimaTokenizerFactory`). Without an analysis engine, falls back
+    to script-aware word segmentation."""
+
+    def __init__(self, analysis_engine: Optional[Callable[[str], List[str]]] = None):
+        super().__init__()
+        self.analysis_engine = analysis_engine
+
+    def create(self, text: str) -> Tokenizer:
+        if self.analysis_engine:
+            return Tokenizer(self.analysis_engine(text), self._pre)
+        tokens = [t for raw in text.split() for t in segment_by_script(raw)]
+        return Tokenizer(tokens, self._pre)

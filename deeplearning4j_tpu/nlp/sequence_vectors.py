@@ -44,7 +44,9 @@ class SequenceVectors:
                  batch_size: int = 1024,
                  sampling: float = 0.0,
                  seed: int = 42,
-                 elements_learning_algorithm: str = "skipgram"):
+                 elements_learning_algorithm: str = "skipgram",
+                 mesh=None,
+                 data_axis: str = "data"):
         if negative <= 0 and not use_hierarchic_softmax:
             raise ValueError("need negative sampling (negative>0) and/or "
                              "hierarchical softmax")
@@ -71,6 +73,20 @@ class SequenceVectors:
         self._unigram: Optional[np.ndarray] = None
         self._loss_sum = 0.0
         self._loss_batches = 0
+        # multi-chip data parallelism (the dl4j-spark-nlp role,
+        # `spark/models/embeddings/word2vec/Word2VecPerformer.java`): pair
+        # batches shard over the mesh's data axis, embedding tables stay
+        # replicated, and XLA psums the scatter contributions over ICI —
+        # where the reference map-reduces word2vec over Spark executors.
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if mesh is not None:
+            n = mesh.shape[data_axis]
+            if batch_size % n != 0:
+                raise ValueError(
+                    f"batch_size {batch_size} must divide by the "
+                    f"'{data_axis}' mesh axis size {n}")
+        self._sharded_kernels = None
 
     # -- vocab/init ---------------------------------------------------------
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
@@ -137,6 +153,31 @@ class SequenceVectors:
                 raise ValueError(self.algorithm)
 
     # hooks used by _PairBatcher ------------------------------------------
+    def _kernels(self):
+        """(skipgram_step, cbow_step) — module-level jits single-chip, or
+        mesh-sharded jits when a mesh was given (batch on the data axis,
+        tables replicated; XLA inserts the ICI all-reduce of the scatter
+        contributions)."""
+        if self.mesh is None:
+            return kernels.skipgram_step, kernels.cbow_step
+        if self._sharded_kernels is None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            bsh = NamedSharding(self.mesh, P(self.data_axis))
+            sg = jax.jit(kernels.skipgram_step.__wrapped__,
+                         in_shardings=(repl, repl, bsh, bsh, bsh, bsh, repl),
+                         out_shardings=(repl, repl, None),
+                         donate_argnums=(0, 1))
+            cb = jax.jit(kernels.cbow_step.__wrapped__,
+                         in_shardings=(repl, repl, bsh, bsh, bsh, bsh, bsh,
+                                       repl),
+                         out_shardings=(repl, repl, None),
+                         donate_argnums=(0, 1))
+            self._sharded_kernels = (sg, cb)
+        return self._sharded_kernels
+
     def _sample_negatives(self, n: int) -> np.ndarray:
         return self._rng.choice(len(self._unigram), size=n, p=self._unigram)
 
@@ -246,30 +287,31 @@ class _PairBatcher:
         self.cmask[self.n:] = 0.0
         lr = jnp.float32(self.alpha)
         syn1 = lt.syn1neg if sv.negative > 0 else lt.syn1
+        skipgram_step, cbow_step = sv._kernels()
         if sv.use_hs and sv.negative > 0:
             # mixed mode: split columns — NS rows live in syn1neg, HS rows
             # in syn1; run two steps on the column slices
             ns_cols = sv.negative + 1
-            lt.syn0, lt.syn1neg, loss1 = kernels.skipgram_step(
+            lt.syn0, lt.syn1neg, loss1 = skipgram_step(
                 lt.syn0, lt.syn1neg, jnp.asarray(self.center),
                 jnp.asarray(self.targets[:, :ns_cols]),
                 jnp.asarray(self.labels[:, :ns_cols]),
                 jnp.asarray(self.mask[:, :ns_cols]), lr)
-            lt.syn0, lt.syn1, loss2 = kernels.skipgram_step(
+            lt.syn0, lt.syn1, loss2 = skipgram_step(
                 lt.syn0, lt.syn1, jnp.asarray(self.center),
                 jnp.asarray(self.targets[:, ns_cols:]),
                 jnp.asarray(self.labels[:, ns_cols:]),
                 jnp.asarray(self.mask[:, ns_cols:]), lr)
             sv._record_loss(float(loss1) + float(loss2))
         elif sv.algorithm == "cbow":
-            lt.syn0, new_syn1, loss = kernels.cbow_step(
+            lt.syn0, new_syn1, loss = cbow_step(
                 lt.syn0, syn1, jnp.asarray(self.context),
                 jnp.asarray(self.cmask), jnp.asarray(self.targets),
                 jnp.asarray(self.labels), jnp.asarray(self.mask), lr)
             self._store_syn1(new_syn1)
             sv._record_loss(float(loss))
         else:
-            lt.syn0, new_syn1, loss = kernels.skipgram_step(
+            lt.syn0, new_syn1, loss = skipgram_step(
                 lt.syn0, syn1, jnp.asarray(self.center),
                 jnp.asarray(self.targets), jnp.asarray(self.labels),
                 jnp.asarray(self.mask), lr)

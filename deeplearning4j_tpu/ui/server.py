@@ -99,22 +99,46 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"sessions": ui._session_ids()})
         if self.path == "/train/model":
             return self._json(ui._model_data())
+        if self.path == "/train/system":
+            return self._json(ui._system_data())
+        if self.path == "/train/histograms":
+            return self._json(ui._histogram_data())
+        if self.path == "/train/histograms/page":
+            return self._html(ui._histogram_page())
+        if self.path == "/tsne":
+            return self._html(ui._tsne_page())
+        if self.path == "/tsne/data":
+            return self._json(ui._tsne)
         return self._json({"error": f"unknown path {self.path}"}, 404)
 
-    # -- POST (remote stats receiver) ---------------------------------------
+    # -- POST (remote stats receiver + tsne upload) -------------------------
     def do_POST(self):
         ui: "UIServer" = self.server.ui  # type: ignore[attr-defined]
-        if self.path != "/remote/receive":
-            return self._json({"error": f"unknown path {self.path}"}, 404)
         n = int(self.headers.get("Content-Length", 0))
-        try:
-            rec = StatsRecord.from_json(self.rfile.read(n).decode())
-        except Exception as e:  # malformed post
-            return self._json({"error": str(e)}, 400)
-        if ui._storages:
-            ui._storages[0].put_record(rec)
-            return self._json({"ok": True})
-        return self._json({"error": "no storage attached"}, 503)
+        body = self.rfile.read(n)
+        if self.path == "/remote/receive":
+            try:
+                rec = StatsRecord.from_json(body.decode())
+            except Exception as e:  # malformed post
+                return self._json({"error": str(e)}, 400)
+            if ui._storages:
+                ui._storages[0].put_record(rec)
+                return self._json({"ok": True})
+            return self._json({"error": "no storage attached"}, 503)
+        if self.path == "/tsne/upload":
+            # {"coords": [[x, y], ...], "labels": ["word", ...]}
+            try:
+                payload = json.loads(body)
+                coords = [[float(c[0]), float(c[1])] for c in payload["coords"]]
+                labels = payload.get("labels") or [""] * len(coords)
+                if len(labels) != len(coords):
+                    raise ValueError("labels/coords length mismatch")
+                labels = [str(l) for l in labels]
+            except Exception as e:
+                return self._json({"error": f"bad upload: {e}"}, 400)
+            ui._tsne = {"coords": coords, "labels": labels}
+            return self._json({"ok": True, "points": len(coords)})
+        return self._json({"error": f"unknown path {self.path}"}, 404)
 
 
 class UIServer:
@@ -125,6 +149,7 @@ class UIServer:
 
     def __init__(self, port: int = 9000):
         self._storages: List[StatsStorage] = []
+        self._tsne: dict = {"coords": [], "labels": []}
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.ui = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
@@ -190,3 +215,63 @@ class UIServer:
         return {"session_id": sid,
                 "static": static[-1].data if static else {},
                 "latest": latest.data if latest else {}}
+
+    def _system_data(self):
+        """System page feed (reference TrainModule system tab: JVM/GC; here
+        host RSS + device HBM per iteration)."""
+        storage, sid = self._latest_session()
+        if storage is None:
+            return {"session_id": None, "iterations": [], "host_rss_mb": [],
+                    "device_bytes_in_use": []}
+        recs = storage.get_records(sid, type_id="stats")
+        out = {"session_id": sid, "iterations": [], "host_rss_mb": [],
+               "device_bytes_in_use": []}
+        for r in recs:
+            sysd = r.data.get("system") or {}
+            out["iterations"].append(r.data.get("iteration"))
+            out["host_rss_mb"].append(sysd.get("host_rss_mb"))
+            out["device_bytes_in_use"].append(sysd.get("device_bytes_in_use"))
+        return out
+
+    def _histogram_data(self):
+        """Latest per-parameter histograms (reference HistogramModule)."""
+        storage, sid = self._latest_session()
+        latest = (storage.get_latest_record(sid, type_id="stats")
+                  if storage else None)
+        if latest is None:
+            return {"session_id": None, "parameters": {}}
+        params = {
+            name: {k: st.get(k) for k in
+                   ("histogram_counts", "histogram_min", "histogram_max",
+                    "mean", "stdev")}
+            for name, st in (latest.data.get("parameters") or {}).items()}
+        return {"session_id": sid, "iteration": latest.data.get("iteration"),
+                "parameters": params}
+
+    def _histogram_page(self) -> str:
+        from deeplearning4j_tpu.ui.components import ChartHistogram, ComponentDiv, render_html
+
+        d = self._histogram_data()
+        div = ComponentDiv()
+        for name, st in d["parameters"].items():
+            counts = st.get("histogram_counts") or []
+            if not counts:
+                continue
+            lo, hi = st.get("histogram_min", 0.0), st.get("histogram_max", 1.0)
+            width = (hi - lo) / max(len(counts), 1)
+            ch = ChartHistogram(title=f"{name} (iter {d.get('iteration')})")
+            for i, c in enumerate(counts):
+                ch.add_bin(lo + i * width, lo + (i + 1) * width, c)
+            div.add(ch)
+        return render_html(div, title="parameter histograms")
+
+    def _tsne_page(self) -> str:
+        from deeplearning4j_tpu.ui.components import ChartScatter, render_html
+
+        coords = self._tsne.get("coords") or []
+        chart = ChartScatter(title=f"t-SNE ({len(coords)} points)")
+        if coords:
+            chart.add_series("points", [c[0] for c in coords],
+                             [c[1] for c in coords],
+                             labels=self._tsne.get("labels"))
+        return render_html(chart, title="t-SNE")

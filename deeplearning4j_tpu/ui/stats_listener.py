@@ -35,6 +35,42 @@ def _array_stats(arr, n_bins: int = 20) -> Dict[str, Any]:
     }
 
 
+def _system_stats() -> Dict[str, Any]:
+    """Host + device memory snapshot (the reference's system page feeds:
+    JVM memory + GC via JMX, `BaseStatsListener.java:356-370`; here process
+    RSS + TPU HBM usage via the PJRT memory stats when exposed)."""
+    out: Dict[str, Any] = {}
+    try:
+        # current RSS, not ru_maxrss: the peak can only grow, which would
+        # hide exactly the leak-vs-plateau signal this page exists to show
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["host_rss_mb"] = float(line.split()[1]) / 1024.0
+                    break
+    except OSError:
+        try:
+            import resource
+            import sys
+
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is KiB on Linux but BYTES on macOS
+            scale = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+            out["host_rss_peak_mb"] = peak / scale
+        except Exception:
+            pass
+    try:
+        import jax
+
+        ms = jax.local_devices()[0].memory_stats()
+        if ms:
+            out["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+            out["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
+    except Exception:
+        pass  # CPU backend / no memory_stats: host stats only
+    return out
+
+
 class StatsListener:
     """Attach with `net.set_listeners(StatsListener(storage))`."""
 
@@ -77,6 +113,7 @@ class StatsListener:
                 name, arr = p
                 params[name] = _array_stats(arr)
             data["parameters"] = params
+        data["system"] = _system_stats()
         self.router.put_record(StatsRecord(
             session_id=self.session_id, type_id="stats",
             worker_id=self.worker_id, timestamp=now, data=data))

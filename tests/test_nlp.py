@@ -214,3 +214,53 @@ def test_tfidf():
     i_dog = v.vocab.index_of("dog")
     assert X[0, i_cat] == pytest.approx(0.0)  # appears in all docs → idf 0
     assert X[0, i_dog] > 0
+
+
+def test_japanese_tokenizer():
+    from deeplearning4j_tpu.nlp.language import JapaneseTokenizerFactory
+
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("JAXは速い123です。").get_tokens()
+    assert "JAX" in toks and "123" in toks
+    # script runs split kanji from kana
+    assert any(all(0x4E00 <= ord(c) <= 0x9FFF for c in t) for t in toks)
+    # pluggable analyzer wins
+    tf2 = JapaneseTokenizerFactory(analyzer=lambda s: ["custom"])
+    assert tf2.create("何でも").get_tokens() == ["custom"]
+
+
+def test_korean_tokenizer():
+    from deeplearning4j_tpu.nlp.language import KoreanTokenizerFactory
+
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("나는 학교에 간다").get_tokens()
+    assert "나" in toks        # '나는' -> particle '는' stripped
+    assert "학교" in toks      # '학교에' -> '에' stripped
+    tf_keep = KoreanTokenizerFactory(strip_particles=False)
+    assert "나는" in tf_keep.create("나는 학교에 간다").get_tokens()
+
+
+def test_uima_sentence_iterator_and_tokenizer():
+    from deeplearning4j_tpu.nlp.language import (
+        UimaSentenceIterator, UimaTokenizerFactory)
+
+    it = UimaSentenceIterator(["First one. Second two! 三番目です。最後?"])
+    sents = list(it)
+    assert sents[0] == "First one." and len(sents) == 4
+    # reset + re-iterate works
+    assert len(list(it)) == 4
+    toks = UimaTokenizerFactory().create("hello 世界 123").get_tokens()
+    assert toks == ["hello", "世界", "123"]
+
+
+def test_word2vec_with_japanese_tokenizer():
+    """Language tokenizers feed the standard Word2Vec pipeline."""
+    from deeplearning4j_tpu.nlp.language import JapaneseTokenizerFactory
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    corpus = ["犬は走る。猫は寝る。"] * 20
+    w2v = Word2Vec(tokenizer_factory=JapaneseTokenizerFactory(),
+                   layer_size=8, window=2, negative=2, epochs=1,
+                   batch_size=32, min_word_frequency=1)
+    w2v.fit(corpus)
+    assert "犬" in w2v.vocab.words()

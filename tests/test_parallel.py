@@ -96,3 +96,34 @@ def test_graft_entry_dryrun():
     assert out.shape == (8, 10)
 
     ge.dryrun_multichip(8)
+
+
+def test_distributed_word2vec_parity():
+    """Mesh-sharded word2vec must match single-chip training exactly
+    (same seed, same pair stream) — the spark-nlp parity analogue of
+    TestCompareParameterAveragingSparkVsSingleMachine."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    corpus = [("the quick brown fox jumps over the lazy dog " * 3).split()
+              for _ in range(30)]
+    kw = dict(layer_size=16, window=2, negative=3, epochs=2, batch_size=64,
+              seed=11, min_word_frequency=1)
+    single = Word2Vec(**kw)
+    single.fit(corpus)
+    mesh = make_mesh({"data": 8})
+    sharded = Word2Vec(mesh=mesh, **kw)
+    sharded.fit(corpus)
+    np.testing.assert_allclose(np.asarray(single.lookup_table.syn0),
+                               np.asarray(sharded.lookup_table.syn0),
+                               atol=1e-5)
+    assert sharded.similarity("quick", "quick") == pytest.approx(1.0)
+
+
+def test_distributed_word2vec_batch_divisibility():
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="must divide"):
+        Word2Vec(mesh=make_mesh({"data": 8}), batch_size=100)

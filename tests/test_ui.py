@@ -131,3 +131,113 @@ def test_evaluation_tools_html(tmp_path):
     p2 = tmp_path / "eval.html"
     EvaluationTools.export_evaluation_to_html_file(ev, p2)
     assert "Confusion matrix" in p2.read_text()
+
+
+def test_ui_components_dsl_round_trip():
+    from deeplearning4j_tpu.ui.components import (
+        ChartHistogram, ChartLine, ChartScatter, Component, ComponentDiv,
+        ComponentTable, ComponentText, render_html)
+
+    line = ChartLine(title="score").add_series("s", [0, 1, 2], [3.0, 2.0, 1.0])
+    table = ComponentTable(header=["k", "v"], rows=[["acc", "0.9"]])
+    div = (ComponentDiv().add(line).add(table)
+           .add(ComponentText(text="note & <tag>"))
+           .add(ChartScatter(title="pts").add_series("a", [0, 1], [1, 0]))
+           .add(ChartHistogram(title="h").add_bin(0, 1, 5).add_bin(1, 2, 3)))
+    restored = Component.from_json(div.to_json())
+    assert isinstance(restored, ComponentDiv)
+    html_doc = render_html(restored, title="report")
+    assert "<svg" in html_doc and "polyline" in html_doc
+    assert "note &amp; &lt;tag&gt;" in html_doc  # text is escaped
+    assert "<table" in html_doc and "acc" in html_doc
+
+
+def test_ui_system_histogram_tsne_modules():
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.stats_listener import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        conf = (dl4j.NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+                .list().layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=2,
+                                   activation=Activation.SOFTMAX)).build())
+        net = dl4j.MultiLayerNetwork(conf)
+        net.init()
+        net.set_listeners(StatsListener(storage))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        for _ in range(4):
+            net.fit(DataSet(x, y))
+
+        base = f"http://127.0.0.1:{server.port}"
+        sysd = _json.loads(urllib.request.urlopen(f"{base}/train/system").read())
+        assert len(sysd["iterations"]) == 4
+        assert sysd["host_rss_mb"][-1] and sysd["host_rss_mb"][-1] > 0
+
+        hist = _json.loads(urllib.request.urlopen(f"{base}/train/histograms").read())
+        assert hist["parameters"] and all(
+            st["histogram_counts"] for st in hist["parameters"].values())
+        page = urllib.request.urlopen(f"{base}/train/histograms/page").read().decode()
+        assert "<svg" in page and "rect" in page
+
+        # t-SNE module: upload coords, read page
+        payload = _json.dumps({"coords": [[0.0, 1.0], [1.0, 0.0]],
+                               "labels": ["a", "b"]}).encode()
+        req = urllib.request.Request(f"{base}/tsne/upload", data=payload,
+                                     method="POST")
+        resp = _json.loads(urllib.request.urlopen(req).read())
+        assert resp["points"] == 2
+        tsne_page = urllib.request.urlopen(f"{base}/tsne").read().decode()
+        assert "circle" in tsne_page
+    finally:
+        server.stop()
+
+
+def test_tsne_upload_rejects_malformed():
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    server = UIServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        bad = _json.dumps({"coords": ["ab", "cd"]}).encode()
+        req = urllib.request.Request(f"{base}/tsne/upload", data=bad,
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+        # labels render on the page after a VALID upload
+        ok = _json.dumps({"coords": [[0.0, 1.0], [1.0, 0.0]],
+                          "labels": ["alpha", "beta"]}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/tsne/upload", data=ok, method="POST"))
+        page = urllib.request.urlopen(f"{base}/tsne").read().decode()
+        assert "alpha" in page and "beta" in page
+    finally:
+        server.stop()
+
+
+def test_component_div_sees_post_add_mutation():
+    from deeplearning4j_tpu.ui.components import ChartLine, ComponentDiv, render_html
+
+    chart = ChartLine(title="t")
+    div = ComponentDiv().add(chart)
+    chart.add_series("late", [0, 1, 2], [1, 2, 3])  # mutate AFTER add()
+    html_doc = render_html(div)
+    assert "polyline" in html_doc and "late" in html_doc
