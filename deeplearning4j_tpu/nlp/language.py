@@ -175,7 +175,8 @@ class UimaTokenizerFactory(TokenizerFactory):
         self.analysis_engine = analysis_engine
 
     def create(self, text: str) -> Tokenizer:
+        norm = unicodedata.normalize("NFKC", text)
         if self.analysis_engine:
-            return Tokenizer(self.analysis_engine(text), self._pre)
-        tokens = [t for raw in text.split() for t in segment_by_script(raw)]
+            return Tokenizer(self.analysis_engine(norm), self._pre)
+        tokens = [t for raw in norm.split() for t in segment_by_script(raw)]
         return Tokenizer(tokens, self._pre)

@@ -254,6 +254,7 @@ class ComputationGraph:
             self._params, self._upd_state, self._layer_state, self._it_device,
             inputs, labels, fmasks, lmasks)
         self._score = loss  # device array; score_value property syncs lazily
+        self._last_batch = mds  # host refs; listeners may recompute grads
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):

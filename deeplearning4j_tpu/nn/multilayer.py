@@ -283,6 +283,7 @@ class MultiLayerNetwork:
             self._params, self._upd_state, self._layer_state, self._it_device,
             f, l, fm, lm)
         self._score = loss  # device array; score_value property syncs lazily
+        self._last_batch = ds  # host refs only; listeners may recompute grads
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):

@@ -88,3 +88,71 @@ class CollectScoresIterationListener(IterationListener):
     def iteration_done(self, model, iteration: int) -> None:
         if iteration % self.frequency == 0:
             self.scores.append((iteration, float(model.score_value)))
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Tab-delimited per-parameter and per-gradient summary statistics
+    every `frequency` iterations (reference
+    `optimize/listeners/ParamAndGradientIterationListener.java`: writes
+    mean/absmean/min/max for params and gradients to console or file).
+
+    Gradients are recomputed on the model's last batch when the listener
+    fires (the compiled train step donates its gradient buffers, so there
+    is nothing to read back) — cost is one extra fwd+bwd per report, only
+    while this listener is attached."""
+
+    HEADER = ("iteration\tscore\tname\tp_mean\tp_absmean\tp_min\tp_max"
+              "\tg_mean\tg_absmean\tg_min\tg_max")
+
+    def __init__(self, frequency: int = 1, file_path=None,
+                 print_console: bool = False):
+        self.frequency = max(1, frequency)
+        self.file_path = file_path
+        self.print_console = print_console
+        self.rows: List[str] = []
+        if file_path is not None:
+            with open(file_path, "w") as f:
+                f.write(self.HEADER + "\n")
+
+    def _emit(self, line: str) -> None:
+        self.rows.append(line)
+        if self.print_console:
+            print(line)
+        if self.file_path is not None:
+            with open(self.file_path, "a") as f:
+                f.write(line + "\n")
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import numpy as np
+
+        if iteration % self.frequency != 0:
+            return
+        ds = getattr(model, "_last_batch", None)
+        if ds is None:
+            return
+        grad_flat, score = model.compute_gradient_and_score(ds)
+        # walk per-layer named params in flat-vector order
+        offset = 0
+        for name, arr in self._named(model):
+            p = np.asarray(arr).ravel()
+            g = grad_flat[offset:offset + p.size]
+            offset += p.size
+            self._emit("\t".join([
+                str(iteration), f"{score:.6g}", name,
+                f"{p.mean():.6g}", f"{np.abs(p).mean():.6g}",
+                f"{p.min():.6g}", f"{p.max():.6g}",
+                f"{g.mean():.6g}", f"{np.abs(g).mean():.6g}",
+                f"{g.min():.6g}", f"{g.max():.6g}"]))
+
+    def _named(self, model):
+        # iteration order must match ravel_pytree's flat layout: dict keys
+        # are flattened in SORTED order
+        ps = model._params
+        if isinstance(ps, dict):
+            for vname in sorted(ps):
+                for pname in sorted(ps[vname]):
+                    yield f"{vname}_{pname}", ps[vname][pname]
+        else:
+            for i, d in enumerate(ps):
+                for pname in sorted(d):
+                    yield f"{i}_{pname}", d[pname]

@@ -109,6 +109,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._html(ui._tsne_page())
         if self.path == "/tsne/data":
             return self._json(ui._tsne)
+        if self.path == "/train/flow":
+            return self._html(ui._flow_page())
+        if self.path == "/train/activations":
+            return self._html(ui._activations_page())
         return self._json({"error": f"unknown path {self.path}"}, 404)
 
     # -- POST (remote stats receiver + tsne upload) -------------------------
@@ -229,7 +233,9 @@ class UIServer:
         for r in recs:
             sysd = r.data.get("system") or {}
             out["iterations"].append(r.data.get("iteration"))
-            out["host_rss_mb"].append(sysd.get("host_rss_mb"))
+            # non-procfs platforms record peak RSS instead of current
+            out["host_rss_mb"].append(sysd.get("host_rss_mb",
+                                               sysd.get("host_rss_peak_mb")))
             out["device_bytes_in_use"].append(sysd.get("device_bytes_in_use"))
         return out
 
@@ -264,6 +270,40 @@ class UIServer:
                 ch.add_bin(lo + i * width, lo + (i + 1) * width, c)
             div.add(ch)
         return render_html(div, title="parameter histograms")
+
+    def _latest_of_type(self, type_id: str):
+        """Most recent record of a type across all sessions/storages (flow
+        and activation listeners run under their own session ids)."""
+        best = None
+        for storage in self._storages:
+            for sid in storage.list_session_ids():
+                rec = storage.get_latest_record(sid, type_id=type_id)
+                if rec is not None and (best is None
+                                        or rec.timestamp > best.timestamp):
+                    best = rec
+        return best
+
+    def _flow_page(self) -> str:
+        from deeplearning4j_tpu.ui.flow import render_flow_svg
+
+        rec = self._latest_of_type("flow")
+        nodes = rec.data.get("nodes", []) if rec else []
+        body = render_flow_svg(nodes) if nodes else "<p>no flow captured</p>"
+        return ("<!DOCTYPE html><html><head><title>network flow</title>"
+                "</head><body><h1>Network flow</h1>" + body + "</body></html>")
+
+    def _activations_page(self) -> str:
+        from deeplearning4j_tpu.ui.flow import render_activation_svg
+
+        rec = self._latest_of_type("activations")
+        if rec is None:
+            body = "<p>no activations captured</p>"
+        else:
+            body = (f"<p>iteration {rec.data.get('iteration')}</p>"
+                    + render_activation_svg(rec.data.get("channels", [])))
+        return ("<!DOCTYPE html><html><head><title>activations</title>"
+                "</head><body><h1>Conv activations</h1>" + body
+                + "</body></html>")
 
     def _tsne_page(self) -> str:
         from deeplearning4j_tpu.ui.components import ChartScatter, render_html

@@ -241,3 +241,85 @@ def test_component_div_sees_post_add_mutation():
     chart.add_series("late", [0, 1, 2], [1, 2, 3])  # mutate AFTER add()
     html_doc = render_html(div)
     assert "polyline" in html_doc and "late" in html_doc
+
+
+def test_flow_and_activation_listeners():
+    import urllib.request
+
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet_configuration
+    from deeplearning4j_tpu.ui.flow import (
+        ConvolutionalIterationListener, FlowListener)
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        net = dl4j.MultiLayerNetwork(lenet_configuration())
+        net.init()
+        conv = ConvolutionalIterationListener(storage, frequency=1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+        conv.set_probe(x)
+        net.set_listeners(FlowListener(storage), conv)
+        net.fit(DataSet(x, y))
+
+        base = f"http://127.0.0.1:{server.port}"
+        flow = urllib.request.urlopen(f"{base}/train/flow").read().decode()
+        assert "ConvolutionLayer" in flow and "<rect" in flow
+        acts = urllib.request.urlopen(f"{base}/train/activations").read().decode()
+        assert "<svg" in acts and acts.count("<rect") > 50
+    finally:
+        server.stop()
+
+
+def test_param_and_gradient_listener(tmp_path):
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener)
+
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+            .list().layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2,
+                               activation=Activation.SOFTMAX)).build())
+    net = dl4j.MultiLayerNetwork(conf)
+    net.init()
+    out = tmp_path / "pg.tsv"
+    net.set_listeners(ParamAndGradientIterationListener(frequency=2,
+                                                        file_path=str(out)))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    for _ in range(4):
+        net.fit(DataSet(x, y))
+    lines = out.read_text().strip().split("\n")
+    assert lines[0].startswith("iteration\tscore")
+    # 4 params (2 layers x W/b), iterations 2 and 4 fire -> 8 rows
+    assert len(lines) == 1 + 8
+    row = lines[1].split("\t")
+    assert row[2] == "0_W" and float(row[1]) > 0
+    # gradient columns are finite numbers
+    assert all(np.isfinite(float(v)) for v in row[3:])
+
+
+def test_time_sources():
+    from deeplearning4j_tpu.parallel.time_source import (
+        MonotonicTimeSource, NTPTimeSource, SystemTimeSource,
+        TimeSourceProvider)
+
+    now = SystemTimeSource().current_time_millis()
+    mono = MonotonicTimeSource().current_time_millis()
+    assert abs(now - mono) < 2000
+    # injected offset: no network IO
+    ntp = NTPTimeSource(offset_ms=5000.0)
+    assert ntp.current_time_millis() - mono > 4000
+    TimeSourceProvider.reset()
+    assert isinstance(TimeSourceProvider.get_instance(), MonotonicTimeSource)
+    TimeSourceProvider.reset()
