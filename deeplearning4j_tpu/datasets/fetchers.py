@@ -292,3 +292,50 @@ class LFWDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self.batch_size
+
+
+class CurvesDataSetIterator(DataSetIterator):
+    """Curves dataset iterator (reference `CurvesDataSetFetcher` /
+    `deeplearning4j-core` curves resource: 784-dim synthetic curve images
+    used by the deep-autoencoder pretraining examples). Generated here as
+    smooth random Bezier-like strokes rasterized onto a 28x28 grid —
+    unsupervised (labels == features, the autoencoder target convention)."""
+
+    def __init__(self, batch_size: int, num_examples: int = 10000,
+                 seed: int = 6):
+        self.batch_size = batch_size
+        rng = np.random.default_rng(seed)
+        n = num_examples
+        imgs = np.zeros((n, 28, 28), np.float32)
+        t = np.linspace(0, 1, 64)
+        for i in range(n):
+            # quadratic Bezier with 3 random control points
+            p = rng.uniform(3, 25, (3, 2))
+            pts = ((1 - t)[:, None] ** 2 * p[0] +
+                   2 * ((1 - t) * t)[:, None] * p[1] +
+                   (t ** 2)[:, None] * p[2])
+            xi = np.clip(pts[:, 0].astype(int), 0, 27)
+            yi = np.clip(pts[:, 1].astype(int), 0, 27)
+            imgs[i, yi, xi] = 1.0
+        # slight blur (box) to make strokes smooth
+        padded = np.pad(imgs, ((0, 0), (1, 1), (1, 1)))
+        imgs = sum(padded[:, dy:dy + 28, dx:dx + 28]
+                   for dy in range(3) for dx in range(3)) / 9.0
+        self.features = np.clip(imgs, 0, 1).reshape(n, 784)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.features)
+
+    def next(self):
+        lo = self._pos
+        hi = min(lo + self.batch_size, len(self.features))
+        self._pos = hi
+        f = self.features[lo:hi]
+        return DataSet(f, f.copy())  # autoencoder convention: target = input
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self.batch_size

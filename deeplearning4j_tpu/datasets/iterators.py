@@ -229,3 +229,104 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self._under.batch()
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batches an iterator of (possibly variously sized) DataSets to a
+    fixed batch size (reference `IteratorDataSetIterator.java`)."""
+
+    def __init__(self, source: Iterable[DataSet], batch_size: int):
+        self._source = source
+        self.batch_size = batch_size
+        # one-shot iterators (generators) can't replay across epochs —
+        # same guard as ExistingDataSetIterator
+        self._one_shot = iter(source) is source
+        self._consumed = False
+        self._iter: Optional[Iterator[DataSet]] = None
+        self._buf: List[DataSet] = []
+        self._buffered = 0
+        self._peek: Optional[DataSet] = None
+
+    def reset(self) -> None:
+        if self._one_shot:
+            if self._consumed:
+                raise ValueError(
+                    "IteratorDataSetIterator wraps a one-shot iterator "
+                    "(generator) that has already been consumed; pass a "
+                    "list or a restartable iterable to train multiple epochs")
+            self._iter = self._source  # type: ignore[assignment]
+            self._consumed = True
+        else:
+            self._iter = iter(self._source)
+        self._buf, self._buffered, self._peek = [], 0, None
+
+    def _assemble(self) -> Optional[DataSet]:
+        while self._buffered < self.batch_size:
+            try:
+                ds = next(self._iter)
+            except StopIteration:
+                break
+            self._buf.append(ds)
+            self._buffered += ds.num_examples()
+        if not self._buf:
+            return None
+        merged = DataSet.merge(self._buf)  # preserves both mask arrays
+        self._buf, take = [], self.batch_size
+
+        def sl(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        n = merged.num_examples()
+        if n > take:  # keep the tail for the next batch
+            self._buf = [DataSet(merged.features[take:],
+                                 sl(merged.labels, take, n),
+                                 sl(merged.features_mask, take, n),
+                                 sl(merged.labels_mask, take, n))]
+            self._buffered = n - take
+            return DataSet(merged.features[:take], sl(merged.labels, 0, take),
+                           sl(merged.features_mask, 0, take),
+                           sl(merged.labels_mask, 0, take))
+        self._buffered = 0
+        return merged
+
+    def has_next(self) -> bool:
+        if self._iter is None:
+            self.reset()
+        if self._peek is None:
+            self._peek = self._assemble()
+        return self._peek is not None
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds, self._peek = self._peek, None
+        return ds
+
+    def batch(self) -> int:
+        return self.batch_size
+
+
+class SingletonMultiDataSetIterator:
+    """Yields one MultiDataSet forever-resettable (reference
+    `impl/SingletonMultiDataSetIterator.java`)."""
+
+    def __init__(self, mds):
+        self._mds = mds
+        self._done = False
+
+    def __iter__(self):
+        self._done = False
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        self._done = True
+        return self._mds
+
+    def reset(self) -> None:
+        self._done = False
+
+    @property
+    def async_supported(self) -> bool:
+        return False

@@ -7,16 +7,32 @@ forward producing predictions — runs on TPU.
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
 
 
+@dataclass
+class Prediction:
+    """One example's (actual, predicted) record for error analysis
+    (reference `eval/meta/Prediction.java` — used by
+    `Evaluation.getPredictionErrors()` etc.)."""
+
+    actual: int
+    predicted: int
+    example_index: int
+
+
 class Evaluation:
     def __init__(self, num_classes: Optional[int] = None,
-                 labels: Optional[List[str]] = None):
+                 labels: Optional[List[str]] = None,
+                 record_meta: bool = False):
         self.num_classes = num_classes or (len(labels) if labels else None)
         self.label_names = labels
+        self.record_meta = record_meta
+        self._predictions: List[Prediction] = []
+        self._examples_seen = 0
         self._confusion: Optional[np.ndarray] = None  # [actual, predicted]
 
     # ------------------------------------------------------------------ acc
@@ -38,10 +54,42 @@ class Evaluation:
             self._confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
         actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
+        total = actual.shape[0]  # PRE-mask flattened positions
         if mask is not None:
-            keep = np.asarray(mask).astype(bool).reshape(-1)
-            actual, pred = actual[keep], pred[keep]
+            keep_idx = np.where(np.asarray(mask).astype(bool).reshape(-1))[0]
+            actual, pred = actual[keep_idx], pred[keep_idx]
+        else:
+            keep_idx = np.arange(total)
         np.add.at(self._confusion, (actual, pred), 1)
+        if self.record_meta:
+            # example_index counts pre-mask flattened positions (row, or
+            # b*T + t for sequences), so it maps back to the evaluated data
+            # even when masked timesteps were skipped
+            base = self._examples_seen
+            self._predictions.extend(
+                Prediction(int(a), int(p), base + int(k))
+                for a, p, k in zip(actual, pred, keep_idx))
+        self._examples_seen += total
+
+    # ----------------------------------------------------- prediction meta
+    def _require_meta(self) -> None:
+        if not self.record_meta:
+            raise ValueError("construct Evaluation(record_meta=True) to "
+                             "record per-example predictions")
+
+    def get_prediction_errors(self) -> List[Prediction]:
+        """Misclassified examples (reference
+        `Evaluation.getPredictionErrors()`)."""
+        self._require_meta()
+        return [p for p in self._predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List[Prediction]:
+        self._require_meta()
+        return [p for p in self._predictions if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> List[Prediction]:
+        self._require_meta()
+        return [p for p in self._predictions if p.predicted == cls]
 
     # -------------------------------------------------------------- metrics
     @property

@@ -82,3 +82,52 @@ class WordVectorSerializer:
         if z["syn1neg"].size:
             table.syn1neg = jnp.asarray(z["syn1neg"])
         return table
+
+    @staticmethod
+    def write_binary(table: InMemoryLookupTable,
+                     path: Union[str, Path]) -> None:
+        """Google word2vec C BINARY format (`WordVectorSerializer.
+        writeWordVectors` binary flavour — the format of
+        GoogleNews-vectors-negative300.bin): header 'V D\\n', then per word
+        'word ' + D little-endian float32s + '\\n'."""
+        syn0 = np.asarray(table.syn0, np.float32)[:table.vocab.num_words()]
+        with open(path, "wb") as f:
+            f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n".encode())
+            for i in range(syn0.shape[0]):
+                f.write(table.vocab.word_at_index(i).encode("utf-8") + b" ")
+                f.write(syn0[i].tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def read_binary(path: Union[str, Path]) -> InMemoryLookupTable:
+        """Load the Google word2vec C binary format
+        (`WordVectorSerializer.readBinaryModel`)."""
+        with open(path, "rb") as f:
+            data = f.read()  # one buffered read; parse by offset (real
+            # word2vec binaries are millions of words — per-byte f.read
+            # calls would cost minutes of interpreter overhead)
+        nl = data.index(b"\n")
+        header = data[:nl].split()
+        n, d = int(header[0]), int(header[1])
+        cache = AbstractCache()
+        vecs = np.zeros((n, d), np.float32)
+        order = []
+        pos = nl + 1
+        vec_bytes = 4 * d
+        for i in range(n):
+            while data[pos:pos + 1] == b"\n":  # record separator
+                pos += 1
+            sp = data.index(b" ", pos)
+            w = data[pos:sp].decode("utf-8", errors="replace")
+            pos = sp + 1
+            vecs[i] = np.frombuffer(data, np.float32, count=d, offset=pos)
+            pos += vec_bytes
+            cache.add_token(VocabWord(w, 1.0))
+            order.append(w)
+        cache._by_index = [cache.word_for(w) for w in order]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        cache.total_word_occurrences = float(n)
+        table = InMemoryLookupTable(cache, d)
+        table.syn0 = jnp.asarray(vecs)
+        return table

@@ -156,3 +156,68 @@ class ParamAndGradientIterationListener(IterationListener):
             for i, d in enumerate(ps):
                 for pname in sorted(d):
                     yield f"{i}_{pname}", d[pname]
+
+
+class CheckpointListener(IterationListener):
+    """Periodic checkpointing with keep-last-N rotation — the training-time
+    fault-tolerance piece (SURVEY §5 checkpoint/resume: the reference
+    checkpoints via `ModelSerializer` and early-stopping savers; this
+    listener automates it on an iteration/epoch cadence).
+
+    Files: `<dir>/checkpoint_<iteration>.zip` + a `latest` marker file the
+    resume path reads."""
+
+    def __init__(self, directory, every_n_iterations: int = 0,
+                 every_n_epochs: int = 0, keep_last: int = 3):
+        import os
+
+        if not every_n_iterations and not every_n_epochs:
+            raise ValueError("set every_n_iterations and/or every_n_epochs")
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = max(1, keep_last)
+        self.saved: List[str] = []
+        self._last_saved_iteration = -1
+
+    def _save(self, model, iteration: int) -> None:
+        import os
+
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        if iteration == self._last_saved_iteration:
+            return  # iteration- and epoch-cadence fired at the same step
+        self._last_saved_iteration = iteration
+        path = os.path.join(self.directory, f"checkpoint_{iteration}.zip")
+        write_model(model, path)
+        self.saved.append(path)
+        with open(os.path.join(self.directory, "latest"), "w") as f:
+            f.write(os.path.basename(path))
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if self.every_n_iterations and iteration % self.every_n_iterations == 0:
+            self._save(model, iteration)
+
+    def on_epoch_end(self, model) -> None:
+        if self.every_n_epochs and (model.epoch + 1) % self.every_n_epochs == 0:
+            self._save(model, model.iteration)
+
+    @staticmethod
+    def last_checkpoint(directory) -> "str | None":
+        """Path of the newest checkpoint, via the `latest` marker."""
+        import os
+
+        marker = os.path.join(directory, "latest")
+        if not os.path.exists(marker):
+            return None
+        with open(marker) as f:
+            name = f.read().strip()
+        path = os.path.join(directory, name)
+        return path if os.path.exists(path) else None

@@ -1,0 +1,270 @@
+"""Tests for the small-inventory tail: word2vec binary serde, prediction
+meta, re-batching iterators, time-series/math utils, Curves dataset, HDF5
+iterator, checkpoint listener."""
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+
+
+def _net(lr=0.1):
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(1).learning_rate(lr)
+            .list().layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2,
+                               activation=Activation.SOFTMAX)).build())
+    net = dl4j.MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+# ------------------------------------------------------- word2vec binary
+def test_word2vec_binary_round_trip(tmp_path):
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    corpus = [["alpha", "beta", "gamma", "delta"]] * 10
+    w2v = Word2Vec(layer_size=8, window=2, negative=2, epochs=1,
+                   batch_size=16, min_word_frequency=1)
+    w2v.fit(corpus)
+    p = tmp_path / "vec.bin"
+    WordVectorSerializer.write_binary(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_binary(p)
+    assert set(table.vocab.words()) == set(w2v.vocab.words())
+    for w in table.vocab.words():
+        np.testing.assert_allclose(
+            np.asarray(table.syn0[table.vocab.index_of(w)]),
+            np.asarray(w2v.lookup_table.syn0[w2v.vocab.index_of(w)]),
+            atol=1e-6)
+
+
+# -------------------------------------------------------- prediction meta
+def test_evaluation_prediction_meta():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    ev = Evaluation(record_meta=True)
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]  # 2 errors: idx 1, 3
+    ev.eval(labels, preds)
+    errs = ev.get_prediction_errors()
+    assert [(e.example_index, e.actual, e.predicted) for e in errs] == \
+        [(1, 1, 2), (3, 0, 1)]
+    assert len(ev.get_predictions_by_actual_class(0)) == 2
+    assert len(ev.get_predictions_by_predicted_class(2)) == 2
+    # second batch continues example indexing
+    ev.eval(labels, labels)
+    assert ev.get_prediction_errors() == errs
+    # meta off -> informative error
+    ev2 = Evaluation()
+    ev2.eval(labels, preds)
+    with pytest.raises(ValueError, match="record_meta"):
+        ev2.get_prediction_errors()
+
+
+# --------------------------------------------------- re-batching iterators
+def test_iterator_dataset_iterator():
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+
+    rng = np.random.default_rng(0)
+    pieces = [DataSet(rng.normal(size=(n, 3)).astype(np.float32),
+                      rng.normal(size=(n, 2)).astype(np.float32))
+              for n in (5, 3, 7, 2)]  # 17 examples
+    it = IteratorDataSetIterator(pieces, batch_size=6)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [6, 6, 5]
+    # values preserved in order
+    first = next(iter(it))
+    np.testing.assert_array_equal(first.features[:5], pieces[0].features)
+    np.testing.assert_array_equal(first.features[5:6], pieces[1].features[:1])
+
+
+def test_singleton_multi_dataset_iterator():
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.datasets.iterators import SingletonMultiDataSetIterator
+
+    mds = MultiDataSet(features=[np.zeros((2, 3), np.float32)],
+                       labels=[np.zeros((2, 1), np.float32)])
+    it = SingletonMultiDataSetIterator(mds)
+    assert len(list(it)) == 1
+    assert len(list(it)) == 1  # resettable
+
+
+# ------------------------------------------------------------------- utils
+def test_time_series_utils():
+    from deeplearning4j_tpu.util.time_series import (
+        extract_last_time_steps, moving_average, reverse_time_series,
+        time_series_mask_to_per_output_mask)
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], np.float32)
+    rev = reverse_time_series(x, mask)
+    np.testing.assert_array_equal(rev[0, 0], x[0, 2])  # valid prefix reversed
+    np.testing.assert_array_equal(rev[0, 3], x[0, 3])  # padding untouched
+    np.testing.assert_array_equal(rev[1, 0], x[1, 1])
+    last = extract_last_time_steps(x, mask)
+    np.testing.assert_array_equal(last[0], x[0, 2])
+    np.testing.assert_array_equal(last[1], x[1, 1])
+    assert extract_last_time_steps(x).shape == (2, 3)
+    m3 = time_series_mask_to_per_output_mask(mask, 5)
+    assert m3.shape == (2, 4, 5) and m3[0, 3].sum() == 0
+    ma = moving_average(np.array([1.0, 2.0, 3.0, 4.0]), 2)
+    np.testing.assert_allclose(ma, [1.0, 1.5, 2.5, 3.5])
+
+
+def test_math_utils():
+    from deeplearning4j_tpu.util.time_series import (
+        clamp, correlation, next_power_of_2, ss_error)
+
+    assert clamp(5.0, 0.0, 1.0) == 1.0
+    assert next_power_of_2(100) == 128 and next_power_of_2(1) == 1
+    assert ss_error(np.array([1.0, 2.0]), np.array([0.0, 0.0])) == 5.0
+    assert correlation(np.array([1, 2, 3]), np.array([2, 4, 6])) == pytest.approx(1.0)
+    assert correlation(np.array([1, 1, 1]), np.array([2, 4, 6])) == 0.0
+
+
+# ------------------------------------------------------------------ curves
+def test_curves_iterator_trains_autoencoder():
+    from deeplearning4j_tpu.datasets.fetchers import CurvesDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    it = CurvesDataSetIterator(batch_size=32, num_examples=64)
+    b = next(iter(it))
+    assert b.features.shape == (32, 784)
+    np.testing.assert_array_equal(b.features, b.labels)
+    assert 0.0 < b.features.mean() < 0.5  # sparse strokes
+
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(1).learning_rate(0.01)
+            .list()
+            .layer(AutoEncoder(n_in=784, n_out=64))
+            .layer(OutputLayer(n_in=64, n_out=784,
+                               activation=Activation.SIGMOID,
+                               loss=LossFunction.MSE))
+            .build())
+    net = dl4j.MultiLayerNetwork(conf)
+    net.init()
+    net.fit(it, epochs=2)
+    assert np.isfinite(net.score_value)
+
+
+# -------------------------------------------------------------------- hdf5
+def test_hdf5_iterator_sliced(tmp_path):
+    import h5py
+
+    from deeplearning4j_tpu.datasets.hdf5 import HDF5MiniBatchDataSetIterator
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(25, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 25)]
+    p = tmp_path / "d.h5"
+    with h5py.File(p, "w") as f:
+        f["features"] = x
+        f["labels"] = y
+    it = HDF5MiniBatchDataSetIterator(p, batch_size=10)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [10, 10, 5]
+    np.testing.assert_allclose(batches[0].features, x[:10])
+    net = _net()
+    net.fit(it, epochs=1)
+    assert np.isfinite(net.score_value)
+
+
+def test_hdf5_iterator_per_batch(tmp_path):
+    import h5py
+
+    from deeplearning4j_tpu.datasets.hdf5 import HDF5MiniBatchDataSetIterator
+
+    p = tmp_path / "b.h5"
+    rng = np.random.default_rng(0)
+    with h5py.File(p, "w") as f:
+        for i in range(3):
+            f[f"features_{i}"] = rng.normal(size=(4, 4)).astype(np.float32)
+            f[f"labels_{i}"] = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+    it = HDF5MiniBatchDataSetIterator(p)
+    batches = list(it)
+    assert len(batches) == 3 and batches[0].features.shape == (4, 4)
+
+
+# -------------------------------------------------------------- checkpoint
+def test_checkpoint_listener(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    net = _net()
+    ckpt = CheckpointListener(str(tmp_path), every_n_iterations=2,
+                              keep_last=2)
+    net.set_listeners(ckpt)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    for _ in range(7):
+        net.fit(DataSet(x, y))
+    # iterations 2,4,6 saved; keep_last=2 -> 4 and 6 remain
+    import os
+
+    remaining = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+    assert remaining == ["checkpoint_4.zip", "checkpoint_6.zip"]
+    latest = CheckpointListener.last_checkpoint(str(tmp_path))
+    assert latest.endswith("checkpoint_6.zip")
+    restored = restore_model(latest)
+    restored.fit(DataSet(x, y))  # resumes cleanly
+    assert np.isfinite(restored.score_value)
+
+
+def test_iterator_dataset_iterator_preserves_masks_and_one_shot_guard():
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+
+    rng = np.random.default_rng(0)
+    pieces = [DataSet(rng.normal(size=(3, 2, 4)).astype(np.float32),
+                      rng.normal(size=(3, 2, 1)).astype(np.float32),
+                      np.ones((3, 2), np.float32),
+                      np.ones((3, 2), np.float32))
+              for _ in range(3)]  # 9 examples with masks
+    it = IteratorDataSetIterator(pieces, batch_size=4)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [4, 4, 1]
+    assert batches[0].features_mask is not None
+    assert batches[0].features_mask.shape == (4, 2)
+    assert batches[2].labels_mask.shape == (1, 2)
+
+    gen = (d for d in pieces)  # one-shot: second epoch must raise
+    it2 = IteratorDataSetIterator(gen, batch_size=4)
+    assert len(list(it2)) == 3
+    with pytest.raises(ValueError, match="one-shot"):
+        list(it2)
+
+
+def test_prediction_meta_mask_indices():
+    """example_index counts pre-mask flattened positions, so masked
+    timesteps don't shift later indices."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    ev = Evaluation(record_meta=True)
+    labels = np.eye(2, dtype=np.float32)[[[0, 1, 0], [1, 0, 1]]]  # (2,3,2)
+    preds = np.eye(2, dtype=np.float32)[[[0, 0, 0], [1, 0, 1]]]   # err at (0,1)
+    mask = np.array([[1, 1, 0], [1, 1, 1]], np.float32)
+    ev.eval(labels, preds, mask=mask)
+    errs = ev.get_prediction_errors()
+    assert [(e.example_index, e.actual, e.predicted) for e in errs] == [(1, 1, 0)]
+    # positions 3..5 are example 1's timesteps regardless of masking
+    assert {p.example_index for p in ev.get_predictions_by_actual_class(1)} <= {1, 3, 5}
+
+
+def test_checkpoint_listener_no_duplicate_on_epoch_boundary(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+
+    net = _net()
+    ckpt = CheckpointListener(str(tmp_path), every_n_iterations=2,
+                              every_n_epochs=1, keep_last=3)
+    net.set_listeners(ckpt)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    # one epoch of 2 batches: iteration cadence fires at it=2 AND epoch end
+    # fires at it=2 -> must save once, not twice
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    net.fit(ListDataSetIterator([DataSet(x, y), DataSet(x, y)]))
+    assert ckpt.saved.count(ckpt.saved[0]) == 1
+    assert len(ckpt.saved) == 1
