@@ -69,11 +69,25 @@ class DataSet:
                 return None
             return np.concatenate(xs, axis=0)
 
+        def cat_masks(masks, refs):
+            # mixing masked and unmasked sets: an absent mask means "all
+            # valid", so synthesize ones instead of silently dropping the
+            # real masks
+            if all(m is None for m in masks):
+                return None
+            filled = [m if m is not None
+                      else np.ones(r.shape[:2], np.float32)
+                      for m, r in zip(masks, refs)]
+            return np.concatenate(filled, axis=0)
+
         return DataSet(
             np.concatenate([d.features for d in sets], axis=0),
             cat([d.labels for d in sets]),
-            cat([d.features_mask for d in sets]),
-            cat([d.labels_mask for d in sets]),
+            cat_masks([d.features_mask for d in sets],
+                      [d.features for d in sets]),
+            cat_masks([d.labels_mask for d in sets],
+                      [d.labels if d.labels is not None else d.features
+                       for d in sets]),
         )
 
 

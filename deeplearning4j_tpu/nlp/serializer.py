@@ -14,6 +14,19 @@ from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
 from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
 
 
+def _cache_from_ordered_words(words) -> AbstractCache:
+    """Vocab cache preserving FILE order (txt/binary formats carry no
+    counts; both loaders need identical index invariants)."""
+    cache = AbstractCache()
+    for w in words:
+        cache.add_token(VocabWord(w, 1.0))
+    cache._by_index = [cache.word_for(w) for w in words]
+    for i, vw in enumerate(cache._by_index):
+        vw.index = i
+    cache.total_word_occurrences = float(len(words))
+    return cache
+
+
 class WordVectorSerializer:
     @staticmethod
     def write_word_vectors(table: InMemoryLookupTable,
@@ -33,19 +46,12 @@ class WordVectorSerializer:
         with open(path, encoding="utf-8") as f:
             header = f.readline().split()
             n, d = int(header[0]), int(header[1])
-            cache = AbstractCache()
             vecs = np.zeros((n, d), np.float32)
             for i in range(n):
                 parts = f.readline().rstrip("\n").split(" ")
-                cache.add_token(VocabWord(parts[0], 1.0))
                 words.append(parts[0])
                 vecs[i] = [float(x) for x in parts[1:d + 1]]
-        # preserve file order (txt format has no counts)
-        cache._by_index = [cache.word_for(w) for w in words]
-        for i, vw in enumerate(cache._by_index):
-            vw.index = i
-        cache.total_word_occurrences = float(n)
-        table = InMemoryLookupTable(cache, d)
+        table = InMemoryLookupTable(_cache_from_ordered_words(words), d)
         table.syn0 = jnp.asarray(vecs)
         return table
 
@@ -109,7 +115,6 @@ class WordVectorSerializer:
         nl = data.index(b"\n")
         header = data[:nl].split()
         n, d = int(header[0]), int(header[1])
-        cache = AbstractCache()
         vecs = np.zeros((n, d), np.float32)
         order = []
         pos = nl + 1
@@ -118,16 +123,10 @@ class WordVectorSerializer:
             while data[pos:pos + 1] == b"\n":  # record separator
                 pos += 1
             sp = data.index(b" ", pos)
-            w = data[pos:sp].decode("utf-8", errors="replace")
+            order.append(data[pos:sp].decode("utf-8", errors="replace"))
             pos = sp + 1
             vecs[i] = np.frombuffer(data, np.float32, count=d, offset=pos)
             pos += vec_bytes
-            cache.add_token(VocabWord(w, 1.0))
-            order.append(w)
-        cache._by_index = [cache.word_for(w) for w in order]
-        for i, vw in enumerate(cache._by_index):
-            vw.index = i
-        cache.total_word_occurrences = float(n)
-        table = InMemoryLookupTable(cache, d)
+        table = InMemoryLookupTable(_cache_from_ordered_words(order), d)
         table.syn0 = jnp.asarray(vecs)
         return table

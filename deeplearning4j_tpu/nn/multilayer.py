@@ -71,6 +71,7 @@ class MultiLayerNetwork:
         self._rnn_state: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         self._it_device: Optional[jnp.ndarray] = None
         self._jit_train = None
+        self._jit_scan = None
         self._jit_output = None
         self._input_types = self._resolve_input_types()
 
@@ -214,6 +215,28 @@ class MultiLayerNetwork:
         compiled XLA computation per step (in-place update in HBM)."""
         return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 3))
 
+    def _make_scan_train(self):
+        """K steps per dispatch: `lax.scan` of the train step over stacked
+        batches (K, B, ...). The whole K-step loop is ONE XLA computation —
+        one host dispatch, one (K,) loss readback — so host/tunnel latency
+        amortizes over K steps. The device-side training loop the reference
+        architecture can't express (its Java loop must drive every op)."""
+        step = self.train_step_fn()
+
+        def multi(params, upd, lstate, iteration, feats, labels):
+            def body(carry, batch):
+                params, upd, lstate, it = carry
+                f, l = batch
+                params, upd, lstate, it, loss = step(
+                    params, upd, lstate, it, f, l, None, None)
+                return (params, upd, lstate, it), loss
+
+            (params, upd, lstate, iteration), losses = jax.lax.scan(
+                body, (params, upd, lstate, iteration), (feats, labels))
+            return params, upd, lstate, iteration, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
+
     def _batch_arrays(self, ds: DataSet):
         f = jnp.asarray(ds.features, self.dtype)
         l = jnp.asarray(ds.labels, self.dtype) if ds.labels is not None else None
@@ -222,10 +245,19 @@ class MultiLayerNetwork:
         return f, l, fm, lm
 
     def fit(self, data: Union[DataSet, DataSetIterator, np.ndarray],
-            labels: Optional[np.ndarray] = None, epochs: int = 1) -> None:
+            labels: Optional[np.ndarray] = None, epochs: int = 1,
+            scan_steps: int = 1) -> None:
         """Train (reference `fit(DataSetIterator)`,
         `MultiLayerNetwork.java:978`; iterator wrapped in async prefetch at
-        `:982`)."""
+        `:982`).
+
+        `scan_steps=K` (K>1) runs K consecutive batches per device dispatch
+        via `lax.scan` (see `_make_scan_train`) — use for small/fast models
+        where host dispatch latency bounds throughput. Requires uniform
+        batch shapes, no masks, and no listeners (listeners need
+        per-iteration model state, which a scanned chunk never
+        materializes); non-conforming batches fall back to the per-step
+        path transparently."""
         self._ensure_init()
         if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
             data = DataSet(np.asarray(data), np.asarray(labels))
@@ -249,19 +281,45 @@ class MultiLayerNetwork:
         line_search_algo = (self.conf.global_conf.optimization_algo
                             != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT)
         tbptt = (self.conf.tbptt_fwd_length > 0)
+        scan = scan_steps > 1 and not line_search_algo and not tbptt
+        if scan and self.listeners:
+            # per-iteration listeners observe model state; inside a scanned
+            # chunk intermediate states never materialize, so a listener at
+            # iteration k would snapshot end-of-chunk params (e.g. a
+            # checkpoint claiming iteration k with k+3's weights)
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").info(
+                "scan_steps disabled: %d listener(s) attached need "
+                "per-iteration model state", len(self.listeners))
+            scan = False
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
                     listener.on_epoch_start(self)
             n_batches = 0
+            pending: List[DataSet] = []
             for ds in iterator:
                 n_batches += 1
                 if line_search_algo:
                     self._fit_batch_solver(ds)
                 elif tbptt and ds.features.ndim == 3:
                     self._fit_tbptt(ds)
+                elif scan:
+                    if (ds.features_mask is not None or ds.labels_mask is not None
+                            or (pending and ds.features.shape != pending[0].features.shape)):
+                        self._flush_scan(pending)  # shape change / masks
+                        pending = []
+                        self._fit_batch(ds)
+                        continue
+                    pending.append(ds)
+                    if len(pending) == scan_steps:
+                        self._flush_scan(pending)
+                        pending = []
                 else:
                     self._fit_batch(ds)
+            if scan and pending:
+                self._flush_scan(pending)
             if n_batches == 0:
                 import logging
 
@@ -272,6 +330,36 @@ class MultiLayerNetwork:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
             self.epoch += 1
+
+    def _flush_scan(self, pending: List[DataSet]) -> None:
+        """Run the accumulated uniform batches as one scanned dispatch.
+        One or two batches aren't worth a separate scan compilation."""
+        if not pending:
+            return
+        if len(pending) == 1:
+            self._fit_batch(pending[0])
+            return
+        for ds in pending:
+            self._validate_labels(ds)
+        if self._jit_scan is None:
+            self._jit_scan = self._make_scan_train()
+        feats = jnp.asarray(np.stack([ds.features for ds in pending]),
+                            self.dtype)
+        labels = jnp.asarray(np.stack([ds.labels for ds in pending]),
+                             self.dtype)
+        if self._it_device is None:
+            self._it_device = jnp.asarray(self.iteration, jnp.int32)
+        (self._params, self._upd_state, self._layer_state, self._it_device,
+         losses) = self._jit_scan(
+            self._params, self._upd_state, self._layer_state,
+            self._it_device, feats, labels)
+        for i, ds in enumerate(pending):
+            self._score = losses[i]  # device slice; lazy sync on read
+            self.iteration += 1
+            for listener in self.listeners:
+                if hasattr(listener, "record_batch"):
+                    listener.record_batch(ds.num_examples())
+                listener.iteration_done(self, self.iteration)
 
     def _fit_batch(self, ds: DataSet):
         self._validate_labels(ds)

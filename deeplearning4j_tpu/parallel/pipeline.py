@@ -1,0 +1,102 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+No counterpart in the reference — its only strategy is data parallelism
+(SURVEY §2.4: "The only parallelism strategy implemented anywhere is DATA
+PARALLELISM") — but pipeline parallelism is a first-class axis of the TPU
+design space (models deeper than one chip's HBM). Shape: S identical
+stages' parameters are STACKED on axis 0 and sharded over the `pipe` mesh
+axis (one stage per device); microbatches flow device→device via
+`lax.ppermute` over ICI. The whole fill/steady/drain schedule runs inside
+one jitted `fori_loop` — XLA overlaps each hop's DMA with the next stage's
+compute.
+
+Differentiable end-to-end: `jax.grad` through `ppermute` yields the
+reverse-direction pipeline for the backward pass automatically.
+
+Restriction: stages must be homogeneous (same param structure and same
+activation shape in == out) — the transformer-block / MLP-stack case. The
+heterogeneous-stage alternative is tensor/data sharding (ParallelWrapper).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
+                   mesh: Mesh, *, axis_name: str = "pipe",
+                   microbatches: int = None) -> jnp.ndarray:
+    """Apply S stacked stages as a pipeline over the mesh axis.
+
+    block_fn(params_i, x) -> y with y.shape == x.shape (homogeneous stages);
+    stacked_params: pytree whose leaves have leading dim S (stage axis);
+    x: (B, ...) global batch, split into `microbatches` equal chunks
+    (default: S — the minimum for a full pipeline).
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches if microbatches is not None else S
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+    if leaf.shape[0] != S:
+        raise ValueError(
+            f"stacked params have {leaf.shape[0]} stages but mesh axis "
+            f"'{axis_name}' has size {S}")
+    xs = x.reshape(M, B // M, *x.shape[1:])
+
+    def local(stage_p, xs_local):
+        # stage_p leaves: (1, ...) — this device's stage; drop the stage dim
+        p = jax.tree.map(lambda a: a[0], stage_p)
+        d = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        mb_shape = xs_local.shape[1:]
+        n_steps = S + M - 1
+
+        def step(t, carry):
+            buf, outs = carry
+            # device 0 injects microbatch t (clamped; masked later), others
+            # consume what arrived from the previous stage
+            inj = xs_local[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(d == 0, inj, buf)
+            y = block_fn(p, inp)
+            # last stage owns the finished microbatch t-(S-1)
+            out_idx = t - (S - 1)
+            oc = jnp.clip(out_idx, 0, M - 1)
+            take = (d == S - 1) & (out_idx >= 0)
+            outs = outs.at[oc].set(jnp.where(take, y, outs[oc]))
+            buf_next = lax.ppermute(y, axis_name, perm)
+            return buf_next, outs
+
+        init = (jnp.zeros(mb_shape, xs_local.dtype),
+                jnp.zeros_like(xs_local))
+        _, outs = lax.fori_loop(0, n_steps, step, init)
+        # results live on the last stage's device: masked psum broadcasts
+        # them to every device (replicated output spec)
+        return lax.psum(jnp.where(d == S - 1, outs, 0.0), axis_name)
+
+    repl = P()
+    out = shard_map(local, mesh=mesh,
+                    in_specs=(P(axis_name), repl),
+                    out_specs=repl, check_vma=False)(stacked_params, xs)
+    return out.reshape(B, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params) -> object:
+    """[stage0_pytree, stage1_pytree, ...] (identical structures) → one
+    pytree with a leading stage axis, ready to shard over `pipe`."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def shard_stacked_params(stacked_params, mesh: Mesh,
+                         axis_name: str = "pipe"):
+    """Place each stage's slice on its pipeline device."""
+    sh = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), stacked_params)

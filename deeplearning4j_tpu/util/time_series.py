@@ -38,8 +38,12 @@ def extract_last_time_steps(x: np.ndarray,
     if mask is None:
         return x[:, -1]
     m = np.asarray(mask) > 0
-    last = np.maximum(m.shape[1] - 1 - np.argmax(m[:, ::-1], axis=1), 0)
-    return x[np.arange(x.shape[0]), last]
+    last = m.shape[1] - 1 - np.argmax(m[:, ::-1], axis=1)
+    out = x[np.arange(x.shape[0]), last]
+    # an all-masked example has no last valid step: return zeros, matching
+    # the fully-masked -> 0 convention used by attention/masked losses
+    out = np.where(m.any(axis=1)[:, None], out, 0.0)
+    return out
 
 
 def time_series_mask_to_per_output_mask(mask: np.ndarray,

@@ -268,3 +268,52 @@ def test_checkpoint_listener_no_duplicate_on_epoch_boundary(tmp_path):
     net.fit(ListDataSetIterator([DataSet(x, y), DataSet(x, y)]))
     assert ckpt.saved.count(ckpt.saved[0]) == 1
     assert len(ckpt.saved) == 1
+
+
+def test_merge_mixed_masks_synthesizes_ones():
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+
+    rng = np.random.default_rng(0)
+    masked = DataSet(rng.normal(size=(2, 3, 4)).astype(np.float32),
+                     rng.normal(size=(2, 3, 1)).astype(np.float32),
+                     np.array([[1, 1, 0], [1, 0, 0]], np.float32))
+    unmasked = DataSet(rng.normal(size=(2, 3, 4)).astype(np.float32),
+                       rng.normal(size=(2, 3, 1)).astype(np.float32))
+    merged = DataSet.merge([masked, unmasked])
+    assert merged.features_mask is not None
+    np.testing.assert_array_equal(merged.features_mask[:2], masked.features_mask)
+    np.testing.assert_array_equal(merged.features_mask[2:], np.ones((2, 3)))
+    # through the re-batching iterator too
+    b = next(iter(IteratorDataSetIterator([masked, unmasked], batch_size=4)))
+    assert b.features_mask is not None and b.features_mask.shape == (4, 3)
+
+
+def test_extract_last_time_steps_all_masked_row():
+    from deeplearning4j_tpu.util.time_series import extract_last_time_steps
+
+    x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    mask = np.array([[1, 1, 0], [0, 0, 0]], np.float32)
+    out = extract_last_time_steps(x, mask)
+    np.testing.assert_array_equal(out[0], x[0, 1])
+    np.testing.assert_array_equal(out[1], np.zeros(2))  # not padding garbage
+
+
+def test_scan_steps_falls_back_with_listeners(tmp_path):
+    """scan mode must not let a checkpoint claim iteration k with
+    iteration k+j's weights: listeners force the per-step path."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+               for _ in range(4)]
+    net = _net()
+    net.set_listeners(CheckpointListener(str(tmp_path), every_n_iterations=2))
+    net.fit(ListDataSetIterator(batches), scan_steps=4)
+    ck2 = restore_model(str(tmp_path / "checkpoint_2.zip"))
+    # train an identical net WITHOUT scan for 2 iterations: params must match
+    ref = _net()
+    ref.fit(ListDataSetIterator(batches[:2]))
+    np.testing.assert_allclose(ck2.params(), ref.params(), atol=1e-6)

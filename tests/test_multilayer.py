@@ -117,3 +117,26 @@ def test_listener_called():
     net.set_listeners(lst)
     net.fit(ListDataSetIterator([ds], batch_size=50), epochs=2)
     assert len(lst.scores) == 6  # 150/50 * 2
+
+
+def test_scan_steps_matches_sequential():
+    """scan_steps=K (device-side multi-step loop) must be bit-identical to
+    per-step training: same batches, same in-trace rng derivation."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(3)
+    batches = [DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+               for _ in range(7)]  # 7 % 4 != 0: exercises the tail flush
+    a = MultiLayerNetwork(mlp_conf())
+    a.init()
+    a.fit(ListDataSetIterator(batches), epochs=2)
+    b = MultiLayerNetwork(mlp_conf())
+    b.init()
+    b.fit(ListDataSetIterator(batches), epochs=2, scan_steps=4)
+    assert a.iteration == b.iteration == 14
+    np.testing.assert_allclose(a.params(), b.params(), atol=1e-6)
+    np.testing.assert_allclose(a.score_value, b.score_value, atol=1e-6)
