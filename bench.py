@@ -38,7 +38,11 @@ def bench_lenet():
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     batch_size, warmup, bench = 512, 5, 30
-    net = MultiLayerNetwork(lenet_configuration())
+    import jax.numpy as jnp
+
+    # mixed precision is the TPU-native training mode (MXU feeds bf16);
+    # params/optimizer state stay f32
+    net = MultiLayerNetwork(lenet_configuration(), compute_dtype=jnp.bfloat16)
     net.init()
     it = MnistDataSetIterator(batch_size, num_examples=batch_size * (warmup + bench))
     dt = _throughput(net, list(it), warmup, bench)
@@ -51,7 +55,10 @@ def bench_resnet50():
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     batch_size, warmup, bench = 256, 3, 10
-    net = ComputationGraph(resnet_configuration(depth=50, n_classes=10))
+    import jax.numpy as jnp
+
+    net = ComputationGraph(resnet_configuration(depth=50, n_classes=10),
+                           compute_dtype=jnp.bfloat16)
     net.init()
     rng = np.random.default_rng(0)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch_size)]

@@ -464,19 +464,27 @@ class BatchNormalization(FeedForwardLayer):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature (last)
+        # batch statistics and normalization math in >= f32: under bf16
+        # mixed precision, bf16-reduced mean/var would feed noisy stats
+        # into both normalization and the carried running stats (standard
+        # mixed-precision practice keeps norm reductions full precision);
+        # promote (not force-f32) so f64 gradient checks keep f64
+        stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        xs = x.astype(stat_dtype)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xs, axis=axes)
+            var = jnp.var(xs, axis=axes)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        xhat = (xs - mean) / jnp.sqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xhat = xhat * params["gamma"] + params["beta"]
-        return self._act()(xhat), new_state
+            xhat = (xhat * params["gamma"].astype(stat_dtype)
+                    + params["beta"].astype(stat_dtype))
+        return self._act()(xhat).astype(x.dtype), new_state
 
     def param_flags(self, name):
         # gamma/beta: no l1/l2 by default (reference BatchNormalizationParamInitializer)

@@ -42,9 +42,13 @@ LState = Dict[str, Dict[str, jnp.ndarray]]
 
 
 class ComputationGraph:
-    def __init__(self, conf: ComputationGraphConfiguration, dtype=jnp.float32):
+    def __init__(self, conf: ComputationGraphConfiguration, dtype=jnp.float32,
+                 compute_dtype=None):
+        """`compute_dtype=jnp.bfloat16` = mixed precision (see
+        MultiLayerNetwork: params/optimizer in `dtype`, fwd/bwd in bf16)."""
         self.conf = conf
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
         self._params: Optional[Params] = None
         self._upd_state = None
         self._layer_state: Optional[LState] = None
@@ -151,8 +155,19 @@ class ComputationGraph:
     def _loss_pure(self, params, lstate, inputs, labels, fmasks, lmasks, rng,
                    train: bool = True):
         conf = self.conf
+        params_in, lstate_in = params, lstate
+        if self.compute_dtype is not None:
+            from deeplearning4j_tpu.nn.precision import tree_cast
+
+            params = tree_cast(params, self.compute_dtype)
+            inputs = tuple(x.astype(self.compute_dtype) for x in inputs)
         acts, new_state = self._forward_pure(params, lstate, inputs,
                                              train=train, rng=rng, fmasks=fmasks)
+        if self.compute_dtype is not None:
+            from deeplearning4j_tpu.nn.precision import restore_dtypes
+
+            acts = {k: v.astype(self.dtype) for k, v in acts.items()}
+            new_state = restore_dtypes(new_state, lstate_in)
         total = 0.0
         for oi, oname in enumerate(conf.network_outputs):
             node = conf.nodes[oname]
@@ -167,10 +182,10 @@ class ComputationGraph:
             li = conf.topological_order.index(oname)
             lrng = None if rng is None else jax.random.fold_in(rng, li)
             lmask = lmasks[oi] if lmasks is not None else None
-            total = total + node.layer.loss_score(params[oname], x, labels[oi],
+            total = total + node.layer.loss_score(params_in[oname], x, labels[oi],
                                                   train=train, rng=lrng,
                                                   mask=lmask)
-        total = total + self._reg_score(params)
+        total = total + self._reg_score(params_in)
         return total, new_state
 
     def _reg_score(self, params: Params):

@@ -56,9 +56,17 @@ LState = List[Dict[str, jnp.ndarray]]
 
 
 class MultiLayerNetwork:
-    def __init__(self, conf: MultiLayerConfiguration, dtype=jnp.float32):
+    def __init__(self, conf: MultiLayerConfiguration, dtype=jnp.float32,
+                 compute_dtype=None):
+        """`compute_dtype=jnp.bfloat16` enables mixed precision: parameters
+        and optimizer state stay in `dtype` (f32 — update math and Adam
+        moments keep full precision), while the forward/backward compute
+        runs in bf16, the MXU's native feed width. Gradients come back f32
+        (jax.grad of an f32->bf16 cast accumulates in f32); bf16's f32-sized
+        exponent makes loss scaling unnecessary."""
         self.conf = conf
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
         self.layers: List[Layer] = conf.layers
         self._params: Optional[Params] = None
         self._upd_state = None
@@ -161,17 +169,30 @@ class MultiLayerNetwork:
                    fmask, lmask, rng, train: bool = True):
         """Loss = output-layer score + L1/L2 penalties (reference
         `computeGradientAndScore` + `calcL1/calcL2` in BaseLayer)."""
+        params_in, lstate_in = params, lstate
+        if self.compute_dtype is not None:
+            # mixed precision: hidden-layer fwd/bwd in the compute dtype;
+            # loss head, L1/L2, and carried state stay in the param dtype
+            from deeplearning4j_tpu.nn.precision import tree_cast
+
+            params = tree_cast(params, self.compute_dtype)
+            features = features.astype(self.compute_dtype)
         x, new_state = self._forward_pure(params, lstate, features, train=train,
                                           rng=rng, fmask=fmask,
                                           upto=len(self.layers) - 1)
+        if self.compute_dtype is not None:
+            from deeplearning4j_tpu.nn.precision import restore_dtypes
+
+            x = x.astype(self.dtype)
+            new_state = restore_dtypes(new_state, lstate_in)
         out_layer = self.layers[-1]
         if len(self.layers) - 1 in self.conf.preprocessors:
             x = self.conf.preprocessors[len(self.layers) - 1].preprocess(x)
         out_rng = None if rng is None else jax.random.fold_in(rng, len(self.layers) - 1)
         mask = lmask if lmask is not None else (fmask if x.ndim == 3 else None)
-        loss = out_layer.loss_score(params[-1], x, labels, train=train,
+        loss = out_layer.loss_score(params_in[-1], x, labels, train=train,
                                     rng=out_rng, mask=mask)
-        loss = loss + self._reg_score(params)
+        loss = loss + self._reg_score(params_in)
         return loss, new_state
 
     def _reg_score(self, params: Params):

@@ -1,0 +1,18 @@
+"""Mixed-precision casting helpers shared by the MultiLayerNetwork and
+ComputationGraph training paths (one protocol, two containers): fwd/bwd in
+the compute dtype, loss head + regularization + carried state in the
+parameter dtype."""
+from __future__ import annotations
+
+import jax
+
+
+def tree_cast(tree, dtype):
+    """Cast every array leaf."""
+    return jax.tree.map(lambda a: a.astype(dtype), tree)
+
+
+def restore_dtypes(tree, ref_tree):
+    """Cast each leaf back to its counterpart's dtype (carried state must
+    keep its original precision across steps or the jit retraces)."""
+    return jax.tree.map(lambda a, b: a.astype(b.dtype), tree, ref_tree)

@@ -166,3 +166,40 @@ def test_graph_gradient_check():
     g = ComputationGraph(conf, dtype=jnp.float64)
     g.init()
     assert check_gradients(g, DataSet(X, labels), print_results=True)
+
+
+def test_graph_mixed_precision_bf16():
+    """compute_dtype=bf16 on a ComputationGraph with BN + merge vertices:
+    master params stay f32, training converges, BN running stats stay f32
+    (stats are reduced in f32 even under bf16 activations)."""
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(2).learning_rate(0.2)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("b", DenseLayer(n_in=4, n_out=8), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("bn", BatchNormalization(n_out=16), "m")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3,
+                                          activation=Activation.SOFTMAX), "bn")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    rng = np.random.default_rng(2)
+    c = rng.integers(0, 3, 120)
+    x = (rng.normal(size=(120, 4)) * 0.4 + c[:, None]).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[c]
+
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16)
+    net.init()
+    for _ in range(30):
+        net.fit(DataSet(x, y))
+    assert net.score_value < 0.7
+    for vparams in net._params.values():
+        for p in vparams.values():
+            assert p.dtype == jnp.float32
+    assert net._layer_state["bn"]["mean"].dtype == jnp.float32
+    acc = (np.argmax(net.output(x)[0], 1) == c).mean()
+    assert acc > 0.8

@@ -140,3 +140,33 @@ def test_scan_steps_matches_sequential():
     assert a.iteration == b.iteration == 14
     np.testing.assert_allclose(a.params(), b.params(), atol=1e-6)
     np.testing.assert_allclose(a.score_value, b.score_value, atol=1e-6)
+
+
+def test_mixed_precision_bf16():
+    """compute_dtype=bf16: params/updater state stay f32, training
+    converges to comparable loss, inference unchanged."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(5)
+    c = rng.integers(0, 3, 120)
+    x = (rng.normal(size=(120, 4)) * 0.4 + c[:, None]).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[c]
+    ds = DataSet(x, y)
+
+    f32 = MultiLayerNetwork(mlp_conf(lr=0.3))
+    f32.init()
+    bf16 = MultiLayerNetwork(mlp_conf(lr=0.3), compute_dtype=jnp.bfloat16)
+    bf16.init()
+    for _ in range(30):
+        f32.fit(ds)
+        bf16.fit(ds)
+    # master params stayed f32
+    assert all(p.dtype == jnp.float32
+               for layer in bf16._params for p in layer.values())
+    assert bf16.score_value < 0.5
+    assert abs(bf16.score_value - f32.score_value) < 0.15
+    acc = (np.argmax(bf16.output(x), 1) == c).mean()
+    assert acc > 0.85
