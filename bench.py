@@ -91,6 +91,8 @@ def bench_lstm():
                                   activation=Activation.SOFTMAX))
             .set_input_type(InputType.recurrent(vocab))
             .build())
+    # NOTE: measured SLOWER with compute_dtype=bf16 (23.6k vs 31.6k) — the
+    # recurrent GEMMs are too small for MXU gains to cover the cast traffic
     net = MultiLayerNetwork(conf)
     net.init()
     rng = np.random.default_rng(0)

@@ -234,31 +234,43 @@ class ComputationGraph:
             iterator = ListDataSetIterator([data])
         else:
             iterator = data
+        wrapped_async = False
         if isinstance(iterator, DataSetIterator) and iterator.async_supported \
                 and not isinstance(iterator, AsyncDataSetIterator):
             iterator = AsyncDataSetIterator(iterator)
+            wrapped_async = True
         if self._jit_train is None:
             self._jit_train = jax.jit(self.train_step_fn(),
                                       donate_argnums=(0, 1, 2, 3))
         self._it_device = jnp.asarray(self.iteration, jnp.int32)
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            n_batches = 0
-            for ds in iterator:
-                n_batches += 1
-                self._fit_batch(self._to_mds(ds))
-            if n_batches == 0:
-                import logging
+        try:
+            for _ in range(epochs):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                n_batches = 0
+                for ds in iterator:
+                    n_batches += 1
+                    self._fit_batch(self._to_mds(ds))
+                if n_batches == 0:
+                    import logging
 
-                logging.getLogger("deeplearning4j_tpu").warning(
-                    "fit(): iterator produced no batches this epoch — if it "
-                    "wraps a generator, it may already be exhausted")
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+                    logging.getLogger("deeplearning4j_tpu").warning(
+                        "fit(): iterator produced no batches this epoch — if it "
+                        "wraps a generator, it may already be exhausted")
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
+        finally:
+            if wrapped_async:
+                # tear down the prefetch producer thread even on
+                # failure (a leaked producer would race a retry
+                # over the underlying iterator's cursor)
+                try:
+                    iterator.reset()
+                except ValueError:
+                    pass  # one-shot underlying cannot rewind
 
     def _fit_batch(self, mds: MultiDataSet):
         inputs, labels, fmasks, lmasks = self._mds_arrays(mds)

@@ -286,8 +286,10 @@ class MultiLayerNetwork:
             iterator: DataSetIterator = ListDataSetIterator([data])
         else:
             iterator = data
+        wrapped_async = False
         if iterator.async_supported and not isinstance(iterator, AsyncDataSetIterator):
             iterator = AsyncDataSetIterator(iterator)
+            wrapped_async = True
 
         if self._jit_train is None:
             self._jit_train = self._make_train_step()
@@ -314,43 +316,53 @@ class MultiLayerNetwork:
                 "scan_steps disabled: %d listener(s) attached need "
                 "per-iteration model state", len(self.listeners))
             scan = False
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            n_batches = 0
-            pending: List[DataSet] = []
-            for ds in iterator:
-                n_batches += 1
-                if line_search_algo:
-                    self._fit_batch_solver(ds)
-                elif tbptt and ds.features.ndim == 3:
-                    self._fit_tbptt(ds)
-                elif scan:
-                    if (ds.features_mask is not None or ds.labels_mask is not None
-                            or (pending and ds.features.shape != pending[0].features.shape)):
-                        self._flush_scan(pending)  # shape change / masks
-                        pending = []
+        try:
+            for _ in range(epochs):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                n_batches = 0
+                pending: List[DataSet] = []
+                for ds in iterator:
+                    n_batches += 1
+                    if line_search_algo:
+                        self._fit_batch_solver(ds)
+                    elif tbptt and ds.features.ndim == 3:
+                        self._fit_tbptt(ds)
+                    elif scan:
+                        if (ds.features_mask is not None or ds.labels_mask is not None
+                                or (pending and ds.features.shape != pending[0].features.shape)):
+                            self._flush_scan(pending)  # shape change / masks
+                            pending = []
+                            self._fit_batch(ds)
+                            continue
+                        pending.append(ds)
+                        if len(pending) == scan_steps:
+                            self._flush_scan(pending)
+                            pending = []
+                    else:
                         self._fit_batch(ds)
-                        continue
-                    pending.append(ds)
-                    if len(pending) == scan_steps:
-                        self._flush_scan(pending)
-                        pending = []
-                else:
-                    self._fit_batch(ds)
-            if scan and pending:
-                self._flush_scan(pending)
-            if n_batches == 0:
-                import logging
+                if scan and pending:
+                    self._flush_scan(pending)
+                if n_batches == 0:
+                    import logging
 
-                logging.getLogger("deeplearning4j_tpu").warning(
-                    "fit(): iterator produced no batches this epoch — if it "
-                    "wraps a generator, it may already be exhausted")
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+                    logging.getLogger("deeplearning4j_tpu").warning(
+                        "fit(): iterator produced no batches this epoch — if it "
+                        "wraps a generator, it may already be exhausted")
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
+        finally:
+            if wrapped_async:
+                # tear down the prefetch producer thread even on
+                # failure (a leaked producer would race a retry
+                # over the underlying iterator's cursor)
+                try:
+                    iterator.reset()
+                except ValueError:
+                    pass  # one-shot underlying cannot rewind
 
     def _flush_scan(self, pending: List[DataSet]) -> None:
         """Run the accumulated uniform batches as one scanned dispatch.

@@ -1,0 +1,143 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts over a mesh
+axis.
+
+No counterpart in the reference (DP-only, SURVEY §2.4); included because
+expert parallelism is the remaining first-class axis of the TPU sharding
+design space (dp/tp/sp/pp/ep). Design: E experts' FFN parameters are
+STACKED and sharded one-per-device over the `expert` mesh axis; a linear
+router picks top-1 per token; tokens travel to their expert's device via
+`lax.all_to_all` over ICI (the standard MoE dispatch collective), are
+processed in one batched expert matmul, and return the same way.
+
+Capacity: each expert processes at most `capacity = ceil(tokens/E) *
+capacity_factor` tokens per device-shard; overflow tokens pass through
+unchanged (Switch Transformer semantics). Everything is static-shaped —
+routing is by sort/scatter, no data-dependent control flow.
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def router_probs(x: jnp.ndarray, router_w: jnp.ndarray) -> jnp.ndarray:
+    """(N, D) tokens × (D, E) router → (N, E) softmax probabilities."""
+    return jax.nn.softmax(x @ router_w, axis=-1)
+
+
+def _dispatch_indices(expert_idx: jnp.ndarray, E: int,
+                      capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Position of each token within its expert's capacity buffer, and a
+    keep-mask for tokens under capacity."""
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, E)
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot      # 1-based
+    pos = jnp.max(pos_in_expert, axis=-1) - 1                # (N,)
+    keep = pos < capacity
+    return pos, keep
+
+
+def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
+                        router_w: jnp.ndarray, *, capacity_factor: float = 1.25
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-device reference semantics (also the parity baseline for the
+    sharded path): top-1 routing with capacity, overflow passes through.
+
+    Returns (y, aux_loss) — aux_loss is the Switch load-balancing loss
+    (mean fraction routed × mean router prob, scaled by E)."""
+    N, D = x.shape
+    E = router_w.shape[1]
+    capacity = int(np.ceil(N / E * capacity_factor))
+    probs = router_probs(x, router_w)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    pos, keep = _dispatch_indices(expert_idx, E, capacity)  # global cap
+
+    # scatter tokens into (E, capacity, D) buffers
+    buf = jnp.zeros((E, capacity, D), x.dtype)
+    safe_pos = jnp.where(keep, pos, 0)
+    buf = buf.at[expert_idx, safe_pos].add(
+        jnp.where(keep[:, None], x, 0.0))
+    # one batched expert application: vmap over the expert axis
+    out_buf = jax.vmap(expert_fn)(stacked_params, buf)
+    # gather back
+    y_expert = out_buf[expert_idx, safe_pos]
+    y = jnp.where(keep[:, None], gate[:, None] * y_expert, x)
+
+    # load-balancing loss (Switch eq. 4)
+    frac_routed = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_routed * mean_prob)
+    return y, aux
+
+
+def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
+              router_w: jnp.ndarray, mesh: Mesh, *,
+              axis_name: str = "expert", capacity_factor: float = 1.25
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE: experts sharded over `axis_name`, token
+    dispatch/return via all_to_all.
+
+    Matches `moe_apply_reference` exactly while no expert overflows
+    (parity-tested). UNDER OVERFLOW the two drop different tokens: here
+    capacity is enforced per (expert, source-device) slice — the
+    GShard-style static dispatch shape that keeps the all_to_all regular —
+    while the reference caps each expert globally in token order. Both are
+    valid Switch semantics; don't expect bitwise agreement when routing is
+    skewed and capacity is tight.
+
+    x: (N, D) tokens (flatten (B, T, D) first); stacked_params: pytree with
+    leading expert dim E == mesh axis size; router_w: (D, E).
+    """
+    E = mesh.shape[axis_name]
+    leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+    if leaf.shape[0] != E:
+        raise ValueError(f"{leaf.shape[0]} experts but mesh axis "
+                         f"'{axis_name}' has size {E}")
+    N, D = x.shape
+    if N % E:
+        raise ValueError(f"token count {N} not divisible by expert axis {E}")
+    capacity = int(np.ceil(N / E * capacity_factor))
+    # per-device capacity slice must be whole
+    capacity = int(np.ceil(capacity / E) * E)
+
+    def local(stage_p, x_local, rw):
+        # x_local: (N/E, D) this device's token shard; stage_p: this
+        # device's expert params (leading dim 1)
+        p = jax.tree.map(lambda a: a[0], stage_p)
+        probs = router_probs(x_local, rw)              # (n, E)
+        expert_idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+        cap_local = capacity // E  # per (expert, source-device) slots
+        pos, keep = _dispatch_indices(expert_idx, E, cap_local)
+        safe_pos = jnp.where(keep, pos, 0)
+        buf = jnp.zeros((E, cap_local, x_local.shape[1]), x_local.dtype)
+        buf = buf.at[expert_idx, safe_pos].add(
+            jnp.where(keep[:, None], x_local, 0.0))
+        # all_to_all: (E, cap_local, D) -> expert e's device receives every
+        # source's slice for e: (E_src, cap_local, D) concat on axis 0
+        recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+        out = expert_fn(p, recv.reshape(-1, recv.shape[-1]))
+        out = out.reshape(E, cap_local, -1)
+        back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+        y_expert = back[expert_idx, safe_pos]
+        y = jnp.where(keep[:, None], gate[:, None] * y_expert, x_local)
+        frac = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(lax.pmean(frac, axis_name)
+                          * lax.pmean(mean_prob, axis_name))
+        return y, aux
+
+    tok = P(axis_name)
+    y, aux = shard_map(local, mesh=mesh,
+                       in_specs=(P(axis_name), tok, P()),
+                       out_specs=(tok, P()), check_vma=False)(
+        stacked_params, x, router_w)
+    return y, aux

@@ -1,0 +1,48 @@
+"""Determinism checking.
+
+Reference (SURVEY §5 "Race detection / sanitizers"): none — the JVM
+reference relies on `synchronized` and blocking queues. The TPU-build
+analogue of a race detector is a DETERMINISM CHECK: all device math is
+compiled and seeded, so two same-seed runs must produce bit-identical
+parameters; any divergence indicates nondeterminism sneaking in (host
+threading feeding batches out of order, un-seeded randomness,
+non-reproducible reductions).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+def assert_deterministic(net_factory: Callable[[], object],
+                        batches: Sequence, epochs: int = 1,
+                        atol: float = 0.0) -> None:
+    """Train two independently constructed nets on the same batches and
+    assert parameter equality (bit-exact by default).
+
+    net_factory: () -> initialized network (fresh params each call, same
+    seed via its configuration); batches: list of DataSets.
+    """
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    runs = []
+    for _ in range(2):
+        net = net_factory()
+        net.fit(ListDataSetIterator(list(batches)), epochs=epochs)
+        runs.append(net.params())
+    a, b = runs
+    if not np.isfinite(a).all():
+        raise AssertionError(
+            "training diverged (non-finite parameters) — determinism "
+            "cannot be assessed; lower the learning rate first")
+    if atol == 0.0:
+        if not np.array_equal(a, b, equal_nan=True):
+            diff = np.abs(a - b)
+            mism = int((~np.isclose(a, b, rtol=0, atol=0)).sum())
+            raise AssertionError(
+                f"nondeterministic training: params differ at "
+                f"{mism}/{a.size} positions "
+                f"(max |diff| = {np.nanmax(diff):.3e})")
+    else:
+        np.testing.assert_allclose(a, b, atol=atol)
