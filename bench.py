@@ -5,7 +5,7 @@ publishes no numbers — `BASELINE.json "published": {}` — so vs_baseline is
 reported against the first recorded run of this framework, stored in
 `.bench_baseline.json`).
 
-Usage: `python bench.py [lenet|resnet50|lstm]` (default: lenet — the
+Usage: `python bench.py [lenet|resnet50|lstm|gpt]` (default: lenet — the
 driver-run config). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -104,9 +104,36 @@ def bench_lstm():
     return "lstm_charrnn_train_samples_per_sec_per_chip", bench * batch_size / dt
 
 
+def bench_gpt():
+    """Causal transformer LM (flagship long-context config): bf16 mixed
+    precision, attention through the flash/blockwise dispatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    vocab, d_model, T, batch_size, warmup, bench = 256, 256, 256, 32, 3, 10
+    net = MultiLayerNetwork(
+        gpt_configuration(vocab_size=vocab, d_model=d_model, n_heads=8,
+                          n_layers=4, max_length=T,
+                          attention_block_size=128),  # T > block: the
+        # flash/blockwise dispatch path is what this config measures
+        compute_dtype=jnp.bfloat16)
+    net.init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (warmup + bench, batch_size, T + 1))
+    eye = np.eye(vocab, dtype=np.float32)
+    batches = [DataSet(ids[i, :, :-1].astype(np.float32), eye[ids[i, :, 1:]])
+               for i in range(warmup + bench)]
+    dt = _throughput(net, batches, warmup, bench)
+    return "gpt_causal_lm_train_tokens_per_sec_per_chip", bench * batch_size * T / dt
+
+
 def main() -> None:
     configs = {"lenet": bench_lenet, "resnet50": bench_resnet50,
-               "lstm": bench_lstm}
+               "lstm": bench_lstm, "gpt": bench_gpt}
     which = sys.argv[1] if len(sys.argv) > 1 else "lenet"
     if which not in configs:
         sys.exit(f"unknown bench config {which!r}; choose from {sorted(configs)}")
@@ -130,7 +157,8 @@ def main() -> None:
     print(json.dumps({
         "metric": metric,
         "value": round(samples_per_sec, 1),
-        "unit": "samples/sec/chip",
+        "unit": ("tokens/sec/chip" if "tokens" in metric
+                 else "samples/sec/chip"),
         "vs_baseline": round(samples_per_sec / baseline, 3),
     }))
 

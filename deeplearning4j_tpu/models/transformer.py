@@ -1,0 +1,65 @@
+"""GPT-style causal transformer language model.
+
+No counterpart in the reference (its sequence toolbox is LSTM + tBPTT,
+SURVEY §5); this is the long-context flagship of the TPU build: token +
+positional embedding → N pre-LN `TransformerBlock`s (attention dispatches
+to the pallas flash kernel / XLA blockwise path for long sequences) →
+final LayerNorm → per-timestep softmax head. Scales via:
+- data/tensor parallel: `ParallelWrapper` over a mesh;
+- long sequences: `parallel/sequence.py` ring/Ulysses attention;
+- deep stacks: homogeneous blocks fit `parallel/pipeline.py`;
+- wide FFN: `parallel/experts.py` Switch MoE.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    LayerNormalization,
+    RnnOutputLayer,
+    TokenEmbedding,
+    TransformerBlock,
+)
+from deeplearning4j_tpu.nn.updater import Updater
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def gpt_configuration(vocab_size: int,
+                      d_model: int = 256,
+                      n_heads: int = 4,
+                      n_layers: int = 4,
+                      max_length: int = 512,
+                      ffn_mult: int = 4,
+                      dropout: float = 0.0,
+                      seed: int = 12345,
+                      learning_rate: float = 3e-4,
+                      updater: Updater = Updater.ADAM,
+                      attention_block_size: int = 1024,
+                      ) -> MultiLayerConfiguration:
+    """Causal LM over int token ids (B, T) with next-token targets
+    (B, T, vocab) one-hot (per-timestep MCXENT, masked)."""
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .learning_rate(learning_rate)
+         .updater(updater)
+         .drop_out(dropout)
+         .list()
+         .layer(TokenEmbedding(n_in=vocab_size, n_out=d_model,
+                               max_length=max_length)))
+    for _ in range(n_layers):
+        b = b.layer(TransformerBlock(n_in=d_model, n_out=d_model,
+                                     n_heads=n_heads, ffn_mult=ffn_mult,
+                                     causal=True,
+                                     block_size=attention_block_size))
+    return (b
+            .layer(LayerNormalization(n_in=d_model, n_out=d_model,
+                                      dropout=0.0))
+            .layer(RnnOutputLayer(n_in=d_model, n_out=vocab_size,
+                                  activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT, dropout=0.0))
+            .set_input_type(InputType.recurrent(vocab_size))
+            .build())

@@ -713,6 +713,9 @@ class EmbeddingLayer(FeedForwardLayer):
 
     TYPE = "embedding"
     input_kind = "ff"
+    # consumes int ids: exempt from mixed-precision feature casts (bf16
+    # cannot represent odd integers above 256)
+    integer_input = True
     n_in: int = 0
     n_out: int = 0
 
@@ -1007,3 +1010,170 @@ class RBM(FeedForwardLayer):
 
 _FIELD_DECODERS["hidden_unit"] = HiddenUnit
 _FIELD_DECODERS["visible_unit"] = VisibleUnit
+
+
+@register_layer
+@dataclass
+class LayerNormalization(FeedForwardLayer):
+    """Layer normalization over the feature axis.
+
+    No counterpart in the reference (its only normalization is batch norm,
+    `nn/conf/layers/BatchNormalization.java`); required by the transformer
+    tier. Statistics are computed in promoted >= f32 precision (same
+    rationale as BatchNormalization under bf16 mixed precision)."""
+
+    TYPE = "layer_norm"
+    input_kind = "rnn"
+    n_in: int = 0
+    n_out: int = 0
+    eps: float = 1e-5
+
+    def __post_init__(self):
+        if self.n_out and self.n_in and self.n_out != self.n_in:
+            raise ValueError("LayerNormalization keeps width: n_in == n_out")
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, it, dtype=jnp.float32) -> Params:
+        nf = self.n_out or self.n_in or it.size
+        return {"gamma": jnp.ones((nf,), dtype),
+                "beta": jnp.zeros((nf,), dtype)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return layer_norm(x, params["gamma"], params["beta"], self.eps), state
+
+
+def layer_norm(x, gamma, beta, eps=1e-5):
+    stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xs = x.astype(stat_dtype)
+    mean = jnp.mean(xs, axis=-1, keepdims=True)
+    var = jnp.var(xs, axis=-1, keepdims=True)
+    xhat = (xs - mean) / jnp.sqrt(var + eps)
+    out = xhat * gamma.astype(stat_dtype) + beta.astype(stat_dtype)
+    return out.astype(x.dtype)
+
+
+@register_layer
+@dataclass
+class TokenEmbedding(FeedForwardLayer):
+    """Token + learned positional embedding: (B, T) int ids → (B, T, D).
+
+    The sequence-model entry point (reference has no transformer tier; its
+    EmbeddingLayer handles one id per example)."""
+
+    TYPE = "token_embedding"
+    input_kind = "rnn"
+    integer_input = True  # int ids: exempt from compute-dtype casts
+    n_in: int = 0          # vocabulary size
+    n_out: int = 0         # d_model
+    max_length: int = 512
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length if isinstance(it, InputTypeRecurrent) else -1
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, it, dtype=jnp.float32) -> Params:
+        k1, k2 = jax.random.split(key)
+        tok = self._winit(k1, (self.n_in, self.n_out), self.n_in, self.n_out,
+                          dtype)
+        pos = 0.02 * jax.random.normal(k2, (self.max_length, self.n_out),
+                                       dtype)
+        return {"W": tok, "P": pos}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:  # (B, T, 1) convenience
+            idx = idx[..., 0]
+        T = idx.shape[1]
+        if T > self.max_length:
+            raise ValueError(f"sequence length {T} exceeds max_length "
+                             f"{self.max_length}")
+        y = params["W"][idx] + params["P"][:T]
+        y = self._maybe_dropout(y, train, rng)
+        return y, state
+
+    def param_flags(self, name):
+        # positional table: neither a bias nor weight-decayed
+        if name == "P":
+            return {"is_bias": False, "regularizable": False}
+        return super().param_flags(name)
+
+
+@register_layer
+@dataclass
+class TransformerBlock(FeedForwardLayer):
+    """Pre-LN transformer block: x + MHA(LN(x)), then x + FFN(LN(x)).
+
+    Self-contained (attention + FFN + both norms in one layer) so a GPT is
+    a plain MultiLayerNetwork stack; the attention math dispatches through
+    `ops/attention.py` (pallas flash kernel for long unmasked sequences)."""
+
+    TYPE = "transformer_block"
+    input_kind = "rnn"
+    n_in: int = 0          # d_model
+    n_out: int = 0
+    n_heads: int = 4
+    ffn_mult: int = 4
+    causal: bool = True
+    block_size: Optional[int] = 1024
+    eps: float = 1e-5
+
+    def __post_init__(self):
+        d = self.n_out or self.n_in
+        if d and d % self.n_heads:
+            raise ValueError(f"d_model {d} not divisible by n_heads "
+                             f"{self.n_heads}")
+        if self.n_in and self.n_out and self.n_in != self.n_out:
+            raise ValueError("TransformerBlock keeps width: n_in == n_out")
+
+    @property
+    def _d(self) -> int:
+        return self.n_out or self.n_in
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, it, dtype=jnp.float32) -> Params:
+        d = self._d
+        h = d * self.ffn_mult
+        ks = jax.random.split(key, 4)
+        mk = lambda k, shape, fi, fo: self._winit(k, shape, fi, fo, dtype)
+        return {
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "Wqkv": mk(ks[0], (d, 3 * d), d, 3 * d),
+            "bqkv": jnp.zeros((3 * d,), dtype),
+            "Wo": mk(ks[1], (d, d), d, d), "bo": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+            "W1": mk(ks[2], (d, h), d, h), "b1": jnp.zeros((h,), dtype),
+            "W2": mk(ks[3], (h, d), h, d), "b2": jnp.zeros((d,), dtype),
+        }
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.attention import multi_head_attention
+
+        B, T, d = x.shape
+        H = self.n_heads
+        h1 = layer_norm(x, params["ln1_g"], params["ln1_b"], self.eps)
+        qkv = h1 @ params["Wqkv"] + params["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, H, d // H)
+        att = multi_head_attention(q.reshape(shape), k.reshape(shape),
+                                   v.reshape(shape), causal=self.causal,
+                                   key_mask=mask,
+                                   block_size=self.block_size)
+        att = att.reshape(B, T, d) @ params["Wo"] + params["bo"]
+        att = self._maybe_dropout(att, train, rng)
+        x = x + att
+        h2 = layer_norm(x, params["ln2_g"], params["ln2_b"], self.eps)
+        ffn = jax.nn.gelu(h2 @ params["W1"] + params["b1"]) @ params["W2"] \
+            + params["b2"]
+        ffn = self._maybe_dropout(
+            ffn, train, None if rng is None else jax.random.fold_in(rng, 1))
+        return x + ffn, state
+
+    def param_flags(self, name):
+        is_bias = name.startswith("b") or name.endswith("_b")
+        norm_scale = name.endswith("_g")
+        return {"is_bias": is_bias,
+                "regularizable": not is_bias and not norm_scale}

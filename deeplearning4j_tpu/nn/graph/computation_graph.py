@@ -160,7 +160,14 @@ class ComputationGraph:
             from deeplearning4j_tpu.nn.precision import tree_cast
 
             params = tree_cast(params, self.compute_dtype)
-            inputs = tuple(x.astype(self.compute_dtype) for x in inputs)
+            # skip the cast for inputs consumed by integer-id layers
+            int_inputs = set()
+            for node in conf.nodes.values():
+                if node.is_layer and getattr(node.layer, "integer_input", False):
+                    int_inputs.update(node.inputs)
+            inputs = tuple(
+                x if name in int_inputs else x.astype(self.compute_dtype)
+                for name, x in zip(conf.network_inputs, inputs))
         acts, new_state = self._forward_pure(params, lstate, inputs,
                                              train=train, rng=rng, fmasks=fmasks)
         if self.compute_dtype is not None:

@@ -176,7 +176,9 @@ class MultiLayerNetwork:
             from deeplearning4j_tpu.nn.precision import tree_cast
 
             params = tree_cast(params, self.compute_dtype)
-            features = features.astype(self.compute_dtype)
+            if not getattr(self.layers[0], "integer_input", False):
+                # token-id inputs must NOT be cast (bf16 corrupts ids > 256)
+                features = features.astype(self.compute_dtype)
         x, new_state = self._forward_pure(params, lstate, features, train=train,
                                           rng=rng, fmask=fmask,
                                           upto=len(self.layers) - 1)
