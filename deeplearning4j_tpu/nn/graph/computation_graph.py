@@ -160,13 +160,23 @@ class ComputationGraph:
             from deeplearning4j_tpu.nn.precision import tree_cast
 
             params = tree_cast(params, self.compute_dtype)
-            # skip the cast for inputs consumed by integer-id layers
-            int_inputs = set()
+            # skip the cast for any input whose value REACHES an integer-id
+            # layer (possibly through vertices): trace backwards to fixpoint
+            int_sinks = set()
             for node in conf.nodes.values():
                 if node.is_layer and getattr(node.layer, "integer_input", False):
-                    int_inputs.update(node.inputs)
+                    int_sinks.update(node.inputs)
+            changed = True
+            while changed:
+                changed = False
+                for name, node in conf.nodes.items():
+                    if name in int_sinks and not node.is_layer:
+                        new = set(node.inputs) - int_sinks
+                        if new:
+                            int_sinks.update(new)
+                            changed = True
             inputs = tuple(
-                x if name in int_inputs else x.astype(self.compute_dtype)
+                x if name in int_sinks else x.astype(self.compute_dtype)
                 for name, x in zip(conf.network_inputs, inputs))
         acts, new_state = self._forward_pure(params, lstate, inputs,
                                              train=train, rng=rng, fmasks=fmasks)

@@ -177,7 +177,10 @@ class MultiLayerNetwork:
 
             params = tree_cast(params, self.compute_dtype)
             if not getattr(self.layers[0], "integer_input", False):
-                # token-id inputs must NOT be cast (bf16 corrupts ids > 256)
+                # token-id inputs must NOT be cast (bf16 corrupts ids > 256);
+                # in a sequential net raw features only ever feed layer 0,
+                # so checking it covers every id-consuming topology here
+                # (the graph variant traces reachability through vertices)
                 features = features.astype(self.compute_dtype)
         x, new_state = self._forward_pure(params, lstate, features, train=train,
                                           rng=rng, fmask=fmask,

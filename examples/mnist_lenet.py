@@ -1,0 +1,27 @@
+"""LeNet on MNIST — the canonical first example (BASELINE config 1).
+
+Run: python examples/mnist_lenet.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet_configuration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main():
+    net = MultiLayerNetwork(lenet_configuration(learning_rate=0.02))
+    net.init()
+    net.set_listeners(ScoreIterationListener(10))
+    net.fit(MnistDataSetIterator(batch_size=128, num_examples=12800), epochs=3)
+    ev = net.evaluate(MnistDataSetIterator(256, num_examples=2560, train=False))
+    print(f"accuracy: {ev.accuracy():.3f}  f1: {ev.f1():.3f}")
+    print(ev.confusion_matrix)
+
+
+if __name__ == "__main__":
+    main()

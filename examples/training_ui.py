@@ -1,0 +1,46 @@
+"""Training with the web UI attached (reference `UIServer.getInstance()
+.attach(...)` flow): browse http://localhost:9000 while it runs.
+
+Run: python examples/training_ui.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats_listener import StatsListener
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+def main():
+    storage = InMemoryStatsStorage()
+    server = UIServer.get_instance()   # port 9000
+    server.attach(storage)
+
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+            .list().layer(DenseLayer(n_in=20, n_out=64))
+            .layer(OutputLayer(n_in=64, n_out=5,
+                               activation=Activation.SOFTMAX)).build())
+    net = dl4j.MultiLayerNetwork(conf)
+    net.init()
+    net.set_listeners(StatsListener(storage, report_frequency=5))
+
+    rng = np.random.default_rng(0)
+    c = rng.integers(0, 5, 2000)
+    x = (rng.normal(size=(2000, 20)) * 0.6 + c[:, None] * 0.2).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[c]
+    for epoch in range(50):
+        for lo in range(0, 2000, 100):
+            net.fit(DataSet(x[lo:lo + 100], y[lo:lo + 100]))
+    print(f"done; dashboard at http://localhost:{server.port} — Ctrl-C to exit")
+
+
+if __name__ == "__main__":
+    main()

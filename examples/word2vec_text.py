@@ -1,0 +1,34 @@
+"""Word2Vec skip-gram with negative sampling (BASELINE config 4).
+
+Run: python examples/word2vec_text.py [corpus.txt]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import sys
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+DEFAULT = ["the king rules the castle", "the queen rules the castle",
+           "a dog chases the cat", "a cat chases the mouse",
+           "the king and the queen dance"] * 50
+
+
+def main():
+    if len(sys.argv) > 1:
+        with open(sys.argv[1]) as f:
+            corpus = [ln.strip() for ln in f if ln.strip()]
+    else:
+        corpus = DEFAULT
+    w2v = Word2Vec(layer_size=64, window=3, negative=5, epochs=5,
+                   min_word_frequency=2, seed=42)
+    w2v.fit(corpus)
+    for w in ("king", "dog"):
+        if w in w2v.vocab.words():
+            print(w, "->", w2v.words_nearest(w, 4))
+
+
+if __name__ == "__main__":
+    main()

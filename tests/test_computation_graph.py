@@ -203,3 +203,30 @@ def test_graph_mixed_precision_bf16():
     assert net._layer_state["bn"]["mean"].dtype == jnp.float32
     acc = (np.argmax(net.output(x)[0], 1) == c).mean()
     assert acc > 0.8
+
+
+def test_graph_bf16_exempts_ids_through_vertices():
+    """Integer ids reaching an EmbeddingLayer THROUGH a vertex must also be
+    exempt from the bf16 cast (reachability, not direct-feed, decides)."""
+    from deeplearning4j_tpu.nn.conf.layers import EmbeddingLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("ids")
+            .add_vertex("sub", SubsetVertex(0, 0), "ids")
+            .add_layer("emb", EmbeddingLayer(n_in=1000, n_out=8), "sub")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation=Activation.SOFTMAX), "emb")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(1))
+            .build())
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16)
+    net.init()
+    ids = np.array([[513.0], [515.0], [777.0], [999.0]], np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    w_before = np.asarray(net._params["emb"]["W"]).copy()
+    net.fit(DataSet(ids, y))
+    w_after = np.asarray(net._params["emb"]["W"])
+    for tok in (513, 515, 777, 999):
+        assert not np.allclose(w_after[tok], w_before[tok]), \
+            f"bf16 cast corrupted id {tok} en route to the embedding"
