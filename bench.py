@@ -37,7 +37,9 @@ def bench_lenet():
     from deeplearning4j_tpu.models.lenet import lenet_configuration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    batch_size, warmup, bench = 512, 5, 30
+    # batch 1024 measured ~25% faster than 512 on v5e; 2048 regresses (the
+    # batch transfer over the host link dominates)
+    batch_size, warmup, bench = 1024, 5, 30
     import jax.numpy as jnp
 
     # mixed precision is the TPU-native training mode (MXU feeds bf16);
@@ -54,7 +56,9 @@ def bench_resnet50():
     from deeplearning4j_tpu.models.resnet import resnet_configuration
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    batch_size, warmup, bench = 256, 3, 10
+    # batch 512 measured ~40% faster than 256 on v5e (1024 regresses:
+    # HBM pressure); bf16 mixed precision throughout
+    batch_size, warmup, bench = 512, 3, 10
     import jax.numpy as jnp
 
     net = ComputationGraph(resnet_configuration(depth=50, n_classes=10),

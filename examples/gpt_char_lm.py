@@ -43,11 +43,15 @@ def main():
     net.fit(ListDataSetIterator(batches), epochs=3)
     print(f"final loss: {net.score_value:.3f}")
 
-    # greedy sampling
+    # greedy sampling on a FIXED-length window (right-padded; causality
+    # means the read position never sees the padding) — a varying window
+    # length would recompile the jitted forward every step
     ctx = [stoi[c] for c in "the quick"]
     for _ in range(60):
-        x = np.asarray(ctx[-T:], np.float32)[None, :]
-        probs = net.output(x)[0, -1]
+        window = ctx[-T:]
+        x = np.zeros((1, T), np.float32)
+        x[0, :len(window)] = window
+        probs = net.output(x)[0, len(window) - 1]
         ctx.append(int(np.argmax(probs)))
     print("sample:", "".join(chars[i] for i in ctx))
 

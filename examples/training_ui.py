@@ -40,6 +40,12 @@ def main():
         for lo in range(0, 2000, 100):
             net.fit(DataSet(x[lo:lo + 100], y[lo:lo + 100]))
     print(f"done; dashboard at http://localhost:{server.port} — Ctrl-C to exit")
+    import threading
+
+    try:
+        threading.Event().wait()  # keep the (daemon) UI server alive
+    except KeyboardInterrupt:
+        pass
 
 
 if __name__ == "__main__":
