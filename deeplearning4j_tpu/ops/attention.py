@@ -14,6 +14,7 @@ are einsums over (T, D)×(D, T') per head: large, batched, MXU-friendly.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from functools import partial
 from typing import Optional, Tuple
 
@@ -149,11 +150,37 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return attention_finalize(o, l)
 
 
+_SEQ_PARALLEL: list = []  # (mesh, seq_axis, batch_axis) stack
+
+
+@contextmanager
+def sequence_parallel_scope(mesh, axis_name: str = "seq",
+                            batch_axis: Optional[str] = None):
+    """Within this scope, `multi_head_attention` (and therefore every
+    attention layer traced under it) computes via ring attention with the
+    time axis sharded over `axis_name` — how a SelfAttention/Transformer
+    model trains with sequences longer than one chip holds. Trace-time
+    static: enter the scope around the jit/trace of the step."""
+    _SEQ_PARALLEL.append((mesh, axis_name, batch_axis))
+    try:
+        yield
+    finally:
+        _SEQ_PARALLEL.pop()
+
+
 def multi_head_attention(q, k, v, *, causal=False, key_mask=None,
                          block_size: Optional[int] = None):
     """Dispatch (the cuDNN-helper pattern: same contract, fastest available
-    path picked): pallas flash kernel for long unmasked sequences, XLA
-    blockwise beyond `block_size`, full attention otherwise."""
+    path picked): ring attention when a sequence-parallel scope is active,
+    pallas flash kernel for long unmasked sequences, XLA blockwise beyond
+    `block_size`, full attention otherwise."""
+    if _SEQ_PARALLEL:
+        from deeplearning4j_tpu.parallel.sequence import ring_attention
+
+        mesh, axis_name, batch_axis = _SEQ_PARALLEL[-1]
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              causal=causal, key_mask=key_mask,
+                              batch_axis=batch_axis)
     long_seq = block_size is not None and k.shape[1] > block_size
     if long_seq and key_mask is None:
         from deeplearning4j_tpu.ops.pallas_attention import flash_attention_or_none

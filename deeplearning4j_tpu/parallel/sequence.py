@@ -34,9 +34,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
 from deeplearning4j_tpu.ops.attention import (
     NEG_INF,
@@ -158,3 +161,68 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return shard_map(fn, mesh=mesh,
                      in_specs=(spec, spec, spec, mask_spec),
                      out_specs=spec, check_vma=False)(q, k, v, key_mask)
+
+
+class SequenceParallelWrapper(ParallelWrapper):
+    """Train a SelfAttention/Transformer network with the TIME axis sharded
+    over the mesh — the context-parallel training loop (sequences longer
+    than one chip's HBM).
+
+    Subclasses ParallelWrapper so the whole training loop (batch trimming,
+    tBPTT guard, listener/epoch bookkeeping) is shared; the overrides are
+    the batch shardings — features (B, T) on (data, seq), labels (B, T, V)
+    on (data, seq, None) — and the step wrapper that opens
+    `sequence_parallel_scope`, so every attention layer traced inside the
+    jitted step computes via ring attention (KV blocks rotating over ICI).
+
+    Loss curves match single-chip training up to f32 summation-order noise
+    (same-seed parity test, `tests/test_transformer.py`). Masked sequences
+    are not supported yet. Parameters are replicated (combine with tp via
+    param_specs if needed)."""
+
+    def __init__(self, net, mesh: Mesh, seq_axis: str = "seq",
+                 data_axis: str = "data", param_specs=None):
+        if seq_axis not in mesh.shape:
+            raise ValueError(f"mesh has no '{seq_axis}' axis: "
+                             f"{dict(mesh.shape)}")
+        if data_axis not in mesh.shape and mesh.shape.get(seq_axis) != \
+                int(np.prod(list(mesh.shape.values()))):
+            raise ValueError(
+                f"data_axis {data_axis!r} not in mesh {dict(mesh.shape)}; "
+                "for a pure-sequence mesh make the seq axis span all devices")
+        self.seq_axis = seq_axis
+        super().__init__(net, mesh=mesh, data_axis=data_axis,
+                         param_specs=param_specs)
+
+    def _wrap_step(self, step):
+        from deeplearning4j_tpu.ops.attention import sequence_parallel_scope
+
+        d = self.data_axis if self.data_axis in self.mesh.shape else None
+
+        def scoped_step(params, upd, lstate, it, f, l, fm, lm):
+            # the scope must be open at TRACE time (first call), which is
+            # why it wraps the step body rather than the jit() call
+            with sequence_parallel_scope(self.mesh, self.seq_axis, d):
+                return step(params, upd, lstate, it, f, l, fm, lm)
+
+        return scoped_step
+
+    def _batch_shardings(self):
+        from jax.sharding import NamedSharding
+
+        d = self.data_axis if self.data_axis in self.mesh.shape else None
+        feat = NamedSharding(self.mesh, P(d, self.seq_axis))
+        lab = NamedSharding(self.mesh, P(d, self.seq_axis, None))
+        return (feat, lab, self._repl, self._repl)
+
+    def _shard_batch(self, ds):
+        if ds.features_mask is not None or ds.labels_mask is not None:
+            raise NotImplementedError(
+                "masked sequences under sequence parallelism are not "
+                "supported yet")
+        n_seq = self.mesh.shape[self.seq_axis]
+        if ds.features.shape[1] % n_seq:
+            raise ValueError(
+                f"sequence length {ds.features.shape[1]} not divisible by "
+                f"the '{self.seq_axis}' mesh axis size {n_seq}")
+        return super()._shard_batch(ds)

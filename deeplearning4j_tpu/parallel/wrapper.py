@@ -91,16 +91,23 @@ class ParallelWrapper:
         net._upd_state = jax.device_put(net._upd_state, self._upd_sh)
         net._layer_state = jax.device_put(net._layer_state, self._lstate_sh)
 
-        step = net.train_step_fn()
+        step = self._wrap_step(net.train_step_fn())
         self._jit_step = jax.jit(
             step,
             in_shardings=(self._param_sh, self._upd_sh, self._lstate_sh,
-                          self._repl, self._batch_sh, self._batch_sh,
-                          self._batch_sh, self._batch_sh),
+                          self._repl) + self._batch_shardings(),
             out_shardings=(self._param_sh, self._upd_sh, self._lstate_sh,
                            self._repl, self._repl),
             donate_argnums=(0, 1, 2, 3),
         )
+
+    # subclass hooks (SequenceParallelWrapper overrides both) --------------
+    def _wrap_step(self, step):
+        return step
+
+    def _batch_shardings(self):
+        """(features, labels, fmask, lmask) shardings."""
+        return (self._batch_sh,) * 4
 
     @property
     def num_devices(self) -> int:
@@ -109,7 +116,7 @@ class ParallelWrapper:
     def _shard_batch(self, ds):
         """Trim the batch to a multiple of the data-axis size (DataSet or
         MultiDataSet)."""
-        n_data = self.mesh.shape[self.data_axis]
+        n_data = self.mesh.shape.get(self.data_axis, 1)
         B = ds.num_examples()
         usable = (B // n_data) * n_data
         if usable == 0:

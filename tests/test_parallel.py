@@ -93,7 +93,8 @@ def test_graft_entry_dryrun():
 
     fn, (params, x) = ge.entry()
     out = jax.jit(fn)(params, x)
-    assert out.shape == (8, 10)
+    # flagship is now the GPT causal LM: (B, T, vocab) logits
+    assert out.shape == (4, 64, 256)
 
     ge.dryrun_multichip(8)
 
