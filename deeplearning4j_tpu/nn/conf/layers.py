@@ -1177,3 +1177,79 @@ class TransformerBlock(FeedForwardLayer):
         norm_scale = name.endswith("_g")
         return {"is_bias": is_bias,
                 "regularizable": not is_bias and not norm_scale}
+
+
+@register_layer
+@dataclass
+class MoELayer(FeedForwardLayer):
+    """Switch-style top-1 mixture-of-experts FFN: (B, T, D) or (B, D) →
+    same shape; router picks one expert per token, overflow passes through.
+
+    No counterpart in the reference. Math is
+    `parallel/experts.moe_apply_reference` (global-capacity semantics); the
+    load-balancing loss is contributed via `ops/aux_loss.add_aux_loss`, so
+    it only takes effect during training (`_loss_pure` collects it). For
+    expert-PARALLEL execution over a mesh use `parallel/experts.moe_apply`
+    directly in a custom step."""
+
+    TYPE = "moe"
+    input_kind = "rnn"
+    n_in: int = 0
+    n_out: int = 0
+    n_experts: int = 4
+    hidden_mult: int = 4
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def __post_init__(self):
+        if self.n_in and self.n_out and self.n_in != self.n_out:
+            raise ValueError("MoELayer keeps width: n_in == n_out")
+
+    @property
+    def _d(self) -> int:
+        return self.n_out or self.n_in
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, it, dtype=jnp.float32) -> Params:
+        d = self._d
+        h = d * self.hidden_mult
+        E = self.n_experts
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "router": self._winit(k1, (d, E), d, E, dtype),
+            "W1": self._winit(k2, (E, d, h), d, h, dtype),
+            "b1": jnp.zeros((E, h), dtype),
+            "W2": self._winit(k3, (E, h, d), h, d, dtype),
+            "b2": jnp.zeros((E, d), dtype),
+        }
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.aux_loss import add_aux_loss
+        from deeplearning4j_tpu.parallel.experts import moe_apply_reference
+
+        x = self._maybe_dropout(x, train, rng)
+        shape = x.shape
+        tokens = x.reshape(-1, shape[-1])
+        # padding tokens must not route, consume capacity, or weight the
+        # load-balancing loss
+        token_mask = (mask.reshape(-1) if mask is not None
+                      and len(shape) == 3 else None)
+
+        def expert_fn(p, t):
+            return jax.nn.relu(t @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+
+        stacked = {"W1": params["W1"], "b1": params["b1"],
+                   "W2": params["W2"], "b2": params["b2"]}
+        y, aux = moe_apply_reference(expert_fn, stacked, tokens,
+                                     params["router"],
+                                     capacity_factor=self.capacity_factor,
+                                     token_mask=token_mask)
+        if train:
+            add_aux_loss(self.aux_loss_weight * aux)
+        return y.reshape(shape), state
+
+    def param_flags(self, name):
+        is_bias = name.startswith("b")
+        return {"is_bias": is_bias, "regularizable": not is_bias}

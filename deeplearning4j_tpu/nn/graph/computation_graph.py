@@ -178,8 +178,12 @@ class ComputationGraph:
             inputs = tuple(
                 x if name in int_sinks else x.astype(self.compute_dtype)
                 for name, x in zip(conf.network_inputs, inputs))
-        acts, new_state = self._forward_pure(params, lstate, inputs,
-                                             train=train, rng=rng, fmasks=fmasks)
+        from deeplearning4j_tpu.ops.aux_loss import aux_loss_scope
+
+        with aux_loss_scope() as aux_terms:
+            acts, new_state = self._forward_pure(params, lstate, inputs,
+                                                 train=train, rng=rng,
+                                                 fmasks=fmasks)
         if self.compute_dtype is not None:
             from deeplearning4j_tpu.nn.precision import restore_dtypes
 
@@ -203,6 +207,8 @@ class ComputationGraph:
                                                   train=train, rng=lrng,
                                                   mask=lmask)
         total = total + self._reg_score(params_in)
+        for term in aux_terms:  # mid-network losses (MoE load balancing)
+            total = total + term
         return total, new_state
 
     def _reg_score(self, params: Params):

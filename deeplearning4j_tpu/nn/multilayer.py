@@ -182,9 +182,13 @@ class MultiLayerNetwork:
                 # so checking it covers every id-consuming topology here
                 # (the graph variant traces reachability through vertices)
                 features = features.astype(self.compute_dtype)
-        x, new_state = self._forward_pure(params, lstate, features, train=train,
-                                          rng=rng, fmask=fmask,
-                                          upto=len(self.layers) - 1)
+        from deeplearning4j_tpu.ops.aux_loss import aux_loss_scope
+
+        with aux_loss_scope() as aux_terms:
+            x, new_state = self._forward_pure(params, lstate, features,
+                                              train=train, rng=rng,
+                                              fmask=fmask,
+                                              upto=len(self.layers) - 1)
         if self.compute_dtype is not None:
             from deeplearning4j_tpu.nn.precision import restore_dtypes
 
@@ -198,6 +202,8 @@ class MultiLayerNetwork:
         loss = out_layer.loss_score(params_in[-1], x, labels, train=train,
                                     rng=out_rng, mask=mask)
         loss = loss + self._reg_score(params_in)
+        for term in aux_terms:  # mid-network losses (MoE load balancing)
+            loss = loss + term
         return loss, new_state
 
     def _reg_score(self, params: Params):

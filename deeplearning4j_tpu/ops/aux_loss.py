@@ -1,0 +1,46 @@
+"""Auxiliary-loss plumbing for mid-network losses.
+
+Layers sometimes contribute loss terms that are not a function of the
+network output — the Switch MoE load-balancing loss is the canonical case.
+The reference has no such mechanism (its losses live only in output
+layers); here a trace-time collector lets any layer `add_aux_loss(term)`
+during the forward pass, and the network's `_loss_pure` drains the
+collected terms into the total. Purely trace-time state (like
+`sequence_parallel_scope`), so it is jit-safe: the terms become part of
+the traced computation.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+# thread-LOCAL: concurrent jit traces (e.g. parameter-server worker threads
+# each tracing their replica's step) must not cross-contaminate scopes
+_tls = threading.local()
+
+
+def _stack() -> list:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextmanager
+def aux_loss_scope():
+    """Collects aux-loss terms added during the enclosed trace; yields the
+    list (sum it after the forward)."""
+    terms: list = []
+    stack = _stack()
+    stack.append(terms)
+    try:
+        yield terms
+    finally:
+        stack.pop()
+
+
+def add_aux_loss(term) -> None:
+    """Called by layers during forward; no-op when no scope is active
+    (e.g. plain inference through `output()`)."""
+    stack = _stack()
+    if stack:
+        stack[-1].append(term)

@@ -31,22 +31,32 @@ def router_probs(x: jnp.ndarray, router_w: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.softmax(x @ router_w, axis=-1)
 
 
-def _dispatch_indices(expert_idx: jnp.ndarray, E: int,
-                      capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _dispatch_indices(expert_idx: jnp.ndarray, E: int, capacity: int,
+                      valid=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Position of each token within its expert's capacity buffer, and a
-    keep-mask for tokens under capacity."""
+    keep-mask for tokens under capacity. `valid` (N,) bool excludes tokens
+    (padding) from dispatch AND from capacity accounting."""
     onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, E)
+    if valid is not None:
+        onehot = onehot * valid[:, None].astype(jnp.int32)
     pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot      # 1-based
     pos = jnp.max(pos_in_expert, axis=-1) - 1                # (N,)
     keep = pos < capacity
+    if valid is not None:
+        keep = keep & (pos >= 0)  # invalid tokens have pos == -1
     return pos, keep
 
 
 def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
-                        router_w: jnp.ndarray, *, capacity_factor: float = 1.25
-                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                        router_w: jnp.ndarray, *,
+                        capacity_factor: float = 1.25,
+                        token_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Single-device reference semantics (also the parity baseline for the
     sharded path): top-1 routing with capacity, overflow passes through.
+
+    `token_mask` (N,) with 1=real: padding tokens bypass the experts
+    entirely — no routing, no capacity consumption, no weight in the
+    load-balancing loss.
 
     Returns (y, aux_loss) — aux_loss is the Switch load-balancing loss
     (mean fraction routed × mean router prob, scaled by E)."""
@@ -56,7 +66,8 @@ def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     probs = router_probs(x, router_w)
     expert_idx = jnp.argmax(probs, axis=-1)
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
-    pos, keep = _dispatch_indices(expert_idx, E, capacity)  # global cap
+    valid = None if token_mask is None else token_mask > 0
+    pos, keep = _dispatch_indices(expert_idx, E, capacity, valid)  # global cap
 
     # scatter tokens into (E, capacity, D) buffers
     buf = jnp.zeros((E, capacity, D), x.dtype)
@@ -69,9 +80,16 @@ def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     y_expert = out_buf[expert_idx, safe_pos]
     y = jnp.where(keep[:, None], gate[:, None] * y_expert, x)
 
-    # load-balancing loss (Switch eq. 4)
-    frac_routed = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
+    # load-balancing loss (Switch eq. 4) over REAL tokens only
+    oh = jax.nn.one_hot(expert_idx, E)
+    if valid is not None:
+        w = valid.astype(x.dtype)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        frac_routed = jnp.sum(oh * w[:, None], axis=0) / denom
+        mean_prob = jnp.sum(probs * w[:, None], axis=0) / denom
+    else:
+        frac_routed = jnp.mean(oh, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac_routed * mean_prob)
     return y, aux
 
