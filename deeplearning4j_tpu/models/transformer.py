@@ -39,6 +39,7 @@ def gpt_configuration(vocab_size: int,
                       learning_rate: float = 3e-4,
                       updater: Updater = Updater.ADAM,
                       attention_block_size: int = 1024,
+                      moe_experts: int = 0,
                       ) -> MultiLayerConfiguration:
     """Causal LM over int token ids (B, T) with next-token targets
     (B, T, vocab) one-hot (per-timestep MCXENT, masked)."""
@@ -54,7 +55,8 @@ def gpt_configuration(vocab_size: int,
         b = b.layer(TransformerBlock(n_in=d_model, n_out=d_model,
                                      n_heads=n_heads, ffn_mult=ffn_mult,
                                      causal=True,
-                                     block_size=attention_block_size))
+                                     block_size=attention_block_size,
+                                     moe_experts=moe_experts))
     return (b
             .layer(LayerNormalization(n_in=d_model, n_out=d_model,
                                       dropout=0.0))

@@ -1118,6 +1118,11 @@ class TransformerBlock(FeedForwardLayer):
     causal: bool = True
     block_size: Optional[int] = 1024
     eps: float = 1e-5
+    # > 0: replace the dense FFN with a Switch MoE of this many experts
+    # (load-balancing aux loss via ops/aux_loss)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         d = self.n_out or self.n_in
@@ -1137,17 +1142,30 @@ class TransformerBlock(FeedForwardLayer):
     def init_params(self, key, it, dtype=jnp.float32) -> Params:
         d = self._d
         h = d * self.ffn_mult
-        ks = jax.random.split(key, 4)
+        ks = jax.random.split(key, 5)
         mk = lambda k, shape, fi, fo: self._winit(k, shape, fi, fo, dtype)
-        return {
+        params = {
             "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
             "Wqkv": mk(ks[0], (d, 3 * d), d, 3 * d),
             "bqkv": jnp.zeros((3 * d,), dtype),
             "Wo": mk(ks[1], (d, d), d, d), "bo": jnp.zeros((d,), dtype),
             "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
-            "W1": mk(ks[2], (d, h), d, h), "b1": jnp.zeros((h,), dtype),
-            "W2": mk(ks[3], (h, d), h, d), "b2": jnp.zeros((d,), dtype),
         }
+        E = self.moe_experts
+        if E > 0:  # sparse-expert FFN (Switch)
+            params.update({
+                "router": mk(ks[4], (d, E), d, E),
+                "W1": mk(ks[2], (E, d, h), d, h),
+                "b1": jnp.zeros((E, h), dtype),
+                "W2": mk(ks[3], (E, h, d), h, d),
+                "b2": jnp.zeros((E, d), dtype),
+            })
+        else:
+            params.update({
+                "W1": mk(ks[2], (d, h), d, h), "b1": jnp.zeros((h,), dtype),
+                "W2": mk(ks[3], (h, d), h, d), "b2": jnp.zeros((d,), dtype),
+            })
+        return params
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         from deeplearning4j_tpu.ops.attention import multi_head_attention
@@ -1166,8 +1184,19 @@ class TransformerBlock(FeedForwardLayer):
         att = self._maybe_dropout(att, train, rng)
         x = x + att
         h2 = layer_norm(x, params["ln2_g"], params["ln2_b"], self.eps)
-        ffn = jax.nn.gelu(h2 @ params["W1"] + params["b1"]) @ params["W2"] \
-            + params["b2"]
+        if self.moe_experts > 0:
+            from deeplearning4j_tpu.parallel.experts import switch_ffn
+
+            tokens = h2.reshape(-1, d)
+            token_mask = mask.reshape(-1) if mask is not None else None
+            ffn = switch_ffn(params, tokens, act=jax.nn.gelu,  # block's FFN
+                             capacity_factor=self.moe_capacity_factor,
+                             aux_weight=self.moe_aux_weight,
+                             token_mask=token_mask,
+                             train=train).reshape(B, T, d)
+        else:
+            ffn = jax.nn.gelu(h2 @ params["W1"] + params["b1"]) @ params["W2"] \
+                + params["b2"]
         ffn = self._maybe_dropout(
             ffn, train, None if rng is None else jax.random.fold_in(rng, 1))
         return x + ffn, state
@@ -1226,8 +1255,7 @@ class MoELayer(FeedForwardLayer):
         }
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.ops.aux_loss import add_aux_loss
-        from deeplearning4j_tpu.parallel.experts import moe_apply_reference
+        from deeplearning4j_tpu.parallel.experts import switch_ffn
 
         x = self._maybe_dropout(x, train, rng)
         shape = x.shape
@@ -1236,18 +1264,13 @@ class MoELayer(FeedForwardLayer):
         # load-balancing loss
         token_mask = (mask.reshape(-1) if mask is not None
                       and len(shape) == 3 else None)
-
-        def expert_fn(p, t):
-            return jax.nn.relu(t @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
-
-        stacked = {"W1": params["W1"], "b1": params["b1"],
-                   "W2": params["W2"], "b2": params["b2"]}
-        y, aux = moe_apply_reference(expert_fn, stacked, tokens,
-                                     params["router"],
-                                     capacity_factor=self.capacity_factor,
-                                     token_mask=token_mask)
-        if train:
-            add_aux_loss(self.aux_loss_weight * aux)
+        # expert hidden activation honors the layer's activation config
+        # (builder default applies like every other layer); RELU if unset
+        act = activation_fn(self.activation or Activation.RELU)
+        y = switch_ffn(params, tokens, act=act,
+                       capacity_factor=self.capacity_factor,
+                       aux_weight=self.aux_loss_weight,
+                       token_mask=token_mask, train=train)
         return y.reshape(shape), state
 
     def param_flags(self, name):

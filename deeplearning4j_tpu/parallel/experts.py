@@ -159,3 +159,26 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
                        out_specs=(tok, P()), check_vma=False)(
         stacked_params, x, router_w)
     return y, aux
+
+
+def switch_ffn(params, tokens: jnp.ndarray, *, act: Callable,
+               capacity_factor: float, aux_weight: float,
+               token_mask=None, train: bool = False) -> jnp.ndarray:
+    """Shared Switch-MoE FFN dispatch used by MoELayer and
+    TransformerBlock's MoE branch (one implementation, one behavior):
+    params needs router/W1/b1/W2/b2 (experts stacked on axis 0); the
+    load-balancing aux loss is contributed via ops/aux_loss when training."""
+    from deeplearning4j_tpu.ops.aux_loss import add_aux_loss
+
+    def expert_fn(p, t):
+        return act(t @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+
+    stacked = {"W1": params["W1"], "b1": params["b1"],
+               "W2": params["W2"], "b2": params["b2"]}
+    y, aux = moe_apply_reference(expert_fn, stacked, tokens,
+                                 params["router"],
+                                 capacity_factor=capacity_factor,
+                                 token_mask=token_mask)
+    if train:
+        add_aux_loss(aux_weight * aux)
+    return y

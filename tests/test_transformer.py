@@ -186,3 +186,25 @@ def test_sequence_parallel_wrapper_guards():
     x2, y2 = _lm_data(7, 4, 16)
     with pytest.raises(NotImplementedError, match="masked"):
         spw.fit(DataSet(x2, y2, np.ones((4, 16), np.float32)))
+
+
+def test_moe_gpt_learns_copy_task():
+    """Sparse-expert GPT (TransformerBlock with a Switch MoE FFN) trains on
+    the copy task; router params move (aux + task gradients flow)."""
+    conf = gpt_configuration(vocab_size=11, d_model=32, n_heads=2,
+                             n_layers=2, max_length=16, learning_rate=3e-3,
+                             moe_experts=4)
+    net = MultiLayerNetwork(conf)
+    net.init()
+    router_before = np.asarray(net._params[1]["router"]).copy()
+    x, y = _lm_data(11, 32, 12)
+    first = None
+    for _ in range(60):
+        net.fit(DataSet(x, y))
+        if first is None:
+            first = net.score_value
+    assert net.score_value < 0.5 < first
+    assert not np.allclose(np.asarray(net._params[1]["router"]), router_before)
+    xt, yt = _lm_data(11, 16, 12, seed=9)
+    acc = (np.argmax(net.output(xt), -1) == np.argmax(yt, -1)).mean()
+    assert acc > 0.9
