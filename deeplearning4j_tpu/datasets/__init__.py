@@ -5,6 +5,7 @@ dataset iterators)."""
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
     DataSetIterator,
     ExistingDataSetIterator,
     ListDataSetIterator,

@@ -231,6 +231,14 @@ class AsyncDataSetIterator(DataSetIterator):
         return self._under.batch()
 
 
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background-thread prefetch over a MultiDataSet iterator (reference
+    `AsyncMultiDataSetIterator.java` — same producer/bounded-queue scheme as
+    `AsyncDataSetIterator.java:36`, element type MultiDataSet). The producer
+    contract here is source-agnostic (`has_next`/`next`), so the multi-input
+    variant only differs in what flows through the queue."""
+
+
 class IteratorDataSetIterator(DataSetIterator):
     """Re-batches an iterator of (possibly variously sized) DataSets to a
     fixed batch size (reference `IteratorDataSetIterator.java`)."""

@@ -60,6 +60,7 @@ _LAYER_REGISTRY: Dict[str, type] = {}
 _FIELD_DECODERS: Dict[str, Callable[[Any], Any]] = {
     "activation": Activation,
     "gate_activation": Activation,
+    "expert_activation": Activation,
     "weight_init": WeightInit,
     "dist": Distribution.from_json,
     "loss": LossFunction,
@@ -1142,7 +1143,10 @@ class TransformerBlock(FeedForwardLayer):
     def init_params(self, key, it, dtype=jnp.float32) -> Params:
         d = self._d
         h = d * self.ffn_mult
-        ks = jax.random.split(key, 5)
+        # fixed split count: the router key is derived by fold_in so that
+        # dense (moe_experts=0) blocks keep bit-identical seeded init
+        # whether or not the MoE branch exists in this version
+        ks = jax.random.split(key, 4)
         mk = lambda k, shape, fi, fo: self._winit(k, shape, fi, fo, dtype)
         params = {
             "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
@@ -1154,7 +1158,7 @@ class TransformerBlock(FeedForwardLayer):
         E = self.moe_experts
         if E > 0:  # sparse-expert FFN (Switch)
             params.update({
-                "router": mk(ks[4], (d, E), d, E),
+                "router": mk(jax.random.fold_in(key, 4), (d, E), d, E),
                 "W1": mk(ks[2], (E, d, h), d, h),
                 "b1": jnp.zeros((E, h), dtype),
                 "W2": mk(ks[3], (E, h, d), h, d),
@@ -1189,11 +1193,15 @@ class TransformerBlock(FeedForwardLayer):
 
             tokens = h2.reshape(-1, d)
             token_mask = mask.reshape(-1) if mask is not None else None
+            # passthrough="zero": the block adds its own residual below, so
+            # dropped (overflow/masked) tokens must contribute 0 to the FFN
+            # term — identity would double-add ln2(x)
             ffn = switch_ffn(params, tokens, act=jax.nn.gelu,  # block's FFN
                              capacity_factor=self.moe_capacity_factor,
                              aux_weight=self.moe_aux_weight,
                              token_mask=token_mask,
-                             train=train).reshape(B, T, d)
+                             train=train,
+                             passthrough="zero").reshape(B, T, d)
         else:
             ffn = jax.nn.gelu(h2 @ params["W1"] + params["b1"]) @ params["W2"] \
                 + params["b2"]
@@ -1229,6 +1237,10 @@ class MoELayer(FeedForwardLayer):
     hidden_mult: int = 4
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # expert hidden activation; a dedicated field (not `activation`) so the
+    # builder's global activation default (sigmoid) cannot silently change
+    # the expert nonlinearity — set explicitly to override
+    expert_activation: Activation = Activation.RELU
 
     def __post_init__(self):
         if self.n_in and self.n_out and self.n_in != self.n_out:
@@ -1264,9 +1276,7 @@ class MoELayer(FeedForwardLayer):
         # load-balancing loss
         token_mask = (mask.reshape(-1) if mask is not None
                       and len(shape) == 3 else None)
-        # expert hidden activation honors the layer's activation config
-        # (builder default applies like every other layer); RELU if unset
-        act = activation_fn(self.activation or Activation.RELU)
+        act = activation_fn(self.expert_activation)
         y = switch_ffn(params, tokens, act=act,
                        capacity_factor=self.capacity_factor,
                        aux_weight=self.aux_loss_weight,

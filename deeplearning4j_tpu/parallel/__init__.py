@@ -24,12 +24,18 @@ from deeplearning4j_tpu.parallel.parameter_server import (  # noqa: F401
     ParameterServer,
     ParameterServerParallelWrapper,
 )
+from deeplearning4j_tpu.parallel.repartition import (  # noqa: F401
+    Repartition,
+    RepartitionStrategy,
+    balanced_partitions,
+)
 from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.training_master import (  # noqa: F401
     DistributedComputationGraph,
     DistributedMultiLayer,
     ParameterAveragingTrainingMaster,
     ParameterAveragingTrainingWorker,
+    TrainingHook,
     TrainingMaster,
     TrainingResult,
     TrainingWorker,

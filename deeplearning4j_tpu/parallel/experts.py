@@ -50,13 +50,21 @@ def _dispatch_indices(expert_idx: jnp.ndarray, E: int, capacity: int,
 def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
                         router_w: jnp.ndarray, *,
                         capacity_factor: float = 1.25,
-                        token_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                        token_mask=None,
+                        passthrough: str = "identity",
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Single-device reference semantics (also the parity baseline for the
     sharded path): top-1 routing with capacity, overflow passes through.
 
     `token_mask` (N,) with 1=real: padding tokens bypass the experts
     entirely — no routing, no capacity consumption, no weight in the
     load-balancing loss.
+
+    `passthrough` is what dropped (overflow/masked) tokens yield:
+    "identity" → the input token (a layer with no external residual, e.g.
+    MoELayer, leaves them unchanged); "zero" → 0, for callers that add
+    their own residual (TransformerBlock's `x + ffn`) — identity there
+    would double-add the input.
 
     Returns (y, aux_loss) — aux_loss is the Switch load-balancing loss
     (mean fraction routed × mean router prob, scaled by E)."""
@@ -78,7 +86,10 @@ def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     out_buf = jax.vmap(expert_fn)(stacked_params, buf)
     # gather back
     y_expert = out_buf[expert_idx, safe_pos]
-    y = jnp.where(keep[:, None], gate[:, None] * y_expert, x)
+    if passthrough not in ("identity", "zero"):
+        raise ValueError(f"unknown passthrough {passthrough!r}")
+    dropped = x if passthrough == "identity" else jnp.zeros_like(x)
+    y = jnp.where(keep[:, None], gate[:, None] * y_expert, dropped)
 
     # load-balancing loss (Switch eq. 4) over REAL tokens only
     oh = jax.nn.one_hot(expert_idx, E)
@@ -96,10 +107,12 @@ def moe_apply_reference(expert_fn: Callable, stacked_params, x: jnp.ndarray,
 
 def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
               router_w: jnp.ndarray, mesh: Mesh, *,
-              axis_name: str = "expert", capacity_factor: float = 1.25
+              axis_name: str = "expert", capacity_factor: float = 1.25,
+              passthrough: str = "identity",
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel MoE: experts sharded over `axis_name`, token
-    dispatch/return via all_to_all.
+    dispatch/return via all_to_all. `passthrough` as in
+    `moe_apply_reference` ("zero" for callers with an external residual).
 
     Matches `moe_apply_reference` exactly while no expert overflows
     (parity-tested). UNDER OVERFLOW the two drop different tokens: here
@@ -123,6 +136,8 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     capacity = int(np.ceil(N / E * capacity_factor))
     # per-device capacity slice must be whole
     capacity = int(np.ceil(capacity / E) * E)
+    if passthrough not in ("identity", "zero"):
+        raise ValueError(f"unknown passthrough {passthrough!r}")
 
     def local(stage_p, x_local, rw):
         # x_local: (N/E, D) this device's token shard; stage_p: this
@@ -146,7 +161,9 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
         back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                               tiled=True)
         y_expert = back[expert_idx, safe_pos]
-        y = jnp.where(keep[:, None], gate[:, None] * y_expert, x_local)
+        dropped = (x_local if passthrough == "identity"
+                   else jnp.zeros_like(x_local))
+        y = jnp.where(keep[:, None], gate[:, None] * y_expert, dropped)
         frac = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
         mean_prob = jnp.mean(probs, axis=0)
         aux = E * jnp.sum(lax.pmean(frac, axis_name)
@@ -163,7 +180,8 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
 
 def switch_ffn(params, tokens: jnp.ndarray, *, act: Callable,
                capacity_factor: float, aux_weight: float,
-               token_mask=None, train: bool = False) -> jnp.ndarray:
+               token_mask=None, train: bool = False,
+               passthrough: str = "identity") -> jnp.ndarray:
     """Shared Switch-MoE FFN dispatch used by MoELayer and
     TransformerBlock's MoE branch (one implementation, one behavior):
     params needs router/W1/b1/W2/b2 (experts stacked on axis 0); the
@@ -178,7 +196,8 @@ def switch_ffn(params, tokens: jnp.ndarray, *, act: Callable,
     y, aux = moe_apply_reference(expert_fn, stacked, tokens,
                                  params["router"],
                                  capacity_factor=capacity_factor,
-                                 token_mask=token_mask)
+                                 token_mask=token_mask,
+                                 passthrough=passthrough)
     if train:
         add_aux_loss(aux_weight * aux)
     return y

@@ -22,6 +22,7 @@ a DCN collective or host-side reduce.
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -32,6 +33,12 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import (
     DataSetIterator,
     ListDataSetIterator,
+)
+from deeplearning4j_tpu.parallel.repartition import (
+    Repartition,
+    RepartitionStrategy,
+    balanced_partitions,
+    should_repartition,
 )
 from deeplearning4j_tpu.parallel.stats import TrainingStats
 
@@ -53,9 +60,55 @@ class TrainingResult:
     num_examples: int
 
 
+class TrainingHook:
+    """Observer invoked around every worker minibatch (reference
+    `spark/api/TrainingHook.java`: onTrainingStart/End and
+    preUpdate/postUpdate — the seam the reference's parameter-server Spark
+    integration plugs into, `ParameterServerTrainingHook.java`). Hooks run
+    on worker shard threads, outside the compiled step; one worker instance
+    serves all shards (unlike the reference, where each Spark executor
+    deserializes its own worker copy), so callback invocations are
+    serialized under a worker-level lock — hook state sees a consistent
+    interleaving without needing to be thread-safe."""
+
+    def on_training_start(self, net) -> None:
+        pass
+
+    def on_training_end(self, net) -> None:
+        pass
+
+    def pre_update(self, ds: DataSet, net) -> None:
+        pass
+
+    def post_update(self, ds: DataSet, net) -> None:
+        pass
+
+
 class TrainingWorker:
     """Per-executor training contract (reference
     `spark/api/TrainingWorker.java`)."""
+
+    def __init__(self):
+        self.training_hooks: List[TrainingHook] = []
+        self._hook_lock = threading.RLock()
+
+    def add_hook(self, hook: TrainingHook) -> None:
+        """Reference `TrainingWorker.addHook`."""
+        with self._hook_lock:
+            self.training_hooks.append(hook)
+
+    def remove_hook(self, hook: TrainingHook) -> None:
+        with self._hook_lock:
+            self.training_hooks.remove(hook)
+
+    def _run_hooks(self, method: str, *args) -> None:
+        with self._hook_lock:
+            hooks = list(self.training_hooks)
+            # callbacks run under the lock for the documented serialization
+            # guarantee, but over a snapshot so a hook may add/remove hooks
+            # (the lock is reentrant) without corrupting this iteration
+            for h in hooks:
+                getattr(h, method)(*args)
 
     def get_initial_model(self):
         raise NotImplementedError
@@ -104,13 +157,20 @@ class ParameterAveragingTrainingWorker(TrainingWorker):
     (`processMinibatch` = net.fit(ds))."""
 
     def __init__(self, template_net):
+        super().__init__()
         self._template = template_net
 
     def get_initial_model(self):
-        return self._template.clone()
+        net = self._template.clone()
+        self._run_hooks("on_training_start", net)
+        return net
 
     def process_minibatch(self, ds: DataSet, net, is_last: bool) -> None:
+        self._run_hooks("pre_update", ds, net)
         net.fit(ds)
+        self._run_hooks("post_update", ds, net)
+        if is_last:
+            self._run_hooks("on_training_end", net)
 
     def get_final_result(self, net) -> TrainingResult:
         return TrainingResult(params=net.params(),
@@ -134,7 +194,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def __init__(self, num_workers: int, averaging_frequency: int = 5,
                  average_updaters: bool = True,
                  collect_training_stats: bool = False,
-                 worker: Optional[TrainingWorker] = None):
+                 worker: Optional[TrainingWorker] = None,
+                 repartition: Repartition = Repartition.ALWAYS,
+                 repartition_strategy: RepartitionStrategy = RepartitionStrategy.ROUND_ROBIN,
+                 rng_seed: Optional[int] = None):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if averaging_frequency < 1:
@@ -142,6 +205,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.num_workers = num_workers
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
+        self.repartition = repartition
+        self.repartition_strategy = repartition_strategy
+        self._rng_seed = rng_seed
         self._worker_factory = worker
         self._stats = TrainingStats() if collect_training_stats else None
 
@@ -177,10 +243,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         if stats:
             t = stats.timer("split")
             t.__enter__()
-        shards: List[List[DataSet]] = [[] for _ in range(self.num_workers)]
-        for i, ds in enumerate(batches):
-            shards[i % self.num_workers].append(ds)
-        shards = [s for s in shards if s]
+        if should_repartition(len(batches), self.num_workers, self.repartition):
+            shards = balanced_partitions(batches, self.num_workers,
+                                         self.repartition_strategy,
+                                         seed=self._rng_seed)
+        else:  # keep arrival-order contiguous chunks (no data movement)
+            shards = balanced_partitions(batches, self.num_workers,
+                                         RepartitionStrategy.BALANCED,
+                                         seed=0)
         if stats:
             t.__exit__()
 

@@ -55,3 +55,27 @@ def test_multiple_epochs_iterator():
     data = [DataSet(np.zeros((1, 1), np.float32)) for _ in range(3)]
     it = MultipleEpochsIterator(4, ListDataSetIterator(data))
     assert sum(1 for _ in it) == 12
+
+
+def test_async_multi_dataset_iterator():
+    """AsyncMultiDataSetIterator: background prefetch of MultiDataSets
+    (reference `AsyncMultiDataSetIterator.java`)."""
+    from deeplearning4j_tpu.datasets import (
+        AsyncMultiDataSetIterator,
+        MultiDataSet,
+    )
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(0)
+    mds = [MultiDataSet([rng.randn(4, 3).astype(np.float32)],
+                        [rng.randn(4, 2).astype(np.float32)])
+           for _ in range(5)]
+    it = AsyncMultiDataSetIterator(ListDataSetIterator(mds), queue_size=2)
+    for epoch in range(2):  # reset between epochs exercises producer restart
+        got = []
+        while it.has_next():
+            got.append(it.next())
+        assert len(got) == 5
+        for a, b in zip(got, mds):
+            np.testing.assert_array_equal(a.features[0], b.features[0])
+        it.reset()

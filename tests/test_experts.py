@@ -207,3 +207,68 @@ def test_moe_token_mask_excludes_padding():
     transformed = (~np.isclose(np.asarray(y2[:8]), np.asarray(xx[:8]))
                    .all(axis=1)).sum()
     assert transformed == 8  # capacity = ceil(16/2*1.0) = 8: all real kept
+
+
+def test_transformer_moe_dropped_tokens_zero_ffn_contribution():
+    """Under TransformerBlock's external residual, dropped (masked/overflow)
+    tokens must contribute ZERO to the FFN term — output exactly x, not
+    x + layer_norm(x) (passthrough='zero' plumbing)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.experts import moe_apply_reference
+
+    rng = np.random.RandomState(3)
+    N, D, E = 8, 4, 2
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    params = {"W1": jnp.asarray(rng.randn(E, D, 8).astype(np.float32)),
+              "W2": jnp.asarray(rng.randn(E, 8, D).astype(np.float32))}
+    ffn = lambda p, t: jnp.tanh(t @ p["W1"]) @ p["W2"]
+    rw = jnp.asarray(rng.randn(D, E).astype(np.float32))
+    mask = jnp.asarray([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+    y_zero, _ = moe_apply_reference(ffn, params, x, rw, token_mask=mask,
+                                    passthrough="zero")
+    y_id, _ = moe_apply_reference(ffn, params, x, rw, token_mask=mask,
+                                  passthrough="identity")
+    # masked tail: zero mode yields 0 (external residual restores x);
+    # identity mode yields the input itself
+    np.testing.assert_allclose(np.asarray(y_zero[4:]), 0.0)
+    np.testing.assert_allclose(np.asarray(y_id[4:]), np.asarray(x[4:]),
+                               rtol=1e-6)
+    # routed head is identical between the two modes
+    np.testing.assert_allclose(np.asarray(y_zero[:4]), np.asarray(y_id[:4]),
+                               rtol=1e-6)
+
+
+def test_sharded_moe_zero_passthrough_matches_reference():
+    """moe_apply(passthrough='zero') parity with the reference under a mesh
+    (no overflow), and dropped tokens yield 0 under tight capacity."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.experts import (
+        moe_apply,
+        moe_apply_reference,
+    )
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        import pytest
+
+        pytest.skip("needs >=2 devices")
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    E, D, H = n_dev, 4, 8
+    mesh = make_mesh({"expert": E})
+    params = {"W1": jnp.asarray(rng.randn(E, D, H).astype(np.float32) * 0.2),
+              "W2": jnp.asarray(rng.randn(E, H, D).astype(np.float32) * 0.2)}
+    ffn = lambda p, t: jnp.tanh(t @ p["W1"]) @ p["W2"]
+    x = jnp.asarray(rng.randn(8 * E, D).astype(np.float32))
+    rw = jnp.asarray(rng.randn(D, E).astype(np.float32))
+    y, _ = jax.jit(lambda p, t, r: moe_apply(
+        ffn, p, t, r, mesh, capacity_factor=float(E) * 8,
+        passthrough="zero"))(params, x, rw)
+    y_ref, _ = moe_apply_reference(ffn, params, x, rw,
+                                   capacity_factor=float(E) * 8,
+                                   passthrough="zero")
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)

@@ -198,3 +198,73 @@ def test_cli_end_to_end(tmp_path, monkeypatch):
     assert rc == 0
     restored = restore_multi_layer_network(model_out)
     assert not np.allclose(restored.params(), net.params())  # it trained
+
+
+def test_training_hooks_invoked():
+    """TrainingHook SPI (reference `spark/api/TrainingHook.java`): pre/post
+    update around every worker minibatch, start/end around the shard."""
+    from deeplearning4j_tpu.parallel.training_master import (
+        ParameterAveragingTrainingWorker,
+        TrainingHook,
+    )
+
+    events = []
+
+    class Recorder(TrainingHook):
+        def on_training_start(self, net):
+            events.append("start")
+
+        def on_training_end(self, net):
+            events.append("end")
+
+        def pre_update(self, ds, net):
+            events.append("pre")
+
+        def post_update(self, ds, net):
+            events.append("post")
+
+    net = _net()
+    worker = ParameterAveragingTrainingWorker(net)
+    worker.add_hook(Recorder())
+    master = ParameterAveragingTrainingMaster(
+        num_workers=1, averaging_frequency=3, worker=worker)
+    master.execute_training(net, ListDataSetIterator(_batches(3)))
+    assert events == ["start", "pre", "post", "pre", "post", "pre", "post",
+                      "end"]
+
+
+def test_repartition_balanced_sizes():
+    """balanced_partitions: sizes differ by at most one, order-preserving in
+    round-robin mode; the NUM_PARTITIONS_WORKERS_DIFFERS gate only fires on
+    uneven splits (reference Repartition/BalancedPartitioner)."""
+    from deeplearning4j_tpu.parallel.repartition import (
+        Repartition,
+        RepartitionStrategy,
+        balanced_partitions,
+        should_repartition,
+    )
+
+    items = list(range(10))
+    for strat in RepartitionStrategy:
+        parts = balanced_partitions(items, 3, strat, seed=7)
+        sizes = sorted(len(p) for p in parts)
+        assert sizes == [3, 3, 4]
+        assert sorted(x for p in parts for x in p) == items
+    # round-robin is deterministic
+    assert balanced_partitions(items, 3)[0] == [0, 3, 6, 9]
+    assert not should_repartition(9, 3, Repartition.NUM_PARTITIONS_WORKERS_DIFFERS)
+    assert should_repartition(10, 3, Repartition.NUM_PARTITIONS_WORKERS_DIFFERS)
+    assert not should_repartition(10, 3, Repartition.NEVER)
+    assert should_repartition(9, 3, Repartition.ALWAYS)
+
+
+def test_repartition_never_still_trains():
+    net = _net()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=2)
+    from deeplearning4j_tpu.parallel.repartition import Repartition
+
+    master.repartition = Repartition.NEVER
+    before = net.params().copy()
+    master.execute_training(net, ListDataSetIterator(_batches(5)))
+    assert not np.allclose(before, net.params())
