@@ -60,6 +60,81 @@ class ComputationGraph:
         self._it_device: Optional[jnp.ndarray] = None
         self._jit_train = None
         self._jit_output = None
+        self._normalizer = None
+
+    # ------------------------------------------------------- normalization
+    def set_normalizer(self, normalizer) -> None:
+        """Attach device-side normalization compiled into the step (see
+        `MultiLayerNetwork.set_normalizer`). Either one `DataNormalization`
+        applied to every (non-integer) feature input, or a sequence with one
+        entry per network input (None = leave that input alone)."""
+        norms = (normalizer if isinstance(normalizer, (list, tuple))
+                 else [normalizer])
+        if (isinstance(normalizer, (list, tuple))
+                and len(normalizer) != len(self.conf.network_inputs)):
+            raise ValueError(
+                f"normalizer list has {len(normalizer)} entries but graph "
+                f"has {len(self.conf.network_inputs)} inputs "
+                f"({self.conf.network_inputs}); pass one entry per input "
+                "(None to leave an input alone)")
+        for n in norms:
+            if n is not None:
+                n.check_device_attachable()
+        if isinstance(normalizer, (list, tuple)):
+            # an EXPLICIT non-None entry for an integer-id input would be
+            # silently skipped by _prep_inputs — reject instead (a single
+            # normalizer broadcast to all inputs documents the skip)
+            int_sinks = self._integer_sink_inputs()
+            for name, n in zip(self.conf.network_inputs, normalizer):
+                if n is not None and name in int_sinks:
+                    raise ValueError(
+                        f"input {name!r} feeds an integer-id layer; ids are "
+                        "never scaled — pass None for this input")
+        self._normalizer = normalizer
+        self._jit_train = None
+        self._jit_output = None
+
+    def get_normalizer(self):
+        return self._normalizer
+
+    def _integer_sink_inputs(self) -> set:
+        """Names of network inputs whose values reach an integer-id layer
+        (possibly through vertices) — fixpoint over the DAG."""
+        conf = self.conf
+        int_sinks = set()
+        for node in conf.nodes.values():
+            if node.is_layer and getattr(node.layer, "integer_input", False):
+                int_sinks.update(node.inputs)
+        changed = True
+        while changed:
+            changed = False
+            for name, node in conf.nodes.items():
+                if name in int_sinks and not node.is_layer:
+                    new = set(node.inputs) - int_sinks
+                    if new:
+                        int_sinks.update(new)
+                        changed = True
+        return int_sinks
+
+    def _prep_inputs(self, inputs):
+        """Traced input prep (mirrors `MultiLayerNetwork._prep_features`):
+        cast compact wire dtypes to the model dtype (integer-id inputs stay
+        integral) and apply the attached device-side normalizer(s)."""
+        int_sinks = self._integer_sink_inputs()
+        norms = self._normalizer
+        if norms is not None and not isinstance(norms, (list, tuple)):
+            norms = [norms] * len(self.conf.network_inputs)
+        out = []
+        for i, (name, x) in enumerate(zip(self.conf.network_inputs, inputs)):
+            if name in int_sinks:  # token ids: never scaled, stay integral
+                out.append(x)
+                continue
+            if x.dtype != self.dtype:
+                x = x.astype(self.dtype)
+            if norms is not None and norms[i] is not None:
+                x = norms[i].device_transform(x)
+            out.append(x)
+        return tuple(out)
 
     @property
     def score_value(self) -> Optional[float]:
@@ -156,25 +231,14 @@ class ComputationGraph:
                    train: bool = True):
         conf = self.conf
         params_in, lstate_in = params, lstate
+        inputs = self._prep_inputs(inputs)
         if self.compute_dtype is not None:
             from deeplearning4j_tpu.nn.precision import tree_cast
 
             params = tree_cast(params, self.compute_dtype)
             # skip the cast for any input whose value REACHES an integer-id
-            # layer (possibly through vertices): trace backwards to fixpoint
-            int_sinks = set()
-            for node in conf.nodes.values():
-                if node.is_layer and getattr(node.layer, "integer_input", False):
-                    int_sinks.update(node.inputs)
-            changed = True
-            while changed:
-                changed = False
-                for name, node in conf.nodes.items():
-                    if name in int_sinks and not node.is_layer:
-                        new = set(node.inputs) - int_sinks
-                        if new:
-                            int_sinks.update(new)
-                            changed = True
+            # layer (possibly through vertices)
+            int_sinks = self._integer_sink_inputs()
             inputs = tuple(
                 x if name in int_sinks else x.astype(self.compute_dtype)
                 for name, x in zip(conf.network_inputs, inputs))
@@ -316,9 +380,12 @@ class ComputationGraph:
         """Forward returning the network outputs (reference
         `ComputationGraph.output`)."""
         self._ensure_init()
-        xs = tuple(jnp.asarray(x, self.dtype) for x in inputs)
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        xs = tuple(wire_asarray(x, self.dtype) for x in inputs)
         if self._jit_output is None:
             def fwd(p, s, xs, rng, train):
+                xs = self._prep_inputs(xs)
                 acts, _ = self._forward_pure(p, s, xs, train=train, rng=rng)
                 return tuple(acts[o] for o in self.conf.network_outputs)
 
@@ -329,7 +396,9 @@ class ComputationGraph:
         return [np.asarray(o) for o in outs]
 
     def _mds_arrays(self, mds: MultiDataSet):
-        inputs = tuple(jnp.asarray(f, self.dtype) for f in mds.features)
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        inputs = tuple(wire_asarray(f, self.dtype) for f in mds.features)
         labels = tuple(jnp.asarray(l, self.dtype) for l in mds.labels)
         fmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
                         for m in mds.features_masks)
@@ -420,7 +489,9 @@ class ComputationGraph:
         self.listeners = list(listeners)
 
     def clone(self) -> "ComputationGraph":
-        net = ComputationGraph(self.conf, self.dtype)
+        net = ComputationGraph(self.conf, self.dtype,
+                               compute_dtype=self.compute_dtype)
+        net._normalizer = self._normalizer  # stateless transform: share
         if self._params is not None:
             net.init()
             net.set_params(self.params())

@@ -81,7 +81,49 @@ class MultiLayerNetwork:
         self._jit_train = None
         self._jit_scan = None
         self._jit_output = None
+        self._normalizer = None
         self._input_types = self._resolve_input_types()
+
+    # ------------------------------------------------------- normalization
+    def set_normalizer(self, normalizer) -> None:
+        """Attach a `DataNormalization` whose feature transform is COMPILED
+        INTO the step/output functions (device-side normalization). The
+        reference applies normalizers host-side between iterator and net
+        (`RecordReaderDataSetIterator.setPreProcessor`); here the transform
+        runs on-chip so iterators can ship raw compact dtypes (e.g. uint8
+        pixels) over the host link and XLA fuses the scaling into the first
+        layer. Also what `ModelSerializer.write_model(..., normalizer=)`
+        persists alongside the checkpoint (`normalizer.bin`)."""
+        if normalizer is not None:
+            normalizer.check_device_attachable()
+            if getattr(self.layers[0], "integer_input", False):
+                raise ValueError(
+                    "cannot attach a normalizer to a network whose first "
+                    "layer consumes integer token ids "
+                    f"({type(self.layers[0]).__name__}): ids are never "
+                    "scaled, so the normalizer would be silently ignored")
+        self._normalizer = normalizer
+        # traced functions embed the transform: drop compiled caches
+        self._jit_train = None
+        self._jit_scan = None
+        self._jit_output = None
+
+    def get_normalizer(self):
+        return self._normalizer
+
+    def _prep_features(self, features):
+        """Traced input prep: cast compact wire dtypes to the model dtype
+        and apply the attached device-side normalizer (both fuse into the
+        first layer's XLA computation)."""
+        if getattr(self.layers[0], "integer_input", False):
+            # token ids: never scaled/normalized, integral dtypes stay
+            # integral (embedding take)
+            return features
+        if features.dtype != self.dtype:
+            features = features.astype(self.dtype)
+        if self._normalizer is not None:
+            features = self._normalizer.device_transform(features)
+        return features
 
     # ----------------------------------------------------------------- score
     @property
@@ -170,6 +212,7 @@ class MultiLayerNetwork:
         """Loss = output-layer score + L1/L2 penalties (reference
         `computeGradientAndScore` + `calcL1/calcL2` in BaseLayer)."""
         params_in, lstate_in = params, lstate
+        features = self._prep_features(features)
         if self.compute_dtype is not None:
             # mixed precision: hidden-layer fwd/bwd in the compute dtype;
             # loss head, L1/L2, and carried state stay in the param dtype
@@ -270,7 +313,9 @@ class MultiLayerNetwork:
         return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
 
     def _batch_arrays(self, ds: DataSet):
-        f = jnp.asarray(ds.features, self.dtype)
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        f = wire_asarray(ds.features, self.dtype)
         l = jnp.asarray(ds.labels, self.dtype) if ds.labels is not None else None
         fm = jnp.asarray(ds.features_mask, self.dtype) if ds.features_mask is not None else None
         lm = jnp.asarray(ds.labels_mask, self.dtype) if ds.labels_mask is not None else None
@@ -387,8 +432,10 @@ class MultiLayerNetwork:
             self._validate_labels(ds)
         if self._jit_scan is None:
             self._jit_scan = self._make_scan_train()
-        feats = jnp.asarray(np.stack([ds.features for ds in pending]),
-                            self.dtype)
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        feats = wire_asarray(np.stack([ds.features for ds in pending]),
+                             self.dtype)
         labels = jnp.asarray(np.stack([ds.labels for ds in pending]),
                              self.dtype)
         if self._it_device is None:
@@ -508,9 +555,12 @@ class MultiLayerNetwork:
         reference's train-mode activations (dropout rng derives from the
         current iteration)."""
         self._ensure_init()
-        x = jnp.asarray(x, self.dtype)
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        x = wire_asarray(x, self.dtype)
         if self._jit_output is None:
             def fwd(p, s, xx, rng, train):
+                xx = self._prep_features(xx)
                 return self._forward_pure(p, s, xx, train=train, rng=rng,
                                           fmask=None)[0]
 
@@ -524,7 +574,7 @@ class MultiLayerNetwork:
         """All layer activations (reference `feedForward`)."""
         self._ensure_init()
         acts = []
-        xx = jnp.asarray(x, self.dtype)
+        xx = self._prep_features(jnp.asarray(x))
         for i, layer in enumerate(self.layers):
             if i in self.conf.preprocessors:
                 xx = self.conf.preprocessors[i].preprocess(xx)
@@ -562,7 +612,7 @@ class MultiLayerNetwork:
         `rnnTimeStep:2196`): carries (h, c) between calls for streaming
         generation."""
         self._ensure_init()
-        xx = jnp.asarray(x, self.dtype)
+        xx = self._prep_features(jnp.asarray(x))
         squeeze = False
         if xx.ndim == 2:  # (B, F) -> single timestep
             xx = xx[:, None, :]
@@ -656,9 +706,11 @@ class MultiLayerNetwork:
 
             def step(p_i, u_i, feats, rng, iteration):
                 def lf(p):
+                    # same wire-dtype/normalizer prep as the supervised step
+                    fx = self._prep_features(feats)
                     # encode input through the preceding (frozen) layers
                     x, _ = self._forward_pure(self._params, self._layer_state,
-                                              feats, train=False, rng=None,
+                                              fx, train=False, rng=None,
                                               fmask=None, upto=i)
                     return layer.pretrain_loss(p, x, rng)
 
@@ -670,7 +722,7 @@ class MultiLayerNetwork:
             it_count = 0
             for _ in range(epochs):
                 for ds in iterator:
-                    f = jnp.asarray(ds.features, self.dtype)
+                    f, _, _, _ = self._batch_arrays(ds)
                     rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed + i), it_count)
                     p_new, u_new, loss = jstep(self._params[i], self._upd_state[i],
                                                f, rng, jnp.asarray(it_count, jnp.int32))
@@ -687,7 +739,9 @@ class MultiLayerNetwork:
         return self._upd_state
 
     def clone(self) -> "MultiLayerNetwork":
-        net = MultiLayerNetwork(self.conf, self.dtype)
+        net = MultiLayerNetwork(self.conf, self.dtype,
+                                compute_dtype=self.compute_dtype)
+        net._normalizer = self._normalizer  # stateless transform: share
         if self._params is not None:
             net.init()
             net.set_params(self.params())

@@ -16,3 +16,24 @@ def restore_dtypes(tree, ref_tree):
     """Cast each leaf back to its counterpart's dtype (carried state must
     keep its original precision across steps or the jit retraces)."""
     return jax.tree.map(lambda a, b: a.astype(b.dtype), tree, ref_tree)
+
+
+def wire_asarray(a, dtype):
+    """Host→device transfer policy, shared by every fit/scan/output path:
+    float features are converted to the model dtype host-side (free — same
+    byte count for f32), while compact non-float dtypes (uint8 pixels, int
+    ids) cross the host link AS-IS and are cast/normalized on-device inside
+    the compiled step (`_prep_features`/`_prep_inputs`). Over a tunneled
+    chip the link is the bottleneck; uint8 is 4x fewer bytes than f32."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    # dtype probe without materializing: np.asarray on an already-on-device
+    # jnp array would round-trip the whole batch through the host
+    adtype = getattr(a, "dtype", None)
+    if adtype is None:
+        a = np.asarray(a)  # plain Python sequence
+        adtype = a.dtype
+    if jnp.issubdtype(adtype, np.floating):
+        return jnp.asarray(a, dtype)
+    return jnp.asarray(a)

@@ -1,0 +1,314 @@
+"""Device-side normalization: the normalizer's feature transform is
+compiled into the step/output functions so iterators can ship compact raw
+dtypes (uint8 pixels) over the host link.
+
+The reference applies normalizers host-side between iterator and net
+(`DataNormalization.preProcess`); the TPU redesign moves the elementwise
+scale on-chip where XLA fuses it into the first layer. Correctness
+invariant: uint8 + device normalizer must train IDENTICALLY to host-side
+f32 normalization (same seed, same batches, same scores).
+"""
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _mlp(n_in=12):
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_in=8, n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _pixel_batches(n, batch=16, n_in=12, seed=0):
+    rng = np.random.RandomState(seed)
+    feats = [rng.randint(0, 256, (batch, n_in)).astype(np.uint8)
+             for _ in range(n)]
+    labels = [np.eye(3, dtype=np.float32)[rng.randint(0, 3, batch)]
+              for _ in range(n)]
+    return feats, labels
+
+
+def test_uint8_device_norm_matches_host_f32_training():
+    """Same seed + same pixels: raw-uint8 + on-device /255 must produce the
+    SAME loss trajectory as host-side f32 scaling."""
+    feats, labels = _pixel_batches(6)
+
+    host = _mlp()
+    h_scores = []
+    for f, l in zip(feats, labels):
+        host.fit(DataSet(f.astype(np.float32) / 255.0, l))
+        h_scores.append(host.score_value)
+
+    dev = _mlp()
+    dev.set_normalizer(ImagePreProcessingScaler())
+    d_scores = []
+    for f, l in zip(feats, labels):
+        dev.fit(DataSet(f, l))  # raw uint8 over the link
+        d_scores.append(dev.score_value)
+
+    np.testing.assert_allclose(d_scores, h_scores, rtol=1e-5)
+    np.testing.assert_allclose(dev.params(), host.params(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_uint8_inference_and_evaluate():
+    feats, labels = _pixel_batches(2)
+    net = _mlp()
+    net.set_normalizer(ImagePreProcessingScaler())
+    net.fit(DataSet(feats[0], labels[0]))
+    out_u8 = net.output(feats[1])
+    out_f32 = net.output(feats[1].astype(np.float32))  # pre-scaled? no —
+    # output() applies the SAME device normalizer to float inputs, so
+    # passing the raw values as float must match the uint8 path exactly
+    np.testing.assert_allclose(out_u8, out_f32, rtol=1e-5)
+    ev = net.evaluate(DataSet(feats[1], labels[1]))
+    assert 0.0 <= ev.accuracy() <= 1.0
+
+
+def test_standardize_device_transform_matches_host():
+    rng = np.random.RandomState(1)
+    data = DataSet(rng.randn(64, 12).astype(np.float32) * 3 + 1,
+                   np.eye(3, dtype=np.float32)[rng.randint(0, 3, 64)])
+    norm = NormalizerStandardize().fit(data)
+    import jax.numpy as jnp
+
+    dev = np.asarray(norm.device_transform(jnp.asarray(data.features)))
+    host = norm.transform(DataSet(data.features.copy(), data.labels)).features
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+    norm2 = NormalizerMinMaxScaler(-1.0, 1.0).fit(data)
+    dev2 = np.asarray(norm2.device_transform(jnp.asarray(data.features)))
+    host2 = norm2.transform(DataSet(data.features.copy(), data.labels)).features
+    np.testing.assert_allclose(dev2, host2, rtol=1e-5, atol=1e-6)
+
+
+def test_set_normalizer_rejects_host_only():
+    class HostOnly(NormalizerStandardize):
+        supports_device = False
+
+    net = _mlp()
+    with pytest.raises(ValueError, match="device-side"):
+        net.set_normalizer(HostOnly())
+
+
+def test_computation_graph_device_norm_uint8():
+    """Graph variant: per-input normalizer list, uint8 wire dtype."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=12, n_out=8,
+                                       activation=Activation.RELU), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+            .build())
+    feats, labels = _pixel_batches(4)
+
+    host = ComputationGraph(conf)
+    host.init()
+    for f, l in zip(feats, labels):
+        host.fit(DataSet(f.astype(np.float32) / 255.0, l))
+
+    dev = ComputationGraph(conf)
+    dev.init()
+    dev.set_normalizer([ImagePreProcessingScaler()])
+    for f, l in zip(feats, labels):
+        dev.fit(DataSet(f, l))
+
+    np.testing.assert_allclose(dev.score_value, host.score_value, rtol=1e-5)
+    np.testing.assert_allclose(dev.params(), host.params(), rtol=1e-5,
+                               atol=1e-6)
+    out = dev.output(feats[0])[0]
+    assert out.shape == (16, 3)
+
+
+def test_mnist_raw_uint8_iterator():
+    it = MnistDataSetIterator(32, num_examples=64, raw_uint8=True)
+    ds = it.next()
+    assert ds.features.dtype == np.uint8
+    assert ds.features.shape == (32, 784)
+    # raw pixels are 0-255, scaled view matches the default iterator
+    it2 = MnistDataSetIterator(32, num_examples=64)
+    np.testing.assert_allclose(ds.features.astype(np.float32) / 255.0,
+                               it2.next().features, atol=1 / 255.0)
+
+
+def test_normalizer_checkpoint_round_trip(tmp_path):
+    """write_model persists the attached normalizer; restore + re-attach
+    reproduces outputs (reference `normalizer.bin` semantics)."""
+    from deeplearning4j_tpu.util.serialization import (
+        restore_multi_layer_network,
+        restore_normalizer,
+        write_model,
+    )
+
+    feats, labels = _pixel_batches(2)
+    net = _mlp()
+    net.set_normalizer(ImagePreProcessingScaler())
+    net.fit(DataSet(feats[0], labels[0]))
+    p = tmp_path / "model.zip"
+    write_model(net, p, normalizer=net.get_normalizer())
+    restored = restore_multi_layer_network(p)
+    restored.set_normalizer(restore_normalizer(p))
+    np.testing.assert_allclose(restored.output(feats[1]),
+                               net.output(feats[1]), rtol=1e-6)
+
+
+def test_scan_path_keeps_uint8_and_matches_per_step():
+    """fit(scan_steps=K) with raw uint8: wire dtype stays compact and the
+    loss trajectory matches the per-step dispatch path."""
+    feats, labels = _pixel_batches(6)
+
+    a = _mlp()
+    a.set_normalizer(ImagePreProcessingScaler())
+    for f, l in zip(feats, labels):
+        a.fit(DataSet(f, l))
+
+    b = _mlp()
+    b.set_normalizer(ImagePreProcessingScaler())
+    b.fit(ListDataSetIterator([DataSet(f, l) for f, l in zip(feats, labels)]),
+          scan_steps=3)
+    np.testing.assert_allclose(b.params(), a.params(), rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_net_rejects_normalizer():
+    """Integer-input first layer: ids are never scaled by an attached
+    normalizer (they'd stop being valid indices)."""
+    from deeplearning4j_tpu.nn.conf.layers import EmbeddingLayer
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(3).learning_rate(0.1)
+            .list()
+            .layer(EmbeddingLayer(n_in=16, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=4,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    # ids are never scaled, so attaching is rejected rather than ignored
+    with pytest.raises(ValueError, match="integer"):
+        net.set_normalizer(ImagePreProcessingScaler())
+    # int ids still train fine without one (compact wire dtype)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 16, (8, 1)).astype(np.int32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+    net.fit(DataSet(ids, y))
+    assert np.isfinite(net.score_value)
+    out = net.output(ids)
+    assert out.shape == (8, 4)
+
+
+def test_graph_normalizer_list_length_validated():
+    from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+        MergeVertex,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=4, n_out=4,
+                                        activation=Activation.RELU), "a")
+            .add_layer("db", DenseLayer(n_in=4, n_out=4,
+                                        activation=Activation.RELU), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "m")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf)
+    net.init()
+    with pytest.raises(ValueError, match="entries"):
+        net.set_normalizer([ImagePreProcessingScaler()])  # 1 entry, 2 inputs
+    net.set_normalizer([ImagePreProcessingScaler(), None])  # correct
+
+
+def test_pretrain_applies_device_normalizer():
+    """Pretraining sees the SAME normalized inputs as supervised fit."""
+    from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+
+    def build():
+        conf = (dl4j.NeuralNetConfiguration.Builder()
+                .seed(9).learning_rate(0.05)
+                .list()
+                .layer(AutoEncoder(n_in=12, n_out=6,
+                                   activation=Activation.SIGMOID))
+                .layer(OutputLayer(n_in=6, n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    feats, labels = _pixel_batches(3)
+
+    host = build()
+    host.pretrain(ListDataSetIterator(
+        [DataSet(f.astype(np.float32) / 255.0, l)
+         for f, l in zip(feats, labels)]))
+
+    dev = build()
+    dev.set_normalizer(ImagePreProcessingScaler())
+    dev.pretrain(ListDataSetIterator(
+        [DataSet(f, l) for f, l in zip(feats, labels)]))
+
+    np.testing.assert_allclose(dev.params(), host.params(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_clone_keeps_normalizer_and_compute_dtype():
+    """Distributed workers train on clones: the device normalizer (and
+    mixed-precision setting) must travel with clone() or every replica
+    would silently see unscaled pixels."""
+    import jax.numpy as jnp
+
+    net = MultiLayerNetwork(_mlp().conf, compute_dtype=jnp.bfloat16)
+    net.init()
+    norm = ImagePreProcessingScaler()
+    net.set_normalizer(norm)
+    c = net.clone()
+    assert c.get_normalizer() is norm
+    assert c.compute_dtype == jnp.bfloat16
+    feats, labels = _pixel_batches(1)
+    c.fit(DataSet(feats[0], labels[0]))
+    assert np.isfinite(c.score_value)
+
+
+def test_fit_label_standardize_rejected_device_side():
+    """NormalizerStandardize(fit_label=True) cannot attach device-side:
+    its label normalization would be silently dropped."""
+    rng = np.random.RandomState(0)
+    data = DataSet(rng.randn(32, 12).astype(np.float32),
+                   rng.randn(32, 3).astype(np.float32) * 100)
+    norm = NormalizerStandardize(fit_label=True).fit(data)
+    net = _mlp()
+    with pytest.raises(ValueError, match="fit_label"):
+        net.set_normalizer(norm)
