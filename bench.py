@@ -5,7 +5,7 @@ publishes no numbers — `BASELINE.json "published": {}` — so vs_baseline is
 reported against the first recorded run of this framework, stored in
 `.bench_baseline.json`).
 
-Usage: `python bench.py [lenet|resnet50|lstm|gpt]` (default: lenet — the
+Usage: `python bench.py [lenet|resnet50|lstm|gpt|word2vec]` (default: lenet — the
 driver-run config). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -148,9 +148,41 @@ def bench_gpt():
     return "gpt_causal_lm_train_tokens_per_sec_per_chip", bench * batch_size * T / dt
 
 
+def bench_word2vec():
+    """Skip-gram with negative sampling (BASELINE config 4: the reference's
+    `SkipGram.iterateSample` / `AggregateSkipGram` native-op path, here a
+    batched XLA scatter step). Metric: corpus words/sec trained."""
+    import time
+
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    # synthetic corpus with Zipf-ish structure — vocab ~2k, 200k words
+    rng = np.random.default_rng(0)
+    vocab_size, n_sentences, sent_len = 2000, 10_000, 20
+    probs = 1.0 / np.arange(1, vocab_size + 1)
+    probs /= probs.sum()
+    words = [f"w{i}" for i in range(vocab_size)]
+    sentences = [[words[j] for j in rng.choice(vocab_size, sent_len, p=probs)]
+                 for i in range(n_sentences)]
+    w2v = Word2Vec(layer_size=128, window=5, negative=5,
+                   min_word_frequency=1, epochs=1, seed=1)
+    w2v.build_vocab(sentences)
+    import jax
+
+    w2v.fit(sentences[:300])  # warm-up: compile the scanned NS kernel
+    jax.block_until_ready(w2v.lookup_table.syn0)
+    t0 = time.perf_counter()
+    w2v.fit(sentences)
+    jax.block_until_ready(w2v.lookup_table.syn0)  # count real device work
+    dt = time.perf_counter() - t0
+    total_words = n_sentences * sent_len
+    return "word2vec_skipgram_train_words_per_sec_per_chip", total_words / dt
+
+
 def main() -> None:
     configs = {"lenet": bench_lenet, "resnet50": bench_resnet50,
-               "lstm": bench_lstm, "gpt": bench_gpt}
+               "lstm": bench_lstm, "gpt": bench_gpt,
+               "word2vec": bench_word2vec}
     which = sys.argv[1] if len(sys.argv) > 1 else "lenet"
     if which not in configs:
         sys.exit(f"unknown bench config {which!r}; choose from {sorted(configs)}")

@@ -46,15 +46,8 @@ def _scatter_clipped(table, idx, upd):
     return table.at[si].add(contrib)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def skipgram_step(syn0, syn1, center, targets, labels, mask, lr):
-    """One batched skip-gram update (negative sampling OR hierarchical
-    softmax — the label/target semantics differ, the math is identical).
-
-    syn0: (V, D) input vectors; syn1: (V', D) output weights
-    center (B,) int32; targets (B, K) int32 rows of syn1
-    labels (B, K) float 1/0; mask (B, K) float validity
-    """
+def _pair_update(syn0, syn1, center, targets, labels, mask, lr):
+    """Shared skip-gram/HS update math (see skipgram_step docstring)."""
     v = syn0[center]                                   # (B, D)
     u = syn1[targets]                                  # (B, K, D)
     logits = jnp.einsum("bd,bkd->bk", v, u)
@@ -68,6 +61,72 @@ def skipgram_step(syn0, syn1, center, targets, labels, mask, lr):
                    jax.nn.log_sigmoid(-logits))
     loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return syn0, syn1, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_step(syn0, syn1, center, targets, labels, mask, lr):
+    """One batched skip-gram update (negative sampling OR hierarchical
+    softmax — the label/target semantics differ, the math is identical).
+
+    syn0: (V, D) input vectors; syn1: (V', D) output weights
+    center (B,) int32; targets (B, K) int32 rows of syn1
+    labels (B, K) float 1/0; mask (B, K) float validity
+    """
+    return _pair_update(syn0, syn1, center, targets, labels, mask, lr)
+
+
+def _ns_batch(syn0, syn1, key, center, context, cdf, lr, nvalid, negative):
+    """One NS batch with negatives drawn ON DEVICE: inverse-CDF over the
+    0.75-power unigram table, `cdf` in uint32 FIXED POINT (host f64 cumsum
+    scaled by 2^32) — f32 spacing near 1.0 (~6e-8) would collapse the tail
+    probabilities of large vocabularies to zero, silently excluding rare
+    words from the negative distribution; 2^-32 resolution does not."""
+    key, sub = jax.random.split(key)
+    B = center.shape[0]
+    u = jax.random.bits(sub, (B, negative), jnp.uint32)
+    negs = jnp.clip(jnp.searchsorted(cdf, u, side="right"), 0,
+                    cdf.shape[0] - 1).astype(jnp.int32)
+    targets = jnp.concatenate([context[:, None], negs], axis=1)
+    one = jnp.ones((B, 1), jnp.float32)
+    labels = jnp.concatenate(
+        [one, jnp.zeros((B, negative), jnp.float32)], axis=1)
+    mask = jnp.concatenate(
+        [one, (negs != context[:, None]).astype(jnp.float32)], axis=1)
+    mask = mask * (jnp.arange(B) < nvalid)[:, None]
+    syn0, syn1, loss = _pair_update(syn0, syn1, center, targets, labels,
+                                    mask, lr)
+    return syn0, syn1, loss, key
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 6), static_argnums=(9,))
+def skipgram_ns_scan(syn0, syn1, centers, contexts, cdf, key, loss_acc,
+                     lrs, nvalids, negative):
+    """K sequential NS batches in ONE dispatch via `lax.scan` — the
+    device-side negative-sampling skip-gram kernel (replaces the
+    reference's native `AggregateSkipGram` inner loop).
+
+    Over a remote-tunnel transport every device operation (transfer or
+    step) costs ~4ms of serialized round-trip latency, so one dispatch per
+    1024-pair batch caps throughput regardless of how fast the scatter
+    math is. Scanning K batches per dispatch amortizes that fixed cost K×:
+    centers/contexts are (K, B) int32, lrs/nvalids are (K,) per-batch
+    learning rates and valid-row counts (tail batches may be partial or
+    empty — nvalid=0 rows are fully masked). `key` is the carried PRNG
+    state (threefry; `jax_threefry_partitionable` makes draws identical
+    under any sharding, preserving mesh vs single-chip parity); `loss_acc`
+    is a carried (donated) running loss sum — folding accumulation into
+    the step keeps the hot loop at exactly one dispatch per flush."""
+
+    def body(carry, xs):
+        syn0, syn1, key, acc = carry
+        center, context, lr, nvalid = xs
+        syn0, syn1, loss, key = _ns_batch(syn0, syn1, key, center, context,
+                                          cdf, lr, nvalid, negative)
+        return (syn0, syn1, key, acc + loss), None
+
+    (syn0, syn1, key, loss_acc), _ = jax.lax.scan(
+        body, (syn0, syn1, key, loss_acc), (centers, contexts, lrs, nvalids))
+    return syn0, syn1, loss_acc, key
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

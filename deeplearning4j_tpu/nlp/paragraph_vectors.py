@@ -83,7 +83,7 @@ class ParagraphVectors(SequenceVectors):
         V = self.vocab.num_words()
         total_words = max(1.0, sum(len(t) for _, t in docs) * self.epochs)
         words_seen = 0.0
-        self._loss_sum, self._loss_batches = 0.0, 0
+        self._reset_loss()
         batch = _PairBatcher(self)
         for _ in range(self.epochs * self.iterations):
             for label, tokens in docs:

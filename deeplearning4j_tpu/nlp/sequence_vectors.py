@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Iterable, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -45,6 +46,7 @@ class SequenceVectors:
                  sampling: float = 0.0,
                  seed: int = 42,
                  elements_learning_algorithm: str = "skipgram",
+                 scan_flushes: int = 32,
                  mesh=None,
                  data_axis: str = "data"):
         if negative <= 0 and not use_hierarchic_softmax:
@@ -67,12 +69,19 @@ class SequenceVectors:
         self.sampling = sampling
         self.seed = seed
         self.algorithm = elements_learning_algorithm
+        # NS fast path: how many flush-batches ride one scanned dispatch
+        self.scan_flushes = max(1, int(scan_flushes))
         self.vocab: Optional[AbstractCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.default_rng(seed)
         self._unigram: Optional[np.ndarray] = None
+        self._unigram_cdf: Optional[np.ndarray] = None
+        self._ns_cdf_dev = None  # device copy of the cdf (NS-on-device)
+        self._ns_key = None      # carried PRNG state for device sampling
         self._loss_sum = 0.0
         self._loss_batches = 0
+        self._loss_dev = None
+        self._loss_dev_count = 0
         # multi-chip data parallelism (the dl4j-spark-nlp role,
         # `spark/models/embeddings/word2vec/Word2VecPerformer.java`): pair
         # batches shard over the mesh's data axis, embedding tables stay
@@ -87,6 +96,7 @@ class SequenceVectors:
                     f"batch_size {batch_size} must divide by the "
                     f"'{data_axis}' mesh axis size {n}")
         self._sharded_kernels = None
+        self._sharded_ns_kernel = None
 
     # -- vocab/init ---------------------------------------------------------
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
@@ -98,6 +108,8 @@ class SequenceVectors:
             negative=self.negative)
         if self.negative > 0:
             self._unigram = self.vocab.unigram_table()
+            self._unigram_cdf = None
+            self._ns_cdf_dev = None
 
     # -- training -----------------------------------------------------------
     def fit(self, sequences: Iterable[Sequence[str]]) -> None:
@@ -107,7 +119,7 @@ class SequenceVectors:
         total_words = max(
             1.0, self.vocab.total_word_occurrences * self.epochs * self.iterations)
         words_seen = 0.0
-        self._loss_sum, self._loss_batches = 0.0, 0
+        self._reset_loss()
         batch = _PairBatcher(self)
         for _ in range(self.epochs * self.iterations):
             for seq in seqs:
@@ -138,6 +150,24 @@ class SequenceVectors:
 
     def _train_sequence(self, ids: List[int], alpha: float, batch: "_PairBatcher"):
         window = self.window
+        if self.algorithm == "skipgram" and self.negative > 0 \
+                and not self.use_hs:
+            # vectorized fast path (the common NS configuration): build the
+            # whole sentence's (center, context) pair list with array ops —
+            # the per-pair Python loop was the training bottleneck, not the
+            # XLA scatter step
+            L = len(ids)
+            arr = np.asarray(ids, np.int32)
+            b = self._rng.integers(1, window + 1, L)  # shrinking windows
+            offs = np.concatenate([np.arange(-window, 0),
+                                   np.arange(1, window + 1)])
+            grid = np.arange(L)[:, None] + offs[None, :]
+            valid = ((np.abs(offs)[None, :] <= b[:, None])
+                     & (grid >= 0) & (grid < L))
+            centers = np.repeat(arr, valid.sum(1))
+            contexts = arr[grid[valid]]  # row-major: aligned with repeat
+            batch.add_pairs(centers, contexts, alpha)
+            return
         for pos, center in enumerate(ids):
             b = int(self._rng.integers(1, window + 1))  # shrinking window
             lo, hi = max(0, pos - b), min(len(ids), pos + b + 1)
@@ -178,15 +208,96 @@ class SequenceVectors:
             self._sharded_kernels = (sg, cb)
         return self._sharded_kernels
 
-    def _sample_negatives(self, n: int) -> np.ndarray:
-        return self._rng.choice(len(self._unigram), size=n, p=self._unigram)
+    def _ns_kernel(self):
+        """Device-side negative-sampling scanned skip-gram step (see
+        `kernels.skipgram_ns_scan`). Sharded variant draws are identical to
+        the single-chip ones because threefry is partitionable — mesh vs
+        single-chip parity holds bit-for-bit."""
+        if self.mesh is None:
+            return kernels.skipgram_ns_scan
+        if self._sharded_ns_kernel is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def _record_loss(self, loss: float) -> None:
-        self._loss_sum += loss
+            repl = NamedSharding(self.mesh, P())
+            bsh = NamedSharding(self.mesh, P(None, self.data_axis))
+            self._sharded_ns_kernel = jax.jit(
+                kernels.skipgram_ns_scan.__wrapped__,
+                in_shardings=(repl, repl, bsh, bsh, repl, repl, repl, repl,
+                              repl),
+                out_shardings=(repl, repl, None, None),
+                donate_argnums=(0, 1, 6), static_argnums=(9,))
+        return self._sharded_ns_kernel
+
+    def _ns_device_state(self):
+        """(device cdf, carried PRNG key) for on-device negative sampling.
+        The cdf ships as uint32 fixed point (f64 cumsum × 2^32): f32 would
+        round adjacent tail entries of a large vocabulary equal, making
+        those words unsampleable (see `kernels._ns_batch`)."""
+        if self._unigram_cdf is None:
+            self._unigram_cdf = np.cumsum(self._unigram)
+        if self._ns_cdf_dev is None:
+            fixed = np.minimum(np.round(self._unigram_cdf * 2.0 ** 32),
+                               2.0 ** 32 - 1).astype(np.uint32)
+            self._ns_cdf_dev = jnp.asarray(fixed)
+        if self._ns_key is None:
+            self._ns_key = jax.random.PRNGKey(self.seed)
+        return self._ns_cdf_dev, self._ns_key
+
+    def _sample_negatives(self, n) -> np.ndarray:
+        """Draw from the 0.75-power unigram distribution. Inverse-CDF via
+        searchsorted: O(log V) per draw and fully vectorizable — the
+        per-pair `rng.choice(p=...)` it replaces rebuilt an O(V) sampler
+        per call and dominated the whole training loop. `n` may be a shape
+        tuple."""
+        if self._unigram_cdf is None:
+            self._unigram_cdf = np.cumsum(self._unigram)
+        idx = np.searchsorted(self._unigram_cdf, self._rng.random(n))
+        # cumsum rounding can leave cdf[-1] slightly below 1.0, in which
+        # case a draw above it would index past the vocabulary
+        return np.minimum(idx, len(self._unigram) - 1).astype(np.int32)
+
+    def _reset_loss(self) -> None:
+        """Zero ALL loss-accumulation state (host f64 sum, batch count, and
+        the carried device accumulator) — every fit entry point must call
+        this, or a prior fit's undrained device sum leaks into the next."""
+        self._loss_sum, self._loss_batches = 0.0, 0
+        self._loss_dev, self._loss_dev_count = None, 0
+
+    def _record_loss(self, loss) -> None:
+        """Accumulate WITHOUT a per-flush host sync: reading `float(loss)`
+        per flush cost a full tunnel round trip (~115ms) and was 80% of
+        training wall-clock. The per-flush losses chain into ONE device
+        scalar (an async eager add — never a list of buffers: fetching N
+        separate remote scalars costs N round trips), which is folded into
+        the host f64 sum every `_LOSS_FOLD` flushes with a single one-
+        scalar sync — an f32 running sum alone would stop absorbing small
+        increments on very long runs."""
+        self._loss_dev = loss if self._loss_dev is None else self._loss_dev + loss
         self._loss_batches += 1
+        self._loss_dev_count += 1
+        if self._loss_dev_count >= self._LOSS_FOLD:
+            self._drain_loss()
+
+    def _record_loss_acc(self, acc, n_batches: int = 1) -> None:
+        """Store a kernel-carried running sum (the accumulation already
+        happened inside the jitted step — no eager dispatch here)."""
+        self._loss_dev = acc
+        self._loss_batches += n_batches
+        self._loss_dev_count += n_batches
+        if self._loss_dev_count >= self._LOSS_FOLD:
+            self._drain_loss()
+
+    _LOSS_FOLD = 256
+
+    def _drain_loss(self) -> None:
+        if self._loss_dev is not None:
+            self._loss_sum += float(self._loss_dev)
+            self._loss_dev = None
+            self._loss_dev_count = 0
 
     @property
     def mean_loss(self) -> float:
+        self._drain_loss()
         return self._loss_sum / max(self._loss_batches, 1)
 
     # -- query passthrough --------------------------------------------------
@@ -221,8 +332,20 @@ class _PairBatcher:
         self.mask = np.zeros((B, self.K), np.float32)
         self.context = np.zeros((B, self.W), np.int32)
         self.cmask = np.zeros((B, self.W), np.float32)
+        # pair-mode staging: scan_k flush-batches accumulate and go to the
+        # device as ONE scanned dispatch (per-operation tunnel latency is
+        # the throughput ceiling, so amortize it over scan_k batches)
+        self.scan_k = max(1, int(getattr(sv, "scan_flushes", 32)))
+        self.pair_center = np.zeros(B * self.scan_k, np.int32)
+        self.pair_context = np.zeros(B * self.scan_k, np.int32)
+        self.row_alpha = np.full(self.scan_k, 0.025, np.float32)
         self.alpha = 0.025
         self.n = 0
+        # "pairs" = NS-only skip-gram fast path (negatives drawn on device,
+        # flush ships two (scan_k, B) id arrays); "generic" = host-built
+        # (B, K) target/label/mask rows (HS, CBOW, ParagraphVectors
+        # add_pair). A batcher serves ONE mode for its lifetime.
+        self._mode: Optional[str] = None
 
     def _fill_targets(self, row: int, predicted: int):
         """Targets for predicting word id `predicted`: NS = [pos, negs];
@@ -249,8 +372,46 @@ class _PairBatcher:
                 self.mask[row, k] = 1.0
                 k += 1
 
+    def add_pairs(self, centers: np.ndarray, contexts: np.ndarray,
+                  alpha: float):
+        """Bulk skip-gram add (NS-only fast path): stages just the
+        (center, context) id pairs — negatives, labels, and masks are built
+        on device by `skipgram_ns_scan`."""
+        assert self._mode != "generic", "batcher already in generic mode"
+        self._mode = "pairs"
+        B = len(self.center)
+        cap = len(self.pair_center)
+        i, n_total = 0, len(centers)
+        while i < n_total:
+            take = min(cap - self.n, n_total - i)
+            rows = slice(self.n, self.n + take)
+            self.pair_center[rows] = centers[i:i + take]
+            self.pair_context[rows] = contexts[i:i + take]
+            self.row_alpha[self.n // B:(self.n + take - 1) // B + 1] = alpha
+            self.n += take
+            i += take
+            if self.n == cap:
+                self.flush()
+
     def add_pair(self, center: int, context: int, alpha: float):
-        """Skip-gram: center predicts context."""
+        """Skip-gram: center predicts context. In the NS-only configuration
+        this stages the raw pair for device-side sampling (same mode as
+        add_pairs, so DBOW doc-pairs and word training share one batcher);
+        with hierarchical softmax the targets are built host-side."""
+        sv = self.sv
+        if sv.negative > 0 and not sv.use_hs:
+            assert self._mode != "generic", "batcher already in generic mode"
+            self._mode = "pairs"
+            row = self.n
+            self.pair_center[row] = center
+            self.pair_context[row] = context
+            self.row_alpha[row // len(self.center)] = alpha
+            self.n += 1
+            if self.n == len(self.pair_center):
+                self.flush()
+            return
+        assert self._mode != "pairs", "batcher already in pairs mode"
+        self._mode = "generic"
         row = self.n
         self.center[row] = center
         self.targets[row] = 0
@@ -263,6 +424,8 @@ class _PairBatcher:
             self.flush()
 
     def add_cbow(self, context: List[int], center: int, alpha: float):
+        assert self._mode != "pairs", "batcher already in pairs mode"
+        self._mode = "generic"
         row = self.n
         self.context[row] = 0
         self.cmask[row] = 0
@@ -283,40 +446,66 @@ class _PairBatcher:
             return
         sv = self.sv
         lt = sv.lookup_table
+        # COPY the staging buffers before dispatch: device_put of a numpy
+        # array can be ZERO-COPY (it aliases host memory, notably on the CPU
+        # backend), and the async step may still be reading while the next
+        # batch overwrites these rows. Without copies, training corrupts
+        # nondeterministically once nothing forces a per-flush sync.
+        ja = lambda a: jnp.asarray(np.array(a))  # np.array always copies
+        lr = jnp.float32(self.alpha)
+        if self._mode == "pairs":
+            cdf, key = sv._ns_device_state()
+            step = sv._ns_kernel()
+            acc = (sv._loss_dev if sv._loss_dev is not None
+                   else jnp.float32(0.0))
+            B = len(self.center)
+            Ks = self.scan_k
+            # always dispatch the full (scan_k, B) shape — tail rows get
+            # nvalid=0 (fully masked) so there is exactly ONE compilation
+            nvalids = np.clip(self.n - np.arange(Ks) * B, 0, B).astype(np.int32)
+            n_rows = -(-self.n // B)  # batches actually represented
+            lt.syn0, lt.syn1neg, new_acc, sv._ns_key = step(
+                lt.syn0, lt.syn1neg,
+                ja(self.pair_center.reshape(Ks, B)),
+                ja(self.pair_context.reshape(Ks, B)),
+                cdf, key, acc, ja(self.row_alpha), ja(nvalids), sv.negative)
+            sv._record_loss_acc(new_acc, n_batches=n_rows)
+            self.n = 0
+            return
         self.mask[self.n:] = 0.0
         self.cmask[self.n:] = 0.0
-        lr = jnp.float32(self.alpha)
         syn1 = lt.syn1neg if sv.negative > 0 else lt.syn1
         skipgram_step, cbow_step = sv._kernels()
         if sv.use_hs and sv.negative > 0:
             # mixed mode: split columns — NS rows live in syn1neg, HS rows
             # in syn1; run two steps on the column slices
             ns_cols = sv.negative + 1
+            center = ja(self.center)
             lt.syn0, lt.syn1neg, loss1 = skipgram_step(
-                lt.syn0, lt.syn1neg, jnp.asarray(self.center),
-                jnp.asarray(self.targets[:, :ns_cols]),
-                jnp.asarray(self.labels[:, :ns_cols]),
-                jnp.asarray(self.mask[:, :ns_cols]), lr)
+                lt.syn0, lt.syn1neg, center,
+                ja(self.targets[:, :ns_cols]),
+                ja(self.labels[:, :ns_cols]),
+                ja(self.mask[:, :ns_cols]), lr)
             lt.syn0, lt.syn1, loss2 = skipgram_step(
-                lt.syn0, lt.syn1, jnp.asarray(self.center),
-                jnp.asarray(self.targets[:, ns_cols:]),
-                jnp.asarray(self.labels[:, ns_cols:]),
-                jnp.asarray(self.mask[:, ns_cols:]), lr)
-            sv._record_loss(float(loss1) + float(loss2))
+                lt.syn0, lt.syn1, center,
+                ja(self.targets[:, ns_cols:]),
+                ja(self.labels[:, ns_cols:]),
+                ja(self.mask[:, ns_cols:]), lr)
+            sv._record_loss(loss1 + loss2)
         elif sv.algorithm == "cbow":
             lt.syn0, new_syn1, loss = cbow_step(
-                lt.syn0, syn1, jnp.asarray(self.context),
-                jnp.asarray(self.cmask), jnp.asarray(self.targets),
-                jnp.asarray(self.labels), jnp.asarray(self.mask), lr)
+                lt.syn0, syn1, ja(self.context),
+                ja(self.cmask), ja(self.targets),
+                ja(self.labels), ja(self.mask), lr)
             self._store_syn1(new_syn1)
-            sv._record_loss(float(loss))
+            sv._record_loss(loss)
         else:
             lt.syn0, new_syn1, loss = skipgram_step(
-                lt.syn0, syn1, jnp.asarray(self.center),
-                jnp.asarray(self.targets), jnp.asarray(self.labels),
-                jnp.asarray(self.mask), lr)
+                lt.syn0, syn1, ja(self.center),
+                ja(self.targets), ja(self.labels),
+                ja(self.mask), lr)
             self._store_syn1(new_syn1)
-            sv._record_loss(float(loss))
+            sv._record_loss(loss)
         self.n = 0
 
     def _store_syn1(self, new_syn1):

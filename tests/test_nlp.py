@@ -264,3 +264,37 @@ def test_word2vec_with_japanese_tokenizer():
                    batch_size=32, min_word_frequency=1)
     w2v.fit(corpus)
     assert "犬" in w2v.vocab.words()
+
+
+def test_refit_resets_loss_accumulator():
+    """A second fit() must not inherit the previous fit's undrained
+    device-side loss accumulator (regression: mean_loss doubled)."""
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(50)]
+    sentences = [[words[j] for j in rng.integers(0, 50, 12)]
+                 for _ in range(30)]
+    w2v = Word2Vec(layer_size=16, negative=3, min_word_frequency=1, seed=1)
+    w2v.fit(sentences)
+    m1 = w2v.mean_loss
+    w2v.fit(sentences)
+    m2 = w2v.mean_loss
+    assert abs(m2) < abs(m1) * 1.5
+
+
+def test_device_cdf_preserves_tail_probabilities():
+    """The device negative-sampling cdf is uint32 fixed point: adjacent
+    entries for rare tail words must stay distinguishable where an f32
+    cdf would round them equal."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    sv = SequenceVectors(negative=5, min_word_frequency=1)
+    # vocabulary with a huge head and many tiny tail words
+    seqs = [["head"] * 50 + [f"tail{i}"] for i in range(5000)]
+    sv.build_vocab(seqs)
+    cdf_dev, _ = sv._ns_device_state()
+    fixed = np.asarray(cdf_dev)
+    assert fixed.dtype == np.uint32
+    # every tail word owns at least one fixed-point slot (strictly
+    # increasing cdf across the tail region)
+    diffs = np.diff(fixed.astype(np.int64))
+    assert (diffs > 0).mean() > 0.99
