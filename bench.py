@@ -115,7 +115,9 @@ def bench_lstm():
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (warmup + bench, batch_size, T + 1))
     eye = np.eye(vocab, dtype=np.float32)
-    batches = [DataSet(eye[ids[i, :, :-1]], eye[ids[i, :, 1:]])
+    # one-hot features (GravesLSTM n_in=vocab, reference char-RNN input);
+    # sparse int labels (vocab× fewer bytes over the link)
+    batches = [DataSet(eye[ids[i, :, :-1]], ids[i, :, 1:].astype(np.int32))
                for i in range(warmup + bench)]
     dt = _throughput(net, batches, warmup, bench)
     return "lstm_charrnn_train_samples_per_sec_per_chip", bench * batch_size / dt
@@ -141,8 +143,10 @@ def bench_gpt():
     net.init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (warmup + bench, batch_size, T + 1))
-    eye = np.eye(vocab, dtype=np.float32)
-    batches = [DataSet(ids[i, :, :-1].astype(np.float32), eye[ids[i, :, 1:]])
+    # sparse int labels: (B, T) ids are vocab× fewer bytes than (B, T, V)
+    # one-hot — the 8MB/batch label transfer dominated this config
+    batches = [DataSet(ids[i, :, :-1].astype(np.int32),
+                       ids[i, :, 1:].astype(np.int32))
                for i in range(warmup + bench)]
     dt = _throughput(net, batches, warmup, bench)
     return "gpt_causal_lm_train_tokens_per_sec_per_chip", bench * batch_size * T / dt

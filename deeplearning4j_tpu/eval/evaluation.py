@@ -42,17 +42,21 @@ class Evaluation:
         (B, T, C) time series (flattened with mask)."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
-        if labels.ndim == 3:
-            B, T, C = labels.shape
-            labels = labels.reshape(B * T, C)
+        # sparse labels: integer class ids shaped predictions.shape[:-1]
+        sparse = (labels.ndim == predictions.ndim - 1
+                  and np.issubdtype(labels.dtype, np.integer))
+        if predictions.ndim == 3:
+            B, T, C = predictions.shape
+            labels = (labels.reshape(B * T) if sparse
+                      else labels.reshape(B * T, C))
             predictions = predictions.reshape(B * T, C)
             if mask is not None:
                 mask = np.asarray(mask).reshape(B * T)
         if self.num_classes is None:
-            self.num_classes = labels.shape[-1]
+            self.num_classes = predictions.shape[-1]
         if self._confusion is None:
             self._confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
-        actual = np.argmax(labels, axis=-1)
+        actual = labels if sparse else np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
         total = actual.shape[0]  # PRE-mask flattened positions
         if mask is not None:
@@ -60,6 +64,17 @@ class Evaluation:
             actual, pred = actual[keep_idx], pred[keep_idx]
         else:
             keep_idx = np.arange(total)
+        # sparse id range check AFTER mask filtering (sentinel ids on
+        # masked-out positions are fine); without it, np.add.at would
+        # silently wrap negatives into the last confusion row
+        if sparse and actual.size and (int(actual.min()) < 0
+                                       or int(actual.max()) >= self.num_classes):
+            bad = (int(actual.min()) if int(actual.min()) < 0
+                   else int(actual.max()))
+            raise ValueError(
+                f"sparse label id {bad} out of range "
+                f"[0, {self.num_classes}) — mask padded positions with a "
+                "labels mask instead of sentinel ids")
         np.add.at(self._confusion, (actual, pred), 1)
         if self.record_meta:
             # example_index counts pre-mask flattened positions (row, or

@@ -249,7 +249,9 @@ class OutputLayer(DenseLayer):
         if pre.ndim == 3:  # time-distributed: flatten rows, expand mask
             B, T, F = pre.shape
             pre = pre.reshape(B * T, F)
-            labels = labels.reshape(B * T, -1)
+            # sparse int labels are (B, T); dense one-hot are (B, T, C)
+            labels = (labels.reshape(B * T) if labels.ndim == 2
+                      else labels.reshape(B * T, -1))
             if mask is not None:
                 mask = mask.reshape(B * T)
         return loss_score(self.loss, self.activation or Activation.IDENTITY,
@@ -297,7 +299,9 @@ class LossLayer(Layer):
         if pre.ndim == 3:
             B, T, F = pre.shape
             pre = pre.reshape(B * T, F)
-            labels = labels.reshape(B * T, -1)
+            # sparse int labels are (B, T); dense one-hot are (B, T, C)
+            labels = (labels.reshape(B * T) if labels.ndim == 2
+                      else labels.reshape(B * T, -1))
             if mask is not None:
                 mask = mask.reshape(B * T)
         return loss_score(self.loss, self.activation or Activation.IDENTITY,

@@ -360,6 +360,7 @@ class ComputationGraph:
                     pass  # one-shot underlying cannot rewind
 
     def _fit_batch(self, mds: MultiDataSet):
+        self._validate_labels(mds)
         inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
         if self._it_device is None:
             self._it_device = jnp.asarray(self.iteration, jnp.int32)
@@ -399,7 +400,7 @@ class ComputationGraph:
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
         inputs = tuple(wire_asarray(f, self.dtype) for f in mds.features)
-        labels = tuple(jnp.asarray(l, self.dtype) for l in mds.labels)
+        labels = tuple(wire_asarray(l, self.dtype) for l in mds.labels)
         fmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
                         for m in mds.features_masks)
                   if mds.features_masks is not None else None)
@@ -421,6 +422,21 @@ class ComputationGraph:
                 f"got {len(mds.labels)} label arrays but graph has "
                 f"{len(self.conf.network_outputs)} outputs "
                 f"({self.conf.network_outputs})")
+        for oname, l in zip(self.conf.network_outputs, mds.labels):
+            larr = np.asarray(l)
+            if not np.issubdtype(larr.dtype, np.integer) or not larr.size:
+                continue
+            # sparse class ids: range-check (same contract as
+            # MultiLayerNetwork — an out-of-range id inside the traced
+            # gather yields NaN, not an error)
+            n_out = getattr(self.conf.nodes[oname].layer, "n_out", None)
+            if n_out and (int(larr.max()) >= n_out or int(larr.min()) < 0):
+                bad = (int(larr.max()) if int(larr.max()) >= n_out
+                       else int(larr.min()))
+                raise ValueError(
+                    f"sparse label id {bad} out of range [0, {n_out}) for "
+                    f"output {oname!r} (mask padded positions with a labels "
+                    "mask instead of sentinel ids)")
 
     def score(self, ds: Union[DataSet, MultiDataSet], train: bool = False) -> float:
         self._ensure_init()

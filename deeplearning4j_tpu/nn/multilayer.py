@@ -316,7 +316,9 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
         f = wire_asarray(ds.features, self.dtype)
-        l = jnp.asarray(ds.labels, self.dtype) if ds.labels is not None else None
+        # labels ride the same wire policy: sparse int class ids stay int
+        # (vocab× fewer bytes than one-hot), floats widen to the model dtype
+        l = wire_asarray(ds.labels, self.dtype) if ds.labels is not None else None
         fm = jnp.asarray(ds.features_mask, self.dtype) if ds.features_mask is not None else None
         lm = jnp.asarray(ds.labels_mask, self.dtype) if ds.labels_mask is not None else None
         return f, l, fm, lm
@@ -386,8 +388,17 @@ class MultiLayerNetwork:
                     elif tbptt and ds.features.ndim == 3:
                         self._fit_tbptt(ds)
                     elif scan:
+                        def _sig(d):
+                            # stackability signature: features AND labels
+                            # shape/dtype (sparse int vs one-hot may mix in
+                            # one iterator)
+                            la = np.asarray(d.labels)
+                            return (d.features.shape,
+                                    np.asarray(d.features).dtype,
+                                    la.shape, la.dtype)
+
                         if (ds.features_mask is not None or ds.labels_mask is not None
-                                or (pending and ds.features.shape != pending[0].features.shape)):
+                                or (pending and _sig(ds) != _sig(pending[0]))):
                             self._flush_scan(pending)  # shape change / masks
                             pending = []
                             self._fit_batch(ds)
@@ -436,8 +447,8 @@ class MultiLayerNetwork:
 
         feats = wire_asarray(np.stack([ds.features for ds in pending]),
                              self.dtype)
-        labels = jnp.asarray(np.stack([ds.labels for ds in pending]),
-                             self.dtype)
+        labels = wire_asarray(np.stack([ds.labels for ds in pending]),
+                              self.dtype)
         if self._it_device is None:
             self._it_device = jnp.asarray(self.iteration, jnp.int32)
         (self._params, self._upd_state, self._layer_state, self._it_device,
@@ -490,11 +501,26 @@ class MultiLayerNetwork:
         if ds.labels is None:
             raise ValueError("fit() requires labels; got DataSet with labels=None "
                              "(use pretrain() for unsupervised training)")
-        if n_out and ds.labels.shape[-1] != n_out:
+        labels = np.asarray(ds.labels)
+        if np.issubdtype(labels.dtype, np.integer):
+            # sparse class-id labels: width check is a range check instead
+            # (negatives included — jnp.take_along_axis would WRAP -1 to the
+            # last class and silently train padding toward it; use a labels
+            # mask for padded positions, not sentinel ids)
+            if n_out and labels.size and (int(labels.max()) >= n_out
+                                          or int(labels.min()) < 0):
+                bad = (int(labels.max()) if int(labels.max()) >= n_out
+                       else int(labels.min()))
+                raise ValueError(
+                    f"sparse label id {bad} out of range [0, {n_out}) for "
+                    "the output layer (mask padded positions with a labels "
+                    "mask instead of sentinel ids)")
+            return
+        if n_out and labels.shape[-1] != n_out:
             raise ValueError(
-                f"labels have width {ds.labels.shape[-1]} but output layer "
+                f"labels have width {labels.shape[-1]} but output layer "
                 f"has n_out={n_out} (features shape {ds.features.shape}, "
-                f"labels shape {ds.labels.shape})")
+                f"labels shape {labels.shape})")
 
     def _fit_tbptt(self, ds: DataSet):
         """Truncated BPTT (reference `doTruncatedBPTT`,
@@ -502,10 +528,14 @@ class MultiLayerNetwork:
         tbptt_fwd_length windows, carrying LSTM (h, c) across windows; each
         window is one jitted step (fixed window shape ⇒ one compilation)."""
         fwd_len = self.conf.tbptt_fwd_length
-        if ds.labels is None or ds.labels.ndim != 3:
+        sparse = (ds.labels is not None
+                  and np.issubdtype(np.asarray(ds.labels).dtype, np.integer)
+                  and np.asarray(ds.labels).ndim == 2)
+        if ds.labels is None or (ds.labels.ndim != 3 and not sparse):
             raise ValueError(
-                "truncated BPTT requires per-timestep labels of shape "
-                f"(batch, time, nOut); got labels shape "
+                "truncated BPTT requires per-timestep labels: one-hot "
+                "(batch, time, nOut) or sparse int (batch, time); got "
+                f"labels shape "
                 f"{None if ds.labels is None else ds.labels.shape}. For "
                 "sequence-to-one models, train without tBPTT "
                 "(t_bptt_forward_length unset)")

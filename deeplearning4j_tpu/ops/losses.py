@@ -92,6 +92,25 @@ def loss_score(
     loss = LossFunction(loss) if not isinstance(loss, LossFunction) else loss
     activation = Activation(activation) if not isinstance(activation, Activation) else activation
 
+    # SPARSE labels: integer class ids of shape preout.shape[:-1] instead of
+    # one-hot rows. A (B, T) int array is vocab_size× fewer bytes over the
+    # host link than its (B, T, V) one-hot — for LM training the label
+    # transfer dominates the batch. (The reference supports only dense
+    # one-hot labels; this is a TPU-native extension.)
+    if (labels.ndim == preout.ndim - 1
+            and jnp.issubdtype(labels.dtype, jnp.integer)):
+        if loss in (LossFunction.MCXENT,
+                    LossFunction.NEGATIVELOGLIKELIHOOD) \
+                and activation == Activation.SOFTMAX:
+            ls = jax.nn.log_softmax(preout, axis=-1)
+            per_row = -jnp.take_along_axis(
+                ls, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            return _masked_row_mean(per_row, mask)
+        raise ValueError(
+            "integer class-id labels require MCXENT/NEGATIVELOGLIKELIHOOD "
+            f"with SOFTMAX output (got loss={loss.value}, "
+            f"activation={activation.value}); pass one-hot labels instead")
+
     if loss in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD) and activation == Activation.SOFTMAX:
         per_elem = -labels * jax.nn.log_softmax(preout, axis=-1)
     elif loss == LossFunction.XENT and activation == Activation.SIGMOID:

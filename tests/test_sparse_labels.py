@@ -1,0 +1,198 @@
+"""Sparse (integer class-id) labels: a TPU-native extension over the
+reference's one-hot-only label contract. A (B, T) int32 label array is
+vocab_size× fewer bytes over the host link than its one-hot expansion and
+the fused sparse log-softmax gather is the same math.
+
+Invariant: training with sparse labels must match one-hot training
+exactly (same seed, same data)."""
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _mlp():
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(11).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=12, activation=Activation.RELU))
+            .layer(OutputLayer(n_in=12, n_out=4, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def test_sparse_matches_one_hot_training():
+    rng = np.random.RandomState(0)
+    x = [rng.randn(16, 6).astype(np.float32) for _ in range(5)]
+    c = [rng.randint(0, 4, 16) for _ in range(5)]
+
+    dense = _mlp()
+    for xi, ci in zip(x, c):
+        dense.fit(DataSet(xi, np.eye(4, dtype=np.float32)[ci]))
+
+    sparse = _mlp()
+    for xi, ci in zip(x, c):
+        sparse.fit(DataSet(xi, ci.astype(np.int32)))
+
+    np.testing.assert_allclose(sparse.params(), dense.params(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sparse.score_value, dense.score_value,
+                               rtol=1e-5)
+
+
+def test_sparse_rnn_labels_with_mask():
+    """Time-series sparse labels (B, T) with per-timestep masking."""
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(2).learning_rate(0.1)
+            .list()
+            .layer(GravesLSTM(n_in=5, n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_in=8, n_out=5,
+                                  activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(5))
+            .build())
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6, 5).astype(np.float32)
+    c = rng.randint(0, 5, (4, 6))
+    mask = np.ones((4, 6), np.float32)
+    mask[:, 4:] = 0.0
+
+    a = MultiLayerNetwork(conf)
+    a.init()
+    a.fit(DataSet(x, np.eye(5, dtype=np.float32)[c], labels_mask=mask))
+
+    b = MultiLayerNetwork(conf)
+    b.init()
+    b.fit(DataSet(x, c.astype(np.int32), labels_mask=mask))
+
+    np.testing.assert_allclose(b.params(), a.params(), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_evaluate():
+    rng = np.random.RandomState(3)
+    net = _mlp()
+    x = rng.randn(32, 6).astype(np.float32)
+    c = rng.randint(0, 4, 32).astype(np.int32)
+    net.fit(DataSet(x, c))
+    ev_sparse = net.evaluate(DataSet(x, c))
+    ev_dense = net.evaluate(DataSet(x, np.eye(4, dtype=np.float32)[c]))
+    assert ev_sparse.accuracy() == ev_dense.accuracy()
+    assert ev_sparse.f1() == ev_dense.f1()
+
+
+def test_sparse_labels_rejected_for_non_softmax():
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(4).learning_rate(0.1)
+            .list()
+            .layer(OutputLayer(n_in=6, n_out=4,
+                               activation=Activation.IDENTITY,
+                               loss=LossFunction.MSE))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(5)
+    with pytest.raises(ValueError, match="integer class-id"):
+        net.fit(DataSet(rng.randn(8, 6).astype(np.float32),
+                        rng.randint(0, 4, 8).astype(np.int32)))
+
+
+def test_sparse_label_range_validated():
+    net = _mlp()
+    rng = np.random.RandomState(6)
+    with pytest.raises(ValueError, match="out of range"):
+        net.fit(DataSet(rng.randn(8, 6).astype(np.float32),
+                        np.full(8, 7, np.int32)))  # n_out=4
+
+
+def test_negative_sparse_labels_rejected():
+    net = _mlp()
+    rng = np.random.RandomState(7)
+    labels = rng.randint(0, 4, 8).astype(np.int32)
+    labels[3] = -1
+    with pytest.raises(ValueError, match="out of range"):
+        net.fit(DataSet(rng.randn(8, 6).astype(np.float32), labels))
+
+
+def test_sparse_tbptt_matches_one_hot():
+    """tBPTT accepts sparse (B, T) labels and matches one-hot windows."""
+    def build():
+        conf = (dl4j.NeuralNetConfiguration.Builder()
+                .seed(8).learning_rate(0.1)
+                .list()
+                .layer(GravesLSTM(n_in=4, n_out=6,
+                                  activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_in=6, n_out=4,
+                                      activation=Activation.SOFTMAX,
+                                      loss=LossFunction.MCXENT))
+                .set_input_type(InputType.recurrent(4))
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    rng = np.random.RandomState(9)
+    x = rng.randn(3, 10, 4).astype(np.float32)
+    c = rng.randint(0, 4, (3, 10))
+
+    a = build()
+    a.fit(DataSet(x, np.eye(4, dtype=np.float32)[c]))
+    b = build()
+    b.fit(DataSet(x, c.astype(np.int32)))
+    np.testing.assert_allclose(b.params(), a.params(), rtol=1e-5, atol=1e-6)
+
+
+def test_scan_handles_mixed_label_formats():
+    """fit(scan_steps=K) over an iterator mixing one-hot and sparse label
+    batches must not crash (the stackability signature splits chunks)."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(10)
+    x = [rng.randn(8, 6).astype(np.float32) for _ in range(4)]
+    c = [rng.randint(0, 4, 8) for _ in range(4)]
+    batches = [DataSet(x[0], np.eye(4, dtype=np.float32)[c[0]]),
+               DataSet(x[1], c[1].astype(np.int32)),
+               DataSet(x[2], c[2].astype(np.int32)),
+               DataSet(x[3], np.eye(4, dtype=np.float32)[c[3]])]
+    net = _mlp()
+    net.fit(ListDataSetIterator(batches), scan_steps=2)
+    assert np.isfinite(net.score_value)
+
+
+def test_graph_sparse_labels_validated_and_train():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(12).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=6, n_out=8,
+                                       activation=Activation.RELU), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=4,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf)
+    net.init()
+    rng = np.random.RandomState(13)
+    x = rng.randn(8, 6).astype(np.float32)
+    net.fit(DataSet(x, rng.randint(0, 4, 8).astype(np.int32)))
+    assert np.isfinite(net.score_value)
+    with pytest.raises(ValueError, match="out of range"):
+        net.fit(DataSet(x, np.full(8, 9, np.int32)))
