@@ -301,3 +301,59 @@ class ImagePreProcessingScaler(DataNormalization):
         return (features / self.max_pixel
                 * (self.max_range - self.min_range) + self.min_range)
 
+
+@register_normalizer
+class OneHotEncoder(DataNormalization):
+    """Expand integer category ids to one-hot feature rows: (B,) or (B, T)
+    ids → (..., n_classes) f32.
+
+    No counterpart in the reference (DL4J iterators pre-expand one-hot on
+    the host). As a DEVICE-side normalizer this keeps the host link traffic
+    at one byte per categorical feature — a char-RNN batch's (B, T, V)
+    one-hot input collapses to (B, T) uint8 ids, with the expansion fused
+    into the compiled step."""
+
+    KIND = "one_hot"
+
+    def __init__(self, n_classes: int = 0):
+        self.n_classes = int(n_classes)
+
+    def _meta(self):
+        return {"n_classes": self.n_classes}
+
+    def _arrays(self):
+        return {}
+
+    def fit(self, data):
+        if self.n_classes <= 0:
+            m = 0
+            for ds in _iter_batches(data):
+                m = max(m, int(np.asarray(ds.features).max()))
+            self.n_classes = m + 1
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        if self.n_classes <= 0:
+            raise ValueError("OneHotEncoder needs n_classes (set it or fit)")
+        ids = np.asarray(ds.features).astype(np.int64)
+        ds.features = np.eye(self.n_classes, dtype=np.float32)[ids]
+        return ds
+
+    def revert_features(self, features: np.ndarray) -> np.ndarray:
+        return np.argmax(np.asarray(features), axis=-1)
+
+    supports_device = True
+
+    def device_transform(self, features):
+        import jax
+        import jax.numpy as jnp
+
+        if self.n_classes <= 0:
+            raise ValueError("OneHotEncoder needs n_classes (set it or fit)")
+        # ids arrive cast to the model float dtype (_prep_features); one_hot
+        # wants integer input, the expansion keeps the float dtype
+        out_dtype = (features.dtype
+                     if jnp.issubdtype(features.dtype, jnp.floating)
+                     else jnp.float32)
+        return jax.nn.one_hot(features.astype(jnp.int32), self.n_classes,
+                              dtype=out_dtype)

@@ -67,14 +67,11 @@ class Evaluation:
         # sparse id range check AFTER mask filtering (sentinel ids on
         # masked-out positions are fine); without it, np.add.at would
         # silently wrap negatives into the last confusion row
-        if sparse and actual.size and (int(actual.min()) < 0
-                                       or int(actual.max()) >= self.num_classes):
-            bad = (int(actual.min()) if int(actual.min()) < 0
-                   else int(actual.max()))
-            raise ValueError(
-                f"sparse label id {bad} out of range "
-                f"[0, {self.num_classes}) — mask padded positions with a "
-                "labels mask instead of sentinel ids")
+        if sparse:
+            from deeplearning4j_tpu.ops.losses import check_sparse_label_range
+
+            check_sparse_label_range(actual, self.num_classes,
+                                     where="evaluation")
         np.add.at(self._confusion, (actual, pred), 1)
         if self.record_meta:
             # example_index counts pre-mask flattened positions (row, or

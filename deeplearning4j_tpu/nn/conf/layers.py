@@ -249,8 +249,11 @@ class OutputLayer(DenseLayer):
         if pre.ndim == 3:  # time-distributed: flatten rows, expand mask
             B, T, F = pre.shape
             pre = pre.reshape(B * T, F)
-            # sparse int labels are (B, T); dense one-hot are (B, T, C)
-            labels = (labels.reshape(B * T) if labels.ndim == 2
+            # sparse int labels are (B, T); dense targets — one-hot OR 2-D
+            # float regression targets — keep a feature axis
+            labels = (labels.reshape(B * T)
+                      if labels.ndim == 2
+                      and jnp.issubdtype(labels.dtype, jnp.integer)
                       else labels.reshape(B * T, -1))
             if mask is not None:
                 mask = mask.reshape(B * T)
@@ -299,8 +302,11 @@ class LossLayer(Layer):
         if pre.ndim == 3:
             B, T, F = pre.shape
             pre = pre.reshape(B * T, F)
-            # sparse int labels are (B, T); dense one-hot are (B, T, C)
-            labels = (labels.reshape(B * T) if labels.ndim == 2
+            # sparse int labels are (B, T); dense targets — one-hot OR 2-D
+            # float regression targets — keep a feature axis
+            labels = (labels.reshape(B * T)
+                      if labels.ndim == 2
+                      and jnp.issubdtype(labels.dtype, jnp.integer)
                       else labels.reshape(B * T, -1))
             if mask is not None:
                 mask = mask.reshape(B * T)

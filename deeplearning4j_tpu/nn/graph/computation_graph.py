@@ -422,21 +422,14 @@ class ComputationGraph:
                 f"got {len(mds.labels)} label arrays but graph has "
                 f"{len(self.conf.network_outputs)} outputs "
                 f"({self.conf.network_outputs})")
-        for oname, l in zip(self.conf.network_outputs, mds.labels):
-            larr = np.asarray(l)
-            if not np.issubdtype(larr.dtype, np.integer) or not larr.size:
-                continue
-            # sparse class ids: range-check (same contract as
-            # MultiLayerNetwork — an out-of-range id inside the traced
-            # gather yields NaN, not an error)
-            n_out = getattr(self.conf.nodes[oname].layer, "n_out", None)
-            if n_out and (int(larr.max()) >= n_out or int(larr.min()) < 0):
-                bad = (int(larr.max()) if int(larr.max()) >= n_out
-                       else int(larr.min()))
-                raise ValueError(
-                    f"sparse label id {bad} out of range [0, {n_out}) for "
-                    f"output {oname!r} (mask padded positions with a labels "
-                    "mask instead of sentinel ids)")
+        from deeplearning4j_tpu.ops.losses import check_sparse_label_range
+
+        lmasks = mds.labels_masks or [None] * len(mds.labels)
+        for oname, l, lm in zip(self.conf.network_outputs, mds.labels,
+                                lmasks):
+            check_sparse_label_range(
+                l, getattr(self.conf.nodes[oname].layer, "n_out", None),
+                mask=lm, where=f"output {oname!r}")
 
     def score(self, ds: Union[DataSet, MultiDataSet], train: bool = False) -> float:
         self._ensure_init()

@@ -391,11 +391,14 @@ class MultiLayerNetwork:
                         def _sig(d):
                             # stackability signature: features AND labels
                             # shape/dtype (sparse int vs one-hot may mix in
-                            # one iterator)
-                            la = np.asarray(d.labels)
-                            return (d.features.shape,
-                                    np.asarray(d.features).dtype,
-                                    la.shape, la.dtype)
+                            # one iterator). Attribute probes only — no
+                            # np.asarray, which would round-trip an
+                            # on-device array through the host
+                            f, la = d.features, d.labels
+                            return (getattr(f, "shape", None),
+                                    getattr(f, "dtype", None),
+                                    getattr(la, "shape", None),
+                                    getattr(la, "dtype", None))
 
                         if (ds.features_mask is not None or ds.labels_mask is not None
                                 or (pending and _sig(ds) != _sig(pending[0]))):
@@ -503,18 +506,12 @@ class MultiLayerNetwork:
                              "(use pretrain() for unsupervised training)")
         labels = np.asarray(ds.labels)
         if np.issubdtype(labels.dtype, np.integer):
-            # sparse class-id labels: width check is a range check instead
-            # (negatives included — jnp.take_along_axis would WRAP -1 to the
-            # last class and silently train padding toward it; use a labels
-            # mask for padded positions, not sentinel ids)
-            if n_out and labels.size and (int(labels.max()) >= n_out
-                                          or int(labels.min()) < 0):
-                bad = (int(labels.max()) if int(labels.max()) >= n_out
-                       else int(labels.min()))
-                raise ValueError(
-                    f"sparse label id {bad} out of range [0, {n_out}) for "
-                    "the output layer (mask padded positions with a labels "
-                    "mask instead of sentinel ids)")
+            # sparse class-id labels: width check is a range check instead;
+            # sentinel ids on mask==0 positions are allowed (the loss clamps
+            # the gather, masked rows contribute nothing)
+            from deeplearning4j_tpu.ops.losses import check_sparse_label_range
+
+            check_sparse_label_range(labels, n_out, mask=ds.labels_mask)
             return
         if n_out and labels.shape[-1] != n_out:
             raise ValueError(

@@ -103,8 +103,11 @@ def loss_score(
                     LossFunction.NEGATIVELOGLIKELIHOOD) \
                 and activation == Activation.SOFTMAX:
             ls = jax.nn.log_softmax(preout, axis=-1)
+            # clamp into range: sentinel ids on MASKED positions must stay
+            # harmless (an OOB gather yields NaN, and NaN×0 mask is NaN)
+            idx = jnp.clip(labels, 0, preout.shape[-1] - 1)
             per_row = -jnp.take_along_axis(
-                ls, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+                ls, idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
             return _masked_row_mean(per_row, mask)
         raise ValueError(
             "integer class-id labels require MCXENT/NEGATIVELOGLIKELIHOOD "
@@ -163,3 +166,32 @@ def loss_fn(loss: LossFunction | str):
         return _masked_row_mean(per_row, mask)
 
     return f
+
+
+def check_sparse_label_range(labels, n_classes, mask=None,
+                             where: str = "the output layer") -> None:
+    """Shared validation for sparse class-id labels (used by
+    MultiLayerNetwork, ComputationGraph, and Evaluation): raise a clear
+    error when an id falls outside [0, n_classes) — inside the traced
+    gather an out-of-range id would clamp and silently train the wrong
+    class. Positions where `mask` == 0 are exempt: pad-with-sentinel plus a
+    labels mask is the standard variable-length convention, and masked
+    positions contribute nothing to the (clamped) loss."""
+    import numpy as np
+
+    larr = np.asarray(labels)
+    if (not np.issubdtype(larr.dtype, np.integer) or not larr.size
+            or not n_classes):
+        return
+    if mask is not None:
+        m = np.asarray(mask).astype(bool).reshape(larr.shape)
+        larr = larr[m]
+        if not larr.size:
+            return
+    mx, mn = int(larr.max()), int(larr.min())
+    if mx >= n_classes or mn < 0:
+        bad = mx if mx >= n_classes else mn
+        raise ValueError(
+            f"sparse label id {bad} out of range [0, {n_classes}) for "
+            f"{where} (mask padded positions with a labels mask instead of "
+            "unmasked sentinel ids)")

@@ -312,3 +312,53 @@ def test_fit_label_standardize_rejected_device_side():
     net = _mlp()
     with pytest.raises(ValueError, match="fit_label"):
         net.set_normalizer(norm)
+
+
+def test_one_hot_encoder_device_matches_host():
+    """OneHotEncoder: uint8 ids + device expansion trains identically to
+    host-expanded one-hot features."""
+    from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
+
+    def build():
+        conf = (dl4j.NeuralNetConfiguration.Builder()
+                .seed(21).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=10, n_out=8,
+                                  activation=Activation.RELU))
+                .layer(OutputLayer(n_in=8, n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    rng = np.random.RandomState(0)
+    ids = [rng.randint(0, 10, 16).astype(np.uint8) for _ in range(4)]
+    ys = [np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+          for _ in range(4)]
+    eye = np.eye(10, dtype=np.float32)
+
+    host = build()
+    for i, y in zip(ids, ys):
+        host.fit(DataSet(eye[i], y))
+
+    dev = build()
+    dev.set_normalizer(OneHotEncoder(10))
+    for i, y in zip(ids, ys):
+        dev.fit(DataSet(i, y))
+
+    np.testing.assert_allclose(dev.params(), host.params(), rtol=1e-5,
+                               atol=1e-6)
+    # host-side transform + fit(auto n_classes) + serde round-trip
+    enc = OneHotEncoder().fit(DataSet(ids[0], ys[0]))
+    assert enc.n_classes == int(ids[0].max()) + 1
+    ds = enc.transform(DataSet(ids[0].copy(), ys[0]))
+    np.testing.assert_array_equal(ds.features,
+                                  np.eye(enc.n_classes,
+                                         dtype=np.float32)[ids[0]])
+    np.testing.assert_array_equal(enc.revert_features(ds.features), ids[0])
+    from deeplearning4j_tpu.datasets.normalizers import DataNormalization
+
+    rt = DataNormalization.from_bytes(enc.to_bytes())
+    assert isinstance(rt, OneHotEncoder) and rt.n_classes == enc.n_classes

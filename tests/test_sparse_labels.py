@@ -196,3 +196,60 @@ def test_graph_sparse_labels_validated_and_train():
     assert np.isfinite(net.score_value)
     with pytest.raises(ValueError, match="out of range"):
         net.fit(DataSet(x, np.full(8, 9, np.int32)))
+
+
+def test_masked_sentinel_ids_allowed():
+    """Pad-with-sentinel + labels mask (the standard variable-length
+    convention) trains fine: the loss clamps the gather and masked rows
+    contribute nothing."""
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(14).learning_rate(0.1)
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=6, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_in=6, n_out=4,
+                                  activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(15)
+    x = rng.randn(3, 6, 4).astype(np.float32)
+    c = rng.randint(0, 4, (3, 6)).astype(np.int32)
+    mask = np.ones((3, 6), np.float32)
+    mask[:, 4:] = 0.0
+    c[:, 4:] = -1  # sentinel on padded positions
+    net.fit(DataSet(x, c, labels_mask=mask))
+    assert np.isfinite(net.score_value)
+    # reference run with safe ids on the padded positions: identical
+    c2 = c.copy()
+    c2[:, 4:] = 0
+    ref = MultiLayerNetwork(conf)
+    ref.init()
+    ref.fit(DataSet(x, c2, labels_mask=mask))
+    np.testing.assert_allclose(net.params(), ref.params(), rtol=1e-6)
+
+
+def test_2d_float_regression_targets_not_sparse():
+    """(B, T) FLOAT regression targets keep their feature axis — they must
+    not be mistaken for sparse class ids (which are integer)."""
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(16).learning_rate(0.05)
+            .list()
+            .layer(GravesLSTM(n_in=3, n_out=5, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_in=5, n_out=1,
+                                  activation=Activation.IDENTITY,
+                                  loss=LossFunction.MSE))
+            .set_input_type(InputType.recurrent(3))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(17)
+    x = rng.randn(4, 7, 3).astype(np.float32)
+    y = rng.randn(4, 7, 1).astype(np.float32)
+    # fit() validates label width, so probe the reshape gate via score()
+    # (the path that skips width validation): a 2-D float target must score
+    # identically to its (B, T, 1) view, not be collapsed like sparse ids
+    s3 = net.score(DataSet(x, y))
+    s2 = net.score(DataSet(x, y.reshape(4, 7)))
+    np.testing.assert_allclose(s2, s3, rtol=1e-6)
