@@ -332,10 +332,26 @@ class OneHotEncoder(DataNormalization):
             self.n_classes = m + 1
         return self
 
+    def check_ids(self, ids) -> None:
+        """Raise on out-of-range ids. The device-side `jax.nn.one_hot`
+        SILENTLY emits an all-zero row for an OOB id (and host `np.eye`
+        indexing wraps negatives / raises on large ids) — the fit paths
+        call this so both placements fail loudly and identically."""
+        ids = np.asarray(ids)
+        if not ids.size:
+            return
+        mn, mx = int(ids.min()), int(ids.max())
+        if mn < 0 or mx >= self.n_classes:
+            bad = mn if mn < 0 else mx
+            raise ValueError(
+                f"OneHotEncoder({self.n_classes}): feature id {bad} out of "
+                f"range [0, {self.n_classes})")
+
     def transform(self, ds: DataSet) -> DataSet:
         if self.n_classes <= 0:
             raise ValueError("OneHotEncoder needs n_classes (set it or fit)")
         ids = np.asarray(ds.features).astype(np.int64)
+        self.check_ids(ids)
         ds.features = np.eye(self.n_classes, dtype=np.float32)[ids]
         return ds
 

@@ -422,6 +422,21 @@ class ComputationGraph:
                 f"got {len(mds.labels)} label arrays but graph has "
                 f"{len(self.conf.network_outputs)} outputs "
                 f"({self.conf.network_outputs})")
+        from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
+
+        norms = self._normalizer
+        if norms is not None:
+            if not isinstance(norms, (list, tuple)):
+                norms = [norms] * len(mds.features)
+            for n, f in zip(norms, mds.features):
+                if isinstance(n, OneHotEncoder):
+                    n.check_ids(f)  # device one_hot zero-rows OOB silently
+        self._check_sparse_labels(mds)
+
+    def _check_sparse_labels(self, mds: MultiDataSet) -> None:
+        """Range-check sparse labels (also called from the non-fit score
+        paths — the loss clamps the gather, so an unchecked out-of-range id
+        would score finite-but-wrong)."""
         from deeplearning4j_tpu.ops.losses import check_sparse_label_range
 
         lmasks = mds.labels_masks or [None] * len(mds.labels)
@@ -433,7 +448,9 @@ class ComputationGraph:
 
     def score(self, ds: Union[DataSet, MultiDataSet], train: bool = False) -> float:
         self._ensure_init()
-        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+        mds = self._to_mds(ds)
+        self._check_sparse_labels(mds)
+        inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
         loss, _ = self._loss_pure(self._params, self._layer_state, inputs,
                                   labels, fmasks, lmasks, None, train)
         return float(loss)
@@ -467,7 +484,9 @@ class ComputationGraph:
         """For GradientCheckUtil parity (reference `GradientCheckUtil:194`
         ComputationGraph variant)."""
         self._ensure_init()
-        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+        mds = self._to_mds(ds)
+        self._check_sparse_labels(mds)
+        inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
 
         def lf(p):
             loss, _ = self._loss_pure(p, self._layer_state, inputs, labels,
@@ -483,7 +502,9 @@ class ComputationGraph:
         (same contract as MultiLayerNetwork.score_function). Masks included
         so numeric and analytic losses agree."""
         self._ensure_init()
-        inputs, labels, fmasks, lmasks = self._mds_arrays(self._to_mds(ds))
+        mds = self._to_mds(ds)
+        self._check_sparse_labels(mds)
+        inputs, labels, fmasks, lmasks = self._mds_arrays(mds)
         _, unravel = ravel_pytree(self._params)
 
         @jax.jit

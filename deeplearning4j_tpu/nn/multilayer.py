@@ -394,11 +394,13 @@ class MultiLayerNetwork:
                             # one iterator). Attribute probes only — no
                             # np.asarray, which would round-trip an
                             # on-device array through the host
-                            f, la = d.features, d.labels
-                            return (getattr(f, "shape", None),
-                                    getattr(f, "dtype", None),
-                                    getattr(la, "shape", None),
-                                    getattr(la, "dtype", None))
+                            def probe(a):
+                                if hasattr(a, "shape"):
+                                    return (a.shape, a.dtype)
+                                a = np.asarray(a)  # plain Python sequence
+                                return (a.shape, a.dtype)
+
+                            return probe(d.features) + probe(d.labels)
 
                         if (ds.features_mask is not None or ds.labels_mask is not None
                                 or (pending and _sig(ds) != _sig(pending[0]))):
@@ -499,6 +501,11 @@ class MultiLayerNetwork:
     def _validate_labels(self, ds: DataSet) -> None:
         """Informative input validation (reference analogue:
         `exceptions/TestInvalidInput` error paths)."""
+        from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
+
+        if isinstance(self._normalizer, OneHotEncoder):
+            # device one_hot silently zero-rows an OOB id: fail loudly here
+            self._normalizer.check_ids(ds.features)
         out_layer = self.layers[-1]
         n_out = getattr(out_layer, "n_out", None)
         if ds.labels is None:
@@ -610,9 +617,22 @@ class MultiLayerNetwork:
             acts.append(np.asarray(xx))
         return acts
 
+    def _check_sparse_labels(self, ds: DataSet) -> None:
+        """Range-check sparse labels on the non-fit entry points too — the
+        loss clamps the gather, so without this an out-of-range id would
+        yield a plausible-but-wrong finite score instead of an error."""
+        if ds.labels is None:
+            return
+        from deeplearning4j_tpu.ops.losses import check_sparse_label_range
+
+        check_sparse_label_range(ds.labels,
+                                 getattr(self.layers[-1], "n_out", None),
+                                 mask=ds.labels_mask)
+
     def score(self, ds: DataSet, train: bool = False) -> float:
         """Loss on a dataset without updating (reference `score(DataSet)`)."""
         self._ensure_init()
+        self._check_sparse_labels(ds)
         f, l, fm, lm = self._batch_arrays(ds)
         loss, _ = self._loss_pure(self._params, self._layer_state, f, l, fm, lm,
                                   None, train)
@@ -695,6 +715,7 @@ class MultiLayerNetwork:
         `Model.computeGradientAndScore` / `gradient()` used by
         `GradientCheckUtil.java:62`). Deterministic: no dropout rng."""
         self._ensure_init()
+        self._check_sparse_labels(ds)
         f, l, fm, lm = self._batch_arrays(ds)
 
         def lf(p):
@@ -709,6 +730,7 @@ class MultiLayerNetwork:
         """Jitted flat-params → loss closure over a fixed batch, for the
         gradient-check harness (numeric central differences)."""
         self._ensure_init()
+        self._check_sparse_labels(ds)
         f, l, fm, lm = self._batch_arrays(ds)
         _, unravel = ravel_pytree(self._params)
 

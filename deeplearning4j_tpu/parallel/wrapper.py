@@ -101,6 +101,27 @@ class ParallelWrapper:
             donate_argnums=(0, 1, 2, 3),
         )
 
+    # -- sharded checkpointing ---------------------------------------------
+    def save_checkpoint(self, path) -> None:
+        """Write params/updater/layer state shard-by-shard via orbax — no
+        full-model host gather (see `util/sharded_checkpoint`)."""
+        from deeplearning4j_tpu.util.sharded_checkpoint import (
+            save_sharded_checkpoint,
+        )
+
+        save_sharded_checkpoint(path, self.net)
+
+    def load_checkpoint(self, path) -> None:
+        """Restore onto THIS wrapper's mesh/shardings — a checkpoint saved
+        from a different mesh layout reshards on load."""
+        from deeplearning4j_tpu.util.sharded_checkpoint import (
+            restore_sharded_checkpoint,
+        )
+
+        restore_sharded_checkpoint(
+            path, self.net,
+            shardings=(self._param_sh, self._upd_sh, self._lstate_sh))
+
     # subclass hooks (SequenceParallelWrapper overrides both) --------------
     def _wrap_step(self, step):
         return step

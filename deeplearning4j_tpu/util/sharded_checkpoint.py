@@ -1,0 +1,92 @@
+"""Sharded (multi-chip) checkpointing for distributed training state.
+
+The reference's checkpoint format (`util/ModelSerializer.java:82` — one
+zip with the FULL flat parameter vector) assumes the model fits on, and is
+gathered to, a single host. TPU-native training shards parameters over a
+`jax.sharding.Mesh` (tensor/expert parallelism in `parallel/wrapper.py`),
+where gathering to one host is exactly the bottleneck checkpoints must
+avoid at scale. This module saves each device's shards directly via orbax
+(the JAX ecosystem's async multi-host checkpoint library, the moral
+equivalent of the reference relying on ND4J serde):
+
+    pw = ParallelWrapper(net, mesh=mesh, param_specs=...)
+    pw.fit(...)
+    pw.save_checkpoint("/ckpt/step1000")
+    ...
+    pw2 = ParallelWrapper(net2, mesh=other_mesh, param_specs=...)
+    pw2.load_checkpoint("/ckpt/step1000")   # reshards onto other_mesh
+
+Restore reshards automatically: the target shardings come from the
+RESTORING wrapper, so a checkpoint written on one mesh layout loads onto
+another (or onto more/fewer chips) without an intermediate full-model
+host copy. Updater state (Adam moments etc.) and the iteration clock
+round-trip, so training resumes exactly (the reference's key checkpoint
+property, SURVEY §5)."""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=1)
+def _checkpointer():
+    """One long-lived StandardCheckpointer (orbax's documented pattern) —
+    constructing one per save would churn its async-thread machinery."""
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _state_tree(net) -> Dict[str, Any]:
+    return {
+        "params": net._params,
+        "upd_state": net._upd_state,
+        "layer_state": net._layer_state,
+        "iteration": np.asarray(net.iteration, np.int64),
+        "epoch": np.asarray(net.epoch, np.int64),
+    }
+
+
+def save_sharded_checkpoint(path, net) -> None:
+    """Write the network's training state shard-by-shard (async under the
+    hood; this call blocks until the checkpoint is durable)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(os.fspath(path)), _state_tree(net))
+    ckptr.wait_until_finished()
+
+
+def restore_sharded_checkpoint(path, net, shardings=None) -> None:
+    """Restore in place. `shardings`: optional pytree of NamedShardings
+    matching (params, upd_state, layer_state) — pass the restoring
+    wrapper's shardings to land shards directly on its mesh; omitted, the
+    current placement of `net`'s arrays is reused."""
+    def _abstract(a, sh=None):
+        return jax.ShapeDtypeStruct(
+            a.shape, a.dtype,
+            sharding=sh if sh is not None else getattr(a, "sharding", None))
+
+    if shardings is None:
+        abstract = jax.tree.map(_abstract, _state_tree(net))
+    else:
+        p_sh, u_sh, l_sh = shardings
+        abstract = {
+            "params": jax.tree.map(_abstract, net._params, p_sh),
+            "upd_state": jax.tree.map(_abstract, net._upd_state, u_sh),
+            "layer_state": jax.tree.map(_abstract, net._layer_state, l_sh),
+            "iteration": jax.ShapeDtypeStruct((), np.int64),
+            "epoch": jax.ShapeDtypeStruct((), np.int64),
+        }
+    ckptr = _checkpointer()
+    restored = ckptr.restore(os.path.abspath(os.fspath(path)), abstract)
+    net._params = restored["params"]
+    net._upd_state = restored["upd_state"]
+    net._layer_state = restored["layer_state"]
+    net.iteration = int(restored["iteration"])
+    net.epoch = int(restored["epoch"])
+    # the device iteration counter is carried through the jitted step;
+    # re-seed it from the restored clock
+    net._it_device = None
