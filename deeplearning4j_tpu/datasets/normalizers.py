@@ -32,6 +32,10 @@ class DataNormalization:
 
     KIND = "base"
 
+    # True for transforms that consume raw integer ids (e.g. OneHotEncoder):
+    # the traced input prep skips the model-dtype float cast for these
+    consumes_integer_ids = False
+
     def fit(self, data) -> "DataNormalization":
         raise NotImplementedError
 
@@ -315,6 +319,11 @@ class OneHotEncoder(DataNormalization):
 
     KIND = "one_hot"
 
+    # _prep_features/_prep_inputs must hand this normalizer the RAW id
+    # array (int32 cast only) — a model-dtype float cast first would round
+    # ids above 256 under bf16 before one_hot's int32 cast
+    consumes_integer_ids = True
+
     def __init__(self, n_classes: int = 0):
         self.n_classes = int(n_classes)
 
@@ -366,8 +375,10 @@ class OneHotEncoder(DataNormalization):
 
         if self.n_classes <= 0:
             raise ValueError("OneHotEncoder needs n_classes (set it or fit)")
-        # ids arrive cast to the model float dtype (_prep_features); one_hot
-        # wants integer input, the expansion keeps the float dtype
+        # contract (consumes_integer_ids): ids arrive RAW — integral, or
+        # int32-truncated by the wire — never pre-cast to a narrow float
+        # dtype; the one-hot expansion comes out f32 and the caller casts
+        # it to the model dtype
         out_dtype = (features.dtype
                      if jnp.issubdtype(features.dtype, jnp.floating)
                      else jnp.float32)

@@ -18,6 +18,25 @@ import jax
 import jax.numpy as jnp
 
 
+def require_partitionable_rng() -> None:
+    """The documented mesh-vs-single-chip bit-parity of device-side
+    negative sampling requires the partitionable threefry implementation
+    (sharded draws == single-chip draws). Called when an NS kernel is
+    built — not at import, which would clobber an explicit user setting
+    process-wide just by importing the nlp package."""
+    if not jax.config.jax_threefry_partitionable:
+        import warnings
+
+        warnings.warn(
+            "jax_threefry_partitionable is disabled: sharded negative-"
+            "sampling draws will differ from single-chip draws, so the "
+            "mesh-vs-single-chip parity claim is void. Enable it via "
+            "jax.config.update('jax_threefry_partitionable', True) if you "
+            "need bit-parity. (Not flipped here: the flag is process-"
+            "global and would change RNG streams for unrelated code.)",
+            stacklevel=3)
+
+
 _ROW_CLIP = 1.0  # max L2 norm of one row's aggregated per-batch update
 
 

@@ -212,7 +212,9 @@ class SequenceVectors:
         """Device-side negative-sampling scanned skip-gram step (see
         `kernels.skipgram_ns_scan`). Sharded variant draws are identical to
         the single-chip ones because threefry is partitionable — mesh vs
-        single-chip parity holds bit-for-bit."""
+        single-chip parity holds bit-for-bit (enforced by
+        `kernels.require_partitionable_rng`)."""
+        kernels.require_partitionable_rng()
         if self.mesh is None:
             return kernels.skipgram_ns_scan
         if self._sharded_ns_kernel is None:
@@ -377,7 +379,8 @@ class _PairBatcher:
         """Bulk skip-gram add (NS-only fast path): stages just the
         (center, context) id pairs — negatives, labels, and masks are built
         on device by `skipgram_ns_scan`."""
-        assert self._mode != "generic", "batcher already in generic mode"
+        if self._mode == "generic":
+            raise RuntimeError("batcher already in generic mode")
         self._mode = "pairs"
         B = len(self.center)
         cap = len(self.pair_center)
@@ -400,7 +403,8 @@ class _PairBatcher:
         with hierarchical softmax the targets are built host-side."""
         sv = self.sv
         if sv.negative > 0 and not sv.use_hs:
-            assert self._mode != "generic", "batcher already in generic mode"
+            if self._mode == "generic":
+                raise RuntimeError("batcher already in generic mode")
             self._mode = "pairs"
             row = self.n
             self.pair_center[row] = center
@@ -410,7 +414,8 @@ class _PairBatcher:
             if self.n == len(self.pair_center):
                 self.flush()
             return
-        assert self._mode != "pairs", "batcher already in pairs mode"
+        if self._mode == "pairs":
+            raise RuntimeError("batcher already in pairs mode")
         self._mode = "generic"
         row = self.n
         self.center[row] = center
@@ -424,7 +429,8 @@ class _PairBatcher:
             self.flush()
 
     def add_cbow(self, context: List[int], center: int, alpha: float):
-        assert self._mode != "pairs", "batcher already in pairs mode"
+        if self._mode == "pairs":
+            raise RuntimeError("batcher already in pairs mode")
         self._mode = "generic"
         row = self.n
         self.context[row] = 0

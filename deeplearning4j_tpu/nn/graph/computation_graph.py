@@ -99,7 +99,12 @@ class ComputationGraph:
 
     def _integer_sink_inputs(self) -> set:
         """Names of network inputs whose values reach an integer-id layer
-        (possibly through vertices) — fixpoint over the DAG."""
+        (possibly through vertices) — fixpoint over the DAG. Determined by
+        the static graph config, so computed once and cached (this runs on
+        the per-batch fit path)."""
+        cached = getattr(self, "_int_sinks_cache", None)
+        if cached is not None:
+            return cached
         conf = self.conf
         int_sinks = set()
         for node in conf.nodes.values():
@@ -114,6 +119,7 @@ class ComputationGraph:
                     if new:
                         int_sinks.update(new)
                         changed = True
+        self._int_sinks_cache = int_sinks
         return int_sinks
 
     def _prep_inputs(self, inputs):
@@ -129,10 +135,18 @@ class ComputationGraph:
             if name in int_sinks:  # token ids: never scaled, stay integral
                 out.append(x)
                 continue
+            n = norms[i] if norms is not None else None
+            if n is not None and n.consumes_integer_ids:
+                # id-consuming transform: int32 ids straight in (a bf16
+                # model-dtype cast would round ids above 256 first)
+                x = n.device_transform(x.astype(jnp.int32))
+                out.append(x if x.dtype == self.dtype
+                           else x.astype(self.dtype))
+                continue
             if x.dtype != self.dtype:
                 x = x.astype(self.dtype)
-            if norms is not None and norms[i] is not None:
-                x = norms[i].device_transform(x)
+            if n is not None:
+                x = n.device_transform(x)
             out.append(x)
         return tuple(out)
 
@@ -383,7 +397,8 @@ class ComputationGraph:
         self._ensure_init()
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
-        xs = tuple(wire_asarray(x, self.dtype) for x in inputs)
+        xs = tuple(wire_asarray(x, self.dtype, ids)
+                   for x, ids in zip(inputs, self._inputs_are_ids()))
         if self._jit_output is None:
             def fwd(p, s, xs, rng, train):
                 xs = self._prep_inputs(xs)
@@ -396,10 +411,23 @@ class ComputationGraph:
         outs = self._jit_output(self._params, self._layer_state, xs, rng, train)
         return [np.asarray(o) for o in outs]
 
+    def _inputs_are_ids(self):
+        """Per-input flags: True where the wire must never float-cast
+        (integer-sink/token-id inputs, or an id-consuming normalizer)."""
+        int_sinks = self._integer_sink_inputs()
+        norms = self._normalizer
+        if norms is not None and not isinstance(norms, (list, tuple)):
+            norms = [norms] * len(self.conf.network_inputs)
+        return [name in int_sinks
+                or (norms is not None and norms[i] is not None
+                    and norms[i].consumes_integer_ids)
+                for i, name in enumerate(self.conf.network_inputs)]
+
     def _mds_arrays(self, mds: MultiDataSet):
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
-        inputs = tuple(wire_asarray(f, self.dtype) for f in mds.features)
+        inputs = tuple(wire_asarray(f, self.dtype, ids)
+                       for f, ids in zip(mds.features, self._inputs_are_ids()))
         labels = tuple(wire_asarray(l, self.dtype) for l in mds.labels)
         fmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
                         for m in mds.features_masks)
@@ -428,8 +456,13 @@ class ComputationGraph:
         if norms is not None:
             if not isinstance(norms, (list, tuple)):
                 norms = [norms] * len(mds.features)
-            for n, f in zip(norms, mds.features):
-                if isinstance(n, OneHotEncoder):
+            # integer-sink (token-id) inputs are skipped by _prep_inputs,
+            # so a broadcast encoder never transforms them — don't range-
+            # check their vocab against the encoder's n_classes
+            int_sinks = self._integer_sink_inputs()
+            for name, n, f in zip(self.conf.network_inputs, norms,
+                                  mds.features):
+                if isinstance(n, OneHotEncoder) and name not in int_sinks:
                     n.check_ids(f)  # device one_hot zero-rows OOB silently
         self._check_sparse_labels(mds)
 

@@ -119,10 +119,18 @@ class MultiLayerNetwork:
             # token ids: never scaled/normalized, integral dtypes stay
             # integral (embedding take)
             return features
+        norm = self._normalizer
+        if norm is not None and norm.consumes_integer_ids:
+            # id-consuming transform (OneHotEncoder): hand it int32 ids —
+            # a bf16 model-dtype cast first would round ids above 256 —
+            # then bring the expanded rows to the model dtype
+            features = norm.device_transform(features.astype(jnp.int32))
+            return (features if features.dtype == self.dtype
+                    else features.astype(self.dtype))
         if features.dtype != self.dtype:
             features = features.astype(self.dtype)
-        if self._normalizer is not None:
-            features = self._normalizer.device_transform(features)
+        if norm is not None:
+            features = norm.device_transform(features)
         return features
 
     # ----------------------------------------------------------------- score
@@ -312,10 +320,18 @@ class MultiLayerNetwork:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
 
+    def _features_are_ids(self) -> bool:
+        """Features are integer ids (embedding-style first layer, or an
+        id-consuming normalizer like OneHotEncoder): the wire must never
+        float-cast them to the model dtype."""
+        return (getattr(self.layers[0], "integer_input", False)
+                or (self._normalizer is not None
+                    and self._normalizer.consumes_integer_ids))
+
     def _batch_arrays(self, ds: DataSet):
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
-        f = wire_asarray(ds.features, self.dtype)
+        f = wire_asarray(ds.features, self.dtype, self._features_are_ids())
         # labels ride the same wire policy: sparse int class ids stay int
         # (vocab× fewer bytes than one-hot), floats widen to the model dtype
         l = wire_asarray(ds.labels, self.dtype) if ds.labels is not None else None
@@ -451,7 +467,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
         feats = wire_asarray(np.stack([ds.features for ds in pending]),
-                             self.dtype)
+                             self.dtype, self._features_are_ids())
         labels = wire_asarray(np.stack([ds.labels for ds in pending]),
                               self.dtype)
         if self._it_device is None:
@@ -591,7 +607,7 @@ class MultiLayerNetwork:
         self._ensure_init()
         from deeplearning4j_tpu.nn.precision import wire_asarray
 
-        x = wire_asarray(x, self.dtype)
+        x = wire_asarray(x, self.dtype, self._features_are_ids())
         if self._jit_output is None:
             def fwd(p, s, xx, rng, train):
                 xx = self._prep_features(xx)

@@ -18,7 +18,7 @@ def restore_dtypes(tree, ref_tree):
     return jax.tree.map(lambda a, b: a.astype(b.dtype), tree, ref_tree)
 
 
-def wire_asarray(a, dtype):
+def wire_asarray(a, dtype, as_ids=False):
     """Host→device transfer policy, shared by every fit/scan/output path:
     float features are converted to the model dtype host-side (free — same
     byte count for f32), while compact non-float dtypes (uint8 pixels, int
@@ -34,6 +34,17 @@ def wire_asarray(a, dtype):
     if adtype is None:
         a = np.asarray(a)  # plain Python sequence
         adtype = a.dtype
+    if as_ids:
+        # destined for an integer-id consumer (embedding input or an
+        # id-consuming normalizer): a FLOAT id array must not be cast to a
+        # narrow model dtype (bf16 rounds ids above 256) — truncate to
+        # int32 instead; integral dtypes ship compact as-is. An already-
+        # on-device array casts on device (no host round trip).
+        if jnp.issubdtype(adtype, np.floating):
+            if isinstance(a, jnp.ndarray):
+                return a.astype(jnp.int32)
+            return jnp.asarray(np.asarray(a).astype(np.int32))
+        return jnp.asarray(a)
     if jnp.issubdtype(adtype, np.floating):
         return jnp.asarray(a, dtype)
     return jnp.asarray(a)

@@ -362,3 +362,74 @@ def test_one_hot_encoder_device_matches_host():
 
     rt = DataNormalization.from_bytes(enc.to_bytes())
     assert isinstance(rt, OneHotEncoder) and rt.n_classes == enc.n_classes
+
+
+def test_one_hot_encoder_bf16_ids_above_256_exact():
+    """A bf16-dtype network must hand RAW ids to the OneHotEncoder: a bf16
+    cast first would round ids > 256 (257 → 256) and silently corrupt the
+    expanded category (ADVICE r1)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
+
+    n_cat = 512
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(5).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=n_cat, n_out=8,
+                              activation=Activation.RELU))
+            .layer(OutputLayer(n_in=8, n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf, dtype=jnp.bfloat16)
+    net.init()
+    net.set_normalizer(OneHotEncoder(n_cat))
+    ids = np.array([0, 255, 257, 301, 511], np.int32)
+    prep = np.asarray(net._prep_features(jnp.asarray(ids)))
+    assert prep.dtype == np.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.argmax(prep, axis=-1), ids)
+    # 257 is the first id a bf16 round would corrupt (bf16(257) == 256)
+    assert prep[2, 257] == 1.0 and prep[2, 256] == 0.0
+
+
+def test_graph_broadcast_one_hot_skips_integer_sink_inputs():
+    """A single OneHotEncoder broadcast to a multi-input graph never
+    transforms token-id inputs — label validation must not range-check
+    their vocab against the encoder's n_classes (ADVICE r1)."""
+    from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
+    from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+        MergeVertex,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import EmbeddingLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    n_cat, vocab = 6, 50  # token vocab far larger than the encoder's range
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(11).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("cat", "tok")
+            .add_layer("dc", DenseLayer(n_in=n_cat, n_out=4,
+                                        activation=Activation.RELU), "cat")
+            .add_layer("emb", EmbeddingLayer(n_in=vocab, n_out=4), "tok")
+            .add_vertex("m", MergeVertex(), "dc", "emb")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "m")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf)
+    net.init()
+    net.set_normalizer(OneHotEncoder(n_cat))  # broadcast to both inputs
+    rng = np.random.RandomState(0)
+    cat = rng.randint(0, n_cat, (8,)).astype(np.uint8)
+    tok = rng.randint(n_cat, vocab, (8, 1)).astype(np.int32)  # ids >= n_cat
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    net.fit(MultiDataSet([cat, tok], [y]))  # must not raise
+    assert np.isfinite(net.score_value)
+    # but a genuinely out-of-range CATEGORICAL id still fails loudly
+    bad = cat.copy(); bad[0] = n_cat
+    with pytest.raises(ValueError, match="out of"):
+        net.fit(MultiDataSet([bad, tok], [y]))
