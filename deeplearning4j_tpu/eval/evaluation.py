@@ -1,7 +1,9 @@
-"""Classification evaluation: accuracy/precision/recall/F1/confusion matrix.
+"""Classification evaluation: accuracy/precision/recall/F1/confusion matrix,
+top-N accuracy, FPR/FNR/false-alarm rate, label-named stats report.
 
 Reference: `deeplearning4j-nn/.../eval/Evaluation.java:46` (precision:454,
-recall:502, f1:645, accuracy:659, confusion matrix). Accumulation is
+recall:502, FPR/FNR:522-600, falseAlarmRate:619, f1:645, accuracy:659,
+topNAccuracy:674, stats:352, network conveniences:160-176). Accumulation is
 host-side numpy (cheap vs. the model forward); the heavy part — the model
 forward producing predictions — runs on TPU.
 """
@@ -25,23 +27,55 @@ class Prediction:
 
 
 class Evaluation:
+    """`top_n > 1` additionally tracks top-N accuracy (reference
+    `Evaluation(int topN)` constructor + `topNAccuracy():674`)."""
+
     def __init__(self, num_classes: Optional[int] = None,
                  labels: Optional[List[str]] = None,
-                 record_meta: bool = False):
+                 record_meta: bool = False,
+                 top_n: int = 1):
         self.num_classes = num_classes or (len(labels) if labels else None)
         self.label_names = labels
         self.record_meta = record_meta
+        self.top_n = int(top_n)
+        self._top_n_correct = 0
+        self._top_n_total = 0
         self._predictions: List[Prediction] = []
         self._examples_seen = 0
         self._confusion: Optional[np.ndarray] = None  # [actual, predicted]
 
     # ------------------------------------------------------------------ acc
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None) -> None:
+             mask: Optional[np.ndarray] = None, network=None) -> None:
         """Accumulate a batch. labels/predictions: (N, C) one-hot/probs, or
-        (B, T, C) time series (flattened with mask)."""
+        (B, T, C) time series (flattened with mask), or (N, 1)/(N,) binary
+        probabilities (thresholded at 0.5, two-class confusion — reference
+        `eval`'s single-output branch).
+
+        With `network=`, the second argument is the network INPUT and the
+        predictions are computed by the network's test-mode forward
+        (reference `eval(labels, input, network)` conveniences :160-176)."""
+        if network is not None:
+            out = network.output(predictions, train=False)
+            # ComputationGraph returns one array per network output
+            predictions = out[0] if isinstance(out, (list, tuple)) else out
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        # binary single-output-column case: p(class 1) thresholded at 0.5;
+        # expansion keeps leading dims so (B, T, 1) sequences flow into the
+        # ndim==3 flatten-with-mask path below
+        if predictions.ndim == 1:
+            predictions = predictions.reshape(-1, 1)
+        if predictions.shape[-1] == 1:
+            p1 = predictions.astype(np.float64)
+            predictions = np.concatenate([1.0 - p1, p1], axis=-1)
+            if labels.ndim == predictions.ndim - 1:
+                labels = labels[..., None]
+            if labels.shape[-1] == 1:
+                l1 = labels > 0.5
+                labels = np.concatenate([~l1, l1], axis=-1).astype(np.float64)
+            if self.num_classes is None:
+                self.num_classes = 2
         # sparse labels: integer class ids shaped predictions.shape[:-1]
         sparse = (labels.ndim == predictions.ndim - 1
                   and np.issubdtype(labels.dtype, np.integer))
@@ -73,6 +107,14 @@ class Evaluation:
             check_sparse_label_range(actual, self.num_classes,
                                      where="evaluation")
         np.add.at(self._confusion, (actual, pred), 1)
+        if self.top_n > 1 and predictions.shape[-1] > 1:
+            kept_probs = predictions[keep_idx]
+            true_prob = kept_probs[np.arange(len(actual)), actual]
+            # correct iff fewer than top_n entries are STRICTLY greater
+            # than the true class's probability (reference `eval:295-305`)
+            n_greater = (kept_probs > true_prob[:, None]).sum(axis=-1)
+            self._top_n_correct += int((n_greater < self.top_n).sum())
+            self._top_n_total += len(actual)
         if self.record_meta:
             # example_index counts pre-mask flattened positions (row, or
             # b*T + t for sequences), so it maps back to the evaluated data
@@ -82,6 +124,39 @@ class Evaluation:
                 Prediction(int(a), int(p), base + int(k))
                 for a, p, k in zip(actual, pred, keep_idx))
         self._examples_seen += total
+
+    def merge(self, other: "Evaluation") -> None:
+        """Accumulate another Evaluation's state into this one (reference
+        `Evaluation.merge` — how distributed evaluation combines
+        per-worker results)."""
+        if other._confusion is None:
+            return
+        untouched = self._confusion is None and self._top_n_total == 0
+        if other.top_n != self.top_n:
+            if untouched and self.top_n == 1:
+                self.top_n = other.top_n  # fresh aggregator adopts source's
+            else:
+                raise ValueError(f"cannot merge: top_n {self.top_n} vs "
+                                 f"{other.top_n}")
+        if self.label_names is None:
+            self.label_names = other.label_names
+        if self._confusion is None:
+            self.num_classes = other.num_classes
+            self._confusion = other._confusion.copy()
+        else:
+            if self.num_classes != other.num_classes:
+                raise ValueError(
+                    f"cannot merge: {self.num_classes} vs "
+                    f"{other.num_classes} classes")
+            self._confusion += other._confusion
+        self._top_n_correct += other._top_n_correct
+        self._top_n_total += other._top_n_total
+        if self.record_meta and other.record_meta:
+            base = self._examples_seen
+            self._predictions.extend(
+                Prediction(p.actual, p.predicted, base + p.example_index)
+                for p in other._predictions)
+        self._examples_seen += other._examples_seen
 
     # ----------------------------------------------------- prediction meta
     def _require_meta(self) -> None:
@@ -103,7 +178,7 @@ class Evaluation:
         self._require_meta()
         return [p for p in self._predictions if p.predicted == cls]
 
-    # -------------------------------------------------------------- metrics
+    # -------------------------------------------------------------- counts
     @property
     def confusion_matrix(self) -> np.ndarray:
         return self._confusion if self._confusion is not None else np.zeros((0, 0))
@@ -117,6 +192,18 @@ class Evaluation:
     def false_negatives(self, cls: int) -> int:
         return int(self._confusion[cls, :].sum() - self._confusion[cls, cls])
 
+    def true_negatives(self, cls: int) -> int:
+        """Examples neither labeled nor predicted as `cls` (reference
+        `trueNegatives` counter semantics)."""
+        c = self._confusion
+        return int(c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls])
+
+    def class_label(self, cls: int) -> str:
+        if self.label_names is not None and cls < len(self.label_names):
+            return self.label_names[cls]
+        return str(cls)
+
+    # -------------------------------------------------------------- metrics
     def accuracy(self) -> float:
         if self._confusion is None:
             return 0.0
@@ -124,34 +211,115 @@ class Evaluation:
         total = c.sum()
         return float(np.trace(c)) / total if total else 0.0
 
-    def precision(self, cls: Optional[int] = None) -> float:
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class was among the top-N
+        predicted probabilities (reference `topNAccuracy():674`; equals
+        `accuracy()` for top_n=1)."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        if self._top_n_total == 0:
+            return 0.0
+        return self._top_n_correct / self._top_n_total
+
+    def _avg_excluding_edge(self, per_class) -> float:
+        """Macro-average of a per-class metric, excluding classes whose
+        metric is undefined (0/0 — reference's `-1` edge-case sentinel
+        exclusion in `precision()`/`recall()`/`falsePositiveRate()`)."""
+        vals = [per_class(i) for i in range(self.num_classes)]
+        vals = [v for v in vals if v is not None]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def precision(self, cls: Optional[int] = None, edge: float = 0.0) -> float:
         if self._confusion is None:
             return 0.0
         if cls is not None:
             tp, fp = self.true_positives(cls), self.false_positives(cls)
-            return tp / (tp + fp) if (tp + fp) else 0.0
-        vals = [self.precision(i) for i in range(self.num_classes)
-                if self._confusion[:, i].sum() > 0 or self._confusion[i, :].sum() > 0]
-        return float(np.mean(vals)) if vals else 0.0
+            return tp / (tp + fp) if (tp + fp) else edge
+        return self._avg_excluding_edge(
+            lambda i: self.precision(i) if (self.true_positives(i)
+                                            + self.false_positives(i)) else None)
 
-    def recall(self, cls: Optional[int] = None) -> float:
+    def recall(self, cls: Optional[int] = None, edge: float = 0.0) -> float:
         if self._confusion is None:
             return 0.0
         if cls is not None:
             tp, fn = self.true_positives(cls), self.false_negatives(cls)
-            return tp / (tp + fn) if (tp + fn) else 0.0
-        vals = [self.recall(i) for i in range(self.num_classes)
-                if self._confusion[i, :].sum() > 0]
-        return float(np.mean(vals)) if vals else 0.0
+            return tp / (tp + fn) if (tp + fn) else edge
+        return self._avg_excluding_edge(
+            lambda i: self.recall(i) if (self.true_positives(i)
+                                         + self.false_negatives(i)) else None)
+
+    def false_positive_rate(self, cls: Optional[int] = None,
+                            edge: float = 0.0) -> float:
+        """FP / (FP + TN); class average excludes undefined classes
+        (reference `falsePositiveRate:522`)."""
+        if self._confusion is None:
+            return 0.0
+        if cls is not None:
+            fp, tn = self.false_positives(cls), self.true_negatives(cls)
+            return fp / (fp + tn) if (fp + tn) else edge
+        return self._avg_excluding_edge(
+            lambda i: self.false_positive_rate(i)
+            if (self.false_positives(i) + self.true_negatives(i)) else None)
+
+    def false_negative_rate(self, cls: Optional[int] = None,
+                            edge: float = 0.0) -> float:
+        """FN / (FN + TP) (reference `falseNegativeRate:560`)."""
+        if self._confusion is None:
+            return 0.0
+        if cls is not None:
+            fn, tp = self.false_negatives(cls), self.true_positives(cls)
+            return fn / (fn + tp) if (fn + tp) else edge
+        return self._avg_excluding_edge(
+            lambda i: self.false_negative_rate(i)
+            if (self.false_negatives(i) + self.true_positives(i)) else None)
+
+    def false_alarm_rate(self) -> float:
+        """(FPR + FNR) / 2 (reference `falseAlarmRate():619`)."""
+        return (self.false_positive_rate() + self.false_negative_rate()) / 2.0
 
     def f1(self, cls: Optional[int] = None) -> float:
         p, r = self.precision(cls), self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
-    def stats(self) -> str:
-        lines = [
+    # --------------------------------------------------------------- report
+    def stats(self, suppress_warnings: bool = False) -> str:
+        """Multi-line classification report: label-named confusion lines,
+        excluded-class warnings, and the scores block (reference
+        `stats():352-408`)."""
+        if self._confusion is None:
+            return "Evaluation: no examples seen"
+        lines: List[str] = []
+        warnings: List[str] = []
+        for a in range(self.num_classes):
+            for p in range(self.num_classes):
+                count = int(self._confusion[a, p])
+                if count:
+                    lines.append(
+                        f"Examples labeled as {self.class_label(a)} "
+                        f"classified by model as {self.class_label(p)}: "
+                        f"{count} times")
+            if not suppress_warnings and self.true_positives(a) == 0:
+                if self.false_positives(a) == 0:
+                    warnings.append(
+                        f"Warning: class {self.class_label(a)} was never "
+                        "predicted by the model. This class was excluded "
+                        "from the average precision")
+                if self.false_negatives(a) == 0:
+                    warnings.append(
+                        f"Warning: class {self.class_label(a)} has never "
+                        "appeared as a true label. This class was excluded "
+                        "from the average recall")
+        lines.append("")
+        lines.extend(warnings)
+        lines += [
             "==========================Scores========================================",
             f" Accuracy:  {self.accuracy():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f" Top {self.top_n} Accuracy:  "
+                         f"{self.top_n_accuracy():.4f}")
+        lines += [
             f" Precision: {self.precision():.4f}",
             f" Recall:    {self.recall():.4f}",
             f" F1 Score:  {self.f1():.4f}",

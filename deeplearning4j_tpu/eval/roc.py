@@ -11,6 +11,17 @@ from typing import Optional
 import numpy as np
 
 
+def _dedup_trapezoid(x: np.ndarray, y: np.ndarray) -> float:
+    """Trapezoidal area under (x, y) points after collapsing duplicate x
+    values to their max y (best operating point at that x)."""
+    best: dict = {}
+    for xi, yi in zip(x, y):
+        best[float(xi)] = max(best.get(float(xi), 0.0), float(yi))
+    xs = np.array(sorted(best))
+    ys = np.array([best[xi] for xi in xs])
+    return float(abs(np.trapezoid(ys, xs)))
+
+
 class ROC:
     """Binary ROC. Labels: (N,) {0,1} or (N,2) one-hot; probs likewise."""
 
@@ -42,10 +53,33 @@ class ROC:
         fpr = self._fp / max(self._neg, 1)
         return fpr, tpr
 
+    def get_precision_recall_curve(self):
+        """(thresholds, precision, recall) at each threshold step
+        (reference `ROC.getPrecisionRecallCurve` — the repo exposes the
+        same thresholded counts as a PR curve alongside the ROC curve).
+        Precision at thresholds with zero predicted positives is defined
+        as 1.0 (nothing claimed, nothing wrong)."""
+        predicted_pos = self._tp + self._fp
+        precision = np.where(predicted_pos > 0,
+                             self._tp / np.maximum(predicted_pos, 1), 1.0)
+        recall = self._tp / max(self._pos, 1)
+        return self.thresholds, precision, recall
+
     def calculate_auc(self) -> float:
+        """Trapezoidal AUC keeping the best TPR at each distinct FPR —
+        several thresholds can share an FPR (coarse threshold grids on
+        well-separated scores), and the curve's value there is the best
+        operating point, not whichever threshold sorted last."""
         fpr, tpr = self.get_roc_curve()
-        order = np.argsort(fpr, kind="stable")
-        return float(abs(np.trapezoid(tpr[order], fpr[order])))
+        return _dedup_trapezoid(fpr, tpr)
+
+    def calculate_auprc(self) -> float:
+        """Area under the precision-recall curve: trapezoidal over the
+        thresholded points, recall-ordered, keeping the BEST precision at
+        each distinct recall level (several thresholds can share a recall;
+        the curve's value there is the best operating point)."""
+        _, precision, recall = self.get_precision_recall_curve()
+        return _dedup_trapezoid(recall, precision)
 
 
 class ROCMultiClass:

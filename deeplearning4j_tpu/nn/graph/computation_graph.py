@@ -126,17 +126,17 @@ class ComputationGraph:
         """Traced input prep (mirrors `MultiLayerNetwork._prep_features`):
         cast compact wire dtypes to the model dtype (integer-id inputs stay
         integral) and apply the attached device-side normalizer(s)."""
-        int_sinks = self._integer_sink_inputs()
+        modes = self._input_wire_modes()
         norms = self._normalizer
         if norms is not None and not isinstance(norms, (list, tuple)):
             norms = [norms] * len(self.conf.network_inputs)
         out = []
-        for i, (name, x) in enumerate(zip(self.conf.network_inputs, inputs)):
-            if name in int_sinks:  # token ids: never scaled, stay integral
+        for i, (mode, x) in enumerate(zip(modes, inputs)):
+            if mode == "sink":  # token ids: never scaled, stay integral
                 out.append(x)
                 continue
             n = norms[i] if norms is not None else None
-            if n is not None and n.consumes_integer_ids:
+            if mode == "ids":
                 # id-consuming transform: int32 ids straight in (a bf16
                 # model-dtype cast would round ids above 256 first)
                 x = n.device_transform(x.astype(jnp.int32))
@@ -411,17 +411,30 @@ class ComputationGraph:
         outs = self._jit_output(self._params, self._layer_state, xs, rng, train)
         return [np.asarray(o) for o in outs]
 
-    def _inputs_are_ids(self):
-        """Per-input flags: True where the wire must never float-cast
-        (integer-sink/token-id inputs, or an id-consuming normalizer)."""
+    def _input_wire_modes(self):
+        """Per-input wire/prep mode — the single source of truth consumed
+        by BOTH the wire (`wire_asarray as_ids`) and the traced input prep,
+        so the two can't drift: 'sink' (token ids pass straight through to
+        an integer-id layer), 'ids' (id-consuming normalizer expands raw
+        int32 ids), 'float' (cast to model dtype + optional normalizer)."""
         int_sinks = self._integer_sink_inputs()
         norms = self._normalizer
         if norms is not None and not isinstance(norms, (list, tuple)):
             norms = [norms] * len(self.conf.network_inputs)
-        return [name in int_sinks
-                or (norms is not None and norms[i] is not None
-                    and norms[i].consumes_integer_ids)
-                for i, name in enumerate(self.conf.network_inputs)]
+        modes = []
+        for i, name in enumerate(self.conf.network_inputs):
+            n = norms[i] if norms is not None else None
+            if name in int_sinks:
+                modes.append("sink")
+            elif n is not None and n.consumes_integer_ids:
+                modes.append("ids")
+            else:
+                modes.append("float")
+        return modes
+
+    def _inputs_are_ids(self):
+        """Per-input flags: True where the wire must never float-cast."""
+        return [m != "float" for m in self._input_wire_modes()]
 
     def _mds_arrays(self, mds: MultiDataSet):
         from deeplearning4j_tpu.nn.precision import wire_asarray
@@ -488,16 +501,18 @@ class ComputationGraph:
                                   labels, fmasks, lmasks, None, train)
         return float(loss)
 
-    def evaluate(self, iterator) -> "Evaluation":
+    def evaluate(self, iterator, labels=None, top_n: int = 1) -> "Evaluation":
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(labels=labels, top_n=top_n)
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = ListDataSetIterator([iterator])
         for ds in iterator:
             mds = self._to_mds(ds)
             out = self.output(*mds.features)
-            ev.eval(mds.labels[0], out[0])
+            lmask = (mds.labels_masks[0]
+                     if mds.labels_masks is not None else None)
+            ev.eval(mds.labels[0], out[0], mask=lmask)
         return ev
 
     # ---------------------------------------------------- params / checks

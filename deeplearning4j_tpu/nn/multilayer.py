@@ -115,22 +115,23 @@ class MultiLayerNetwork:
         """Traced input prep: cast compact wire dtypes to the model dtype
         and apply the attached device-side normalizer (both fuse into the
         first layer's XLA computation)."""
-        if getattr(self.layers[0], "integer_input", False):
+        mode = self._feature_wire_mode()
+        if mode == "sink":
             # token ids: never scaled/normalized, integral dtypes stay
             # integral (embedding take)
             return features
-        norm = self._normalizer
-        if norm is not None and norm.consumes_integer_ids:
+        if mode == "ids":
             # id-consuming transform (OneHotEncoder): hand it int32 ids —
             # a bf16 model-dtype cast first would round ids above 256 —
             # then bring the expanded rows to the model dtype
-            features = norm.device_transform(features.astype(jnp.int32))
+            features = self._normalizer.device_transform(
+                features.astype(jnp.int32))
             return (features if features.dtype == self.dtype
                     else features.astype(self.dtype))
         if features.dtype != self.dtype:
             features = features.astype(self.dtype)
-        if norm is not None:
-            features = norm.device_transform(features)
+        if self._normalizer is not None:
+            features = self._normalizer.device_transform(features)
         return features
 
     # ----------------------------------------------------------------- score
@@ -320,13 +321,22 @@ class MultiLayerNetwork:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
 
+    def _feature_wire_mode(self) -> str:
+        """Wire/prep mode for the feature array — single source of truth
+        consumed by BOTH the wire (`wire_asarray as_ids`) and the traced
+        `_prep_features`, so the two can't drift: 'sink' (integer-id first
+        layer, ids pass straight through), 'ids' (id-consuming normalizer
+        expands raw int32 ids), 'float' (model-dtype cast + normalizer)."""
+        if getattr(self.layers[0], "integer_input", False):
+            return "sink"
+        if (self._normalizer is not None
+                and self._normalizer.consumes_integer_ids):
+            return "ids"
+        return "float"
+
     def _features_are_ids(self) -> bool:
-        """Features are integer ids (embedding-style first layer, or an
-        id-consuming normalizer like OneHotEncoder): the wire must never
-        float-cast them to the model dtype."""
-        return (getattr(self.layers[0], "integer_input", False)
-                or (self._normalizer is not None
-                    and self._normalizer.consumes_integer_ids))
+        """True when the wire must never float-cast the features."""
+        return self._feature_wire_mode() != "float"
 
     def _batch_arrays(self, ds: DataSet):
         from deeplearning4j_tpu.nn.precision import wire_asarray
@@ -657,11 +667,13 @@ class MultiLayerNetwork:
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.argmax(self.output(x), axis=-1)
 
-    def evaluate(self, iterator: Union[DataSetIterator, DataSet]):
-        """Classification evaluation (reference `evaluate:2365`)."""
+    def evaluate(self, iterator: Union[DataSetIterator, DataSet],
+                 labels: Optional[List[str]] = None, top_n: int = 1):
+        """Classification evaluation (reference `evaluate:2365`;
+        `evaluate(iterator, labelsList, topN)` overload)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(labels=labels, top_n=top_n)
         if isinstance(iterator, DataSet):
             iterator = ListDataSetIterator([iterator])
         for ds in iterator:

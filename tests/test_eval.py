@@ -1,0 +1,233 @@
+"""Evaluation metric depth — ports the assertion patterns of the
+reference's `deeplearning4j-core/src/test/.../eval/EvalTest.java`
+(hand-computed confusion counts, topN, FPR/FNR, label-named stats) against
+the numpy accumulator.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.roc import ROC
+
+
+def _one_hot(ids, n):
+    return np.eye(n, dtype=np.float32)[np.asarray(ids)]
+
+
+def test_counts_and_rates_hand_computed():
+    # actual:    0 0 0 1 1 2
+    # predicted: 0 1 0 1 1 0
+    actual = [0, 0, 0, 1, 1, 2]
+    pred = [0, 1, 0, 1, 1, 0]
+    ev = Evaluation(num_classes=3)
+    probs = _one_hot(pred, 3) * 0.8 + 0.1  # argmax == pred
+    ev.eval(_one_hot(actual, 3), probs)
+
+    assert ev.true_positives(0) == 2
+    assert ev.false_positives(0) == 1   # the class-2 example predicted 0
+    assert ev.false_negatives(0) == 1   # the 0 predicted as 1
+    assert ev.true_negatives(0) == 2
+    assert ev.true_positives(1) == 2
+    assert ev.false_positives(1) == 1
+    assert ev.false_negatives(1) == 0
+    assert ev.true_positives(2) == 0
+    assert ev.false_negatives(2) == 1
+    assert ev.false_positives(2) == 0
+
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    # per-class rates
+    assert ev.precision(0) == pytest.approx(2 / 3)
+    assert ev.recall(0) == pytest.approx(2 / 3)
+    assert ev.false_positive_rate(0) == pytest.approx(1 / 3)
+    assert ev.false_negative_rate(0) == pytest.approx(1 / 3)
+    assert ev.false_negative_rate(1) == pytest.approx(0.0)
+    assert ev.false_negative_rate(2) == pytest.approx(1.0)
+    # macro averages exclude 0/0-undefined classes (reference edge-case
+    # sentinel): precision average excludes class 2 (never predicted)
+    assert ev.precision() == pytest.approx((2 / 3 + 2 / 3) / 2)
+    # recall average includes all three (each appears as a true label)
+    assert ev.recall() == pytest.approx((2 / 3 + 1.0 + 0.0) / 3)
+    assert ev.false_alarm_rate() == pytest.approx(
+        (ev.false_positive_rate() + ev.false_negative_rate()) / 2)
+
+
+def test_top_n_accuracy():
+    ev = Evaluation(num_classes=4, top_n=2)
+    labels = _one_hot([0, 1, 2, 3], 4)
+    probs = np.array([
+        [0.6, 0.3, 0.05, 0.05],   # top-1 correct
+        [0.5, 0.4, 0.05, 0.05],   # true class 2nd -> top-2 correct
+        [0.4, 0.3, 0.2, 0.1],     # true class 3rd -> top-2 wrong
+        [0.1, 0.2, 0.3, 0.4],     # top-1 correct
+    ], np.float32)
+    ev.eval(labels, probs)
+    assert ev.accuracy() == pytest.approx(2 / 4)
+    assert ev.top_n_accuracy() == pytest.approx(3 / 4)
+    assert "Top 2 Accuracy" in ev.stats()
+    # top_n=1 degenerates to plain accuracy
+    ev1 = Evaluation(num_classes=4)
+    ev1.eval(labels, probs)
+    assert ev1.top_n_accuracy() == ev1.accuracy()
+
+
+def test_binary_single_column():
+    """(N, 1) probabilities threshold at 0.5 into a 2-class confusion
+    (reference eval()'s single-output branch)."""
+    ev = Evaluation()
+    labels = np.array([[1], [1], [0], [0], [1]], np.float32)
+    probs = np.array([[0.9], [0.4], [0.2], [0.7], [0.8]], np.float32)
+    ev.eval(labels, probs)
+    assert ev.num_classes == 2
+    assert ev.true_positives(1) == 2
+    assert ev.false_negatives(1) == 1
+    assert ev.false_positives(1) == 1
+    assert ev.true_negatives(1) == 1
+    assert ev.accuracy() == pytest.approx(3 / 5)
+
+
+def test_label_named_stats_and_warnings():
+    names = ["cat", "dog", "bird"]
+    ev = Evaluation(labels=names)
+    ev.eval(_one_hot([0, 0, 1], 3), _one_hot([0, 1, 1], 3))
+    s = ev.stats()
+    assert "Examples labeled as cat classified by model as cat: 1 times" in s
+    assert "Examples labeled as cat classified by model as dog: 1 times" in s
+    # bird never predicted AND never a true label -> both warnings
+    assert "class bird was never predicted" in s
+    assert "class bird has never appeared" in s
+    assert "never predicted" not in ev.stats(suppress_warnings=True)
+    assert ev.class_label(2) == "bird"
+    assert Evaluation().class_label(2) == "2"
+
+
+def test_eval_with_network_convenience():
+    """eval(labels, input, network=net) computes predictions via the
+    network's test-mode forward (reference conveniences :160-176)."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_in=8, n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(10, 4).astype(np.float32)
+    y = _one_hot(rng.randint(0, 3, 10), 3)
+    ev = Evaluation()
+    ev.eval(y, x, network=net)
+    ev2 = Evaluation()
+    ev2.eval(y, net.output(x))
+    np.testing.assert_array_equal(ev.confusion_matrix, ev2.confusion_matrix)
+
+
+def test_merge():
+    a, b = Evaluation(num_classes=3, top_n=2), Evaluation(num_classes=3, top_n=2)
+    labels = _one_hot([0, 1, 2, 0], 3)
+    probs = np.array([[.5, .3, .2], [.2, .5, .3], [.4, .35, .25], [.3, .5, .2]],
+                     np.float32)
+    a.eval(labels[:2], probs[:2])
+    b.eval(labels[2:], probs[2:])
+    merged = Evaluation(num_classes=3, top_n=2)
+    merged.merge(a)
+    merged.merge(b)
+    whole = Evaluation(num_classes=3, top_n=2)
+    whole.eval(labels, probs)
+    np.testing.assert_array_equal(merged.confusion_matrix,
+                                  whole.confusion_matrix)
+    assert merged.top_n_accuracy() == whole.top_n_accuracy()
+    assert merged._examples_seen == whole._examples_seen
+
+
+def test_evaluate_top_n_through_network():
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_in=8, n_out=5, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(1)
+    ds = DataSet(rng.rand(20, 4).astype(np.float32),
+                 _one_hot(rng.randint(0, 5, 20), 5))
+    ev = net.evaluate(ds, labels=list("abcde"), top_n=3)
+    assert 0.0 <= ev.accuracy() <= ev.top_n_accuracy() <= 1.0
+    assert ev.label_names == list("abcde")
+
+
+def test_roc_precision_recall_curve():
+    roc = ROC(threshold_steps=10)
+    rng = np.random.RandomState(0)
+    # well-separated scores: positives ~0.9, negatives ~0.1
+    labels = np.array([1] * 50 + [0] * 50)
+    probs = np.concatenate([rng.uniform(0.8, 1.0, 50),
+                            rng.uniform(0.0, 0.2, 50)])
+    roc.eval(labels, probs)
+    thresholds, precision, recall = roc.get_precision_recall_curve()
+    assert thresholds.shape == precision.shape == recall.shape
+    # at threshold 0 everything is predicted positive
+    assert recall[0] == pytest.approx(1.0)
+    assert precision[0] == pytest.approx(0.5)
+    # at threshold 0.5 separation is perfect
+    mid = np.searchsorted(thresholds, 0.5)
+    assert precision[mid] == pytest.approx(1.0)
+    assert recall[mid] == pytest.approx(1.0)
+    # beyond every score, nothing predicted: precision defined as 1.0
+    assert precision[-1] == pytest.approx(1.0)
+    assert recall[-1] == pytest.approx(0.0)
+    assert roc.calculate_auprc() > 0.95
+    assert roc.calculate_auc() > 0.95
+
+
+def test_time_series_mask_still_works():
+    ev = Evaluation(num_classes=2)
+    labels = _one_hot([[0, 1, 0], [1, 1, 0]], 2)          # (2, 3, 2)
+    probs = _one_hot([[0, 1, 1], [1, 0, 0]], 2) * 0.9 + 0.05
+    mask = np.array([[1, 1, 0], [1, 1, 1]], np.float32)   # drop one step
+    ev.eval(labels, probs, mask=mask)
+    assert int(ev.confusion_matrix.sum()) == 5
+    assert ev.accuracy() == pytest.approx(4 / 5)
+
+
+def test_binary_time_series_with_mask():
+    """(B, T, 1) sigmoid sequence outputs flow through the binary
+    expansion into the masked flatten path."""
+    ev = Evaluation()
+    labels = np.array([[[1], [0], [1]], [[0], [1], [0]]], np.float32)
+    probs = np.array([[[.9], [.2], [.4]], [[.1], [.8], [.9]]], np.float32)
+    mask = np.array([[1, 1, 1], [1, 1, 0]], np.float32)
+    ev.eval(labels, probs, mask=mask)
+    assert ev.num_classes == 2
+    assert int(ev.confusion_matrix.sum()) == 5
+    assert ev.accuracy() == pytest.approx(4 / 5)
+
+
+def test_merge_adopts_and_validates_top_n():
+    worker = Evaluation(num_classes=3, labels=["a", "b", "c"], top_n=2)
+    worker.eval(_one_hot([0, 1], 3),
+                np.array([[.5, .4, .1], [.2, .5, .3]], np.float32))
+    agg = Evaluation()  # fresh default aggregator adopts worker settings
+    agg.merge(worker)
+    assert agg.top_n == 2
+    assert agg.label_names == ["a", "b", "c"]
+    assert agg.top_n_accuracy() == worker.top_n_accuracy()
+    other = Evaluation(num_classes=3, top_n=5)
+    other.eval(_one_hot([2], 3), np.array([[.1, .2, .7]], np.float32))
+    with pytest.raises(ValueError, match="top_n"):
+        agg.merge(other)
