@@ -59,7 +59,10 @@ class ComputationGraph:
         self._score = None
         self._it_device: Optional[jnp.ndarray] = None
         self._jit_train = None
+        self._jit_scan = None
         self._jit_output = None
+        self._jit_rnn_step = None
+        self._rnn_state: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         self._normalizer = None
 
     # ------------------------------------------------------- normalization
@@ -121,6 +124,45 @@ class ComputationGraph:
                         changed = True
         self._int_sinks_cache = int_sinks
         return int_sinks
+
+    def _temporal_token_inputs(self) -> set:
+        """Names of network inputs whose (B, T) integer ids are a TIME
+        sequence — they feed a sequence-consuming id layer (integer_input
+        AND input_kind == 'rnn', e.g. TokenEmbedding). Distinguishes them
+        from static id inputs to feed-forward EmbeddingLayers, whose
+        (B, K) axis is features, not time."""
+        cached = getattr(self, "_temporal_tok_cache", None)
+        if cached is not None:
+            return cached
+        conf = self.conf
+        toks = set()
+        for node in conf.nodes.values():
+            if (node.is_layer and getattr(node.layer, "integer_input", False)
+                    and node.layer.input_kind == "rnn"):
+                toks.update(node.inputs)
+        changed = True
+        while changed:
+            changed = False
+            for name, node in conf.nodes.items():
+                if name in toks and not node.is_layer:
+                    new = set(node.inputs) - toks
+                    if new:
+                        toks.update(new)
+                        changed = True
+        toks &= set(conf.network_inputs)
+        self._temporal_tok_cache = toks
+        return toks
+
+    def _temporal_feature_flags(self, features) -> List[bool]:
+        """Per-input: does this feature array carry a time axis? 3-D
+        always; (B, T) integer ids only when the input feeds a
+        sequence-id layer (see `_temporal_token_inputs`)."""
+        toks = self._temporal_token_inputs()
+        flags = []
+        for name, f in zip(self.conf.network_inputs, features):
+            a = np.ndim(f)
+            flags.append(a == 3 or (a == 2 and name in toks))
+        return flags
 
     def _prep_inputs(self, inputs):
         """Traced input prep (mirrors `MultiLayerNetwork._prep_features`):
@@ -328,8 +370,15 @@ class ComputationGraph:
             features_masks=[ds.features_mask] if ds.features_mask is not None else None,
             labels_masks=[ds.labels_mask] if ds.labels_mask is not None else None)
 
-    def fit(self, data, epochs: int = 1) -> None:
-        """Train (reference `ComputationGraph.fit:670`)."""
+    def fit(self, data, epochs: int = 1, scan_steps: int = 1) -> None:
+        """Train (reference `ComputationGraph.fit:670`).
+
+        `scan_steps=K` stacks K uniform mask-free batches into ONE
+        `lax.scan`-rolled dispatch (same dispatch-amortization as
+        `MultiLayerNetwork.fit(scan_steps=...)` — multi-output models get
+        the same remote-chip latency win). With `t_bptt_forward_length`
+        set, 3-D (temporal) batches train via truncated BPTT
+        (reference `ComputationGraph.java:707` doTruncatedBPTT)."""
         self._ensure_init()
         if isinstance(data, (DataSet, MultiDataSet)):
             iterator = ListDataSetIterator([data])
@@ -344,15 +393,48 @@ class ComputationGraph:
             self._jit_train = jax.jit(self.train_step_fn(),
                                       donate_argnums=(0, 1, 2, 3))
         self._it_device = jnp.asarray(self.iteration, jnp.int32)
+        tbptt = self.conf.tbptt_fwd_length > 0
+        scan = scan_steps > 1 and not tbptt
+        if scan and self.listeners:
+            # per-iteration listeners observe model state; inside a scanned
+            # chunk intermediate states never materialize (see
+            # MultiLayerNetwork.fit)
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").info(
+                "scan_steps disabled: %d listener(s) attached need "
+                "per-iteration model state", len(self.listeners))
+            scan = False
         try:
             for _ in range(epochs):
                 for listener in self.listeners:
                     if hasattr(listener, "on_epoch_start"):
                         listener.on_epoch_start(self)
                 n_batches = 0
+                pending: List[MultiDataSet] = []
                 for ds in iterator:
                     n_batches += 1
-                    self._fit_batch(self._to_mds(ds))
+                    mds = self._to_mds(ds)
+                    if tbptt and any(self._temporal_feature_flags(mds.features)):
+                        self._fit_tbptt(mds)
+                    elif scan:
+                        if (mds.features_masks is not None
+                                or mds.labels_masks is not None
+                                or (pending
+                                    and self._mds_sig(mds)
+                                    != self._mds_sig(pending[0]))):
+                            self._flush_scan(pending)
+                            pending = []
+                            self._fit_batch(mds)
+                            continue
+                        pending.append(mds)
+                        if len(pending) == scan_steps:
+                            self._flush_scan(pending)
+                            pending = []
+                    else:
+                        self._fit_batch(mds)
+                if scan and pending:
+                    self._flush_scan(pending)
                 if n_batches == 0:
                     import logging
 
@@ -389,6 +471,348 @@ class ComputationGraph:
             if hasattr(listener, "record_batch"):
                 listener.record_batch(int(mds.features[0].shape[0]))
             listener.iteration_done(self, self.iteration)
+
+    # -------------------------------------------------------- scanned fit
+    @staticmethod
+    def _mds_sig(mds: MultiDataSet):
+        """Stackability signature: shapes/dtypes of every input and label."""
+        def probe(a):
+            if hasattr(a, "shape"):
+                return (a.shape, a.dtype)
+            a = np.asarray(a)
+            return (a.shape, a.dtype)
+
+        return (tuple(probe(f) for f in mds.features)
+                + tuple(probe(l) for l in mds.labels))
+
+    def _make_scan_train(self):
+        """K batches rolled into one `lax.scan` dispatch (multi-output
+        analog of `MultiLayerNetwork._make_scan_train`): amortizes the
+        per-dispatch host-link latency across K train steps."""
+        step = self.train_step_fn()
+
+        def multi(params, upd, lstate, iteration, feats, labels):
+            def body(carry, batch):
+                params, upd, lstate, it = carry
+                f, l = batch
+                params, upd, lstate, it, loss = step(
+                    params, upd, lstate, it, f, l, None, None)
+                return (params, upd, lstate, it), loss
+
+            (params, upd, lstate, iteration), losses = jax.lax.scan(
+                body, (params, upd, lstate, iteration), (feats, labels))
+            return params, upd, lstate, iteration, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
+
+    def _flush_scan(self, pending: List[MultiDataSet]) -> None:
+        if not pending:
+            return
+        if len(pending) == 1:
+            self._fit_batch(pending[0])
+            return
+        for mds in pending:
+            self._validate_labels(mds)
+        if self._jit_scan is None:
+            self._jit_scan = self._make_scan_train()
+        from deeplearning4j_tpu.nn.precision import wire_asarray
+
+        ids_flags = self._inputs_are_ids()
+        feats = tuple(
+            wire_asarray(np.stack([np.asarray(m.features[i]) for m in pending]),
+                         self.dtype, ids_flags[i])
+            for i in range(len(self.conf.network_inputs)))
+        labels = tuple(
+            wire_asarray(np.stack([np.asarray(m.labels[o]) for m in pending]),
+                         self.dtype)
+            for o in range(len(self.conf.network_outputs)))
+        if self._it_device is None:
+            self._it_device = jnp.asarray(self.iteration, jnp.int32)
+        (self._params, self._upd_state, self._layer_state, self._it_device,
+         losses) = self._jit_scan(
+            self._params, self._upd_state, self._layer_state,
+            self._it_device, feats, labels)
+        self._score = losses[-1]
+        self._last_batch = pending[-1]
+        self.iteration += len(pending)
+
+    # ------------------------------------------------------------- tBPTT
+    def _recurrent_layer_nodes(self) -> List[str]:
+        """Layer nodes that carry streaming (h, c) state — exactly
+        GravesLSTM (bidirectional needs the full sequence, so it cannot
+        stream/carry; reference behaves the same)."""
+        from deeplearning4j_tpu.nn.conf.layers import GravesLSTM
+
+        return [name for name, node in self.conf.nodes.items()
+                if node.is_layer and type(node.layer) is GravesLSTM]
+
+    def _fit_tbptt(self, mds: MultiDataSet) -> None:
+        """Truncated BPTT over the DAG (reference
+        `ComputationGraph.java:707` doTruncatedBPTT): slice the time axis
+        of every temporal input/label into `tbptt_fwd_length` windows,
+        carrying each GravesLSTM node's (h, c) across windows; the tail
+        window is padded + masked to keep ONE compiled window shape."""
+        fwd_len = self.conf.tbptt_fwd_length
+        tflags = self._temporal_feature_flags(mds.features)
+        t_lens = {np.asarray(f).shape[1]
+                  for f, tf in zip(mds.features, tflags) if tf}
+        if len(t_lens) != 1:
+            raise ValueError(
+                "truncated BPTT requires all temporal inputs to share "
+                f"one sequence length; got lengths {sorted(t_lens)}")
+        T = t_lens.pop()
+        B = np.asarray(mds.features[0]).shape[0]
+        for o, l in zip(self.conf.network_outputs, mds.labels):
+            arr = np.asarray(l)
+            sparse = np.issubdtype(arr.dtype, np.integer) and arr.ndim == 2
+            if arr.ndim != 3 and not sparse:
+                raise ValueError(
+                    f"truncated BPTT requires per-timestep labels for output "
+                    f"{o!r}: one-hot (batch, time, nOut) or sparse int "
+                    f"(batch, time); got shape {arr.shape}")
+        # seed transient (h, c) carries into the LSTM nodes' state slots
+        saved = {}
+        for name in self._recurrent_layer_nodes():
+            n = self.conf.nodes[name].layer.n_out
+            saved[name] = self._layer_state[name]
+            self._layer_state[name] = {"h": jnp.zeros((B, n), self.dtype),
+                                       "c": jnp.zeros((B, n), self.dtype)}
+
+        def slice_time(a, lo, hi, pad, temporal):
+            a = np.asarray(a)
+            if not temporal:
+                return a  # static (non-temporal) input rides every window
+            w = a[:, lo:hi]
+            if pad:
+                w = np.concatenate([w, np.zeros_like(a[:, :pad])], axis=1)
+            return w
+
+        n_windows = (T + fwd_len - 1) // fwd_len
+        losses = []
+        for w in range(n_windows):
+            lo, hi = w * fwd_len, min((w + 1) * fwd_len, T)
+            pad = fwd_len - (hi - lo) if (hi - lo < fwd_len and n_windows > 1) else 0
+            win_m = np.concatenate(
+                [np.ones((B, hi - lo), np.float32),
+                 np.zeros((B, pad), np.float32)], axis=1) if pad else None
+            fmasks = mds.features_masks or [None] * len(mds.features)
+            lmasks = mds.labels_masks or [None] * len(mds.labels)
+
+            def wmask(m):
+                if m is None:
+                    return win_m
+                sliced = slice_time(m, lo, hi, 0, temporal=True)
+                if pad:
+                    sliced = np.concatenate(
+                        [sliced, np.zeros((B, pad), np.float32)], axis=1)
+                return sliced
+
+            def label_temporal(l):
+                # per-timestep labels: one-hot (B, T, C) or sparse (B, T)
+                arr = np.asarray(l)
+                return arr.ndim == 3 or (
+                    arr.ndim == 2 and np.issubdtype(arr.dtype, np.integer))
+
+            window = MultiDataSet(
+                features=[slice_time(f, lo, hi, pad, tf)
+                          for f, tf in zip(mds.features, tflags)],
+                labels=[slice_time(l, lo, hi, pad, label_temporal(l))
+                        for l in mds.labels],
+                features_masks=([wmask(m) for m in fmasks]
+                                if pad or mds.features_masks else None),
+                labels_masks=([wmask(m) for m in lmasks]
+                              if pad or mds.labels_masks else None))
+            self._fit_batch(window)
+            losses.append(self._score)
+        self.score_value = float(np.mean([np.asarray(l) for l in losses]))
+        # rnn carries are per-batch transients; restore persistent slots
+        for name, st in saved.items():
+            self._layer_state[name] = st
+
+    # --------------------------------------------------------- rnn support
+    def rnn_time_step(self, *inputs: np.ndarray) -> List[np.ndarray]:
+        """Stateful streaming inference over the DAG (reference
+        `ComputationGraph.rnnTimeStep:1788`): carries each GravesLSTM
+        node's (h, c) between calls. Inputs are (B, F) single steps or
+        (B, T, F) chunks; outputs match (2-D iff every input was 2-D).
+        The per-timestep DAG walk is jitted once — the Python loop only
+        dispatches compiled steps."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesBidirectionalLSTM,
+            GravesLSTM,
+            TokenEmbedding,
+            TransformerBlock,
+        )
+
+        self._ensure_init()
+        conf = self.conf
+        for name, node in conf.nodes.items():
+            if not node.is_layer:
+                continue
+            if isinstance(node.layer, GravesBidirectionalLSTM):
+                raise ValueError(
+                    f"rnn_time_step cannot stream through bidirectional "
+                    f"LSTM node {name!r} (the backward pass needs the full "
+                    "sequence)")
+            if isinstance(node.layer, TransformerBlock):
+                raise ValueError(
+                    f"rnn_time_step cannot stream through attention node "
+                    f"{name!r} — use the jitted sampler "
+                    "(models.transformer.generate) which carries a KV "
+                    "cache")
+        xs = [jnp.asarray(x) for x in inputs]
+        # temporal = has a time axis to step over: 3-D float sequences, or
+        # (B, T) integer ids feeding a sequence-id layer (TokenEmbedding)
+        tflags = self._temporal_feature_flags(xs)
+        squeeze = not any(tflags)
+        T = 1 if squeeze else max(x.shape[1]
+                                  for x, tf in zip(xs, tflags) if tf)
+        B = xs[0].shape[0]
+        for name in self._recurrent_layer_nodes():
+            if name not in self._rnn_state:
+                n = conf.nodes[name].layer.n_out
+                self._rnn_state[name] = (jnp.zeros((B, n), self.dtype),
+                                         jnp.zeros((B, n), self.dtype))
+        if self._jit_rnn_step is None:
+            def step_fn(params, lstate, rnn_state, xs_t, pos):
+                xs_t = self._prep_inputs(xs_t)
+                acts: Dict[str, jnp.ndarray] = dict(
+                    zip(conf.network_inputs, xs_t))
+                new_rnn = dict(rnn_state)
+                for name in conf.topological_order:
+                    node = conf.nodes[name]
+                    in_acts = [acts[i] for i in node.inputs]
+                    if node.is_layer:
+                        x = in_acts[0]
+                        if node.preprocessor is not None:
+                            x = node.preprocessor.preprocess(x)
+                        layer = node.layer
+                        if type(layer) is GravesLSTM:
+                            h, (hn, cn) = layer.step(params[name], x,
+                                                     *rnn_state[name])
+                            acts[name] = h
+                            new_rnn[name] = (hn, cn)
+                            continue
+                        if isinstance(layer, TokenEmbedding):
+                            # streaming position: P row = tokens consumed
+                            # so far (clamped at the table end)
+                            idx = (x if x.ndim == 1 else x[:, 0]).astype(
+                                jnp.int32)
+                            p = jnp.minimum(pos, layer.max_length - 1)
+                            acts[name] = (params[name]["W"][idx]
+                                          + params[name]["P"][p])
+                            continue
+                        if x.ndim == 1:
+                            # single-step token ids (B,) -> (B, 1) so the
+                            # sequence-id layer sees one timestep
+                            x = x[:, None]
+                        elif x.ndim == 2 and layer.input_kind == "rnn" \
+                                and not getattr(layer, "integer_input",
+                                                False):
+                            x = x[:, None, :]
+                        y, _ = layer.forward(params[name], lstate[name], x,
+                                             train=False, rng=None)
+                        if y.ndim == 3 and y.shape[1] == 1:
+                            y = y[:, 0]
+                        acts[name] = y
+                    else:
+                        v = node.vertex
+                        if isinstance(v, (LastTimeStepVertex,
+                                          DuplicateToTimeSeriesVertex)) \
+                                and in_acts[0].ndim == 2:
+                            acts[name] = in_acts[0]  # single step: identity
+                        else:
+                            acts[name] = v.forward(in_acts)
+                return (tuple(acts[o] for o in conf.network_outputs),
+                        new_rnn)
+
+            self._jit_rnn_step = jax.jit(step_fn)
+        pos0 = getattr(self, "_rnn_pos", 0)
+        outs_t: List[List[jnp.ndarray]] = []
+        for t in range(T):
+            xs_t = tuple(x[:, t] if tf else x
+                         for x, tf in zip(xs, tflags))
+            outs, self._rnn_state = self._jit_rnn_step(
+                self._params, self._layer_state, self._rnn_state, xs_t,
+                jnp.asarray(pos0 + t, jnp.int32))
+            outs_t.append(outs)
+        self._rnn_pos = pos0 + T
+        result = []
+        for oi in range(len(conf.network_outputs)):
+            stacked = jnp.stack([o[oi] for o in outs_t], axis=1)
+            result.append(np.asarray(stacked[:, 0] if squeeze else stacked))
+        return result
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = {}
+        self._rnn_pos = 0
+
+    def rnn_get_previous_state(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Per-LSTM-node streaming state (reference
+        `rnnGetPreviousState:1868`)."""
+        return {name: {"h": np.asarray(h), "c": np.asarray(c)}
+                for name, (h, c) in self._rnn_state.items()}
+
+    def rnn_set_previous_state(self, states: Dict[str, Dict[str, np.ndarray]]) -> None:
+        """(reference `rnnSetPreviousState:1878`)."""
+        self._rnn_state = {
+            name: (jnp.asarray(st["h"], self.dtype),
+                   jnp.asarray(st["c"], self.dtype))
+            for name, st in states.items()}
+
+    # ------------------------------------------------------------ pretrain
+    def pretrain(self, iterator, epochs: int = 1) -> None:
+        """Greedy layerwise unsupervised pretraining over the DAG in
+        topological order, for any layer node exposing `pretrain_loss`
+        (AutoEncoder, RBM, VAE) — reference `ComputationGraph.pretrain`.
+        Upstream nodes are frozen; XLA dead-code-eliminates everything
+        downstream of the node being trained (its loss only consumes the
+        node's input activation)."""
+        self._ensure_init()
+        if isinstance(iterator, (DataSet, MultiDataSet)):
+            iterator = ListDataSetIterator([iterator])
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if not (node.is_layer and hasattr(node.layer, "pretrain_loss")):
+                continue
+            layer = node.layer
+
+            def step(p_n, u_n, inputs, rng, iteration, node=node, layer=layer):
+                def lf(p):
+                    xs = self._prep_inputs(inputs)
+                    acts, _ = self._forward_pure(
+                        self._params, self._layer_state, xs,
+                        train=False, rng=None)
+                    x = acts[node.inputs[0]]
+                    if node.preprocessor is not None:
+                        x = node.preprocessor.preprocess(x)
+                    return layer.pretrain_loss(p, x, rng)
+
+                loss, g = jax.value_and_grad(lf)(p_n)
+                p_new, u_new = apply_layer_update(layer, u_n, p_n, g,
+                                                  iteration)
+                return p_new, u_new, loss
+
+            jstep = jax.jit(step)
+            # rng stream mirrors MultiLayerNetwork.pretrain exactly
+            # (PRNGKey(seed + layer_position) folded by iteration) so a
+            # linear-chain graph pretrains bit-identically to the
+            # sequential container
+            li = self.conf.topological_order.index(name)
+            it_count = 0
+            for _ in range(epochs):
+                for ds in iterator:
+                    mds = self._to_mds(ds)
+                    ins, _, _, _ = self._mds_arrays(mds)
+                    rng = jax.random.fold_in(
+                        jax.random.PRNGKey(self.conf.seed + li), it_count)
+                    p_new, u_new, loss = jstep(
+                        self._params[name], self._upd_state[name], ins, rng,
+                        jnp.asarray(it_count, jnp.int32))
+                    self._params[name] = p_new
+                    self._upd_state[name] = u_new
+                    self.score_value = float(loss)
+                    it_count += 1
 
     # ------------------------------------------------------------ inference
     def output(self, *inputs: np.ndarray, train: bool = False) -> List[np.ndarray]:
@@ -441,7 +865,8 @@ class ComputationGraph:
 
         inputs = tuple(wire_asarray(f, self.dtype, ids)
                        for f, ids in zip(mds.features, self._inputs_are_ids()))
-        labels = tuple(wire_asarray(l, self.dtype) for l in mds.labels)
+        labels = tuple(wire_asarray(l, self.dtype) if l is not None else None
+                       for l in mds.labels)
         fmasks = (tuple(None if m is None else jnp.asarray(m, self.dtype)
                         for m in mds.features_masks)
                   if mds.features_masks is not None else None)

@@ -723,6 +723,19 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
 
+    def rnn_get_previous_state(self) -> Dict[int, Dict[str, np.ndarray]]:
+        """Per-LSTM-layer streaming state (reference
+        `rnnGetPreviousState:2252`)."""
+        return {i: {"h": np.asarray(h), "c": np.asarray(c)}
+                for i, (h, c) in self._rnn_state.items()}
+
+    def rnn_set_previous_state(self, states: Dict[int, Dict[str, np.ndarray]]) -> None:
+        """(reference `rnnSetPreviousState:2262`)."""
+        self._rnn_state = {
+            int(i): (jnp.asarray(st["h"], self.dtype),
+                     jnp.asarray(st["c"], self.dtype))
+            for i, st in states.items()}
+
     # ---------------------------------------------------- params / serde
     def params(self) -> np.ndarray:
         """Flat parameter vector (reference `Model.params()` — the flat view

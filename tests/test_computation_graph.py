@@ -230,3 +230,335 @@ def test_graph_bf16_exempts_ids_through_vertices():
     for tok in (513, 515, 777, 999):
         assert not np.allclose(w_after[tok], w_before[tok]), \
             f"bf16 cast corrupted id {tok} en route to the embedding"
+
+
+# ---------------------------------------------------------------- RNN tier
+# (reference `ComputationGraphTestRNN`: tBPTT :707, rnnTimeStep :1788,
+#  state get/set :1868-1878)
+
+def _lstm_chain_conf(tbptt=0, seed=5):
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(0.1)
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("lstm", GravesLSTM(n_in=4, n_out=6,
+                                       activation=Activation.TANH), "in")
+         .add_layer("out", RnnOutputLayer(n_in=6, n_out=3,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "lstm")
+         .set_outputs("out"))
+    if tbptt:
+        b = b.t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+    return b.build()
+
+
+def _mln_lstm_conf(tbptt=0, seed=5):
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    b = (dl4j.NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(0.1)
+         .list()
+         .layer(GravesLSTM(n_in=4, n_out=6, activation=Activation.TANH))
+         .layer(RnnOutputLayer(n_in=6, n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+         .set_input_type(InputType.recurrent(4)))
+    if tbptt:
+        b = b.t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+    return b.build()
+
+
+def test_cg_tbptt_matches_mln():
+    """A linear-chain CG trained with tBPTT must match the SAME model
+    trained through MultiLayerNetwork.doTruncatedBPTT step for step."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    g = ComputationGraph(_lstm_chain_conf(tbptt=4))
+    g.init()
+    net = MultiLayerNetwork(_mln_lstm_conf(tbptt=4))
+    net.init()
+    np.testing.assert_allclose(np.asarray(g._params["lstm"]["W"]),
+                               np.asarray(net._params[0]["W"]))
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 10, 4).astype(np.float32)   # T=10 -> 3 windows (pad)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (2, 10))]
+    for _ in range(3):
+        g.fit(MultiDataSet([x], [y]))
+        net.fit(DataSet(x, y))
+    np.testing.assert_allclose(np.asarray(g._params["lstm"]["W"]),
+                               np.asarray(net._params[0]["W"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g._params["out"]["W"]),
+                               np.asarray(net._params[1]["W"]),
+                               rtol=1e-5, atol=1e-6)
+    assert g.score_value == pytest.approx(net.score_value, rel=1e-4)
+
+
+def test_cg_tbptt_masked_trains():
+    """Variable-length sequences: tBPTT with masks trains and the loss
+    decreases (char-LM shape: sparse int labels)."""
+    conf = _lstm_chain_conf(tbptt=5, seed=11)
+    g = ComputationGraph(conf)
+    g.init()
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 12, 4).astype(np.float32)
+    # learnable per-timestep labels (a function of the input, not noise)
+    y = ((x[..., 0] > 0).astype(np.int32)
+         + (x[..., 1] > 0).astype(np.int32))
+    mask = np.zeros((4, 12), np.float32)
+    for i, ln in enumerate([12, 9, 7, 12]):
+        mask[i, :ln] = 1.0
+    mds = MultiDataSet([x], [y], features_masks=[mask], labels_masks=[mask])
+    first = None
+    for _ in range(15):
+        g.fit(mds)
+        first = first if first is not None else g.score_value
+    assert np.isfinite(g.score_value)
+    assert g.score_value < first * 0.9
+
+
+def test_cg_rnn_time_step_matches_full_forward():
+    """Chunked stateful stepping == one full-sequence forward, and the
+    2-D single-step form squeezes."""
+    g = ComputationGraph(_lstm_chain_conf())
+    g.init()
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 8, 4).astype(np.float32)
+    full = g.output(x)[0]                       # (3, 8, 3)
+    a = g.rnn_time_step(x[:, :5])[0]
+    b = g.rnn_time_step(x[:, 5:])[0]
+    np.testing.assert_allclose(np.concatenate([a, b], axis=1), full,
+                               rtol=1e-5, atol=1e-6)
+    # state get/set round trip reproduces continuation
+    g.rnn_clear_previous_state()
+    g.rnn_time_step(x[:, :5])
+    st = g.rnn_get_previous_state()
+    c1 = g.rnn_time_step(x[:, 5:6])[0]
+    g.rnn_set_previous_state(st)
+    c2 = g.rnn_time_step(x[:, 5:6])[0]
+    np.testing.assert_allclose(c1, c2, rtol=1e-6, atol=1e-7)
+    # single-timestep 2-D form
+    g.rnn_clear_previous_state()
+    s = g.rnn_time_step(x[:, 0])[0]
+    assert s.shape == (3, 3)
+    np.testing.assert_allclose(s, full[:, 0], rtol=1e-5, atol=1e-6)
+
+
+def test_cg_rnn_time_step_matches_mln():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    g = ComputationGraph(_lstm_chain_conf())
+    g.init()
+    net = MultiLayerNetwork(_mln_lstm_conf())
+    net.init()
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 6, 4).astype(np.float32)
+    np.testing.assert_allclose(g.rnn_time_step(x)[0], net.rnn_time_step(x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cg_rnn_time_step_rejects_bidirectional():
+    from deeplearning4j_tpu.nn.conf.layers import (
+        GravesBidirectionalLSTM,
+        RnnOutputLayer,
+    )
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("bi", GravesBidirectionalLSTM(
+                n_in=4, n_out=6, activation=Activation.TANH), "in")
+            .add_layer("out", RnnOutputLayer(n_in=6, n_out=3,
+                                             activation=Activation.SOFTMAX,
+                                             loss=LossFunction.MCXENT), "bi")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    with pytest.raises(ValueError, match="bidirectional"):
+        g.rnn_time_step(np.zeros((2, 4), np.float32))
+
+
+def test_cg_pretrain_matches_mln():
+    """Greedy layerwise pretraining over the DAG == the sequential
+    container's pretrain on the same linear chain."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    gconf = (NeuralNetConfiguration.Builder()
+             .seed(21).learning_rate(0.05)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("ae", AutoEncoder(n_in=6, n_out=4,
+                                          activation=Activation.SIGMOID), "in")
+             .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                           activation=Activation.SOFTMAX,
+                                           loss=LossFunction.MCXENT), "ae")
+             .set_outputs("out")
+             .build())
+    mconf = (dl4j.NeuralNetConfiguration.Builder()
+             .seed(21).learning_rate(0.05)
+             .list()
+             .layer(AutoEncoder(n_in=6, n_out=4,
+                                activation=Activation.SIGMOID))
+             .layer(OutputLayer(n_in=4, n_out=2,
+                                activation=Activation.SOFTMAX,
+                                loss=LossFunction.MCXENT))
+             .build())
+    g = ComputationGraph(gconf)
+    g.init()
+    net = MultiLayerNetwork(mconf)
+    net.init()
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.rand(16, 6).astype(np.float32), None)
+               for _ in range(4)]
+    g.pretrain(ListDataSetIterator(list(batches)), epochs=2)
+    net.pretrain(ListDataSetIterator(list(batches)), epochs=2)
+    np.testing.assert_allclose(np.asarray(g._params["ae"]["W"]),
+                               np.asarray(net._params[0]["W"]),
+                               rtol=1e-5, atol=1e-6)
+    assert np.isfinite(g.score_value)
+
+
+def test_cg_scan_steps_parity():
+    """fit(scan_steps=K) == per-batch fits on a multi-output graph."""
+    rng = np.random.RandomState(7)
+    xs = [rng.randn(8, 4).astype(np.float32) for _ in range(6)]
+    y1 = [np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)] for _ in range(6)]
+    y2 = [np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)] for _ in range(6)]
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(13).learning_rate(0.1)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=4, n_out=8,
+                                           activation=Activation.RELU), "in")
+                .add_layer("o1", OutputLayer(n_in=8, n_out=2,
+                                             activation=Activation.SOFTMAX,
+                                             loss=LossFunction.MCXENT), "h")
+                .add_layer("o2", OutputLayer(n_in=8, n_out=3,
+                                             activation=Activation.SOFTMAX,
+                                             loss=LossFunction.MCXENT), "h")
+                .set_outputs("o1", "o2")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        return g
+
+    data = [MultiDataSet([x], [a, b]) for x, a, b in zip(xs, y1, y2)]
+    seq = build()
+    for mds in data:
+        seq.fit(mds)
+    scan = build()
+    scan.fit(ListDataSetIterator(list(data)), scan_steps=3)
+    np.testing.assert_allclose(np.asarray(scan._params["h"]["W"]),
+                               np.asarray(seq._params["h"]["W"]),
+                               rtol=1e-5, atol=1e-6)
+    assert scan.iteration == seq.iteration == 6
+
+
+def _token_lstm_conf(tbptt=0, vocab=12, seed=17):
+    from deeplearning4j_tpu.nn.conf.layers import (
+        GravesLSTM,
+        RnnOutputLayer,
+        TokenEmbedding,
+    )
+
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(0.1)
+         .graph_builder()
+         .add_inputs("ids")
+         .add_layer("emb", TokenEmbedding(n_in=vocab, n_out=6,
+                                          max_length=32), "ids")
+         .add_layer("lstm", GravesLSTM(n_in=6, n_out=8,
+                                       activation=Activation.TANH), "emb")
+         .add_layer("out", RnnOutputLayer(n_in=8, n_out=vocab,
+                                          activation=Activation.SOFTMAX,
+                                          loss=LossFunction.MCXENT), "lstm")
+         .set_outputs("out"))
+    if tbptt:
+        b = b.t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+    return b.build()
+
+
+def test_cg_tbptt_dispatches_for_token_id_sequences(monkeypatch):
+    """(B, T) integer token ids ARE temporal: tBPTT must fire for them
+    (a 2-D int sequence into TokenEmbedding, no 3-D features at all)."""
+    g = ComputationGraph(_token_lstm_conf(tbptt=4))
+    g.init()
+    calls = []
+    orig = ComputationGraph._fit_tbptt
+    monkeypatch.setattr(ComputationGraph, "_fit_tbptt",
+                        lambda self, mds: calls.append(1) or orig(self, mds))
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 12, (2, 10)).astype(np.int32)
+    labels = rng.randint(0, 12, (2, 10)).astype(np.int32)  # sparse per-step
+    g.fit(MultiDataSet([ids], [labels]))
+    assert calls, "tBPTT was not dispatched for a (B, T) token-id sequence"
+    assert np.isfinite(g.score_value)
+
+
+def test_cg_rnn_time_step_token_ids_match_full_forward():
+    """Streaming a (B, T) token-id sequence must equal the full forward —
+    including the positional rows of TokenEmbedding (regression: the old
+    path consumed only the first token)."""
+    g = ComputationGraph(_token_lstm_conf())
+    g.init()
+    rng = np.random.RandomState(8)
+    ids = rng.randint(0, 12, (2, 7)).astype(np.int32)
+    full = g.output(ids)[0]                     # (2, 7, 12)
+    s1 = g.rnn_time_step(ids[:, :4])[0]
+    s2 = g.rnn_time_step(ids[:, 4:])[0]
+    np.testing.assert_allclose(np.concatenate([s1, s2], axis=1), full,
+                               rtol=1e-5, atol=1e-6)
+    # mutating later tokens must change later outputs (old bug: it didn't)
+    ids2 = ids.copy()
+    ids2[:, 3] = (ids2[:, 3] + 1) % 12
+    g.rnn_clear_previous_state()
+    out2 = g.rnn_time_step(ids2)[0]
+    assert not np.allclose(out2[:, 3], full[:, 3])
+
+
+def test_cg_tbptt_static_embedding_side_input():
+    """A static (B,) id side input (feed-forward EmbeddingLayer) rides
+    every tBPTT window unsliced while the temporal input is windowed."""
+    from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (
+        DuplicateToTimeSeriesVertex,
+        MergeVertex,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer,
+        GravesLSTM,
+        RnnOutputLayer,
+    )
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("seq", "cond")
+            .add_layer("emb", EmbeddingLayer(n_in=5, n_out=4), "cond")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex("seq"), "emb")
+            .add_vertex("m", MergeVertex(), "seq", "dup")
+            .add_layer("lstm", GravesLSTM(n_in=7, n_out=6,
+                                          activation=Activation.TANH), "m")
+            .add_layer("out", RnnOutputLayer(n_in=6, n_out=3,
+                                             activation=Activation.SOFTMAX,
+                                             loss=LossFunction.MCXENT),
+                       "lstm")
+            .set_outputs("out")
+            .t_bptt_forward_length(4).t_bptt_backward_length(4)
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    rng = np.random.RandomState(1)
+    seq = rng.randn(3, 10, 3).astype(np.float32)
+    cond = rng.randint(0, 5, (3,)).astype(np.int32)
+    y = rng.randint(0, 3, (3, 10)).astype(np.int32)
+    g.fit(MultiDataSet([seq, cond], [y]))   # windows slice seq, not cond
+    assert np.isfinite(g.score_value)

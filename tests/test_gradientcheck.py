@@ -142,3 +142,35 @@ def test_lstm_gradients_masked():
     net = MultiLayerNetwork(conf, dtype=jnp.float64)
     net.init()
     assert check_gradients(net, DataSet(X, labels, mask, mask), print_results=True)
+
+
+def test_cg_lstm_gradients_masked():
+    """Recurrent ComputationGraph with variable-length masking (reference
+    `GradientCheckTestsComputationGraph` + `GradientCheckTestsMasking`)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    rng = np.random.default_rng(9)
+    B, T, nin, nout = 3, 5, 3, 2
+    X = rng.normal(size=(B, T, nin))
+    labels = np.eye(nout)[rng.integers(0, nout, (B, T))]
+    mask = np.ones((B, T), np.float64)
+    mask[1, 3:] = 0
+    mask[2, 1:] = 0
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42).updater(Updater.NONE)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=nin, n_out=4,
+                                          activation=Activation.TANH), "in")
+            .add_layer("out", RnnOutputLayer(n_in=4, n_out=nout,
+                                             loss=LossFunction.MCXENT,
+                                             activation=Activation.SOFTMAX),
+                       "lstm")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf, dtype=jnp.float64)
+    g.init()
+    mds = MultiDataSet([X], [labels], features_masks=[mask],
+                       labels_masks=[mask])
+    assert check_gradients(g, mds, print_results=True)
