@@ -546,12 +546,36 @@ class ComputationGraph:
         return [name for name, node in self.conf.nodes.items()
                 if node.is_layer and type(node.layer) is GravesLSTM]
 
-    def _fit_tbptt(self, mds: MultiDataSet) -> None:
-        """Truncated BPTT over the DAG (reference
-        `ComputationGraph.java:707` doTruncatedBPTT): slice the time axis
-        of every temporal input/label into `tbptt_fwd_length` windows,
-        carrying each GravesLSTM node's (h, c) across windows; the tail
-        window is padded + masked to keep ONE compiled window shape."""
+    def _tbptt_applicable(self, ds) -> bool:
+        """Does this batch train via tBPTT? (called by ParallelWrapper's
+        dispatch too — keeps the container-specific temporal test in one
+        place)."""
+        mds = self._to_mds(ds)
+        return any(self._temporal_feature_flags(mds.features))
+
+    def _tbptt_seed_carries(self, B: int):
+        """Seed zero (h, c) carries into every streaming-LSTM node slot;
+        returns saved persistent states (same contract as
+        `MultiLayerNetwork._tbptt_seed_carries`, so ParallelWrapper's
+        sharded tBPTT drives either container)."""
+        saved = {}
+        for name in self._recurrent_layer_nodes():
+            n = self.conf.nodes[name].layer.n_out
+            saved[name] = self._layer_state[name]
+            self._layer_state[name] = {"h": jnp.zeros((B, n), self.dtype),
+                                       "c": jnp.zeros((B, n), self.dtype)}
+        return saved
+
+    def _tbptt_restore_carries(self, saved) -> None:
+        for name, st in saved.items():
+            self._layer_state[name] = st
+
+    def _tbptt_windows(self, ds) -> List[MultiDataSet]:
+        """Fixed-shape tBPTT window batches over the DAG: every temporal
+        input/label sliced into `tbptt_fwd_length` chunks (static inputs
+        ride every window), the tail chunk padded + masked so every window
+        compiles to ONE shape. Validates shapes eagerly."""
+        mds = self._to_mds(ds)
         fwd_len = self.conf.tbptt_fwd_length
         tflags = self._temporal_feature_flags(mds.features)
         t_lens = {np.asarray(f).shape[1]
@@ -570,13 +594,6 @@ class ComputationGraph:
                     f"truncated BPTT requires per-timestep labels for output "
                     f"{o!r}: one-hot (batch, time, nOut) or sparse int "
                     f"(batch, time); got shape {arr.shape}")
-        # seed transient (h, c) carries into the LSTM nodes' state slots
-        saved = {}
-        for name in self._recurrent_layer_nodes():
-            n = self.conf.nodes[name].layer.n_out
-            saved[name] = self._layer_state[name]
-            self._layer_state[name] = {"h": jnp.zeros((B, n), self.dtype),
-                                       "c": jnp.zeros((B, n), self.dtype)}
 
         def slice_time(a, lo, hi, pad, temporal):
             a = np.asarray(a)
@@ -587,8 +604,14 @@ class ComputationGraph:
                 w = np.concatenate([w, np.zeros_like(a[:, :pad])], axis=1)
             return w
 
+        def label_temporal(l):
+            # per-timestep labels: one-hot (B, T, C) or sparse (B, T)
+            arr = np.asarray(l)
+            return arr.ndim == 3 or (
+                arr.ndim == 2 and np.issubdtype(arr.dtype, np.integer))
+
         n_windows = (T + fwd_len - 1) // fwd_len
-        losses = []
+        windows = []
         for w in range(n_windows):
             lo, hi = w * fwd_len, min((w + 1) * fwd_len, T)
             pad = fwd_len - (hi - lo) if (hi - lo < fwd_len and n_windows > 1) else 0
@@ -607,13 +630,7 @@ class ComputationGraph:
                         [sliced, np.zeros((B, pad), np.float32)], axis=1)
                 return sliced
 
-            def label_temporal(l):
-                # per-timestep labels: one-hot (B, T, C) or sparse (B, T)
-                arr = np.asarray(l)
-                return arr.ndim == 3 or (
-                    arr.ndim == 2 and np.issubdtype(arr.dtype, np.integer))
-
-            window = MultiDataSet(
+            windows.append(MultiDataSet(
                 features=[slice_time(f, lo, hi, pad, tf)
                           for f, tf in zip(mds.features, tflags)],
                 labels=[slice_time(l, lo, hi, pad, label_temporal(l))
@@ -621,13 +638,23 @@ class ComputationGraph:
                 features_masks=([wmask(m) for m in fmasks]
                                 if pad or mds.features_masks else None),
                 labels_masks=([wmask(m) for m in lmasks]
-                              if pad or mds.labels_masks else None))
+                              if pad or mds.labels_masks else None)))
+        return windows
+
+    def _fit_tbptt(self, mds: MultiDataSet) -> None:
+        """Truncated BPTT over the DAG (reference
+        `ComputationGraph.java:707` doTruncatedBPTT): windows from
+        `_tbptt_windows`, GravesLSTM (h, c) carried across windows via the
+        seeded state slots."""
+        windows = self._tbptt_windows(mds)
+        saved = self._tbptt_seed_carries(np.asarray(mds.features[0]).shape[0])
+        losses = []
+        for window in windows:
             self._fit_batch(window)
             losses.append(self._score)
         self.score_value = float(np.mean([np.asarray(l) for l in losses]))
         # rnn carries are per-batch transients; restore persistent slots
-        for name, st in saved.items():
-            self._layer_state[name] = st
+        self._tbptt_restore_carries(saved)
 
     # --------------------------------------------------------- rnn support
     def rnn_time_step(self, *inputs: np.ndarray) -> List[np.ndarray]:
@@ -748,13 +775,19 @@ class ComputationGraph:
         self._rnn_pos = 0
 
     def rnn_get_previous_state(self) -> Dict[str, Dict[str, np.ndarray]]:
-        """Per-LSTM-node streaming state (reference
-        `rnnGetPreviousState:1868`)."""
-        return {name: {"h": np.asarray(h), "c": np.asarray(c)}
-                for name, (h, c) in self._rnn_state.items()}
+        """Per-LSTM-node streaming state plus the stream position (under
+        the reserved key '__pos__' — TokenEmbedding's positional row is
+        part of the streaming state, so a get/set round trip must carry
+        it). Reference `rnnGetPreviousState:1868`."""
+        out: Dict = {name: {"h": np.asarray(h), "c": np.asarray(c)}
+                     for name, (h, c) in self._rnn_state.items()}
+        out["__pos__"] = getattr(self, "_rnn_pos", 0)
+        return out
 
     def rnn_set_previous_state(self, states: Dict[str, Dict[str, np.ndarray]]) -> None:
         """(reference `rnnSetPreviousState:1878`)."""
+        states = dict(states)
+        self._rnn_pos = int(states.pop("__pos__", 0))
         self._rnn_state = {
             name: (jnp.asarray(st["h"], self.dtype),
                    jnp.asarray(st["c"], self.dtype))

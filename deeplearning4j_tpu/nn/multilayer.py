@@ -411,7 +411,7 @@ class MultiLayerNetwork:
                     n_batches += 1
                     if line_search_algo:
                         self._fit_batch_solver(ds)
-                    elif tbptt and ds.features.ndim == 3:
+                    elif tbptt and self._tbptt_applicable(ds):
                         self._fit_tbptt(ds)
                     elif scan:
                         def _sig(d):
@@ -569,17 +569,57 @@ class MultiLayerNetwork:
                 f"{None if ds.labels is None else ds.labels.shape}. For "
                 "sequence-to-one models, train without tBPTT "
                 "(t_bptt_forward_length unset)")
-        T = ds.features.shape[1]
-        B = ds.features.shape[0]
-        # seed transient carries into the rnn layers' state slots
-        saved = list(self._layer_state)
+        saved = self._tbptt_seed_carries(ds.features.shape[0])
+        losses = []
+        for window in self._tbptt_windows(ds):
+            self._fit_batch(window)
+            losses.append(self._score)
+        self.score_value = float(np.mean([np.asarray(l) for l in losses]))
+        # rnn carries are per-batch transients; restore persistent state slots
+        self._tbptt_restore_carries(saved)
+
+    def _tbptt_applicable(self, ds) -> bool:
+        """Does this batch train via tBPTT? 3-D sequences always; (B, T)
+        integer ids when the first layer consumes id sequences
+        (TokenEmbedding-style). Shared with ParallelWrapper's dispatch."""
+        f = getattr(ds, "features", None)
+        if f is None:
+            return False
+        nd = np.ndim(f)
+        if nd == 3:
+            return True
+        l0 = self.layers[0]
+        if not (nd == 2 and getattr(l0, "integer_input", False)
+                and l0.input_kind == "rnn"):
+            return False
+        dt = f.dtype if hasattr(f, "dtype") else np.asarray(f).dtype
+        return np.issubdtype(dt, np.integer)
+
+    def _tbptt_seed_carries(self, B: int):
+        """Seed zero (h, c) carries into every streaming-LSTM slot; returns
+        the saved persistent states for `_tbptt_restore_carries`. Shared
+        with ParallelWrapper's sharded tBPTT path."""
+        saved = {}
         for i, layer in enumerate(self.layers):
             if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
                 n = layer.n_out
+                saved[i] = self._layer_state[i]
                 self._layer_state[i] = {"h": jnp.zeros((B, n), self.dtype),
                                         "c": jnp.zeros((B, n), self.dtype)}
+        return saved
+
+    def _tbptt_restore_carries(self, saved) -> None:
+        for i, st in saved.items():
+            self._layer_state[i] = st
+
+    def _tbptt_windows(self, ds: DataSet):
+        """Yield fixed-shape tBPTT window batches: the time axis sliced
+        into `tbptt_fwd_length` chunks, the tail chunk padded + masked so
+        every window compiles to ONE shape."""
+        fwd_len = self.conf.tbptt_fwd_length
+        T = ds.features.shape[1]
+        B = ds.features.shape[0]
         n_windows = (T + fwd_len - 1) // fwd_len
-        losses = []
         for w in range(n_windows):
             lo, hi = w * fwd_len, min((w + 1) * fwd_len, T)
             if hi - lo < fwd_len and n_windows > 1:
@@ -594,19 +634,14 @@ class MultiLayerNetwork:
                     [np.ones((B, hi - lo), np.float32), np.zeros((B, pad), np.float32)], axis=1)
                 fmask = m if ds.features_mask is None else np.concatenate(
                     [ds.features_mask[:, lo:hi], np.zeros((B, pad), np.float32)], axis=1)
-                window = DataSet(feats, labs, fmask, m)
+                lmask = m if ds.labels_mask is None else np.concatenate(
+                    [ds.labels_mask[:, lo:hi], np.zeros((B, pad), np.float32)], axis=1)
+                yield DataSet(feats, labs, fmask, lmask)
             else:
-                window = DataSet(
+                yield DataSet(
                     ds.features[:, lo:hi], ds.labels[:, lo:hi],
                     None if ds.features_mask is None else ds.features_mask[:, lo:hi],
                     None if ds.labels_mask is None else ds.labels_mask[:, lo:hi])
-            self._fit_batch(window)
-            losses.append(self._score)
-        self.score_value = float(np.mean([np.asarray(l) for l in losses]))
-        # rnn carries are per-batch transients; restore persistent state slots
-        for i, layer in enumerate(self.layers):
-            if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
-                self._layer_state[i] = saved[i]
 
     # ------------------------------------------------------------ inference
     def output(self, x: np.ndarray, train: bool = False) -> np.ndarray:

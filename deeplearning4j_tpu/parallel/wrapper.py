@@ -91,6 +91,8 @@ class ParallelWrapper:
         net._upd_state = jax.device_put(net._upd_state, self._upd_sh)
         net._layer_state = jax.device_put(net._layer_state, self._lstate_sh)
 
+        self._jit_step_tbptt = None
+        self._tbptt_lstate_sh = None
         step = self._wrap_step(net.train_step_fn())
         self._jit_step = jax.jit(
             step,
@@ -174,10 +176,7 @@ class ParallelWrapper:
             iterator = data
         if iterator.async_supported and not isinstance(iterator, AsyncDataSetIterator):
             iterator = AsyncDataSetIterator(iterator, self.prefetch_buffer)
-        if net.conf.tbptt_fwd_length > 0:
-            raise NotImplementedError(
-                "truncated BPTT under ParallelWrapper is not supported yet; "
-                "train tBPTT models single-chip via MultiLayerNetwork.fit")
+        tbptt = net.conf.tbptt_fwd_length > 0
         net._it_device = jax.device_put(
             jnp.asarray(net.iteration, jnp.int32), self._repl)
         for _ in range(epochs):
@@ -187,6 +186,9 @@ class ParallelWrapper:
             for ds in iterator:
                 ds = self._shard_batch(ds)
                 if ds is None:
+                    continue
+                if tbptt and net._tbptt_applicable(ds):
+                    self._fit_tbptt(ds)
                     continue
                 net._validate_labels(ds)
                 f, l, fm, lm = net._batch_arrays(ds)
@@ -204,3 +206,51 @@ class ParallelWrapper:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(net)
             net.epoch += 1
+
+    # -- data-parallel truncated BPTT --------------------------------------
+    def _fit_tbptt(self, ds) -> None:
+        """Truncated BPTT with the window step sharded over the mesh
+        (BASELINE configs 3x5 composed: recurrent + data-parallel). The
+        per-example LSTM (h, c) carries are sharded on the data axis like
+        the batch itself, so the carry never crosses devices — only the
+        gradient psum does (reference analogue:
+        `ParallelWrapper.java:322` + `MultiLayerNetwork.java:1140`)."""
+        net = self.net
+        saved = net._tbptt_seed_carries(ds.num_examples())
+        if self._jit_step_tbptt is None:
+            # lstate shardings for the SEEDED structure: (B, n) carries ride
+            # the data axis, everything else keeps its original placement
+            lstate_sh = (list(self._lstate_sh)
+                         if isinstance(self._lstate_sh, list)
+                         else dict(self._lstate_sh))
+            for key in saved:
+                lstate_sh[key] = {"h": self._batch_sh, "c": self._batch_sh}
+            self._tbptt_lstate_sh = lstate_sh
+            step = self._wrap_step(net.train_step_fn())
+            self._jit_step_tbptt = jax.jit(
+                step,
+                in_shardings=(self._param_sh, self._upd_sh, lstate_sh,
+                              self._repl) + self._batch_shardings(),
+                out_shardings=(self._param_sh, self._upd_sh, lstate_sh,
+                               self._repl, self._repl),
+                donate_argnums=(0, 1, 2, 3),
+            )
+        net._layer_state = jax.device_put(net._layer_state,
+                                          self._tbptt_lstate_sh)
+        losses = []
+        for window in net._tbptt_windows(ds):
+            net._validate_labels(window)
+            f, l, fm, lm = net._batch_arrays(window)
+            (net._params, net._upd_state, net._layer_state, net._it_device,
+             loss) = self._jit_step_tbptt(
+                net._params, net._upd_state, net._layer_state,
+                net._it_device, f, l, fm, lm)
+            losses.append(loss)
+            net.iteration += 1
+            for listener in net.listeners:
+                if hasattr(listener, "record_batch"):
+                    listener.record_batch(window.num_examples())
+                listener.iteration_done(net, net.iteration)
+        net.score_value = float(np.mean([np.asarray(l) for l in losses]))
+        # carries are per-batch transients; restore the persistent slots
+        net._tbptt_restore_carries(saved)

@@ -562,3 +562,56 @@ def test_cg_tbptt_static_embedding_side_input():
     y = rng.randint(0, 3, (3, 10)).astype(np.int32)
     g.fit(MultiDataSet([seq, cond], [y]))   # windows slice seq, not cond
     assert np.isfinite(g.score_value)
+
+
+def test_cg_token_stream_state_round_trip_carries_position():
+    """get/set of streaming state must carry the TokenEmbedding position:
+    restoring mid-stream state must reproduce the SAME continuation
+    (P row is part of the state)."""
+    g = ComputationGraph(_token_lstm_conf())
+    g.init()
+    rng = np.random.RandomState(14)
+    ids = rng.randint(0, 12, (2, 6)).astype(np.int32)
+    g.rnn_time_step(ids[:, :4])
+    st = g.rnn_get_previous_state()
+    assert st["__pos__"] == 4
+    a = g.rnn_time_step(ids[:, 4:5])[0]
+    g.rnn_set_previous_state(st)
+    b = g.rnn_time_step(ids[:, 4:5])[0]
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    # and it matches the full-sequence forward at that position
+    full = g.output(ids)[0]
+    np.testing.assert_allclose(b[:, 0], full[:, 4], rtol=1e-5, atol=1e-6)
+
+
+def test_mln_tbptt_token_id_sequences():
+    """MultiLayerNetwork: (B, T) int ids into TokenEmbedding dispatch to
+    tBPTT too (same temporal classification as the DAG container)."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf.layers import (
+        GravesLSTM,
+        RnnOutputLayer,
+        TokenEmbedding,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(19).learning_rate(0.1)
+            .list()
+            .layer(TokenEmbedding(n_in=12, n_out=6, max_length=32))
+            .layer(GravesLSTM(n_in=6, n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_in=8, n_out=12,
+                                  activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(12))
+            .t_bptt_forward_length(4).t_bptt_backward_length(4)
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    assert net._tbptt_applicable(
+        DataSet(np.zeros((2, 10), np.int32), None))
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 12, (2, 10)).astype(np.int32)
+    labels = rng.randint(0, 12, (2, 10)).astype(np.int32)
+    net.fit(DataSet(ids, labels))
+    assert np.isfinite(net.score_value)

@@ -181,3 +181,92 @@ def test_device_prefetch_iterator():
     net.fit(DevicePrefetchIterator(ListDataSetIterator(cls_batches)),
             epochs=2)
     assert np.isfinite(net.score_value)
+
+
+def test_data_parallel_tbptt_matches_single_device():
+    """BASELINE configs 3x5 composed: LSTM tBPTT sharded over 8 devices
+    must match single-chip tBPTT step for step (the per-example (h, c)
+    carries ride the data axis; only the gradient psum crosses chips)."""
+    from deeplearning4j_tpu.nn.conf import GravesLSTM, RnnOutputLayer
+
+    def conf():
+        return (NeuralNetConfiguration.Builder()
+                .seed(31).learning_rate(0.1)
+                .list()
+                .layer(GravesLSTM(n_out=8, activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(5))
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .build())
+
+    rng = np.random.default_rng(11)
+    # T=10 -> 3 windows incl. a padded+masked tail; B=16 splits over 8
+    X = rng.normal(size=(16, 10, 5)).astype(np.float32)
+    labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (16, 10))]
+    mask = np.ones((16, 10), np.float32)
+    mask[3, 7:] = 0  # a variable-length series on top of tBPTT windows
+    batches = [DataSet(X, labels, mask, mask)]
+
+    net1 = MultiLayerNetwork(conf())
+    net1.init()
+    net1.fit(ListDataSetIterator(list(batches)), epochs=3)
+
+    net8 = MultiLayerNetwork(conf())
+    net8.init()
+    pw = ParallelWrapper(net8, mesh=make_mesh({"data": 8}))
+    pw.fit(ListDataSetIterator(list(batches)), epochs=3)
+
+    np.testing.assert_allclose(net1.params(), net8.params(), rtol=1e-4,
+                               atol=1e-6)
+    assert net1.iteration == net8.iteration  # one iteration per window
+    assert abs(net1.score_value - net8.score_value) < 1e-4
+    # after tBPTT the sharded net still runs the plain step path
+    flat = DataSet(X, labels, mask, mask)
+    assert np.isfinite(net8.score_value)
+    out = net8.output(X)
+    assert out.shape == (16, 10, 4)
+
+
+def test_data_parallel_tbptt_computation_graph():
+    """A tBPTT ComputationGraph under ParallelWrapper matches single-chip
+    CG training (the DAG container rides the same sharded window path)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.conf import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    def conf():
+        return (NeuralNetConfiguration.Builder()
+                .seed(41).learning_rate(0.1)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_in=5, n_out=8,
+                                              activation=Activation.TANH),
+                           "in")
+                .add_layer("out", RnnOutputLayer(n_in=8, n_out=4,
+                                                 activation=Activation.SOFTMAX,
+                                                 loss=LossFunction.MCXENT),
+                           "lstm")
+                .set_outputs("out")
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .build())
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(16, 10, 5)).astype(np.float32)
+    labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (16, 10))]
+    mds = MultiDataSet([X], [labels])
+
+    g1 = ComputationGraph(conf())
+    g1.init()
+    for _ in range(2):
+        g1.fit(mds)
+
+    g8 = ComputationGraph(conf())
+    g8.init()
+    pw = ParallelWrapper(g8, mesh=make_mesh({"data": 8}))
+    pw.fit(ListDataSetIterator([mds]), epochs=2)
+
+    np.testing.assert_allclose(
+        np.asarray(g1._params["lstm"]["W"]),
+        np.asarray(g8._params["lstm"]["W"]), rtol=1e-4, atol=1e-6)
+    assert abs(g1.score_value - g8.score_value) < 1e-4
