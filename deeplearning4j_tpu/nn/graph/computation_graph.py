@@ -94,8 +94,11 @@ class ComputationGraph:
                         f"input {name!r} feeds an integer-id layer; ids are "
                         "never scaled — pass None for this input")
         self._normalizer = normalizer
+        # traced functions embed the transform: drop compiled caches
         self._jit_train = None
+        self._jit_scan = None
         self._jit_output = None
+        self._jit_rnn_step = None
 
     def get_normalizer(self):
         return self._normalizer

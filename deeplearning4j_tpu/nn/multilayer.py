@@ -81,6 +81,8 @@ class MultiLayerNetwork:
         self._jit_train = None
         self._jit_scan = None
         self._jit_output = None
+        self._jit_rnn_step = None
+        self._rnn_pos = 0
         self._normalizer = None
         self._input_types = self._resolve_input_types()
 
@@ -107,6 +109,7 @@ class MultiLayerNetwork:
         self._jit_train = None
         self._jit_scan = None
         self._jit_output = None
+        self._jit_rnn_step = None
 
     def get_normalizer(self):
         return self._normalizer
@@ -557,18 +560,6 @@ class MultiLayerNetwork:
         `MultiLayerNetwork.java:1140-1194`): slice the time axis into
         tbptt_fwd_length windows, carrying LSTM (h, c) across windows; each
         window is one jitted step (fixed window shape ⇒ one compilation)."""
-        fwd_len = self.conf.tbptt_fwd_length
-        sparse = (ds.labels is not None
-                  and np.issubdtype(np.asarray(ds.labels).dtype, np.integer)
-                  and np.asarray(ds.labels).ndim == 2)
-        if ds.labels is None or (ds.labels.ndim != 3 and not sparse):
-            raise ValueError(
-                "truncated BPTT requires per-timestep labels: one-hot "
-                "(batch, time, nOut) or sparse int (batch, time); got "
-                f"labels shape "
-                f"{None if ds.labels is None else ds.labels.shape}. For "
-                "sequence-to-one models, train without tBPTT "
-                "(t_bptt_forward_length unset)")
         saved = self._tbptt_seed_carries(ds.features.shape[0])
         losses = []
         for window in self._tbptt_windows(ds):
@@ -613,13 +604,27 @@ class MultiLayerNetwork:
             self._layer_state[i] = st
 
     def _tbptt_windows(self, ds: DataSet):
-        """Yield fixed-shape tBPTT window batches: the time axis sliced
+        """Fixed-shape tBPTT window batches: the time axis sliced
         into `tbptt_fwd_length` chunks, the tail chunk padded + masked so
-        every window compiles to ONE shape."""
+        every window compiles to ONE shape. Validates per-timestep labels
+        eagerly (both the single-chip fit path and ParallelWrapper's
+        sharded path come through here)."""
+        sparse = (ds.labels is not None
+                  and np.issubdtype(np.asarray(ds.labels).dtype, np.integer)
+                  and np.asarray(ds.labels).ndim == 2)
+        if ds.labels is None or (ds.labels.ndim != 3 and not sparse):
+            raise ValueError(
+                "truncated BPTT requires per-timestep labels: one-hot "
+                "(batch, time, nOut) or sparse int (batch, time); got "
+                f"labels shape "
+                f"{None if ds.labels is None else ds.labels.shape}. For "
+                "sequence-to-one models, train without tBPTT "
+                "(t_bptt_forward_length unset)")
         fwd_len = self.conf.tbptt_fwd_length
         T = ds.features.shape[1]
         B = ds.features.shape[0]
         n_windows = (T + fwd_len - 1) // fwd_len
+        windows = []
         for w in range(n_windows):
             lo, hi = w * fwd_len, min((w + 1) * fwd_len, T)
             if hi - lo < fwd_len and n_windows > 1:
@@ -636,12 +641,13 @@ class MultiLayerNetwork:
                     [ds.features_mask[:, lo:hi], np.zeros((B, pad), np.float32)], axis=1)
                 lmask = m if ds.labels_mask is None else np.concatenate(
                     [ds.labels_mask[:, lo:hi], np.zeros((B, pad), np.float32)], axis=1)
-                yield DataSet(feats, labs, fmask, lmask)
+                windows.append(DataSet(feats, labs, fmask, lmask))
             else:
-                yield DataSet(
+                windows.append(DataSet(
                     ds.features[:, lo:hi], ds.labels[:, lo:hi],
                     None if ds.features_mask is None else ds.features_mask[:, lo:hi],
-                    None if ds.labels_mask is None else ds.labels_mask[:, lo:hi])
+                    None if ds.labels_mask is None else ds.labels_mask[:, lo:hi]))
+        return windows
 
     # ------------------------------------------------------------ inference
     def output(self, x: np.ndarray, train: bool = False) -> np.ndarray:
@@ -720,36 +726,80 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x: np.ndarray) -> np.ndarray:
         """Stateful single/multi-step inference (reference
         `rnnTimeStep:2196`): carries (h, c) between calls for streaming
-        generation."""
+        generation. The whole per-timestep layer walk is jitted ONCE; the
+        Python loop only dispatches compiled steps — over a tunneled chip
+        this removes the ~10-dispatches-per-timestep eager cost."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesBidirectionalLSTM,
+            TokenEmbedding,
+            TransformerBlock,
+        )
+
         self._ensure_init()
-        xx = self._prep_features(jnp.asarray(x))
-        squeeze = False
-        if xx.ndim == 2:  # (B, F) -> single timestep
-            xx = xx[:, None, :]
-            squeeze = True
-        B, T, _ = xx.shape
-        outs = []
-        for t in range(T):
-            h = xx[:, t]
-            for i, layer in enumerate(self.layers):
-                if i in self.conf.preprocessors:
-                    h = self.conf.preprocessors[i].preprocess(h)
-                if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM:
-                    if i not in self._rnn_state:
-                        n = layer.n_out
-                        self._rnn_state[i] = (jnp.zeros((B, n), self.dtype),
-                                              jnp.zeros((B, n), self.dtype))
-                    hp, cp = self._rnn_state[i]
-                    h, (hn, cn) = layer.step(self._params[i], h, hp, cp)
-                    self._rnn_state[i] = (hn, cn)
-                else:
-                    if h.ndim == 2 and layer.input_kind == "rnn":
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, GravesBidirectionalLSTM):
+                raise ValueError(
+                    f"rnn_time_step cannot stream through bidirectional "
+                    f"LSTM layer {i} (the backward pass needs the full "
+                    "sequence)")
+            if isinstance(layer, TransformerBlock):
+                raise ValueError(
+                    f"rnn_time_step cannot stream through attention layer "
+                    f"{i} — use models.transformer.generate (jitted KV-"
+                    "cache sampler)")
+        xx = jnp.asarray(x)
+        token_seq = self._feature_wire_mode() == "sink" \
+            and self.layers[0].input_kind == "rnn"
+        temporal = xx.ndim == 3 or (token_seq and xx.ndim == 2)
+        squeeze = not temporal
+        T = xx.shape[1] if temporal else 1
+        B = xx.shape[0]
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, GravesLSTM) and type(layer) is GravesLSTM \
+                    and i not in self._rnn_state:
+                n = layer.n_out
+                self._rnn_state[i] = (jnp.zeros((B, n), self.dtype),
+                                      jnp.zeros((B, n), self.dtype))
+        if self._jit_rnn_step is None:
+            def step_fn(params, lstate, rnn_state, x_t, pos):
+                h = self._prep_features(x_t)
+                new_rnn = dict(rnn_state)
+                for i, layer in enumerate(self.layers):
+                    if i in self.conf.preprocessors:
+                        h = self.conf.preprocessors[i].preprocess(h)
+                    if isinstance(layer, GravesLSTM) \
+                            and type(layer) is GravesLSTM:
+                        h, (hn, cn) = layer.step(params[i], h,
+                                                 *rnn_state[i])
+                        new_rnn[i] = (hn, cn)
+                        continue
+                    if isinstance(layer, TokenEmbedding):
+                        idx = (h if h.ndim == 1 else h[:, 0]).astype(
+                            jnp.int32)
+                        p = jnp.minimum(pos, layer.max_length - 1)
+                        h = params[i]["W"][idx] + params[i]["P"][p]
+                        continue
+                    if h.ndim == 1:
+                        h = h[:, None]   # single-step ids -> one timestep
+                    elif h.ndim == 2 and layer.input_kind == "rnn" \
+                            and not getattr(layer, "integer_input", False):
                         h = h[:, None, :]
-                    h, _ = layer.forward(self._params[i], self._layer_state[i], h,
+                    h, _ = layer.forward(params[i], lstate[i], h,
                                          train=False, rng=None)
                     if h.ndim == 3 and h.shape[1] == 1:
                         h = h[:, 0]
-            outs.append(h)
+                return h, new_rnn
+
+            self._jit_rnn_step = jax.jit(step_fn)
+        pos0 = getattr(self, "_rnn_pos", 0)
+        outs = []
+        for t in range(T):
+            x_t = xx[:, t] if temporal else xx
+            out, self._rnn_state = self._jit_rnn_step(
+                self._params, self._layer_state, self._rnn_state, x_t,
+                jnp.asarray(pos0 + t, jnp.int32))
+            outs.append(out)
+        self._rnn_pos = pos0 + T
         out = jnp.stack(outs, axis=1)
         if squeeze:
             out = out[:, 0]
@@ -757,15 +807,21 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
+        self._rnn_pos = 0
 
     def rnn_get_previous_state(self) -> Dict[int, Dict[str, np.ndarray]]:
-        """Per-LSTM-layer streaming state (reference
-        `rnnGetPreviousState:2252`)."""
-        return {i: {"h": np.asarray(h), "c": np.asarray(c)}
-                for i, (h, c) in self._rnn_state.items()}
+        """Per-LSTM-layer streaming state plus the stream position (under
+        the reserved key '__pos__' — TokenEmbedding's positional row is
+        part of the streaming state). Reference `rnnGetPreviousState:2252`."""
+        out: Dict = {i: {"h": np.asarray(h), "c": np.asarray(c)}
+                     for i, (h, c) in self._rnn_state.items()}
+        out["__pos__"] = getattr(self, "_rnn_pos", 0)
+        return out
 
     def rnn_set_previous_state(self, states: Dict[int, Dict[str, np.ndarray]]) -> None:
         """(reference `rnnSetPreviousState:2262`)."""
+        states = dict(states)
+        self._rnn_pos = int(states.pop("__pos__", 0))
         self._rnn_state = {
             int(i): (jnp.asarray(st["h"], self.dtype),
                      jnp.asarray(st["c"], self.dtype))

@@ -43,17 +43,15 @@ def main():
     net.fit(ListDataSetIterator(batches), epochs=3)
     print(f"final loss: {net.score_value:.3f}")
 
-    # greedy sampling on a FIXED-length window (right-padded; causality
-    # means the read position never sees the padding) — a varying window
-    # length would recompile the jitted forward every step
-    ctx = [stoi[c] for c in "the quick"]
-    for _ in range(60):
-        window = ctx[-T:]
-        x = np.zeros((1, T), np.float32)
-        x[0, :len(window)] = window
-        probs = net.output(x)[0, len(window) - 1]
-        ctx.append(int(np.argmax(probs)))
-    print("sample:", "".join(chars[i] for i in ctx))
+    # jitted KV-cache sampler: one prefill dispatch + one scanned decode
+    # dispatch for the whole generation (vs. one full forward per token)
+    from deeplearning4j_tpu.models.transformer import generate
+
+    prompt = np.array([[stoi[c] for c in "the quick"]], np.int32)
+    # generate up to the positional-table limit (prompt + new <= max_length)
+    out = generate(net, prompt, n_tokens=T - prompt.shape[1],
+                   temperature=0.0, include_prompt=True)
+    print("sample:", "".join(chars[i] for i in out[0]))
 
 
 if __name__ == "__main__":

@@ -170,3 +170,37 @@ def test_mixed_precision_bf16():
     assert abs(bf16.score_value - f32.score_value) < 0.15
     acc = (np.argmax(bf16.output(x), 1) == c).mean()
     assert acc > 0.85
+
+
+def test_rnn_time_step_chunked_matches_full_forward():
+    """Jitted streaming stepper: chunked stateful stepping == the full
+    sequence forward; state survives get/set round trips."""
+    from deeplearning4j_tpu.nn.conf import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(23).learning_rate(0.1)
+            .list()
+            .layer(GravesLSTM(n_out=6, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 9, 4).astype(np.float32)
+    full = net.output(x)
+    a = net.rnn_time_step(x[:, :4])
+    b = net.rnn_time_step(x[:, 4:])
+    np.testing.assert_allclose(np.concatenate([a, b], axis=1), full,
+                               rtol=1e-5, atol=1e-6)
+    st = net.rnn_get_previous_state()
+    assert st["__pos__"] == 9
+    c1 = net.rnn_time_step(x[:, :1])
+    net.rnn_set_previous_state(st)
+    c2 = net.rnn_time_step(x[:, :1])
+    np.testing.assert_allclose(c1, c2, rtol=1e-6, atol=1e-7)
+    net.rnn_clear_previous_state()
+    s = net.rnn_time_step(x[:, 0])     # (B, F) single step squeezes
+    assert s.shape == (2, 3)
+    np.testing.assert_allclose(s, full[:, 0], rtol=1e-5, atol=1e-6)
