@@ -338,3 +338,88 @@ class SingletonMultiDataSetIterator:
     @property
     def async_supported(self) -> bool:
         return False
+
+
+class DeviceCacheDataSetIterator(DataSetIterator):
+    """Upload a pre-batched dataset to the device ONCE and iterate the
+    resident copies (any number of epochs for free).
+
+    The TPU-native answer to a slow host link: small benchmark datasets
+    (MNIST 47 MB, CIFAR-10 180 MB) fit in HBM many times over, so paying
+    the host→HBM transfer per epoch — let alone per step over a remote
+    tunnel — is pure waste. Batches keep their compact wire dtypes (uint8
+    pixels, int ids); the compiled step casts/normalizes on device exactly
+    as it does for host-fed batches, so training is bit-identical.
+    """
+
+    def __init__(self, data, batch_size=None):
+        import jax
+
+        if not isinstance(data, list):
+            data = list(data)
+        if batch_size is not None and len(data) == 1:
+            data = data[0].batch_by(batch_size)
+
+        def put(a):
+            return None if a is None else jax.device_put(a)
+
+        def int_range(a, mask=None):
+            """(min, max) of an integer array while it is still host-side
+            — the fit-path range validation consumes this instead of
+            downloading the resident batch every step (masked positions
+            exempt: sentinel-id padding is legal under a labels mask)."""
+            if a is None:
+                return None
+            arr = np.asarray(a)
+            if not np.issubdtype(arr.dtype, np.integer) or not arr.size:
+                return None
+            if mask is not None:
+                arr = arr[np.asarray(mask).astype(bool).reshape(arr.shape)]
+                if not arr.size:
+                    return None
+            return (int(arr.min()), int(arr.max()))
+
+        staged = []
+        for d in data:
+            ds = DataSet(put(d.features), put(d.labels),
+                         put(d.features_mask), put(d.labels_mask))
+            ds._value_ranges = {
+                "features": int_range(d.features),
+                "labels": int_range(d.labels, d.labels_mask),
+            }
+            staged.append(ds)
+        self._data = staged
+        self._pos = 0
+        # force the uploads to COMPLETE now (device_put is async, and over
+        # a remote transport block_until_ready is not a reliable barrier):
+        # one scalar that depends on every staged buffer, materialized host-
+        # side, so the first training pass never waits on a transfer
+        import jax.numpy as jnp
+
+        arrs = [a for d in self._data
+                for a in (d.features, d.labels, d.features_mask,
+                          d.labels_mask) if a is not None]
+        if arrs:
+            # full reductions: a single-element read is not enough on a
+            # lazy remote transport — only consuming every element forces
+            # the complete buffers across
+            tot = sum(jnp.sum(a.astype(jnp.float32)) for a in arrs)
+            float(tot)
+
+    def has_next(self):
+        return self._pos < len(self._data)
+
+    def next(self):
+        d = self._data[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._data[0].num_examples() if self._data else 0
+
+    @property
+    def async_supported(self):
+        return False  # already resident: a prefetch thread adds nothing

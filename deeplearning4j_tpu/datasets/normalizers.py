@@ -341,11 +341,27 @@ class OneHotEncoder(DataNormalization):
             self.n_classes = m + 1
         return self
 
-    def check_ids(self, ids) -> None:
+    def check_ids(self, ids, value_range=None) -> None:
         """Raise on out-of-range ids. The device-side `jax.nn.one_hot`
         SILENTLY emits an all-zero row for an OOB id (and host `np.eye`
         indexing wraps negatives / raises on large ids) — the fit paths
-        call this so both placements fail loudly and identically."""
+        call this so both placements fail loudly and identically. For a
+        device-resident batch, `value_range` is the (min, max) recorded at
+        staging time (DeviceCacheDataSetIterator) — checking the array
+        itself would download it through the host link per step."""
+        import jax.numpy as jnp
+
+        if isinstance(ids, jnp.ndarray) and not isinstance(ids, np.ndarray):
+            if value_range is None:
+                return
+            mn, mx = value_range
+            if mn < 0 or mx >= self.n_classes:
+                bad = mn if mn < 0 else mx
+                raise ValueError(
+                    f"OneHotEncoder({self.n_classes}): feature id {bad} "
+                    f"out of range [0, {self.n_classes}) (range recorded "
+                    "when the batch was staged on device)")
+            return
         ids = np.asarray(ids)
         if not ids.size:
             return

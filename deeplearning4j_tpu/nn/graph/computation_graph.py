@@ -368,10 +368,18 @@ class ComputationGraph:
     def _to_mds(self, ds: Union[DataSet, MultiDataSet]) -> MultiDataSet:
         if isinstance(ds, MultiDataSet):
             return ds
-        return MultiDataSet(
+        mds = MultiDataSet(
             features=[ds.features], labels=[ds.labels],
             features_masks=[ds.features_mask] if ds.features_mask is not None else None,
             labels_masks=[ds.labels_mask] if ds.labels_mask is not None else None)
+        # staged-time integer ranges travel with the wrapped batch so the
+        # validation paths can range-check device-resident data (see
+        # DeviceCacheDataSetIterator)
+        r = getattr(ds, "_value_ranges", None)
+        if r is not None:
+            mds._value_ranges = {"features": [r.get("features")],
+                                 "labels": [r.get("labels")]}
+        return mds
 
     def fit(self, data, epochs: int = 1, scan_steps: int = 1) -> None:
         """Train (reference `ComputationGraph.fit:670`).
@@ -426,18 +434,18 @@ class ComputationGraph:
                                 or (pending
                                     and self._mds_sig(mds)
                                     != self._mds_sig(pending[0]))):
-                            self._flush_scan(pending)
+                            self._flush_scan(pending, scan_steps)
                             pending = []
                             self._fit_batch(mds)
                             continue
                         pending.append(mds)
                         if len(pending) == scan_steps:
-                            self._flush_scan(pending)
+                            self._flush_scan(pending, scan_steps)
                             pending = []
                     else:
                         self._fit_batch(mds)
                 if scan and pending:
-                    self._flush_scan(pending)
+                    self._flush_scan(pending, scan_steps)
                 if n_batches == 0:
                     import logging
 
@@ -508,26 +516,31 @@ class ComputationGraph:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
 
-    def _flush_scan(self, pending: List[MultiDataSet]) -> None:
+    def _flush_scan(self, pending: List[MultiDataSet],
+                    full: Optional[int] = None) -> None:
+        """A flush shorter than the configured chunk (`full`) runs
+        per-batch through the already-compiled single step — a lax.scan is
+        specialized on its length, so a one-off tail length would pay a
+        fresh multi-second XLA compile (see MultiLayerNetwork._flush_scan)."""
         if not pending:
             return
-        if len(pending) == 1:
-            self._fit_batch(pending[0])
+        if len(pending) == 1 or (full is not None and len(pending) < full):
+            for mds in pending:
+                self._fit_batch(mds)
             return
         for mds in pending:
             self._validate_labels(mds)
         if self._jit_scan is None:
             self._jit_scan = self._make_scan_train()
-        from deeplearning4j_tpu.nn.precision import wire_asarray
+        from deeplearning4j_tpu.nn.precision import stack_wire
 
         ids_flags = self._inputs_are_ids()
         feats = tuple(
-            wire_asarray(np.stack([np.asarray(m.features[i]) for m in pending]),
-                         self.dtype, ids_flags[i])
+            stack_wire([m.features[i] for m in pending], self.dtype,
+                       ids_flags[i])
             for i in range(len(self.conf.network_inputs)))
         labels = tuple(
-            wire_asarray(np.stack([np.asarray(m.labels[o]) for m in pending]),
-                         self.dtype)
+            stack_wire([m.labels[o] for m in pending], self.dtype)
             for o in range(len(self.conf.network_outputs)))
         if self._it_device is None:
             self._it_device = jnp.asarray(self.iteration, jnp.int32)
@@ -934,10 +947,13 @@ class ComputationGraph:
             # so a broadcast encoder never transforms them — don't range-
             # check their vocab against the encoder's n_classes
             int_sinks = self._integer_sink_inputs()
-            for name, n, f in zip(self.conf.network_inputs, norms,
-                                  mds.features):
+            f_ranges = getattr(mds, "_value_ranges",
+                               {}).get("features") or [None] * len(mds.features)
+            for name, n, f, fr in zip(self.conf.network_inputs, norms,
+                                      mds.features, f_ranges):
                 if isinstance(n, OneHotEncoder) and name not in int_sinks:
-                    n.check_ids(f)  # device one_hot zero-rows OOB silently
+                    # device one_hot zero-rows OOB silently
+                    n.check_ids(f, value_range=fr)
         self._check_sparse_labels(mds)
 
     def _check_sparse_labels(self, mds: MultiDataSet) -> None:
@@ -947,11 +963,13 @@ class ComputationGraph:
         from deeplearning4j_tpu.ops.losses import check_sparse_label_range
 
         lmasks = mds.labels_masks or [None] * len(mds.labels)
-        for oname, l, lm in zip(self.conf.network_outputs, mds.labels,
-                                lmasks):
+        l_ranges = getattr(mds, "_value_ranges",
+                           {}).get("labels") or [None] * len(mds.labels)
+        for oname, l, lm, lr in zip(self.conf.network_outputs, mds.labels,
+                                    lmasks, l_ranges):
             check_sparse_label_range(
                 l, getattr(self.conf.nodes[oname].layer, "n_out", None),
-                mask=lm, where=f"output {oname!r}")
+                mask=lm, where=f"output {oname!r}", value_range=lr)
 
     def score(self, ds: Union[DataSet, MultiDataSet], train: bool = False) -> float:
         self._ensure_init()

@@ -433,18 +433,18 @@ class MultiLayerNetwork:
 
                         if (ds.features_mask is not None or ds.labels_mask is not None
                                 or (pending and _sig(ds) != _sig(pending[0]))):
-                            self._flush_scan(pending)  # shape change / masks
+                            self._flush_scan(pending, scan_steps)  # shape change / masks
                             pending = []
                             self._fit_batch(ds)
                             continue
                         pending.append(ds)
                         if len(pending) == scan_steps:
-                            self._flush_scan(pending)
+                            self._flush_scan(pending, scan_steps)
                             pending = []
                     else:
                         self._fit_batch(ds)
                 if scan and pending:
-                    self._flush_scan(pending)
+                    self._flush_scan(pending, scan_steps)
                 if n_batches == 0:
                     import logging
 
@@ -465,24 +465,29 @@ class MultiLayerNetwork:
                 except ValueError:
                     pass  # one-shot underlying cannot rewind
 
-    def _flush_scan(self, pending: List[DataSet]) -> None:
+    def _flush_scan(self, pending: List[DataSet],
+                    full: Optional[int] = None) -> None:
         """Run the accumulated uniform batches as one scanned dispatch.
-        One or two batches aren't worth a separate scan compilation."""
+        A flush SHORTER than the configured chunk (`full`) — the iterator
+        tail, or a signature change mid-stream — runs per-batch through the
+        already-compiled single step instead: a lax.scan is specialized on
+        its length, so every distinct chunk length would trigger a fresh
+        multi-second XLA compile for a one-off shape."""
         if not pending:
             return
-        if len(pending) == 1:
-            self._fit_batch(pending[0])
+        if len(pending) == 1 or (full is not None and len(pending) < full):
+            for ds in pending:
+                self._fit_batch(ds)
             return
         for ds in pending:
             self._validate_labels(ds)
         if self._jit_scan is None:
             self._jit_scan = self._make_scan_train()
-        from deeplearning4j_tpu.nn.precision import wire_asarray
+        from deeplearning4j_tpu.nn.precision import stack_wire
 
-        feats = wire_asarray(np.stack([ds.features for ds in pending]),
-                             self.dtype, self._features_are_ids())
-        labels = wire_asarray(np.stack([ds.labels for ds in pending]),
-                              self.dtype)
+        feats = stack_wire([ds.features for ds in pending],
+                           self.dtype, self._features_are_ids())
+        labels = stack_wire([ds.labels for ds in pending], self.dtype)
         if self._it_device is None:
             self._it_device = jnp.asarray(self.iteration, jnp.int32)
         (self._params, self._upd_state, self._layer_state, self._it_device,
@@ -532,22 +537,28 @@ class MultiLayerNetwork:
         `exceptions/TestInvalidInput` error paths)."""
         from deeplearning4j_tpu.datasets.normalizers import OneHotEncoder
 
+        ranges = getattr(ds, "_value_ranges", {})
         if isinstance(self._normalizer, OneHotEncoder):
             # device one_hot silently zero-rows an OOB id: fail loudly here
-            self._normalizer.check_ids(ds.features)
+            self._normalizer.check_ids(ds.features,
+                                       value_range=ranges.get("features"))
         out_layer = self.layers[-1]
         n_out = getattr(out_layer, "n_out", None)
         if ds.labels is None:
             raise ValueError("fit() requires labels; got DataSet with labels=None "
                              "(use pretrain() for unsupervised training)")
-        labels = np.asarray(ds.labels)
+        # dtype/shape probes only — never np.asarray a device-resident
+        # batch (that would download it through the host link every step)
+        labels = (ds.labels if hasattr(ds.labels, "dtype")
+                  else np.asarray(ds.labels))
         if np.issubdtype(labels.dtype, np.integer):
             # sparse class-id labels: width check is a range check instead;
             # sentinel ids on mask==0 positions are allowed (the loss clamps
             # the gather, masked rows contribute nothing)
             from deeplearning4j_tpu.ops.losses import check_sparse_label_range
 
-            check_sparse_label_range(labels, n_out, mask=ds.labels_mask)
+            check_sparse_label_range(labels, n_out, mask=ds.labels_mask,
+                                     value_range=ranges.get("labels"))
             return
         if n_out and labels.shape[-1] != n_out:
             raise ValueError(

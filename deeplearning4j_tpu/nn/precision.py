@@ -48,3 +48,23 @@ def wire_asarray(a, dtype, as_ids=False):
     if jnp.issubdtype(adtype, np.floating):
         return jnp.asarray(a, dtype)
     return jnp.asarray(a)
+
+
+def stack_wire(arrs, dtype, as_ids=False):
+    """Stack a list of per-batch arrays for a scanned dispatch, with the
+    same cast policy as `wire_asarray`. Already-device-resident batches
+    (DeviceCacheDataSetIterator) stack ON DEVICE — np.stack would drag
+    every batch back through the host link."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if all(isinstance(a, jnp.ndarray) for a in arrs):
+        x = jnp.stack(arrs)
+        if as_ids:
+            return x.astype(jnp.int32) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
+            x = x.astype(dtype)
+        return x
+    return wire_asarray(np.stack([np.asarray(a) for a in arrs]), dtype,
+                        as_ids)

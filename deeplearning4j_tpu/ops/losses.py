@@ -169,7 +169,8 @@ def loss_fn(loss: LossFunction | str):
 
 
 def check_sparse_label_range(labels, n_classes, mask=None,
-                             where: str = "the output layer") -> None:
+                             where: str = "the output layer",
+                             value_range=None) -> None:
     """Shared validation for sparse class-id labels (used by
     MultiLayerNetwork, ComputationGraph, and Evaluation): raise a clear
     error when an id falls outside [0, n_classes) — inside the traced
@@ -177,8 +178,23 @@ def check_sparse_label_range(labels, n_classes, mask=None,
     class. Positions where `mask` == 0 are exempt: pad-with-sentinel plus a
     labels mask is the standard variable-length convention, and masked
     positions contribute nothing to the (clamped) loss."""
+    import jax.numpy as jnp
     import numpy as np
 
+    if isinstance(labels, jnp.ndarray) and not isinstance(labels, np.ndarray):
+        # device-resident batch: a value check would download it through
+        # the host link every step. DeviceCacheDataSetIterator records the
+        # (masked) integer range at staging time while the data is still
+        # host-side — validate against that instead.
+        if value_range is not None and n_classes:
+            mn, mx = value_range
+            if mx >= n_classes or mn < 0:
+                bad = mx if mx >= n_classes else mn
+                raise ValueError(
+                    f"sparse label id {bad} out of range [0, {n_classes}) "
+                    f"for {where} (range recorded when the batch was "
+                    "staged on device)")
+        return
     larr = np.asarray(labels)
     if (not np.issubdtype(larr.dtype, np.integer) or not larr.size
             or not n_classes):

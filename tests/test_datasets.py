@@ -1,6 +1,7 @@
 """Dataset iterator tests (reference analogues: MNIST/Iris iterator tests in
 `deeplearning4j-core`, `AsyncDataSetIteratorTest`)."""
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.fetchers import (
@@ -79,3 +80,151 @@ def test_async_multi_dataset_iterator():
         for a, b in zip(got, mds):
             np.testing.assert_array_equal(a.features[0], b.features[0])
         it.reset()
+
+
+def test_device_cache_iterator_matches_host_fed_training():
+    """DeviceCacheDataSetIterator: staged-in-HBM batches train bit-identically
+    to host-fed batches (same compiled step, compact dtypes preserved)."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        DeviceCacheDataSetIterator,
+        ListDataSetIterator,
+    )
+    from deeplearning4j_tpu.datasets.normalizers import (
+        ImagePreProcessingScaler,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    def build():
+        conf = (dl4j.NeuralNetConfiguration.Builder()
+                .seed(3).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=8,
+                                  activation=Activation.RELU))
+                .layer(OutputLayer(n_in=8, n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.set_normalizer(ImagePreProcessingScaler())
+        return net
+
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randint(0, 256, (16, 6)).astype(np.uint8),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+               for _ in range(4)]
+    host = build()
+    host.fit(ListDataSetIterator(list(batches)), epochs=2)
+    dev = build()
+    cache = DeviceCacheDataSetIterator(list(batches))
+    dev.fit(cache, epochs=2)   # reset() between epochs is free
+    np.testing.assert_allclose(dev.params(), host.params(), rtol=1e-6,
+                               atol=1e-7)
+    assert dev.iteration == host.iteration == 8
+
+
+def test_scan_tail_runs_per_batch():
+    """An iterator whose length is not a multiple of scan_steps runs the
+    tail per-batch (no one-off scan-length compile) and still matches
+    sequential training."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    def build():
+        conf = (dl4j.NeuralNetConfiguration.Builder()
+                .seed(5).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8,
+                                  activation=Activation.TANH))
+                .layer(OutputLayer(n_in=8, n_out=2,
+                                   activation=Activation.SOFTMAX,
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    rng = np.random.RandomState(1)
+    batches = [DataSet(rng.randn(8, 4).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)])
+               for _ in range(7)]   # 7 batches, scan_steps=3 -> tail of 1
+    seq = build()
+    for ds in batches:
+        seq.fit(ds)
+    scan = build()
+    scan.fit(ListDataSetIterator(list(batches)), scan_steps=3)
+    np.testing.assert_allclose(scan.params(), seq.params(), rtol=1e-5,
+                               atol=1e-6)
+    assert scan.iteration == 7
+
+
+def test_device_cache_preserves_range_validation():
+    """Staging a batch on device keeps the loud OOB failure: the integer
+    range recorded at staging time is validated on fit, without
+    downloading the resident batch (regression: the device-array skip
+    silently dropped the check)."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        DeviceCacheDataSetIterator,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(3).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_in=8, n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    bad = rng.randint(0, 3, 8).astype(np.int32)
+    bad[2] = 3  # == n_out: the classic off-by-one vocab bug
+    it = DeviceCacheDataSetIterator([DataSet(x, bad)])
+    with pytest.raises(ValueError, match="out of range"):
+        net.fit(it)
+    # masked sentinel ids stay legal (range is recorded mask-aware)
+    seq = rng.randint(0, 3, (4, 5)).astype(np.int32)
+    # sentinel on a masked position must NOT trip the staged range
+    from deeplearning4j_tpu.nn.conf.layers import (
+        GravesLSTM,
+        RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    rconf = (dl4j.NeuralNetConfiguration.Builder()
+             .seed(4).learning_rate(0.1)
+             .list()
+             .layer(GravesLSTM(n_in=4, n_out=6, activation=Activation.TANH))
+             .layer(RnnOutputLayer(n_in=6, n_out=3,
+                                   activation=Activation.SOFTMAX,
+                                   loss=LossFunction.MCXENT))
+             .set_input_type(InputType.recurrent(4))
+             .build())
+    rnet = MultiLayerNetwork(rconf)
+    rnet.init()
+    mask = np.ones((4, 5), np.float32)
+    mask[:, 4] = 0
+    seq2 = seq.copy()
+    seq2[:, 4] = 99  # sentinel under mask==0
+    xs = rng.randn(4, 5, 4).astype(np.float32)
+    rit = DeviceCacheDataSetIterator([DataSet(xs, seq2, mask, mask)])
+    rnet.fit(rit)  # must not raise
+    assert np.isfinite(rnet.score_value)
