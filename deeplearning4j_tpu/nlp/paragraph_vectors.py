@@ -33,12 +33,22 @@ class ParagraphVectors(SequenceVectors):
                  train_words: bool = True,
                  **kwargs):
         kwargs.setdefault("elements_learning_algorithm", "skipgram")
-        kwargs.setdefault("negative", 5)
-        if kwargs.get("use_hierarchic_softmax"):
-            raise NotImplementedError("ParagraphVectors: negative sampling only")
+        # HS configurations default to PURE hierarchical softmax — the
+        # inherited negative=5 default would silently put the model in
+        # mixed HS+NS mode
+        kwargs.setdefault(
+            "negative", 0 if kwargs.get("use_hierarchic_softmax") else 5)
         super().__init__(**kwargs)
         if sequence_learning_algorithm not in ("dbow", "dm"):
             raise ValueError(sequence_learning_algorithm)
+        if (self.use_hs and self.negative > 0
+                and sequence_learning_algorithm == "dm"):
+            # same restriction SequenceVectors applies to cbow (PV-DM is
+            # the cbow-shaped path): the mixed-mode flush trains the
+            # skip-gram buffers only
+            raise NotImplementedError(
+                "PV-DM with mixed HS+negative-sampling is not supported; "
+                "use negative=0 (pure HS) or use_hierarchic_softmax=False")
         self.seq_algorithm = sequence_learning_algorithm
         self.train_words = train_words
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
@@ -156,20 +166,43 @@ class ParagraphVectors(SequenceVectors):
         vec = jnp.asarray((rng.random(D) - 0.5) / D, self.lookup_table.syn0.dtype)
         if not ids:
             return np.asarray(vec)
-        K = self.negative + 1
-        syn1 = self.lookup_table.syn1neg
+        hs_args = None
+        if self.use_hs:
+            # hierarchical softmax: each word contributes its Huffman path
+            # (targets = internal-node rows of syn1, labels = 1 - code).
+            # The paths are deterministic — build once, reuse every step.
+            K = max(max((len(self.vocab.element_at_index(w).codes)
+                         for w in ids), default=1), 1)
+            t = np.zeros((len(ids), K), np.int32)
+            lb = np.zeros((len(ids), K), np.float32)
+            mk = np.zeros((len(ids), K), np.float32)
+            for r, w in enumerate(ids):
+                vw = self.vocab.element_at_index(w)
+                for k, (code, point) in enumerate(zip(vw.codes, vw.points)):
+                    t[r, k] = point
+                    lb[r, k] = 1.0 - code
+                    mk[r, k] = 1.0
+            hs_args = (jnp.asarray(t), jnp.asarray(lb), jnp.asarray(mk))
         for step in range(steps):
             lr = alpha * (1.0 - step / steps)
-            targets = np.zeros((len(ids), K), np.int32)
-            labels = np.zeros((len(ids), K), np.float32)
-            mask = np.ones((len(ids), K), np.float32)
-            for r, w in enumerate(ids):
-                targets[r, 0] = w
-                labels[r, 0] = 1.0
-                negs = self._sample_negatives(self.negative)
-                targets[r, 1:] = negs
-                mask[r, 1:] = (negs != w).astype(np.float32)
-            vec, _ = kernels.infer_step(vec, syn1, jnp.asarray(targets),
-                                        jnp.asarray(labels), jnp.asarray(mask),
-                                        jnp.float32(lr))
+            if hs_args is not None:
+                vec, _ = kernels.infer_step(vec, self.lookup_table.syn1,
+                                            *hs_args, jnp.float32(lr))
+            if self.negative > 0:
+                # negatives resample every step — the training objective's
+                # stochastic half (mixed HS+NS models optimize both)
+                K = self.negative + 1
+                targets = np.zeros((len(ids), K), np.int32)
+                labels = np.zeros((len(ids), K), np.float32)
+                mask = np.zeros((len(ids), K), np.float32)
+                for r, w in enumerate(ids):
+                    targets[r, 0] = w
+                    labels[r, 0] = 1.0
+                    mask[r, 0] = 1.0
+                    negs = self._sample_negatives(self.negative)
+                    targets[r, 1:] = negs
+                    mask[r, 1:] = (negs != w).astype(np.float32)
+                vec, _ = kernels.infer_step(
+                    vec, self.lookup_table.syn1neg, jnp.asarray(targets),
+                    jnp.asarray(labels), jnp.asarray(mask), jnp.float32(lr))
         return np.asarray(vec)

@@ -315,8 +315,8 @@ class PreprocessorVertex(GraphVertex):
     def output_type(self, inputs):
         return self.preprocessor.output_type(inputs[0])
 
-    def forward(self, inputs):
-        return self.preprocessor.preprocess(inputs[0])
+    def forward(self, inputs, rng=None, train=False):
+        return self.preprocessor.preprocess(inputs[0], rng=rng, train=train)
 
     def to_json(self) -> dict:
         return {"type": self.TYPE,

@@ -134,6 +134,9 @@ class Layer:
     dist: Optional[Distribution] = None
     bias_init: Optional[float] = None
     dropout: Optional[float] = None  # keep-independent drop prob, 0 = off
+    # DropConnect: mask the weight matrix instead of the input (reference
+    # `NeuralNetConfiguration.useDropConnect` + `BaseLayer.preOutput:369`)
+    use_drop_connect: Optional[bool] = None
     l1: Optional[float] = None
     l2: Optional[float] = None
     l1_bias: Optional[float] = None
@@ -185,6 +188,18 @@ class Layer:
         m = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(m, x / keep, 0.0)
 
+    def _maybe_drop_connect(self, W, train, rng):
+        """DropConnect: the WEIGHT matrix gets the dropout mask instead of
+        the input (reference `BaseLayer.preOutput:369-370` →
+        `Dropout.applyDropConnect` when `useDropConnect` is set). Inverted
+        scaling keeps E[W]."""
+        p = self.dropout or 0.0
+        if not train or p <= 0.0 or rng is None:
+            return W
+        keep = 1.0 - p
+        m = jax.random.bernoulli(jax.random.fold_in(rng, 1), keep, W.shape)
+        return jnp.where(m, W / keep, 0.0)
+
     def _winit(self, key, shape, fan_in, fan_out, dtype):
         return init_weights(key, shape, fan_in, fan_out,
                             self.weight_init or WeightInit.XAVIER, self.dist, dtype)
@@ -228,8 +243,15 @@ class DenseLayer(FeedForwardLayer):
         return {"W": W, "b": b}
 
     def pre_output(self, params, x, *, train=False, rng=None):
-        x = self._maybe_dropout(x, train, rng)
-        return x @ params["W"] + params["b"]
+        W = params["W"]
+        if self.use_drop_connect:
+            # reference semantics: DropConnect REPLACES input dropout
+            # (BaseLayer.preOutput:485 gates input dropout on
+            # !isUseDropConnect)
+            W = self._maybe_drop_connect(W, train, rng)
+        else:
+            x = self._maybe_dropout(x, train, rng)
+        return x @ W + params["b"]
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         return self._act()(self.pre_output(params, x, train=train, rng=rng)), state

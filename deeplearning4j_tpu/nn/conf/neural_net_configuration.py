@@ -75,6 +75,7 @@ class GlobalConf:
     l1_bias: float = 0.0
     l2_bias: float = 0.0
     dropout: float = 0.0
+    use_drop_connect: bool = False
     updater: Updater = Updater.SGD
     momentum: float = 0.9
     rho: float = 0.95
@@ -156,6 +157,13 @@ class NeuralNetConfiguration:
 
         def drop_out(self, p: float):
             self._g.dropout = p
+            return self
+
+        def use_drop_connect(self, use: bool = True):
+            """DropConnect: the dropout probability masks WEIGHTS instead
+            of layer inputs (reference
+            `NeuralNetConfiguration.Builder.useDropConnect`)."""
+            self._g.use_drop_connect = use
             return self
 
         def updater(self, u):
@@ -360,6 +368,24 @@ def _merge_layer_defaults(layer: Layer, g: GlobalConf) -> Layer:
         l.bias_init = g.bias_init
     if l.dropout is None:
         l.dropout = g.dropout
+    if l.use_drop_connect is None:
+        # DropConnect applies where the reference applies it: the
+        # BaseLayer.preOutput W·x+b path, i.e. the dense family here.
+        # Conv/LSTM/etc. have their own preOutput in the reference and do
+        # NOT dropconnect — so the global flag only lands on dense layers
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+
+        l.use_drop_connect = (g.use_drop_connect
+                              if isinstance(l, DenseLayer) else False)
+    elif l.use_drop_connect:
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+
+        if not isinstance(l, DenseLayer):
+            raise ValueError(
+                f"use_drop_connect is only supported on dense-family "
+                f"layers (the reference's BaseLayer.preOutput path); "
+                f"{type(l).__name__} applies input dropout — set "
+                "use_drop_connect=False/None for this layer")
     if l.l1 is None:
         l.l1 = g.l1 if g.use_regularization else 0.0
     if l.l2 is None:

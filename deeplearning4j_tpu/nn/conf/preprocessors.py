@@ -39,16 +39,26 @@ def register_preprocessor(cls):
 def preprocessor_to_json(p) -> dict:
     import dataclasses
 
+    if p.TYPE == "composable":
+        return {"type": "composable",
+                "children": [preprocessor_to_json(c) for c in p.children]}
     return {"type": p.TYPE, **dataclasses.asdict(p)}
 
 
 def preprocessor_from_json(d: dict):
     d = dict(d)
-    return _PRE_REGISTRY[d.pop("type")](**d)
+    t = d.pop("type")
+    if t == "composable":
+        return _PRE_REGISTRY[t](*[preprocessor_from_json(c)
+                                  for c in d.pop("children")])
+    return _PRE_REGISTRY[t](**d)
 
 
 class InputPreProcessor:
-    def preprocess(self, x: jnp.ndarray) -> jnp.ndarray:
+    def preprocess(self, x: jnp.ndarray, rng=None,
+                   train: bool = False) -> jnp.ndarray:
+        """`rng`/`train` flow from the training step for stochastic
+        preprocessors (BinomialSampling); deterministic ones ignore them."""
         raise NotImplementedError
 
     def output_type(self, it: InputType) -> InputType:
@@ -66,7 +76,7 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(x.shape[0], -1)
 
     def output_type(self, it):
@@ -85,7 +95,7 @@ class FeedForwardToCnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(x.shape[0], self.height, self.width, self.channels)
 
     def output_type(self, it):
@@ -100,7 +110,7 @@ class RnnToFeedForwardPreProcessor(InputPreProcessor):
 
     TYPE = "rnn_to_ff"
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(-1, x.shape[-1])
 
     def output_type(self, it):
@@ -117,7 +127,7 @@ class FeedForwardToRnnPreProcessor(InputPreProcessor):
     TYPE = "ff_to_rnn"
     timeseries_length: int = -1
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(-1, self.timeseries_length, x.shape[-1])
 
     def output_type(self, it):
@@ -137,7 +147,7 @@ class CnnToRnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(x.shape[0], 1, -1)
 
     def output_type(self, it):
@@ -156,7 +166,7 @@ class RnnToCnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape(-1, self.height, self.width, self.channels)
 
     def output_type(self, it):
@@ -171,7 +181,7 @@ class ReshapePreProcessor(InputPreProcessor):
     TYPE = "reshape"
     shape: tuple = ()
 
-    def preprocess(self, x):
+    def preprocess(self, x, rng=None, train=False):
         return x.reshape((x.shape[0],) + tuple(self.shape))
 
     def output_type(self, it):
@@ -180,3 +190,100 @@ class ReshapePreProcessor(InputPreProcessor):
         if len(self.shape) == 3:
             return InputType.convolutional(*self.shape)
         raise ValueError(self.shape)
+
+
+@register_preprocessor
+@dataclass
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    """Subtract per-feature batch mean (reference
+    `preprocessor/ZeroMeanPrePreProcessor.java`)."""
+
+    TYPE = "zero_mean"
+
+    def preprocess(self, x, rng=None, train=False):
+        return x - jnp.mean(x, axis=0, keepdims=True)
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+@register_preprocessor
+@dataclass
+class UnitVarianceProcessor(InputPreProcessor):
+    """Divide by per-feature batch std (reference
+    `preprocessor/UnitVarianceProcessor.java`)."""
+
+    TYPE = "unit_variance"
+    eps: float = 1e-8
+
+    def preprocess(self, x, rng=None, train=False):
+        return x / (jnp.std(x, axis=0, keepdims=True) + self.eps)
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+@register_preprocessor
+@dataclass
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Standardize over the batch (reference
+    `preprocessor/ZeroMeanAndUnitVariancePreProcessor.java`)."""
+
+    TYPE = "zero_mean_unit_variance"
+    eps: float = 1e-8
+
+    def preprocess(self, x, rng=None, train=False):
+        m = jnp.mean(x, axis=0, keepdims=True)
+        s = jnp.std(x, axis=0, keepdims=True)
+        return (x - m) / (s + self.eps)
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+@register_preprocessor
+@dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Sample Bernoulli(activation) — binary stochastic units for
+    RBM-style stacks (reference
+    `preprocessor/BinomialSamplingPreProcessor.java`). Sampling happens
+    only in training with an rng available; inference passes the
+    probabilities through (expectation), the same eval convention as
+    dropout."""
+
+    TYPE = "binomial_sampling"
+
+    def preprocess(self, x, rng=None, train=False):
+        if not train or rng is None:
+            return x
+        import jax
+
+        return jax.random.bernoulli(
+            jax.random.fold_in(rng, 97), x).astype(x.dtype)
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Apply a sequence of preprocessors in order (reference
+    `preprocessor/ComposableInputPreProcessor.java`)."""
+
+    TYPE = "composable"
+
+    def __init__(self, *children: InputPreProcessor):
+        self.children = list(children)
+
+    def preprocess(self, x, rng=None, train=False):
+        for c in self.children:
+            x = c.preprocess(x, rng=rng, train=train)
+        return x
+
+    def output_type(self, it: InputType) -> InputType:
+        for c in self.children:
+            it = c.output_type(it)
+        return it
+
+
+_PRE_REGISTRY["composable"] = ComposableInputPreProcessor

@@ -262,9 +262,10 @@ class ComputationGraph:
                             if masks.get(i) is not None), None)
             if node.is_layer:
                 x = in_acts[0]
-                if node.preprocessor is not None:
-                    x = node.preprocessor.preprocess(x)
                 lrng = None if rng is None else jax.random.fold_in(rng, li)
+                if node.preprocessor is not None:
+                    x = node.preprocessor.preprocess(x, rng=lrng,
+                                                     train=train)
                 mask = in_mask if x.ndim == 3 else None
                 acts[name], new_state[name] = node.layer.forward(
                     params[name], lstate[name], x, train=train, rng=lrng,
@@ -272,7 +273,15 @@ class ComputationGraph:
                 masks[name] = in_mask if acts[name].ndim == 3 else None
             else:
                 v = node.vertex
-                if isinstance(v, LastTimeStepVertex):
+                from deeplearning4j_tpu.nn.conf.computation_graph_configuration import (  # noqa: E501
+                    PreprocessorVertex,
+                )
+
+                if isinstance(v, PreprocessorVertex):
+                    vrng = None if rng is None else jax.random.fold_in(rng, li)
+                    acts[name] = v.forward(in_acts, rng=vrng, train=train)
+                    masks[name] = in_mask if acts[name].ndim == 3 else None
+                elif isinstance(v, LastTimeStepVertex):
                     m = masks.get(v.mask_input) if v.mask_input else in_mask
                     acts[name] = v.forward(in_acts, mask=m)
                     masks[name] = None
@@ -321,10 +330,13 @@ class ComputationGraph:
             # recompute the output head's loss from its INPUT activation so
             # the softmax+CE fuses stably (acts[oname] is post-activation)
             x = acts[node.inputs[0]]
-            if node.preprocessor is not None:
-                x = node.preprocessor.preprocess(x)
             li = conf.topological_order.index(oname)
             lrng = None if rng is None else jax.random.fold_in(rng, li)
+            if node.preprocessor is not None:
+                # SAME rng as the forward pass's application (fold_in by
+                # topo index) — a stochastic preprocessor must sample
+                # identically in acts and in the loss recompute
+                x = node.preprocessor.preprocess(x, rng=lrng, train=train)
             lmask = lmasks[oi] if lmasks is not None else None
             total = total + node.layer.loss_score(params_in[oname], x, labels[oi],
                                                   train=train, rng=lrng,

@@ -211,9 +211,10 @@ class MultiLayerNetwork:
         new_state = list(lstate)
         for i in range(n):
             layer = self.layers[i]
-            if i in self.conf.preprocessors:
-                x = self.conf.preprocessors[i].preprocess(x)
             lrng = None if rng is None else jax.random.fold_in(rng, i)
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].preprocess(x, rng=lrng,
+                                                          train=train)
             mask = fmask if x.ndim == 3 else None
             x, new_state[i] = layer.forward(params[i], lstate[i], x,
                                             train=train, rng=lrng, mask=mask)
@@ -250,9 +251,10 @@ class MultiLayerNetwork:
             x = x.astype(self.dtype)
             new_state = restore_dtypes(new_state, lstate_in)
         out_layer = self.layers[-1]
-        if len(self.layers) - 1 in self.conf.preprocessors:
-            x = self.conf.preprocessors[len(self.layers) - 1].preprocess(x)
         out_rng = None if rng is None else jax.random.fold_in(rng, len(self.layers) - 1)
+        if len(self.layers) - 1 in self.conf.preprocessors:
+            x = self.conf.preprocessors[len(self.layers) - 1].preprocess(
+                x, rng=out_rng, train=train)
         mask = lmask if lmask is not None else (fmask if x.ndim == 3 else None)
         loss = out_layer.loss_score(params_in[-1], x, labels, train=train,
                                     rng=out_rng, mask=mask)

@@ -101,3 +101,79 @@ def test_strict_mode_invalid_size_raises():
          .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
          .set_input_type(InputType.convolutional(5, 5, 1))
          .build())
+
+
+def test_new_preprocessors_round_trip_and_semantics():
+    """ZeroMean/UnitVariance/ZeroMeanAndUnitVariance/BinomialSampling/
+    Composable (the remaining reference `nn/conf/preprocessor/` classes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        BinomialSamplingPreProcessor,
+        ComposableInputPreProcessor,
+        UnitVarianceProcessor,
+        ZeroMeanAndUnitVariancePreProcessor,
+        ZeroMeanPrePreProcessor,
+        preprocessor_from_json,
+        preprocessor_to_json,
+    )
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 5).astype(np.float32) * 3 + 1)
+
+    zm = ZeroMeanPrePreProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(zm).mean(axis=0), 0, atol=1e-5)
+    uv = UnitVarianceProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(uv).std(axis=0), 1, atol=1e-4)
+    zs = ZeroMeanAndUnitVariancePreProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(zs).mean(axis=0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(zs).std(axis=0), 1, atol=1e-4)
+
+    probs = jnp.asarray(rng.uniform(0, 1, (64, 8)).astype(np.float32))
+    bs = BinomialSamplingPreProcessor()
+    # inference/no-rng: pass-through expectations
+    np.testing.assert_array_equal(np.asarray(bs.preprocess(probs)),
+                                  np.asarray(probs))
+    sampled = np.asarray(bs.preprocess(probs, rng=jax.random.PRNGKey(0),
+                                       train=True))
+    assert set(np.unique(sampled)) <= {0.0, 1.0}
+    assert abs(sampled.mean() - float(probs.mean())) < 0.1
+
+    comp = ComposableInputPreProcessor(ZeroMeanPrePreProcessor(),
+                                       UnitVarianceProcessor())
+    cx = comp.preprocess(x)
+    np.testing.assert_allclose(np.asarray(cx).mean(axis=0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cx).std(axis=0), 1, atol=1e-4)
+    # serde round trip incl. nested composable
+    d = preprocessor_to_json(comp)
+    comp2 = preprocessor_from_json(d)
+    np.testing.assert_allclose(np.asarray(comp2.preprocess(x)),
+                               np.asarray(cx), rtol=1e-6)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    it = InputType.feed_forward(5)
+    assert comp2.output_type(it).size == 5
+
+
+def test_drop_connect_config_round_trip():
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.ops.activations import Activation
+
+    conf = (dl4j.NeuralNetConfiguration.Builder()
+            .seed(1).drop_out(0.4).use_drop_connect(True)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=4,
+                              activation=Activation.RELU))
+            .layer(OutputLayer(n_in=4, n_out=2,
+                               activation=Activation.SOFTMAX))
+            .build())
+    assert conf.layers[0].use_drop_connect is True
+    c2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert c2.layers[0].use_drop_connect is True
+    assert c2.layers[0].dropout == 0.4

@@ -174,3 +174,23 @@ def test_cg_lstm_gradients_masked():
     mds = MultiDataSet([X], [labels], features_masks=[mask],
                        labels_masks=[mask])
     assert check_gradients(g, mds, print_results=True)
+
+
+def test_dropconnect_gradients_deterministic_path():
+    """use_drop_connect configured: the deterministic gradient-check path
+    (no dropout rng) must still pass (reference gradient checks also run
+    with stochastic regularizers inactive at check time)."""
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder()
+         .seed(42).updater(Updater.NONE)
+         .drop_out(0.3).use_drop_connect(True)
+         .list()
+         .layer(DenseLayer(n_out=6, activation=Activation.TANH))
+         .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX))
+         .set_input_type(InputType.feed_forward(4))
+         .build()),
+        dtype=jnp.float64)
+    net.init()
+    assert net.layers[0].use_drop_connect is True
+    assert check_gradients(net, small_ds(), print_results=True)

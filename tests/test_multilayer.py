@@ -204,3 +204,56 @@ def test_rnn_time_step_chunked_matches_full_forward():
     s = net.rnn_time_step(x[:, 0])     # (B, F) single step squeezes
     assert s.shape == (2, 3)
     np.testing.assert_allclose(s, full[:, 0], rtol=1e-5, atol=1e-6)
+
+
+def test_drop_connect_masks_weights_not_inputs():
+    """DropConnect (reference BaseLayer.preOutput:369): training-mode
+    forwards are stochastic over the WEIGHT mask, inference is
+    deterministic, expectation is preserved by inverted scaling."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf import DenseLayer as DL
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5).learning_rate(0.1)
+            .drop_out(0.5).use_drop_connect(True)
+            .list()
+            .layer(DL(n_out=64, activation=Activation.IDENTITY))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT, dropout=0.0))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    layer = net.layers[0]
+    assert layer.use_drop_connect is True
+    x = jnp.ones((4, 8))
+    p = net._params[0]
+    # same rng -> identical; different rng -> different (stochastic mask)
+    r1 = jax.random.PRNGKey(1)
+    r2 = jax.random.PRNGKey(2)
+    a = layer.pre_output(p, x, train=True, rng=r1)
+    b = layer.pre_output(p, x, train=True, rng=r1)
+    c = layer.pre_output(p, x, train=True, rng=r2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+    # inference: deterministic, full weights
+    d = layer.pre_output(p, x, train=False, rng=r1)
+    np.testing.assert_allclose(np.asarray(d),
+                               np.asarray(x @ p["W"] + p["b"]), rtol=1e-6)
+    # expectation preserved: average many masked outputs ~ full output
+    outs = [np.asarray(layer.pre_output(p, x, train=True,
+                                        rng=jax.random.PRNGKey(i)))
+            for i in range(300)]
+    np.testing.assert_allclose(np.mean(outs, axis=0), np.asarray(d),
+                               rtol=0.2, atol=0.05)
+    # training still converges
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)]
+    first = None
+    for _ in range(30):
+        net.fit(DataSet(X, y))
+        first = first if first is not None else net.score_value
+    assert net.score_value < first

@@ -298,3 +298,34 @@ def test_device_cdf_preserves_tail_probabilities():
     # increasing cdf across the tail region)
     diffs = np.diff(fixed.astype(np.int64))
     assert (diffs > 0).mean() > 0.99
+
+
+def test_paragraph_vectors_hierarchical_softmax():
+    """PV-DBOW and PV-DM with hierarchical softmax (reference shares the
+    Huffman path between word and doc training; r1 raised
+    NotImplementedError here). Quality bar: trained doc vectors separate
+    the two topic clusters."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    cats = ["cat likes milk and sleeps on the warm mat all day long",
+            "the cat chased a mouse and then drank milk by the mat"]
+    cars = ["the car engine roared down the highway past the red truck",
+            "a truck and a car raced on the highway with loud engines"]
+    docs = [(f"cat_{i}", t) for i, t in enumerate(cats * 4)] \
+        + [(f"car_{i}", t) for i, t in enumerate(cars * 4)]
+
+    for algo in ("dbow", "dm"):
+        pv = ParagraphVectors(sequence_learning_algorithm=algo,
+                              layer_size=32, window=3, epochs=12, seed=3,
+                              negative=0, use_hierarchic_softmax=True,
+                              min_word_frequency=1)
+        pv.fit(docs)
+        assert pv.lookup_table.syn1 is not None  # Huffman table trained
+        same = pv.docs_nearest("cat_0", top_n=3)
+        assert same, f"{algo}: no neighbours"
+        # a same-topic doc should out-rank the cross-topic ones
+        top_labels = [l for l, _ in same]
+        assert any(l.startswith("cat") for l in top_labels[:2]), (algo, same)
+        # HS inference for unseen text produces a finite vector
+        v = pv.infer_vector("milk for the sleepy cat on a mat")
+        assert np.isfinite(v).all() and v.shape == (32,)
