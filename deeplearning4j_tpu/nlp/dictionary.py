@@ -1,0 +1,204 @@
+"""Dictionary-backed CJK morphological segmentation.
+
+The reference vendors a full Kuromoji fork (~6,920 LoC of
+lattice-and-Viterbi dictionary analysis) for `deeplearning4j-nlp-japanese`
+and a Twitter-text analyzer for `-korean`. This module is the same
+*mechanism* in miniature: a cost lattice over an embedded lexicon solved
+by Viterbi, with script-run fallback for out-of-vocabulary spans. The
+lexicon is deliberately small (no dictionary assets can ship in this
+environment) and PLUGGABLE — `Lexicon.from_entries` accepts any
+IPADIC-style word list, so a real dictionary drops in without code
+changes (the Kuromoji-replacement seam).
+
+Costs: known words cost less than unknown runs, and longer matches cost
+less per character, so the lattice prefers "日本語 | を | 勉強 | します"
+over per-character or whole-run segmentations — the standard unigram
+lattice behavior.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deeplearning4j_tpu.nlp.language import _script
+
+
+@dataclass(frozen=True)
+class LexEntry:
+    surface: str
+    pos: str = "unknown"
+    cost: float = 0.7
+
+
+class Lexicon:
+    """Surface-form dictionary with per-entry cost/POS."""
+
+    def __init__(self, entries: Iterable[LexEntry]):
+        self._by_surface: Dict[str, LexEntry] = {}
+        self.max_len = 1
+        for e in entries:
+            self._by_surface[e.surface] = e
+            self.max_len = max(self.max_len, len(e.surface))
+
+    @classmethod
+    def from_entries(cls, words: Iterable[Tuple[str, str]],
+                     cost: float = 0.7) -> "Lexicon":
+        """Build from (surface, pos) pairs — the seam for loading a real
+        IPADIC-style dictionary."""
+        return cls(LexEntry(w, p, cost) for w, p in words)
+
+    def lookup(self, surface: str) -> Optional[LexEntry]:
+        return self._by_surface.get(surface)
+
+    def __len__(self) -> int:
+        return len(self._by_surface)
+
+
+_UNKNOWN_BASE = 1.3    # an OOV run costs more than any dictionary word
+_UNKNOWN_PER_CHAR = 0.05
+_KNOWN_LEN_BONUS = 0.05  # longer dictionary matches cost slightly less
+
+
+def viterbi_segment(text: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
+    """Minimum-cost segmentation of `text` into (surface, pos) tokens.
+    Whitespace and punctuation separate the lattice; unknown spans fall
+    back to script runs tagged pos='unknown'."""
+    out: List[Tuple[str, str]] = []
+    n = len(text)
+    i = 0
+    while i < n:
+        ch = text[i]
+        if _script(ch) in ("space", "other"):
+            i += 1
+            continue
+        j = _chunk_end(text, i)
+        out.extend(_viterbi_chunk(text[i:j], lexicon))
+        i = j
+    return out
+
+
+def _chunk_end(text: str, i: int) -> int:
+    j = i
+    while j < len(text) and _script(text[j]) not in ("space", "other"):
+        j += 1
+    return j
+
+
+def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
+    n = len(chunk)
+    INF = float("inf")
+    best = [INF] * (n + 1)
+    back: List[Optional[Tuple[int, str, str]]] = [None] * (n + 1)
+    best[0] = 0.0
+    # run_end[i]: end of the maximal same-script run starting at i,
+    # precomputed right-to-left in ONE pass (recomputing per position
+    # would make long same-script chunks quadratic)
+    scripts = [_script(c) for c in chunk]
+    run_end = [0] * n
+    for i in range(n - 1, -1, -1):
+        run_end[i] = (run_end[i + 1]
+                      if i + 1 < n and scripts[i + 1] == scripts[i]
+                      else i + 1)
+    for i in range(n):
+        if best[i] == INF:
+            continue
+        # dictionary matches starting at i
+        for ln in range(1, min(lexicon.max_len, n - i) + 1):
+            surf = chunk[i:i + ln]
+            e = lexicon.lookup(surf)
+            if e is None:
+                continue
+            c = best[i] + max(0.1, e.cost - _KNOWN_LEN_BONUS * (ln - 1))
+            if c < best[i + ln]:
+                best[i + ln] = c
+                back[i + ln] = (i, surf, e.pos)
+        # unknown fallback: the maximal script run starting at i (never
+        # zero-length, so the lattice always reaches n)
+        j = run_end[i]
+        c = best[i] + _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)
+        if c < best[j]:
+            best[j] = c
+            back[j] = (i, chunk[i:j], "unknown")
+    # safety: lattice is always complete (the unknown edge advances), but
+    # guard against pathological inputs
+    if best[n] == INF:
+        return [(chunk, "unknown")]
+    toks: List[Tuple[str, str]] = []
+    i = n
+    while i > 0:
+        prev, surf, pos = back[i]
+        toks.append((surf, pos))
+        i = prev
+    toks.reverse()
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# Embedded Japanese lexicon — particles, auxiliaries, copulas, common
+# verbs/adjectives/nouns. Small by necessity; the Kuromoji replacement
+# seam is `Lexicon.from_entries` above.
+
+_JA_PARTICLES = ["は", "が", "を", "に", "で", "と", "も", "へ", "の",
+                 "や", "か", "ね", "よ", "から", "まで", "より", "など",
+                 "だけ", "しか", "でも", "には", "とは", "ので", "のに"]
+_JA_AUX = ["です", "でした", "ます", "ました", "ません", "ましょう",
+           "する", "します", "しました", "した", "して", "している",
+           "だ", "だった", "である", "ない", "なかった", "ある",
+           "あります", "いる", "います", "いた", "れる", "られる",
+           "たい", "ください"]
+_JA_NOUNS = ["日本", "日本語", "東京", "学校", "学生", "先生", "勉強",
+             "研究", "会社", "仕事", "言葉", "今日", "明日", "昨日",
+             "時間", "天気", "電車", "映画", "音楽", "料理", "水",
+             "本", "人", "私", "彼", "彼女", "猫", "犬", "山", "川",
+             "機械", "学習", "計算", "模型"]
+_JA_VERBS = ["行く", "行きます", "行った", "来る", "来ます", "来た",
+             "食べる", "食べます", "食べた", "飲む", "飲みます",
+             "読む", "読みます", "読んだ", "見る", "見ます", "見た",
+             "書く", "書きます", "話す", "話します", "使う", "使います",
+             "思う", "思います", "分かる", "分かります"]
+_JA_ADJ = ["速い", "遅い", "高い", "安い", "大きい", "小さい",
+           "新しい", "古い", "良い", "悪い", "面白い", "難しい",
+           "簡単", "きれい", "静か"]
+
+JAPANESE_LEXICON = Lexicon(
+    [LexEntry(w, "particle", 0.5) for w in _JA_PARTICLES]
+    + [LexEntry(w, "auxiliary", 0.6) for w in _JA_AUX]
+    + [LexEntry(w, "noun", 0.7) for w in _JA_NOUNS]
+    + [LexEntry(w, "verb", 0.7) for w in _JA_VERBS]
+    + [LexEntry(w, "adjective", 0.7) for w in _JA_ADJ])
+
+
+# ---------------------------------------------------------------------------
+# Embedded Korean lexicon — josa (case particles) and common verb/copula
+# endings; eojeol are split stem + particle(s), Twitter-text style.
+
+KOREAN_PARTICLES = ["은", "는", "이", "가", "을", "를", "에", "의",
+                    "와", "과", "도", "로", "으로", "에서", "부터",
+                    "까지", "에게", "한테", "처럼", "보다", "마다",
+                    "이나", "나", "든지", "요"]
+KOREAN_ENDINGS = ["입니다", "합니다", "습니다", "있습니다", "없습니다",
+                  "했습니다", "인다", "한다", "된다", "이다", "하다",
+                  "했다", "되다"]
+
+_KO_SUFFIXES = tuple(sorted(set(KOREAN_PARTICLES + KOREAN_ENDINGS),
+                            key=len, reverse=True))
+
+
+def split_korean_eojeol(token: str) -> List[Tuple[str, str]]:
+    """Split one whitespace-delimited eojeol into stem + trailing
+    particle/ending morphemes via longest-suffix dictionary matching
+    (iterated, so '학교에서는' → 학교/에서/는)."""
+    suffixes: List[Tuple[str, str]] = []
+    stem = token
+    while len(stem) >= 2:
+        for sfx in _KO_SUFFIXES:
+            if (stem.endswith(sfx) and len(stem) > len(sfx)
+                    and all(_script(c) == "hangul"
+                            for c in stem[:-len(sfx)])):
+                kind = ("ending" if sfx in KOREAN_ENDINGS else "particle")
+                suffixes.append((sfx, kind))
+                stem = stem[:-len(sfx)]
+                break
+        else:
+            break
+    return [(stem, "stem")] + list(reversed(suffixes))

@@ -3,13 +3,14 @@
 analysis — `deeplearning4j-nlp-korean` and `deeplearning4j-nlp-uima`,
 SURVEY §2.5).
 
-Dictionary assets can't ship in this environment (zero egress), so:
-- `JapaneseTokenizerFactory`: script-run segmentation (kanji / hiragana /
-  katakana / latin / digit runs) — the dictionary-free core of Japanese
-  tokenization; a real morphological analyzer plugs in via `analyzer=`.
-- `KoreanTokenizerFactory`: whitespace eojeol segmentation with optional
-  trailing-particle stripping (the role of the reference's KoreanTwitterText
-  tokenizer); a real analyzer plugs in the same way.
+- `JapaneseTokenizerFactory`: dictionary-backed Viterbi segmentation over
+  the embedded lexicon (`nlp/dictionary.py` — the Kuromoji mechanism in
+  miniature), script-run fallback for OOV spans; `lexicon=` swaps in a
+  full IPADIC-style dictionary, `analyzer=` plugs in a MeCab-class
+  binding, `use_dictionary=False` reverts to pure script runs.
+- `KoreanTokenizerFactory`: eojeol segmentation + dictionary-backed
+  stem/josa/ending morpheme splitting (the reference's KoreanTwitterText
+  tokenizer role); `particles=` picks drop/keep/eojeol modes.
 - `UimaTokenizerFactory` / `UimaSentenceIterator`: the reference uses UIMA
   for sentence segmentation + tokenization; here the same surface backed by
   rule-based segmentation, gated on an optional analyzer callable.
@@ -69,50 +70,91 @@ def segment_by_script(text: str) -> List[str]:
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Script-run tokenizer for Japanese text (reference
-    `deeplearning4j-nlp-japanese`'s Kuromoji `JapaneseTokenizerFactory`).
-    Pass `analyzer=` (a `str -> List[str]` callable, e.g. a MeCab/Kuromoji
-    binding) to use dictionary-based morphological analysis instead."""
+    """Dictionary-backed tokenizer for Japanese text (reference
+    `deeplearning4j-nlp-japanese`'s Kuromoji `JapaneseTokenizerFactory`):
+    a Viterbi cost lattice over an embedded lexicon
+    (`nlp/dictionary.py`) with script-run fallback for OOV spans — the
+    Kuromoji mechanism in miniature. `lexicon=` swaps in a full
+    IPADIC-style dictionary (`Lexicon.from_entries`);
+    `use_dictionary=False` reverts to pure script-run segmentation;
+    `analyzer=` (a `str -> List[str]` callable, e.g. a MeCab binding)
+    overrides everything."""
 
-    def __init__(self, analyzer: Optional[Callable[[str], List[str]]] = None):
+    def __init__(self, analyzer: Optional[Callable[[str], List[str]]] = None,
+                 use_dictionary: bool = True, lexicon=None):
         super().__init__()
         self.analyzer = analyzer
+        self.use_dictionary = use_dictionary
+        self.lexicon = lexicon
+
+    def _lex(self):
+        if self.lexicon is None:
+            from deeplearning4j_tpu.nlp.dictionary import JAPANESE_LEXICON
+
+            self.lexicon = JAPANESE_LEXICON
+        return self.lexicon
 
     def create(self, text: str) -> Tokenizer:
         norm = unicodedata.normalize("NFKC", text)
-        tokens = self.analyzer(norm) if self.analyzer else segment_by_script(norm)
+        if self.analyzer:
+            tokens = self.analyzer(norm)
+        elif self.use_dictionary:
+            from deeplearning4j_tpu.nlp.dictionary import viterbi_segment
+
+            tokens = [t for t, _pos in viterbi_segment(norm, self._lex())]
+        else:
+            tokens = segment_by_script(norm)
         return Tokenizer(tokens, self._pre)
 
+    def tokenize_with_pos(self, text: str):
+        """(surface, pos) morphemes — the Kuromoji token attribute the
+        plain Tokenizer surface drops. Consistent with create(): the same
+        analyzer/use_dictionary configuration produces the same surfaces
+        (non-dictionary modes tag pos='unknown')."""
+        norm = unicodedata.normalize("NFKC", text)
+        if self.analyzer:
+            return [(t, "unknown") for t in self.analyzer(norm)]
+        if not self.use_dictionary:
+            return [(t, "unknown") for t in segment_by_script(norm)]
+        from deeplearning4j_tpu.nlp.dictionary import viterbi_segment
 
-_KOREAN_PARTICLES = (
-    "은", "는", "이", "가", "을", "를", "에", "의", "와", "과", "도",
-    "로", "으로", "에서", "부터", "까지", "에게", "한테", "처럼",
-)
-# longest-first so compound particles ("에서") win over prefixes ("에");
-# sorted once — _strip runs per token on the tokenization hot path
-_PARTICLES_BY_LEN = tuple(sorted(_KOREAN_PARTICLES, key=len, reverse=True))
+        return viterbi_segment(norm, self._lex())
 
 
 class KoreanTokenizerFactory(TokenizerFactory):
-    """Eojeol (whitespace) tokenizer with optional trailing-particle
-    stripping (reference `deeplearning4j-nlp-korean`'s Twitter-text
-    tokenizer role). `analyzer=` plugs in a real morphological analyzer."""
+    """Eojeol (whitespace) tokenizer with dictionary-backed morpheme
+    splitting (reference `deeplearning4j-nlp-korean`'s Twitter-text
+    tokenizer role): each eojeol splits into stem + trailing josa/ending
+    morphemes via iterated longest-suffix matching against the embedded
+    lexicon (`nlp/dictionary.py`). `keep_particles=False` drops the
+    particle morphemes (stems only); `strip_particles=False` keeps whole
+    eojeol; `analyzer=` plugs in a real morphological analyzer."""
 
     def __init__(self, strip_particles: bool = True,
-                 analyzer: Optional[Callable[[str], List[str]]] = None):
+                 keep_particles: bool = False,
+                 analyzer: Optional[Callable[[str], List[str]]] = None,
+                 particles: Optional[str] = None):
+        """`particles` is the single mode switch: 'drop' (split, stems
+        only — the default), 'keep' (split, stems + particle morphemes),
+        'eojeol' (no split). The legacy strip_particles/keep_particles
+        booleans map onto it when `particles` is not given."""
         super().__init__()
-        self.strip_particles = strip_particles
+        if particles is None:
+            particles = ("eojeol" if not strip_particles
+                         else ("keep" if keep_particles else "drop"))
+        if particles not in ("drop", "keep", "eojeol"):
+            raise ValueError(f"particles={particles!r}: choose "
+                             "'drop' | 'keep' | 'eojeol'")
+        self.particles = particles
         self.analyzer = analyzer
 
-    def _strip(self, token: str) -> str:
-        if len(token) < 2:
-            return token
-        for p in _PARTICLES_BY_LEN:
-            if token.endswith(p) and len(token) > len(p):
-                stem = token[:-len(p)]
-                if all(_script(c) == "hangul" for c in stem):
-                    return stem
-        return token
+    def _split(self, token: str) -> List[str]:
+        from deeplearning4j_tpu.nlp.dictionary import split_korean_eojeol
+
+        morphs = split_korean_eojeol(token)
+        if self.particles == "drop":
+            morphs = morphs[:1]  # stem only
+        return [m for m, _kind in morphs]
 
     def create(self, text: str) -> Tokenizer:
         norm = unicodedata.normalize("NFKC", text)
@@ -121,9 +163,23 @@ class KoreanTokenizerFactory(TokenizerFactory):
         else:
             tokens = [t for raw in norm.split()
                       for t in segment_by_script(raw)]
-            if self.strip_particles:
-                tokens = [self._strip(t) for t in tokens]
+            if self.particles != "eojeol":
+                tokens = [m for t in tokens for m in self._split(t)]
         return Tokenizer(tokens, self._pre)
+
+    def tokenize_with_pos(self, text: str):
+        """(surface, kind) morphemes per eojeol (stem/particle/ending),
+        consistent with create(): analyzer/eojeol modes return their
+        surfaces tagged 'unknown'/'stem'."""
+        from deeplearning4j_tpu.nlp.dictionary import split_korean_eojeol
+
+        norm = unicodedata.normalize("NFKC", text)
+        if self.analyzer:
+            return [(t, "unknown") for t in self.analyzer(norm)]
+        raws = [t for raw in norm.split() for t in segment_by_script(raw)]
+        if self.particles == "eojeol":
+            return [(t, "stem") for t in raws]
+        return [m for t in raws for m in split_korean_eojeol(t)]
 
 
 # latin sentence enders need trailing whitespace (protects "U.S."-style

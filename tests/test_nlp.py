@@ -221,9 +221,11 @@ def test_japanese_tokenizer():
 
     tf = JapaneseTokenizerFactory()
     toks = tf.create("JAXは速い123です。").get_tokens()
-    assert "JAX" in toks and "123" in toks
-    # script runs split kanji from kana
-    assert any(all(0x4E00 <= ord(c) <= 0x9FFF for c in t) for t in toks)
+    assert toks == ["JAX", "は", "速い", "123", "です"]
+    # dictionary-free script-run mode still available
+    tf_script = JapaneseTokenizerFactory(use_dictionary=False)
+    stoks = tf_script.create("日本語は楽しい").get_tokens()
+    assert any(all(0x4E00 <= ord(c) <= 0x9FFF for c in t) for t in stoks)
     # pluggable analyzer wins
     tf2 = JapaneseTokenizerFactory(analyzer=lambda s: ["custom"])
     assert tf2.create("何でも").get_tokens() == ["custom"]
@@ -329,3 +331,49 @@ def test_paragraph_vectors_hierarchical_softmax():
         # HS inference for unseen text produces a finite vector
         v = pv.infer_vector("milk for the sleepy cat on a mat")
         assert np.isfinite(v).all() and v.shape == (32,)
+
+
+def test_japanese_dictionary_segmentation():
+    """Viterbi lattice over the embedded lexicon (the Kuromoji mechanism
+    in miniature, reference deeplearning4j-nlp-japanese): morphological
+    splits with POS, OOV spans falling back to script runs, and a
+    pluggable IPADIC-style lexicon."""
+    from deeplearning4j_tpu.nlp.dictionary import Lexicon, viterbi_segment
+    from deeplearning4j_tpu.nlp.language import JapaneseTokenizerFactory
+
+    tf = JapaneseTokenizerFactory()
+    assert tf.create("私は日本語を勉強します。").get_tokens() == \
+        ["私", "は", "日本語", "を", "勉強", "します"]
+    # 日本語 must beat 日本+語 (longest dictionary match wins the lattice)
+    assert "日本語" in tf.create("日本語で話します").get_tokens()
+    # POS attributes survive (the Kuromoji token attribute)
+    pos = dict(tf.tokenize_with_pos("猫が水を飲みます"))
+    assert pos["が"] == "particle" and pos["猫"] == "noun"
+    assert pos["飲みます"] == "verb"
+    # OOV katakana span: single unknown run, not per-character shards
+    toks = tf.create("ヘリコプターは速い").get_tokens()
+    assert "ヘリコプター" in toks
+    # pluggable lexicon: a domain word joins the lattice
+    lex = Lexicon.from_entries([("量子計算", "noun"), ("は", "particle"),
+                                ("面白い", "adjective")])
+    segs = [t for t, _ in viterbi_segment("量子計算は面白い", lex)]
+    assert segs == ["量子計算", "は", "面白い"]
+
+
+def test_korean_dictionary_morphemes():
+    """Eojeol → stem + josa/ending morphemes via iterated longest-suffix
+    dictionary matching (reference deeplearning4j-nlp-korean role)."""
+    from deeplearning4j_tpu.nlp.dictionary import split_korean_eojeol
+    from deeplearning4j_tpu.nlp.language import KoreanTokenizerFactory
+
+    # stacked particles: 학교에서는 -> 학교 / 에서 / 는
+    assert split_korean_eojeol("학교에서는") == \
+        [("학교", "stem"), ("에서", "particle"), ("는", "particle")]
+    assert split_korean_eojeol("공부합니다") == \
+        [("공부", "stem"), ("합니다", "ending")]
+    ko = KoreanTokenizerFactory(keep_particles=True)
+    assert ko.create("저는 학교에서는 공부합니다").get_tokens() == \
+        ["저", "는", "학교", "에서", "는", "공부", "합니다"]
+    # default drops the particles (stems feed embeddings)
+    assert KoreanTokenizerFactory().create("저는 학교에서").get_tokens() == \
+        ["저", "학교"]
