@@ -55,7 +55,7 @@ class Lexicon:
 
 
 _UNKNOWN_BASE = 1.3    # an OOV run costs more than any dictionary word
-_UNKNOWN_PER_CHAR = 0.05
+_UNKNOWN_PER_CHAR = 0.3
 _KNOWN_LEN_BONUS = 0.05  # longer dictionary matches cost slightly less
 
 
@@ -112,13 +112,16 @@ def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
             if c < best[i + ln]:
                 best[i + ln] = c
                 back[i + ln] = (i, surf, e.pos)
-        # unknown fallback: the maximal script run starting at i (never
-        # zero-length, so the lattice always reaches n)
-        j = run_end[i]
-        c = best[i] + _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)
-        if c < best[j]:
-            best[j] = c
-            back[j] = (i, chunk[i:j], "unknown")
+        # unknown fallbacks: the maximal script run starting at i (never
+        # zero-length, so the lattice always reaches n) AND a single-char
+        # edge, so an OOV prefix cannot swallow in-vocabulary words later
+        # in the same run (Kuromoji generates multi-length unknown
+        # candidates for the same reason)
+        for j in {run_end[i], i + 1}:
+            c = best[i] + _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)
+            if c < best[j]:
+                best[j] = c
+                back[j] = (i, chunk[i:j], "unknown")
     # safety: lattice is always complete (the unknown edge advances), but
     # guard against pathological inputs
     if best[n] == INF:
@@ -175,7 +178,7 @@ JAPANESE_LEXICON = Lexicon(
 KOREAN_PARTICLES = ["은", "는", "이", "가", "을", "를", "에", "의",
                     "와", "과", "도", "로", "으로", "에서", "부터",
                     "까지", "에게", "한테", "처럼", "보다", "마다",
-                    "이나", "나", "든지", "요"]
+                    "이나", "든지"]
 KOREAN_ENDINGS = ["입니다", "합니다", "습니다", "있습니다", "없습니다",
                   "했습니다", "인다", "한다", "된다", "이다", "하다",
                   "했다", "되다"]
@@ -190,15 +193,24 @@ def split_korean_eojeol(token: str) -> List[Tuple[str, str]]:
     (iterated, so '학교에서는' → 학교/에서/는)."""
     suffixes: List[Tuple[str, str]] = []
     stem = token
-    while len(stem) >= 2:
+    single_char_stripped = False
+    while len(stem) >= 2 and len(suffixes) < 2:  # josa stack depth <= 2
         for sfx in _KO_SUFFIXES:
-            if (stem.endswith(sfx) and len(stem) > len(sfx)
+            if not (stem.endswith(sfx) and len(stem) > len(sfx)
                     and all(_script(c) == "hangul"
                             for c in stem[:-len(sfx)])):
-                kind = ("ending" if sfx in KOREAN_ENDINGS else "particle")
-                suffixes.append((sfx, kind))
-                stem = stem[:-len(sfx)]
-                break
+                continue
+            if len(sfx) == 1:
+                # single-char josa: at most one (the outermost), and not
+                # when the remaining stem ends in the same syllable
+                # (reduplicated words like 바나나 are not stem+josa)
+                if single_char_stripped or stem[-2] == sfx:
+                    continue
+                single_char_stripped = True
+            kind = ("ending" if sfx in KOREAN_ENDINGS else "particle")
+            suffixes.append((sfx, kind))
+            stem = stem[:-len(sfx)]
+            break
         else:
             break
     return [(stem, "stem")] + list(reversed(suffixes))

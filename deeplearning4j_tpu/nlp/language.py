@@ -126,22 +126,20 @@ class KoreanTokenizerFactory(TokenizerFactory):
     splitting (reference `deeplearning4j-nlp-korean`'s Twitter-text
     tokenizer role): each eojeol splits into stem + trailing josa/ending
     morphemes via iterated longest-suffix matching against the embedded
-    lexicon (`nlp/dictionary.py`). `keep_particles=False` drops the
-    particle morphemes (stems only); `strip_particles=False` keeps whole
-    eojeol; `analyzer=` plugs in a real morphological analyzer."""
+    lexicon (`nlp/dictionary.py`). `particles=` picks the mode ('drop'
+    stems only, 'keep' stems + particle morphemes, 'eojeol' no split);
+    `analyzer=` plugs in a real morphological analyzer."""
 
     def __init__(self, strip_particles: bool = True,
-                 keep_particles: bool = False,
                  analyzer: Optional[Callable[[str], List[str]]] = None,
                  particles: Optional[str] = None):
         """`particles` is the single mode switch: 'drop' (split, stems
         only — the default), 'keep' (split, stems + particle morphemes),
-        'eojeol' (no split). The legacy strip_particles/keep_particles
-        booleans map onto it when `particles` is not given."""
+        'eojeol' (no split). The legacy strip_particles boolean maps onto
+        it when `particles` is not given."""
         super().__init__()
         if particles is None:
-            particles = ("eojeol" if not strip_particles
-                         else ("keep" if keep_particles else "drop"))
+            particles = "drop" if strip_particles else "eojeol"
         if particles not in ("drop", "keep", "eojeol"):
             raise ValueError(f"particles={particles!r}: choose "
                              "'drop' | 'keep' | 'eojeol'")

@@ -371,7 +371,7 @@ def test_korean_dictionary_morphemes():
         [("학교", "stem"), ("에서", "particle"), ("는", "particle")]
     assert split_korean_eojeol("공부합니다") == \
         [("공부", "stem"), ("합니다", "ending")]
-    ko = KoreanTokenizerFactory(keep_particles=True)
+    ko = KoreanTokenizerFactory(particles="keep")
     assert ko.create("저는 학교에서는 공부합니다").get_tokens() == \
         ["저", "는", "학교", "에서", "는", "공부", "합니다"]
     # default drops the particles (stems feed embeddings)
