@@ -54,6 +54,13 @@ class RegressionEvaluation:
     def root_mean_squared_error(self, col: int = 0) -> float:
         return float(np.sqrt(self._sum_sq_err[col] / self.n))
 
+    def relative_squared_error(self, col: int = 0) -> float:
+        """RSE = Σ(pred - label)² / Σ(label - mean_label)² (reference
+        `RegressionEvaluation.relativeSquaredError`)."""
+        n = self.n
+        denom = self._sum_label_sq[col] - self._sum_label[col] ** 2 / n
+        return float(self._sum_sq_err[col] / max(denom, 1e-12))
+
     def correlation_r2(self, col: int = 0) -> float:
         n = self.n
         sx, sy = self._sum_label[col], self._sum_pred[col]

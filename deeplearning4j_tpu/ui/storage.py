@@ -105,7 +105,13 @@ class InMemoryStatsStorage(StatsStorage):
 
 class FileStatsStorage(StatsStorage):
     """Durable append-only JSONL storage (role of
-    `ui/storage/FileStatsStorage.java`); readable cross-process."""
+    `ui/storage/FileStatsStorage.java`, which persists via MapDB);
+    readable cross-process.
+
+    Queries are served from an in-memory per-session index; only the
+    bytes APPENDED since the last read are parsed on refresh (r1 re-read
+    and re-parsed the whole file on every dashboard query, which falls
+    over on long runs). External truncation/rotation triggers a rebuild."""
 
     def __init__(self, path: Union[str, Path]):
         super().__init__()
@@ -113,24 +119,56 @@ class FileStatsStorage(StatsStorage):
         self._path.parent.mkdir(parents=True, exist_ok=True)
         if not self._path.exists():
             self._path.touch()
+        self._offset = 0                      # bytes fully parsed so far
+        self._by_session: dict = {}           # session_id -> [records]
 
     def _store(self, record: StatsRecord) -> None:
-        with open(self._path, "a", encoding="utf-8") as f:
-            f.write(record.to_json() + "\n")
+        with open(self._path, "ab") as f:
+            f.write((record.to_json() + "\n").encode("utf-8"))
 
-    def _load(self) -> List[StatsRecord]:
-        out = []
-        for line in self._path.read_text(encoding="utf-8").splitlines():
-            if line.strip():
-                out.append(StatsRecord.from_json(line))
-        return out
+    def _refresh(self) -> None:
+        # the UI serves queries from ThreadingHTTPServer handler threads:
+        # index mutation must hold the same lock as writes, or concurrent
+        # refreshes double-append and push _offset past EOF
+        with self._lock:
+            size = self._path.stat().st_size
+            if size < self._offset:
+                # truncated or rotated externally: rebuild from scratch
+                self._offset = 0
+                self._by_session = {}
+            if size == self._offset:
+                return
+            with open(self._path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+            # consume only COMPLETE lines — a writer may be mid-line
+            end = chunk.rfind(b"\n") + 1
+            parsed = []
+            for line in chunk[:end].decode("utf-8").splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    parsed.append(StatsRecord.from_json(line))
+                except Exception:
+                    # a corrupt line (crashed writer) is skipped, not
+                    # retried forever: parse the whole chunk BEFORE
+                    # mutating the index, then advance past it
+                    import logging
+
+                    logging.getLogger("deeplearning4j_tpu").warning(
+                        "FileStatsStorage: skipping malformed record in %s",
+                        self._path)
+            for r in parsed:
+                self._by_session.setdefault(r.session_id, []).append(r)
+            self._offset += end
 
     def list_session_ids(self) -> List[str]:
-        return sorted({r.session_id for r in self._load()})
+        self._refresh()
+        return sorted(self._by_session)
 
     def get_records(self, session_id: str, type_id: Optional[str] = None,
                     worker_id: Optional[str] = None) -> List[StatsRecord]:
-        return [r for r in self._load()
-                if r.session_id == session_id
-                and (type_id is None or r.type_id == type_id)
+        self._refresh()
+        return [r for r in self._by_session.get(session_id, [])
+                if (type_id is None or r.type_id == type_id)
                 and (worker_id is None or r.worker_id == worker_id)]

@@ -231,3 +231,23 @@ def test_merge_adopts_and_validates_top_n():
     other.eval(_one_hot([2], 3), np.array([[.1, .2, .7]], np.float32))
     with pytest.raises(ValueError, match="top_n"):
         agg.merge(other)
+
+
+def test_regression_relative_squared_error():
+    from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+    rng = np.random.RandomState(0)
+    y = rng.randn(200, 2)
+    pred = y + 0.1 * rng.randn(200, 2)
+    ev = RegressionEvaluation()
+    ev.eval(y, pred)
+    for c in range(2):
+        rse = ev.relative_squared_error(c)
+        expected = np.sum((pred[:, c] - y[:, c]) ** 2) / np.sum(
+            (y[:, c] - y[:, c].mean()) ** 2)
+        assert rse == pytest.approx(expected, rel=1e-6)
+        assert rse < 0.05  # small noise -> tiny RSE
+    # predicting the mean -> RSE ~ 1
+    ev2 = RegressionEvaluation()
+    ev2.eval(y, np.tile(y.mean(axis=0), (200, 1)))
+    assert ev2.relative_squared_error(0) == pytest.approx(1.0, rel=1e-3)
