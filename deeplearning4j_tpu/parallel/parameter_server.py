@@ -134,3 +134,158 @@ class ParameterServerParallelWrapper:
         # propagate the last score for listener/reporting purposes
         if replica.score_value is not None:
             self.net.score_value = replica.score_value
+
+
+# ---------------------------------------------------------------------------
+# Network transport (the Aeron role)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("parameter-server peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, op: bytes, payload: bytes = b"") -> None:
+    import struct
+
+    sock.sendall(op + struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    import struct
+
+    op = _recv_exact(sock, 1)
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return op, _recv_exact(sock, n)
+
+
+class NetworkParameterServer:
+    """TCP-served `ParameterServer` (the role of the reference's embedded
+    Aeron `MediaDriver` + `ParameterServerNode`,
+    `ParameterServerParallelWrapper.java:160-218`). Aeron is reliable
+    UDP; a plain TCP stream gives the same reliable push/pull contract
+    without vendoring a media driver, and the protocol (1-byte opcode +
+    length-prefixed f32 payload) keeps the wire format trivial for a
+    faster transport to replace.
+
+    Serves PULL (current params) and PUSH (delta accumulate) from any
+    number of clients/processes/hosts; one handler thread per client."""
+
+    def __init__(self, initial_params: np.ndarray, host: str = "localhost",
+                 port: int = 0):
+        import socket
+
+        self._store = ParameterServer(initial_params)
+        self._dtype = np.float32
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = self._sock.getsockname()  # (host, port)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True,
+                                               name="ps-accept")
+        self._accept_thread.start()
+
+    # store passthroughs (the server process reads its own aggregate)
+    def pull(self) -> np.ndarray:
+        return self._store.pull()
+
+    @property
+    def num_pushes(self) -> int:
+        return self._store.num_pushes
+
+    def _accept_loop(self) -> None:
+        import socket
+
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True, name="ps-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn) -> None:
+        try:
+            while True:
+                op, payload = _recv_msg(conn)
+                if op == b"P":                      # pull
+                    params = self._store.pull().astype(self._dtype)
+                    _send_msg(conn, b"R", params.tobytes())
+                elif op == b"U":                    # push delta
+                    delta = np.frombuffer(payload, self._dtype)
+                    self._store.push_update(delta.astype(np.float64)
+                                            .astype(self._dtype))
+                    _send_msg(conn, b"A")           # ack: delta applied
+                elif op == b"Q":
+                    return
+                else:
+                    raise ValueError(f"unknown parameter-server op {op!r}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteParameterServerClient:
+    """Client with the SAME pull/push contract as the in-process
+    `ParameterServer` (reference `ParameterServerClient`) — so
+    `ParameterServerParallelWrapper(server=...)` and any external process
+    can train against a networked server. Push is synchronous through the
+    ack (reliable delivery, matching Aeron's reliable-stream semantics);
+    asynchrony lives in the training protocol (no barrier between
+    workers), not in dropped updates."""
+
+    def __init__(self, host: str, port: int):
+        import socket
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.connect((host, port))
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            _send_msg(self._sock, b"P")
+            op, payload = _recv_msg(self._sock)
+        if op != b"R":
+            raise ValueError(f"unexpected parameter-server reply {op!r}")
+        return np.frombuffer(payload, np.float32).copy()
+
+    def push_update(self, delta: np.ndarray) -> None:
+        with self._lock:
+            _send_msg(self._sock, b"U",
+                      np.asarray(delta, np.float32).tobytes())
+            op, _ = _recv_msg(self._sock)
+        if op != b"A":
+            raise ValueError(f"push not acknowledged: {op!r}")
+
+    @property
+    def num_pushes(self) -> int:  # server-side stat; clients don't track
+        return -1
+
+    def close(self) -> None:
+        try:
+            _send_msg(self._sock, b"Q")
+            self._sock.close()
+        except OSError:
+            pass
