@@ -353,6 +353,16 @@ class OneHotEncoder(DataNormalization):
 
         if isinstance(ids, jnp.ndarray) and not isinstance(ids, np.ndarray):
             if value_range is None:
+                from deeplearning4j_tpu.ops.losses import warn_range_skip_once
+
+                key = f"OneHotEncoder({self.n_classes})"
+                warn_range_skip_once(
+                    key,
+                    f"{key}: id range check skipped — ids are "
+                    "device-resident with no staged value range; "
+                    "out-of-range ids will one-hot to zero rows "
+                    "silently (stage via DeviceCacheDataSetIterator "
+                    "to keep the loud failure)")
                 return
             mn, mx = value_range
             if mn < 0 or mx >= self.n_classes:

@@ -27,6 +27,8 @@ from deeplearning4j_tpu.nn.updater import Updater
 from deeplearning4j_tpu.ops.activations import Activation
 from deeplearning4j_tpu.ops.losses import LossFunction
 
+_GEN_CACHE_MAX = 8  # compiled prefill+decode pairs kept per network (LRU)
+
 
 def gpt_configuration(vocab_size: int,
                       d_model: int = 256,
@@ -163,9 +165,12 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
+    from collections import OrderedDict
+
     cache_key = (B, T0, n_tokens, float(temperature), int(top_k))
-    gen_cache = net.__dict__.setdefault("_gen_cache", {})
+    gen_cache = net.__dict__.setdefault("_gen_cache", OrderedDict())
     if cache_key in gen_cache:
+        gen_cache.move_to_end(cache_key)  # LRU hit
         prefill, decode = gen_cache[cache_key]
         return _run_generation(net, prefill, decode, prompt, n_tokens, seed,
                                include_prompt)
@@ -227,6 +232,11 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         return jnp.swapaxes(toks, 0, 1)  # (B, n_tokens - 1)
 
     gen_cache[cache_key] = (prefill, decode)
+    # bound the cache: each entry pins a compiled prefill+decode pair (XLA
+    # executables) for the net's lifetime — serving varied prompt lengths
+    # must not leak executables, so evict least-recently-used beyond 8
+    while len(gen_cache) > _GEN_CACHE_MAX:
+        gen_cache.popitem(last=False)
     return _run_generation(net, prefill, decode, prompt, n_tokens, seed,
                            include_prompt)
 

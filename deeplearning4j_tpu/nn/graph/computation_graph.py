@@ -677,12 +677,15 @@ class ComputationGraph:
         windows = self._tbptt_windows(mds)
         saved = self._tbptt_seed_carries(np.asarray(mds.features[0]).shape[0])
         losses = []
-        for window in windows:
-            self._fit_batch(window)
-            losses.append(self._score)
+        try:
+            for window in windows:
+                self._fit_batch(window)
+                losses.append(self._score)
+        finally:
+            # rnn carries are per-batch transients; restore persistent slots
+            # even when a window fails mid-batch
+            self._tbptt_restore_carries(saved)
         self.score_value = float(np.mean([np.asarray(l) for l in losses]))
-        # rnn carries are per-batch transients; restore persistent slots
-        self._tbptt_restore_carries(saved)
 
     # --------------------------------------------------------- rnn support
     def rnn_time_step(self, *inputs: np.ndarray) -> List[np.ndarray]:

@@ -573,14 +573,21 @@ class MultiLayerNetwork:
         `MultiLayerNetwork.java:1140-1194`): slice the time axis into
         tbptt_fwd_length windows, carrying LSTM (h, c) across windows; each
         window is one jitted step (fixed window shape ⇒ one compilation)."""
+        # build windows (and run their label validation) BEFORE seeding the
+        # transient carries, so a validation error can't leave batch-sized
+        # transients in the persistent state slots; restore via try/finally
+        # for mid-window failures (matches the CG container's ordering)
+        windows = list(self._tbptt_windows(ds))
         saved = self._tbptt_seed_carries(ds.features.shape[0])
         losses = []
-        for window in self._tbptt_windows(ds):
-            self._fit_batch(window)
-            losses.append(self._score)
+        try:
+            for window in windows:
+                self._fit_batch(window)
+                losses.append(self._score)
+        finally:
+            # rnn carries are per-batch transients; restore persistent slots
+            self._tbptt_restore_carries(saved)
         self.score_value = float(np.mean([np.asarray(l) for l in losses]))
-        # rnn carries are per-batch transients; restore persistent state slots
-        self._tbptt_restore_carries(saved)
 
     def _tbptt_applicable(self, ds) -> bool:
         """Does this batch train via tBPTT? 3-D sequences always; (B, T)

@@ -168,6 +168,21 @@ def loss_fn(loss: LossFunction | str):
     return f
 
 
+_range_skip_warned: set = set()
+
+
+def warn_range_skip_once(key: str, message: str) -> None:
+    """Warn once per `key` that a device-resident batch skipped its id/label
+    range validation (shared by check_sparse_label_range and
+    OneHotEncoder.check_ids so the dedup policy lives in one place)."""
+    if key in _range_skip_warned:
+        return
+    _range_skip_warned.add(key)
+    import warnings
+
+    warnings.warn(message, stacklevel=3)
+
+
 def check_sparse_label_range(labels, n_classes, mask=None,
                              where: str = "the output layer",
                              value_range=None) -> None:
@@ -194,6 +209,16 @@ def check_sparse_label_range(labels, n_classes, mask=None,
                     f"sparse label id {bad} out of range [0, {n_classes}) "
                     f"for {where} (range recorded when the batch was "
                     "staged on device)")
+        elif n_classes:
+            # raw jnp labels with no staged range: the loud OOB failure the
+            # docstrings promise cannot run — say so once instead of
+            # silently reverting to clamp semantics
+            warn_range_skip_once(
+                where,
+                f"sparse-label range check skipped for {where}: labels are "
+                "device-resident with no staged value range (pass host "
+                "arrays or use DeviceCacheDataSetIterator to keep the "
+                "out-of-range check); out-of-range ids will clamp silently")
         return
     larr = np.asarray(labels)
     if (not np.issubdtype(larr.dtype, np.integer) or not larr.size

@@ -392,7 +392,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     return _flash_mha(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
-_probe_ok: Optional[bool] = None
+_probe_cache: dict = {}  # (dtype name, block) -> compile probe verdict
 
 
 def _platform_supported() -> bool:
@@ -402,19 +402,21 @@ def _platform_supported() -> bool:
         return False
 
 
-def _eager_probe(dtype) -> bool:
+def _eager_probe(dtype, block: int) -> bool:
     """Compile + run the forward AND backward kernels once on tiny
     concrete inputs, OUTSIDE any trace. The dispatch itself usually runs
     inside a jit trace, where a Mosaic compile failure would surface at
     the OUTER jit's compile — far from any try/except here. Probing
     eagerly up front turns a platform that can't compile the kernels into
-    a silent XLA fallback instead of a training crash."""
-    B, T, H, D = 1, 128, 1, 128
+    a silent XLA fallback instead of a training crash. Probed per
+    (dtype, block) at T=block so the exact tile configuration that will
+    run is the one proven to compile."""
+    B, T, H, D = 1, block, 1, 128
     x = jnp.zeros((B, T, H, D), dtype)
 
     def l(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True).astype(
-            jnp.float32))
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=block,
+                                       block_k=block).astype(jnp.float32))
 
     g = jax.grad(l, argnums=(0, 1, 2))(x, x, x)
     return bool(jnp.all(jnp.isfinite(g[0].astype(jnp.float32))))
@@ -428,26 +430,27 @@ def flash_attention_or_none(q, k, v, *,
     Block sizes: largest of 512/256/128 dividing the sequence (bigger tiles
     amortise the per-grid-step overhead that dominates this kernel on
     v5e)."""
-    global _probe_ok
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     block = next((b for b in (512, 256, 128) if Tq % b == 0 and Tk % b == 0),
                  None)
-    if (_probe_ok is False or block is None or not _platform_supported()
+    if (block is None or not _platform_supported()
             or (causal and Tq != Tk)
             or D % 128 or q.dtype not in (jnp.float32, jnp.bfloat16)):
         return None
-    if _probe_ok is None:
+    key = (jnp.dtype(q.dtype).name, block)
+    ok = _probe_cache.get(key)
+    if ok is None:
         try:
-            _probe_ok = _eager_probe(q.dtype)
+            ok = _eager_probe(q.dtype, block)
         except Exception as e:  # Mosaic/compile failure: remember, fall back
             logger.warning(
-                "pallas flash-attention unavailable (%s); using XLA "
-                "blockwise path", e)
-            _probe_ok = False
-            return None
-        if not _probe_ok:
-            return None
+                "pallas flash-attention unavailable for %s (%s); using XLA "
+                "blockwise path", key, e)
+            ok = False
+        _probe_cache[key] = ok
+    if not ok:
+        return None
     try:
         return flash_attention(q, k, v, causal=causal, block_q=block,
                                block_k=block)

@@ -62,9 +62,12 @@ def initialize_multiprocess(coordinator_address: str, num_processes: int,
         flags = os.environ.get("XLA_FLAGS", "")
         m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
                       flags)
-        if m and int(m.group(1)) < local_device_count:
-            # raise an existing smaller count — leaving it would silently
-            # shrink this process's mesh contribution
+        if m and int(m.group(1)) != local_device_count:
+            # force the EXACT count: a pre-existing larger value would make
+            # this process contribute more local devices than its peers
+            # expect, so the global mesh shape diverges across processes
+            # (collective hang or wrong sharding); a smaller one would
+            # silently shrink this process's mesh contribution
             flags = flags.replace(
                 m.group(0), f"--xla_force_host_platform_device_count="
                 f"{local_device_count}")
