@@ -212,9 +212,10 @@ def check_sparse_label_range(labels, n_classes, mask=None,
         elif n_classes:
             # raw jnp labels with no staged range: the loud OOB failure the
             # docstrings promise cannot run — say so once instead of
-            # silently reverting to clamp semantics
+            # silently reverting to clamp semantics (key includes n_classes
+            # so distinct nets sharing the default `where` still each warn)
             warn_range_skip_once(
-                where,
+                f"{where}[{n_classes}]",
                 f"sparse-label range check skipped for {where}: labels are "
                 "device-resident with no staged value range (pass host "
                 "arrays or use DeviceCacheDataSetIterator to keep the "

@@ -392,7 +392,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     return _flash_mha(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
-_probe_cache: dict = {}  # (dtype name, block) -> compile probe verdict
+_probe_cache: dict = {}  # (dtype name, block, head_dim) -> probe verdict
 
 
 def _platform_supported() -> bool:
@@ -402,7 +402,7 @@ def _platform_supported() -> bool:
         return False
 
 
-def _eager_probe(dtype, block: int) -> bool:
+def _eager_probe(dtype, block: int, head_dim: int) -> bool:
     """Compile + run the forward AND backward kernels once on tiny
     concrete inputs, OUTSIDE any trace. The dispatch itself usually runs
     inside a jit trace, where a Mosaic compile failure would surface at
@@ -411,8 +411,8 @@ def _eager_probe(dtype, block: int) -> bool:
     a silent XLA fallback instead of a training crash. Probed per
     (dtype, block) at T=block so the exact tile configuration that will
     run is the one proven to compile."""
-    B, T, H, D = 1, block, 1, 128
-    x = jnp.zeros((B, T, H, D), dtype)
+    B, T, H = 1, block, 1
+    x = jnp.zeros((B, T, H, head_dim), dtype)
 
     def l(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=True, block_q=block,
@@ -438,11 +438,11 @@ def flash_attention_or_none(q, k, v, *,
             or (causal and Tq != Tk)
             or D % 128 or q.dtype not in (jnp.float32, jnp.bfloat16)):
         return None
-    key = (jnp.dtype(q.dtype).name, block)
+    key = (jnp.dtype(q.dtype).name, block, D)
     ok = _probe_cache.get(key)
     if ok is None:
         try:
-            ok = _eager_probe(q.dtype, block)
+            ok = _eager_probe(q.dtype, block, D)
         except Exception as e:  # Mosaic/compile failure: remember, fall back
             logger.warning(
                 "pallas flash-attention unavailable for %s (%s); using XLA "

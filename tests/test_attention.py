@@ -34,6 +34,8 @@ from deeplearning4j_tpu.ops.losses import LossFunction
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
+
 
 def qkv(B=2, T=32, H=4, D=8, seed=0, dtype=jnp.float64):
     rng = np.random.default_rng(seed)

@@ -145,6 +145,7 @@ def test_lfw_iterator_shapes():
 
 # ------------------------------------------------- serialization regression
 @pytest.mark.parametrize("stem", ["mlp_adam_v1", "lstm_v1"])
+@pytest.mark.slow
 def test_regression_fixture_restores(stem):
     from deeplearning4j_tpu.util.serialization import restore_model
 

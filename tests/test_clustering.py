@@ -143,6 +143,7 @@ def test_exact_tsne_separates_blobs():
     assert d01 > 2 * spread0
 
 
+@pytest.mark.slow
 def test_barnes_hut_tsne_runs_and_separates():
     X, y = _blobs(n_per=25)
     ts = BarnesHutTsne(theta=0.5, perplexity=10.0, n_iter=150,

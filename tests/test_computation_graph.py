@@ -84,6 +84,7 @@ def test_residual_and_merge_vertices():
     assert g.evaluate(ds).accuracy() > 0.8
 
 
+@pytest.mark.slow
 def test_multi_input_multi_output():
     rng = np.random.default_rng(3)
     Xa = rng.normal(size=(64, 3)).astype(np.float32)
@@ -147,6 +148,7 @@ def test_graph_cycle_detection():
         b.build()
 
 
+@pytest.mark.slow
 def test_graph_gradient_check():
     rng = np.random.default_rng(7)
     X = rng.normal(size=(6, 4))
@@ -270,6 +272,7 @@ def _mln_lstm_conf(tbptt=0, seed=5):
     return b.build()
 
 
+@pytest.mark.slow
 def test_cg_tbptt_matches_mln():
     """A linear-chain CG trained with tBPTT must match the SAME model
     trained through MultiLayerNetwork.doTruncatedBPTT step for step."""
@@ -487,6 +490,7 @@ def _token_lstm_conf(tbptt=0, vocab=12, seed=17):
     return b.build()
 
 
+@pytest.mark.slow
 def test_cg_tbptt_dispatches_for_token_id_sequences(monkeypatch):
     """(B, T) integer token ids ARE temporal: tBPTT must fire for them
     (a 2-D int sequence into TokenEmbedding, no 3-D features at all)."""
@@ -525,6 +529,7 @@ def test_cg_rnn_time_step_token_ids_match_full_forward():
     assert not np.allclose(out2[:, 3], full[:, 3])
 
 
+@pytest.mark.slow
 def test_cg_tbptt_static_embedding_side_input():
     """A static (B,) id side input (feed-forward EmbeddingLayer) rides
     every tBPTT window unsliced while the temporal input is windowed."""
@@ -584,6 +589,7 @@ def test_cg_token_stream_state_round_trip_carries_position():
     np.testing.assert_allclose(b[:, 0], full[:, 4], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_mln_tbptt_token_id_sequences():
     """MultiLayerNetwork: (B, T) int ids into TokenEmbedding dispatch to
     tBPTT too (same temporal classification as the DAG container)."""

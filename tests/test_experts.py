@@ -10,6 +10,8 @@ from deeplearning4j_tpu.parallel.experts import (
 )
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
+
 
 def _ffn(p, x):
     return jax.nn.relu(x @ p["W1"]) @ p["W2"]

@@ -39,6 +39,7 @@ def small_ds(n=8, nin=4, nout=3, seed=0):
     (Activation.ELU, LossFunction.XENT, Activation.SIGMOID),
     (Activation.SOFTPLUS, LossFunction.NEGATIVELOGLIKELIHOOD, Activation.SOFTMAX),
 ])
+@pytest.mark.slow
 def test_mlp_gradients(act, loss, out_act):
     conf = (NeuralNetConfiguration.Builder()
             .seed(42).updater(Updater.NONE).activation(act)
@@ -71,6 +72,7 @@ def test_mlp_gradients_regularization(l1, l2):
     assert check_gradients(net, small_ds())
 
 
+@pytest.mark.slow
 def test_cnn_gradients():
     rng = np.random.default_rng(3)
     X = rng.normal(size=(4, 6 * 6))
@@ -105,6 +107,7 @@ def test_batchnorm_gradients():
     assert check_gradients(net, small_ds(), print_results=True)
 
 
+@pytest.mark.slow
 def test_lstm_gradients():
     rng = np.random.default_rng(5)
     B, T, nin, nout = 3, 4, 3, 2
@@ -123,6 +126,7 @@ def test_lstm_gradients():
     assert check_gradients(net, DataSet(X, labels), print_results=True)
 
 
+@pytest.mark.slow
 def test_lstm_gradients_masked():
     rng = np.random.default_rng(6)
     B, T, nin, nout = 3, 5, 3, 2
@@ -144,6 +148,7 @@ def test_lstm_gradients_masked():
     assert check_gradients(net, DataSet(X, labels, mask, mask), print_results=True)
 
 
+@pytest.mark.slow
 def test_cg_lstm_gradients_masked():
     """Recurrent ComputationGraph with variable-length masking (reference
     `GradientCheckTestsComputationGraph` + `GradientCheckTestsMasking`)."""

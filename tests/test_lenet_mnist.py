@@ -1,6 +1,7 @@
 """End-to-end slice: LeNet on (synthetic) MNIST — BASELINE config 1,
 SURVEY §7 stage 4 exit criterion (LeNet trains to accuracy with zero CUDA)."""
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
 from deeplearning4j_tpu.models.lenet import lenet_configuration
@@ -8,6 +9,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
 
 
+@pytest.mark.slow
 def test_lenet_trains_on_mnist():
     train = MnistDataSetIterator(batch_size=64, num_examples=1024, train=True)
     test = MnistDataSetIterator(batch_size=256, num_examples=512, train=False)

@@ -10,6 +10,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
+
 
 def test_two_process_training_matches_single_process(tmp_path):
     from deeplearning4j_tpu.parallel.multiprocess import (

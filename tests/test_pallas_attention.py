@@ -13,6 +13,8 @@ import pytest
 from deeplearning4j_tpu.ops.attention import full_attention
 from deeplearning4j_tpu.ops.pallas_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
+
 
 def _qkv(B=2, T=256, H=2, D=128, seed=0):
     rng = np.random.default_rng(seed)

@@ -85,6 +85,7 @@ def test_tensor_parallel_matches_single_device():
     np.testing.assert_allclose(net1.params(), net_tp.params(), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import sys
 
@@ -99,6 +100,7 @@ def test_graft_entry_dryrun():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_distributed_word2vec_parity():
     """Mesh-sharded word2vec must match single-chip training exactly
     (same seed, same pair stream) — the spark-nlp parity analogue of
@@ -183,6 +185,7 @@ def test_device_prefetch_iterator():
     assert np.isfinite(net.score_value)
 
 
+@pytest.mark.slow
 def test_data_parallel_tbptt_matches_single_device():
     """BASELINE configs 3x5 composed: LSTM tBPTT sharded over 8 devices
     must match single-chip tBPTT step for step (the per-example (h, c)
@@ -228,6 +231,7 @@ def test_data_parallel_tbptt_matches_single_device():
     assert out.shape == (16, 10, 4)
 
 
+@pytest.mark.slow
 def test_data_parallel_tbptt_computation_graph():
     """A tBPTT ComputationGraph under ParallelWrapper matches single-chip
     CG training (the DAG container rides the same sharded window path)."""

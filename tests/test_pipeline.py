@@ -43,6 +43,7 @@ def test_pipeline_forward_parity(microbatches):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_backward_parity():
     """jax.grad through the pipeline (ppermute reverses automatically) must
     match sequential gradients."""

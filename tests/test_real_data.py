@@ -4,6 +4,7 @@ the unreachable MNIST download of `MnistDataFetcher.java:40`. The bar
 mirrors the reference's integration-test strategy (small net trained to
 an accuracy threshold on real data)."""
 import numpy as np
+import pytest
 
 import deeplearning4j_tpu as dl4j
 from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
@@ -19,6 +20,8 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater import Updater
 from deeplearning4j_tpu.ops.activations import Activation
 from deeplearning4j_tpu.ops.losses import LossFunction
+
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
 
 
 def test_digits_iterator_is_real_data():

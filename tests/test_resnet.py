@@ -2,6 +2,7 @@
 ResNet on CIFAR-shaped data; reference analogue: ComputationGraph residual
 nets through `ComputationGraph.fit:670` with `ElementWiseVertex` adds)."""
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
@@ -10,6 +11,8 @@ from deeplearning4j_tpu.models.resnet import (
     resnet_tiny_configuration,
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
 
 
 def _cifar_like(n, h=8, w=8, c=3, classes=10, seed=0):

@@ -49,6 +49,7 @@ TP_SPECS = {0: {"W": P(None, "model"), "b": P("model")},
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.slow
 def test_sharded_checkpoint_resume_same_mesh(tmp_path):
     mesh = make_mesh({"data": 4, "model": 2})
     batches = _batches(8)

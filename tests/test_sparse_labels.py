@@ -128,6 +128,7 @@ def test_negative_sparse_labels_rejected():
         net.fit(DataSet(rng.randn(8, 6).astype(np.float32), labels))
 
 
+@pytest.mark.slow
 def test_sparse_tbptt_matches_one_hot():
     """tBPTT accepts sparse (B, T) labels and matches one-hot windows."""
     def build():
@@ -198,6 +199,7 @@ def test_graph_sparse_labels_validated_and_train():
         net.fit(DataSet(x, np.full(8, 9, np.int32)))
 
 
+@pytest.mark.slow
 def test_masked_sentinel_ids_allowed():
     """Pad-with-sentinel + labels mask (the standard variable-length
     convention) trains fine: the loss clamps the gather and masked rows

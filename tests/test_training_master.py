@@ -89,6 +89,7 @@ def test_identical_shards_average_to_single_machine():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_averaging_trains_and_averages_updater_state():
     batches = _batches(8, seed=2)
     net = _net(updater=Updater.ADAM, lr=0.01)

@@ -10,6 +10,8 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.models.transformer import gpt_configuration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+pytestmark = pytest.mark.slow  # bench/convergence-shaped module: excluded from the quick tier
+
 
 def _lm_data(vocab, B, T, seed=0):
     """Next-token prediction over a deterministic cyclic language:
