@@ -17,10 +17,13 @@ real barrier over the remote-tunnel backend this build runs on, and the r1
 numbers taken with it overstated throughput up to ~25x. Batches are staged
 in HBM up front (DeviceCacheDataSetIterator) and the timed pass is a
 steady-state epoch, so the figures measure the chip, not the ~33 MB/s
-tunnel. Honest steady-state per-chip numbers (v5e, 2026-07-30):
-lenet ~300-460k samples/s, resnet50 ~6.7k samples/s (~25% MFU),
-lstm ~55k samples/s (~4% MFU), gpt train ~1.3-1.4M tok/s (~15% MFU),
-word2vec ~116k words/s, gpt generate ~34k tok/s.
+tunnel. Honest steady-state per-chip numbers (v5e, 2026-07-30 r3):
+lenet ~460k samples/s, resnet50 ~7.7-8k samples/s (~29-30% MFU, one-pass
+folded BN), lstm ~123k samples/s (~8% MFU, Pallas fused cell at B=8192),
+gpt train ~1.4M tok/s (~16% MFU, toy scale), gpt_long (T=4096, d=1024)
+~127k tok/s (~42% MFU, Pallas flash fwd+bwd, measured 2.9x the XLA
+blockwise path at the bench shape), word2vec ~116-128k words/s,
+gpt generate ~34-36k tok/s.
 """
 from __future__ import annotations
 
@@ -140,7 +143,10 @@ def bench_resnet50():
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     # batch sweep (steady state): 256->7.1k, 512->6.1k, 1024->6.3k,
-    # 2048->5.9k samples/s — 256 wins (BN reductions + HBM locality)
+    # 2048->5.9k samples/s — 256 wins (BN reductions + HBM locality).
+    # r3: folded one-pass BN lifted 256 to ~7.7-8k (re-swept: 512 -> 7.5k,
+    # still behind); remaining time is BN-backward channel reductions,
+    # which are HBM-bandwidth-bound at CIFAR's 32x32xwide-channel shapes
     batch_size, warmup, bench, scan = 256, 4, 16, 1
     import jax.numpy as jnp
 
@@ -175,7 +181,7 @@ def bench_lstm():
     from deeplearning4j_tpu.ops.activations import Activation
     from deeplearning4j_tpu.ops.losses import LossFunction
 
-    vocab, hidden, T, batch_size, warmup, bench, scan = 64, 256, 64, 512, 4, 16, 1
+    vocab, hidden, T, batch_size, warmup, bench, scan = 64, 256, 64, 8192, 3, 8, 1
     conf = (NeuralNetConfiguration.Builder()
             .seed(1).learning_rate(0.1).updater(Updater.RMSPROP)
             .list()
@@ -185,9 +191,11 @@ def bench_lstm():
                                   activation=Activation.SOFTMAX))
             .set_input_type(InputType.recurrent(vocab))
             .build())
-    # batch sweep (steady state, f32): 64->7.6k, 256->33k, 512->48k,
-    # 1024->49k; bf16 at 512 -> 54.5k (the larger batch makes the recurrent
-    # GEMMs big enough for the MXU's bf16 feed to win)
+    # r3: the Pallas fused LSTM cell (ops/pallas_lstm.py) replaces the
+    # lax.scan time loop; its batch-parallel grid scales where the scan
+    # plateaued. Fused-path sweep: 512->68k, 2048->76k, 4096->98k,
+    # 8192->113k samples/s (16384 exhausts HBM); r2 scan path peaked ~55k
+    # at 512. bf16 throughout (MXU native feed).
     import jax.numpy as jnp
 
     net = MultiLayerNetwork(conf, compute_dtype=jnp.bfloat16)
@@ -205,7 +213,17 @@ def bench_lstm():
                for i in range(warmup + bench)]
     dt = _throughput(net, batches, warmup, bench, scan_steps=scan)
     value = bench * batch_size / dt
-    mfu = _mfu(_step_flops(net, batches[0]) / batch_size, value, bf16=True)
+    # count step FLOPs on the lax.scan path, not the Pallas one: XLA's cost
+    # analysis can't see inside custom-call kernels, and the MFU metric
+    # should not change just because the implementation moved into one
+    import os
+
+    os.environ["DL4J_TPU_NO_PALLAS_LSTM"] = "1"
+    try:
+        flops = _step_flops(net, batches[0])  # traces fresh under the env
+    finally:
+        del os.environ["DL4J_TPU_NO_PALLAS_LSTM"]
+    mfu = _mfu(flops / batch_size, value, bf16=True)
     return "lstm_charrnn_train_samples_per_sec_per_chip", value, mfu
 
 
@@ -241,6 +259,105 @@ def bench_gpt():
     mfu = _mfu(_step_flops(net, batches[0]) / (batch_size * T), value,
                bf16=True)
     return "gpt_causal_lm_train_tokens_per_sec_per_chip", value, mfu
+
+
+def bench_gpt_long():
+    """Long-context causal LM (T=4096) riding the Pallas flash fwd+bwd
+    kernels (`ops/pallas_attention.py`) — the flagship long-context config.
+    d_model=1024, 8 layers, head_dim=128, attention through the
+    flash/blockwise dispatch with block 512. Sweeps (on-chip, steady
+    state): d512/B32 257k tok/s (~23% MFU); d1024: B8 103k, B16 OOM
+    without remat, 82-84k with per-block remat (recompute not paid back at
+    this scale) -> d1024/B8 no-remat wins on MFU. Also measures the
+    flash-vs-XLA-blockwise kernel ratio in-bench (`flash_speedup`) at this
+    exact shape instead of claiming it in a docstring."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    vocab, d_model, heads, layers = 256, 1024, 8, 8
+    T, batch_size, warmup, bench = 4096, 8, 2, 6
+
+    net = MultiLayerNetwork(
+        gpt_configuration(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                          n_layers=layers, max_length=T,
+                          attention_block_size=512),
+        compute_dtype=jnp.bfloat16)
+    net.init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (warmup + bench, batch_size, T + 1))
+    batches = [DataSet(ids[i, :, :-1].astype(np.int32),
+                       ids[i, :, 1:].astype(np.int32))
+               for i in range(warmup + bench)]
+    dt = _throughput(net, batches, warmup, bench)
+    value = bench * batch_size * T / dt
+
+    # MFU accounting: XLA's cost analysis counts everything EXCEPT inside
+    # the flash custom calls; add the kernel's matmul FLOPs analytically.
+    # Per causally-needed (blk, blk) tile: fwd = 2 matmuls, bwd = 7 across
+    # the dQ/dKV kernels (incl. 2 score recomputes) -> 18*bq*bk*D MACs.
+    # Gate on the dispatch's ACTUAL probe verdict: if flash declined (all
+    # tiles failed to compile here), attention ran on the XLA blockwise
+    # path whose FLOPs cost analysis already counts — adding the analytic
+    # term then would double-count the dominant component.
+    from deeplearning4j_tpu.ops.pallas_attention import _probed_block
+
+    xla_flops = _step_flops(net, batches[0])
+    blk = _probed_block(jnp.bfloat16, T, T, d_model // heads)
+    if blk is not None:
+        nb = T // blk
+        needed_tiles = nb * (nb + 1) // 2
+        flash_flops = (batch_size * heads * needed_tiles
+                       * 18 * blk * blk * (d_model // heads))
+    else:
+        flash_flops = 0.0
+    mfu = _mfu((xla_flops + flash_flops) / (batch_size * T), value,
+               bf16=True)
+
+    # kernel-level flash vs XLA-blockwise A/B at the bench shape (full-net
+    # A/B is impossible: the blockwise scan's saved residuals alone exceed
+    # HBM at T=4096, which is the flash kernel's point)
+    from deeplearning4j_tpu.ops.attention import blockwise_attention
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+    x = jnp.asarray(rng.standard_normal(
+        (batch_size, T, heads, d_model // heads)), jnp.bfloat16)
+
+    def mk_loss(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def g_scalar(q, k, v):
+            # reduce grads to ONE scalar on device: materializing a full
+            # gradient would time the host tunnel, not the kernel
+            gq, gk, gv = g(q, k, v)
+            return (jnp.sum(gq.astype(jnp.float32))
+                    + jnp.sum(gk.astype(jnp.float32))
+                    + jnp.sum(gv.astype(jnp.float32)))
+        return g_scalar
+
+    flash = mk_loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=1024, block_k=1024))
+    xla = mk_loss(lambda q, k, v: blockwise_attention(
+        q, k, v, causal=True, block_size=512))
+    times = {}
+    for name, f in (("flash", flash), ("xla", xla)):
+        float(f(x, x, x))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(6):
+            s = f(x, x, x)
+        float(s)  # true host sync (scalar)
+        times[name] = (time.perf_counter() - t0) / 6
+    bench_gpt_long.flash_speedup = round(times["xla"] / times["flash"], 3)
+    return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu
 
 
 def bench_word2vec():
@@ -305,6 +422,7 @@ def bench_generate():
 
 _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "lstm": bench_lstm, "gpt": bench_gpt,
+            "gpt_long": bench_gpt_long,
             "word2vec": bench_word2vec, "generate": bench_generate}
 
 
@@ -348,6 +466,9 @@ def main() -> None:
             "unit": _unit(metric), "vs_baseline": round(ratio, 3),
             "mfu": None if mfu is None else round(mfu, 4),
         }
+        extra = getattr(_CONFIGS[name], "flash_speedup", None)
+        if extra is not None:
+            entries[name]["flash_speedup_vs_xla_blockwise"] = extra
     if on_chip:
         baseline_file.write_text(json.dumps(baselines))
 

@@ -42,6 +42,7 @@ def gpt_configuration(vocab_size: int,
                       updater: Updater = Updater.ADAM,
                       attention_block_size: int = 1024,
                       moe_experts: int = 0,
+                      remat: bool = False,
                       ) -> MultiLayerConfiguration:
     """Causal LM over int token ids (B, T) with next-token targets
     (B, T, vocab) one-hot (per-timestep MCXENT, masked)."""
@@ -58,7 +59,8 @@ def gpt_configuration(vocab_size: int,
                                      n_heads=n_heads, ffn_mult=ffn_mult,
                                      causal=True,
                                      block_size=attention_block_size,
-                                     moe_experts=moe_experts))
+                                     moe_experts=moe_experts,
+                                     remat=remat))
     return (b
             .layer(LayerNormalization(n_in=d_model, n_out=d_model,
                                       dropout=0.0))

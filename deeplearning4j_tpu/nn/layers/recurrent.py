@@ -36,6 +36,9 @@ def lstm_forward(
     c0: Optional[jnp.ndarray] = None,
     mask: Optional[jnp.ndarray] = None,  # (B, T) 1=valid
     reverse: bool = False,
+    unroll: int = 1,
+    gate_is_sigmoid: bool = False,
+    cell_is_tanh: bool = False,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Run the LSTM over time; returns (outputs (B,T,nOut), (hT, cT)).
 
@@ -44,6 +47,17 @@ def lstm_forward(
     """
     B, T, _ = x.shape
     n_out = RW.shape[0]
+    # fused-kernel fast path (the cuDNN-helper dispatch): whole time loop
+    # in one Pallas kernel when the call qualifies; silently falls through
+    # to the scan below otherwise
+    from deeplearning4j_tpu.ops.pallas_lstm import lstm_fused_or_none
+
+    fused = lstm_fused_or_none(x, W, RW, b, peephole, h0, c0,
+                               gate_is_sigmoid=gate_is_sigmoid,
+                               cell_is_tanh=cell_is_tanh, mask=mask,
+                               reverse=reverse)
+    if fused is not None:
+        return fused
     h = jnp.zeros((B, n_out), x.dtype) if h0 is None else h0
     c = jnp.zeros((B, n_out), x.dtype) if c0 is None else c0
 
@@ -79,11 +93,16 @@ def lstm_forward(
 
     xs_xw = jnp.swapaxes(xw, 0, 1)  # (T, B, 4nOut)
     xs_m = None if mask is None else jnp.swapaxes(mask, 0, 1)  # (T, B)
+    import os
+
+    unroll = int(os.environ.get("DL4J_TPU_LSTM_UNROLL", unroll))
     if xs_m is None:
         (hT, cT), outs = lax.scan(lambda cr, xw_t: cell(cr, (xw_t, None)),
-                                  (h, c), xs_xw, reverse=reverse)
+                                  (h, c), xs_xw, reverse=reverse,
+                                  unroll=unroll)
     else:
-        (hT, cT), outs = lax.scan(cell, (h, c), (xs_xw, xs_m), reverse=reverse)
+        (hT, cT), outs = lax.scan(cell, (h, c), (xs_xw, xs_m),
+                                  reverse=reverse, unroll=unroll)
     return jnp.swapaxes(outs, 0, 1), (hT, cT)
 
 

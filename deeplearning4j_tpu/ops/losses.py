@@ -201,6 +201,8 @@ def check_sparse_label_range(labels, n_classes, mask=None,
         # the host link every step. DeviceCacheDataSetIterator records the
         # (masked) integer range at staging time while the data is still
         # host-side — validate against that instead.
+        if not jnp.issubdtype(labels.dtype, jnp.integer):
+            return  # float labels (one-hot/regression): not sparse ids
         if value_range is not None and n_classes:
             mn, mx = value_range
             if mx >= n_classes or mn < 0:

@@ -1,0 +1,385 @@
+"""Pallas TPU fused LSTM cell: the recurrent scan as ONE kernel (fwd + bwd).
+
+The reference's hottest loop is the LSTM time loop
+(`deeplearning4j-nn/.../recurrent/LSTMHelpers.java:157` forward,
+`:311` BPTT backward), which it accelerates with cuDNN-class fused RNN
+kernels. The XLA lowering here (`nn/layers/recurrent.py` `lax.scan`)
+compiles the cell once, but on v5e each scan iteration still runs ~5
+separate kernels (recurrent-GEMM fusion, gate elementwise, carry copies,
+dynamic-update-slice output stacking) at ~14 us/step measured — mostly
+per-iteration overhead around a 1.4 us matmul.
+
+This module fuses the whole time loop into one Pallas kernel per
+direction:
+
+- grid = (B/block_b, T): batch blocks parallel, time sequential
+  (`dimension_semantics=("parallel", "arbitrary")`); the (h, c) carries
+  live in f32 VMEM scratch ACROSS grid steps, so HBM sees no carry
+  traffic at all.
+- Per step the kernel does exactly one MXU matmul (h @ RW) plus the gate
+  elementwise chain, and streams in the pre-computed input projections
+  xw[t] (the (B,T,nIn)@(nIn,4H) GEMM is batched over time OUTSIDE the
+  kernel where the MXU runs it at full tilt).
+- The TRAINING forward also stashes post-activation gates (i,f,o,g) and
+  the cell states — the residuals the backward needs. The backward kernel
+  walks the grid time-reversed computing only the truly-sequential part
+  (dz per step + one (B,4H)@(4H,H) matmul for dh_prev); every batched
+  gradient contraction (dW, dRW, db, d-peephole, dx) is a single big XLA
+  GEMM/reduction over the stashed slabs outside the kernel.
+
+Gate math (order [i, f, o, g], matching GravesLSTMParamInitializer):
+  z  = xw[t] + h @ RW;  zi += pI*c;  zf += pF*c          (peepholes)
+  i, f = sigmoid(zi), sigmoid(zf);  g = tanh(zg)
+  c' = f*c + i*g;  o = sigmoid(zo + pO*c');  h' = o*tanh(c')
+
+Dispatch follows the cuDNN-helper pattern (`ConvolutionLayer.java:69-79`,
+as in `ops/pallas_attention.py`): an eager compile probe per shape class,
+silent fall-through to the lax.scan path when the kernel can't serve
+(mask given, non-sigmoid/tanh activations, non-MXU-friendly sizes, or a
+platform where Mosaic won't compile).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.pallas_attention import (
+    _dot,
+    _mxu_dtype,
+    _run_probe_out_of_trace,
+    _stat_dtype,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def _lstm_fwd_kernel(xw_ref, rw_ref, peep_ref, h0_ref, c0_ref,
+                     h_out_ref, cT_ref, c_stash_ref, gates_ref,
+                     h_scr, c_scr, *, n_out: int, with_stash: bool):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    dt = _mxu_dtype(xw_ref.dtype)
+    sdt = _stat_dtype(xw_ref.dtype)
+    H = n_out
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:].astype(sdt)
+        c_scr[:] = c0_ref[:].astype(sdt)
+
+    c = c_scr[:]
+    z = xw_ref[0].astype(sdt) + _dot(h_scr[:].astype(dt), rw_ref[:],
+                                     ((1,), (0,)), dt)
+    pI = peep_ref[0:1].astype(sdt)
+    pF = peep_ref[1:2].astype(sdt)
+    pO = peep_ref[2:3].astype(sdt)
+    i = jax.nn.sigmoid(z[:, :H] + pI * c)
+    f = jax.nn.sigmoid(z[:, H:2 * H] + pF * c)
+    g = jnp.tanh(z[:, 3 * H:])
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(z[:, 2 * H:3 * H] + pO * c_new)
+    h_new = o * jnp.tanh(c_new)
+
+    h_out_ref[0] = h_new.astype(h_out_ref.dtype)
+    if with_stash:
+        c_stash_ref[0] = c_new.astype(c_stash_ref.dtype)
+        gates_ref[0] = jnp.concatenate([i, f, o, g], axis=1).astype(
+            gates_ref.dtype)
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+
+    @pl.when(t == nt - 1)
+    def _final_cell():
+        cT_ref[:] = c_new.astype(cT_ref.dtype)
+
+
+def _lstm_bwd_kernel(gates_ref, c_ref, c_prev_ref, dh_out_ref, dcT_ref,
+                     rw_ref, peep_ref, c0_ref, dz_ref, dhc0_ref,
+                     dh_scr, dc_scr, *, n_out: int):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    s_is_first = t == nt - 1  # reversed walk: last grid step is timestep 0
+    dt = _mxu_dtype(dz_ref.dtype)
+    sdt = _stat_dtype(dz_ref.dtype)
+    H = n_out
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = dcT_ref[:].astype(sdt)
+
+    gates = gates_ref[0].astype(sdt)
+    i, f, o, g = (gates[:, :H], gates[:, H:2 * H], gates[:, 2 * H:3 * H],
+                  gates[:, 3 * H:])
+    c_t = c_ref[0].astype(sdt)
+    # c_{t-1}: the block index is clamped to 0 at the first timestep, where
+    # the real previous state is c0
+    c_prev = jnp.where(s_is_first, c0_ref[:].astype(sdt),
+                       c_prev_ref[0].astype(sdt))
+    pI = peep_ref[0:1].astype(sdt)
+    pF = peep_ref[1:2].astype(sdt)
+    pO = peep_ref[2:3].astype(sdt)
+
+    tanh_c = jnp.tanh(c_t)
+    dh = dh_out_ref[0].astype(sdt) + dh_scr[:]
+    do = dh * tanh_c
+    dzo = do * o * (1.0 - o)
+    dct = dh * o * (1.0 - tanh_c * tanh_c) + dc_scr[:] + dzo * pO
+    dzg = dct * i * (1.0 - g * g)
+    dzi = dct * g * i * (1.0 - i)
+    dzf = dct * c_prev * f * (1.0 - f)
+    dc_prev = dct * f + dzi * pI + dzf * pF
+    dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=1)
+    dh_prev = _dot(dz.astype(dt), rw_ref[:], ((1,), (1,)), dt)
+
+    dz_ref[0] = dz.astype(dz_ref.dtype)
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(s_is_first)
+    def _emit_carry_grads():
+        dhc0_ref[0] = dh_prev.astype(dhc0_ref.dtype)
+        dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
+
+
+def _batch_block(B: int) -> Optional[int]:
+    """Largest batch block that keeps the kernel comfortably inside VMEM."""
+    for bb in (512, 256, 128, 64, 32, 16, 8):
+        if B % bb == 0:
+            return bb
+    return None
+
+
+def _fwd_call(xw, rw, peep, h0, c0, *, with_stash: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, G = xw.shape
+    H = G // 4
+    bb = _batch_block(B)
+    sdt = _stat_dtype(xw.dtype)
+    kernel = functools.partial(_lstm_fwd_kernel, n_out=H,
+                               with_stash=with_stash)
+    blk = lambda shape: pl.BlockSpec(shape, lambda b, t: (t, b, 0))
+    const2 = lambda shape: pl.BlockSpec(shape, lambda b, t: (b, 0))
+    small = pl.BlockSpec((1, 1, 1), lambda b, t: (0, 0, 0))
+    h_out, cT, c_stash, gates = pl.pallas_call(
+        kernel,
+        grid=(B // bb, T),
+        in_specs=[
+            blk((1, bb, G)),                                   # xw[t]
+            pl.BlockSpec((H, G), lambda b, t: (0, 0)),         # RW
+            pl.BlockSpec((3, H), lambda b, t: (0, 0)),         # peepholes
+            const2((bb, H)),                                   # h0
+            const2((bb, H)),                                   # c0
+        ],
+        out_specs=[blk((1, bb, H)),
+                   const2((bb, H)),
+                   blk((1, bb, H)) if with_stash else small,
+                   blk((1, bb, G)) if with_stash else small],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), xw.dtype),
+            jax.ShapeDtypeStruct((B, H), xw.dtype),
+            jax.ShapeDtypeStruct((T, B, H) if with_stash else (1, 1, 1),
+                                 xw.dtype),
+            jax.ShapeDtypeStruct((T, B, G) if with_stash else (1, 1, 1),
+                                 xw.dtype)],
+        scratch_shapes=[pltpu.VMEM((bb, H), sdt),
+                        pltpu.VMEM((bb, H), sdt)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(xw, rw, peep, h0, c0)
+    return h_out, cT, c_stash, gates
+
+
+def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *,
+              interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, G = gates.shape
+    H = G // 4
+    bb = _batch_block(B)
+    sdt = _stat_dtype(gates.dtype)
+    kernel = functools.partial(_lstm_bwd_kernel, n_out=H)
+    rev = lambda shape: pl.BlockSpec(shape, lambda b, t: (T - 1 - t, b, 0))
+    const2 = lambda shape: pl.BlockSpec(shape, lambda b, t: (b, 0))
+    dz, dhc0 = pl.pallas_call(
+        kernel,
+        grid=(B // bb, T),
+        in_specs=[
+            rev((1, bb, G)),                                   # gates[s]
+            rev((1, bb, H)),                                   # c[s]
+            # c[s-1] (block index clamped at s == 0; kernel swaps in c0)
+            pl.BlockSpec((1, bb, H),
+                         lambda b, t: (jnp.maximum(T - 2 - t, 0), b, 0)),
+            rev((1, bb, H)),                                   # dh_out[s]
+            const2((bb, H)),                                   # dcT
+            pl.BlockSpec((H, G), lambda b, t: (0, 0)),         # RW
+            pl.BlockSpec((3, H), lambda b, t: (0, 0)),         # peepholes
+            const2((bb, H)),                                   # c0
+        ],
+        out_specs=[rev((1, bb, G)),
+                   pl.BlockSpec((2, bb, H), lambda b, t: (0, b, 0))],
+        out_shape=[jax.ShapeDtypeStruct((T, B, G), gates.dtype),
+                   jax.ShapeDtypeStruct((2, B, H), sdt)],
+        scratch_shapes=[pltpu.VMEM((bb, H), sdt),
+                        pltpu.VMEM((bb, H), sdt)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(gates, c_stash, c_stash, dh_out, dcT, rw, peep, c0)
+    return dz, dhc0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _lstm_core(xw, rw, peep, h0, c0, interpret):
+    """(T,B,4H) projected inputs -> ((T,B,H) hidden states, cT (B,H))."""
+    h_out, cT, _, _ = _fwd_call(xw, rw, peep, h0, c0, with_stash=False,
+                                interpret=interpret)
+    return h_out, cT
+
+
+def _lstm_core_fwd(xw, rw, peep, h0, c0, interpret):
+    h_out, cT, c_stash, gates = _fwd_call(xw, rw, peep, h0, c0,
+                                          with_stash=True,
+                                          interpret=interpret)
+    return (h_out, cT), (gates, c_stash, h_out, rw, peep, h0, c0)
+
+
+def _lstm_core_bwd(interpret, res, cots):
+    dh_out, dcT = cots
+    gates, c_stash, h_out, rw, peep, h0, c0 = res
+    T, B, G = gates.shape
+    H = G // 4
+    sdt = _stat_dtype(gates.dtype)
+    dz, dhc0 = _bwd_call(gates, c_stash, dh_out, dcT.astype(gates.dtype),
+                         rw, peep, c0, interpret=interpret)
+    # batched contractions over the full (T*B) slab — big single XLA GEMMs,
+    # the MXU-friendly shape the per-step kernel deliberately leaves out
+    dt = _mxu_dtype(gates.dtype)
+    h_prev = jnp.concatenate([h0[None], h_out[:-1]], axis=0)
+    drw = _dot(h_prev.reshape(T * B, H).astype(dt).T,
+               dz.reshape(T * B, G).astype(dt), ((1,), (0,)), dt)
+    c_prev = jnp.concatenate([c0[None], c_stash[:-1]], axis=0).astype(sdt)
+    dzf32 = dz.astype(sdt)
+    dpi = jnp.sum(dzf32[..., :H] * c_prev, axis=(0, 1))
+    dpf = jnp.sum(dzf32[..., H:2 * H] * c_prev, axis=(0, 1))
+    dpo = jnp.sum(dzf32[..., 2 * H:3 * H] * c_stash.astype(sdt),
+                  axis=(0, 1))
+    dpeep = jnp.stack([dpi, dpf, dpo]).astype(peep.dtype)
+    return (dz, drw.astype(rw.dtype), dpeep,
+            dhc0[0].astype(h0.dtype), dhc0[1].astype(c0.dtype))
+
+
+_lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
+
+
+_probe_cache: dict = {}  # (dtype name, batch block, H) -> probe verdict
+
+
+def _platform_ok() -> bool:
+    if os.environ.get("DL4J_TPU_NO_PALLAS_LSTM"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _eager_probe(dtype, bb, H) -> bool:
+    """Compile + run fwd AND bwd once at the TILE configuration the real
+    call will use — (T=2, B=batch block, H) — outside any trace, so a
+    Mosaic failure becomes a silent scan fallback instead of an outer-jit
+    compile crash (same rationale as the flash-attention probe). The block
+    shapes are what Mosaic compiles; T and the number of batch blocks only
+    set the grid length, so a tiny-T probe proves the real kernel without
+    allocating GB-scale probe buffers (the real (T, B, 4H) could rival the
+    training step itself near HBM capacity)."""
+    T = 2
+    k = jax.random.PRNGKey(0)
+    xw = jax.random.normal(k, (T, bb, 4 * H), dtype)
+    rw = jax.random.normal(k, (H, 4 * H), dtype) * 0.05
+    peep = jnp.zeros((3, H), dtype)
+    z = jnp.zeros((bb, H), dtype)
+
+    def loss(xw, rw):
+        h, cT = _lstm_core(xw, rw, peep, z, z, False)
+        return jnp.sum(h.astype(jnp.float32)) + jnp.sum(
+            cT.astype(jnp.float32))
+
+    g = jax.grad(loss, argnums=(0, 1))(xw, rw)
+    return bool(jnp.all(jnp.isfinite(g[1].astype(jnp.float32))))
+
+
+def lstm_fused_or_none(x, W, RW, b, peephole, h0, c0, *,
+                       gate_is_sigmoid: bool, cell_is_tanh: bool,
+                       mask=None, reverse: bool = False,
+                       interpret: bool = False
+                       ) -> Optional[Tuple[jnp.ndarray,
+                                           Tuple[jnp.ndarray,
+                                                 jnp.ndarray]]]:
+    """Fused-path dispatch: returns (out (B,T,H), (hT, cT)) or None when
+    the kernel can't serve this call (the reflective cuDNN-helper
+    contract). `interpret=True` runs the Pallas interpreter (any platform;
+    used by parity/gradient-check tests)."""
+    B, T, _ = x.shape
+    H = RW.shape[0]
+    f64 = (jnp.float64,) if interpret else ()
+    if (mask is not None or not gate_is_sigmoid or not cell_is_tanh
+            or H % 128 or T < 2 or _batch_block(B) is None
+            or x.dtype not in (jnp.float32, jnp.bfloat16, *f64)):
+        return None
+    if not interpret and not _platform_ok():
+        return None
+    if not interpret:
+        key = (jnp.dtype(x.dtype).name, _batch_block(B), H)
+        ok = _probe_cache.get(key)
+        if ok is None:
+            try:
+                ok = _run_probe_out_of_trace(_eager_probe, x.dtype,
+                                             _batch_block(B), H)
+            except Exception as e:
+                logger.warning("pallas fused LSTM unavailable for %s (%s); "
+                               "using lax.scan path", key, e)
+                ok = False
+            _probe_cache[key] = ok
+        if not ok:
+            return None
+    # time-major input projection: ONE big GEMM, with the transpose to the
+    # layout the kernel streams fused into the GEMM output
+    xw = jnp.einsum("bti,ig->tbg", x, W) + b
+    if reverse:
+        xw = xw[::-1]
+    if peephole is None:
+        peep = jnp.zeros((3, H), x.dtype)
+    else:
+        peep = jnp.stack(peephole).astype(x.dtype)
+    zh = jnp.zeros((B, H), x.dtype)
+    h0 = zh if h0 is None else h0.astype(x.dtype)
+    c0 = zh if c0 is None else c0.astype(x.dtype)
+    try:
+        h_tbh, cT = _lstm_core(xw, RW, peep, h0, c0, interpret)
+    except Exception as e:  # per-shape staging failure: fall back
+        logger.warning("pallas fused LSTM declined for shape %s (%s)",
+                       x.shape, e)
+        return None
+    if reverse:
+        h_tbh = h_tbh[::-1]
+        hT = h_tbh[0]
+    else:
+        hT = h_tbh[-1]
+    return jnp.swapaxes(h_tbh, 0, 1), (hT, cT)
+
+
+__all__ = ["lstm_fused_or_none"]

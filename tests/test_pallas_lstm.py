@@ -1,0 +1,150 @@
+"""Fused Pallas LSTM cell: parity + gradient checks vs the lax.scan path.
+
+Reference strategy analogue: `CuDNNGradientChecks.java` /
+`TestConvolution.java` — the accelerated helper must produce the same
+outputs and pass gradient checks against the built-in path. Runs the
+kernel in Pallas interpret mode so the same math executes on the CPU CI
+mesh (Mosaic-compiled execution is exercised on-chip by `bench.py lstm`
+and the probe)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers.recurrent import lstm_forward
+from deeplearning4j_tpu.ops.pallas_lstm import lstm_fused_or_none
+
+pytestmark = pytest.mark.slow  # interpret-mode kernels are CPU-heavy
+
+
+def _inputs(dt, B=8, T=5, NI=16, H=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((B, T, NI)), dt)
+    W = jnp.asarray(rng.standard_normal((NI, 4 * H)) * 0.2, dt)
+    RW = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.2, dt)
+    b = jnp.asarray(rng.standard_normal(4 * H) * 0.1, dt)
+    peep = tuple(jnp.asarray(rng.standard_normal(H) * 0.1, dt)
+                 for _ in range(3))
+    h0 = jnp.asarray(rng.standard_normal((B, H)) * 0.5, dt)
+    c0 = jnp.asarray(rng.standard_normal((B, H)) * 0.5, dt)
+    return x, W, RW, b, peep, h0, c0
+
+
+def _fused(x, W, RW, b, peep, h0, c0, **kw):
+    res = lstm_fused_or_none(x, W, RW, b, peep, h0, c0,
+                             gate_is_sigmoid=True, cell_is_tanh=True,
+                             interpret=True, **kw)
+    assert res is not None, "fused dispatch declined a qualifying call"
+    return res
+
+
+def test_forward_matches_scan_exactly():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float32)
+    ref, (rh, rc) = lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid,
+                                 jnp.tanh, h0, c0)
+    out, (hT, cT) = _fused(x, W, RW, b, peep, h0, c0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rh), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rc), atol=2e-6)
+
+
+def test_reverse_matches_scan():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float32)
+    ref, (rh, rc) = lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid,
+                                 jnp.tanh, h0, c0, reverse=True)
+    out, (hT, cT) = _fused(x, W, RW, b, peep, h0, c0, reverse=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rh), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rc), atol=2e-6)
+
+
+def test_gradients_match_scan_f64():
+    """Analytic VJP of the kernel vs the scan transpose, f64: every
+    parameter, the input, and both initial carries."""
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float64)
+    weights = jnp.asarray(
+        np.random.default_rng(1).standard_normal((8, 5, 128)))
+
+    def loss(fwd, W, RW, b, peep, h0, c0, x):
+        out, (hT, cT) = fwd(x, W, RW, b, peep, h0, c0)
+        return (jnp.sum(out * weights) + jnp.sum(hT * cT)
+                + jnp.sum(jnp.tanh(cT)))
+
+    def scan_fwd(x, W, RW, b, peep, h0, c0):
+        return lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid, jnp.tanh,
+                            h0, c0)
+
+    def fused_fwd(x, W, RW, b, peep, h0, c0):
+        return _fused(x, W, RW, b, peep, h0, c0)
+
+    args = (W, RW, b, peep, h0, c0, x)
+    g_ref = jax.grad(lambda *a: loss(scan_fwd, *a),
+                     argnums=tuple(range(7)))(*args)
+    g_fus = jax.grad(lambda *a: loss(fused_fwd, *a),
+                     argnums=tuple(range(7)))(*args)
+    flat_r, _ = jax.tree_util.tree_flatten(g_ref)
+    flat_f, _ = jax.tree_util.tree_flatten(g_fus)
+    for r, f in zip(flat_r, flat_f):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(r),
+                                   rtol=1e-9, atol=1e-11)
+
+
+def test_numeric_gradient_check_f64():
+    """f64 central differences vs the kernel's custom VJP (the reference's
+    gradient-check bar: eps 1e-6, maxRelError 1e-3,
+    `GradientCheckUtil.java:62`)."""
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float64, B=8, T=3, NI=8, H=128)
+
+    def loss_rw(RW_flat):
+        out, (hT, cT) = _fused(x, W, RW_flat.reshape(RW.shape), b, peep,
+                               h0, c0)
+        return jnp.sum(out ** 2) + jnp.sum(hT * cT)
+
+    rw_flat = RW.ravel()
+    g = np.asarray(jax.grad(loss_rw)(rw_flat))
+    rng = np.random.default_rng(2)
+    eps = 1e-6
+    for idx in rng.choice(rw_flat.size, 25, replace=False):
+        e = np.zeros(rw_flat.size)
+        e[idx] = eps
+        num = (float(loss_rw(rw_flat + e)) - float(loss_rw(rw_flat - e))) \
+            / (2 * eps)
+        denom = max(abs(num), abs(g[idx]), 1e-8)
+        assert abs(num - g[idx]) / denom < 1e-3, (
+            f"RW[{idx}]: numeric {num} vs analytic {g[idx]}")
+
+
+def test_dispatch_declines_unsupported_calls():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float32)
+    mask = jnp.ones(x.shape[:2])
+    assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0, mask=mask,
+                              gate_is_sigmoid=True, cell_is_tanh=True,
+                              interpret=True) is None
+    assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0,
+                              gate_is_sigmoid=False, cell_is_tanh=True,
+                              interpret=True) is None
+    # H not a lane multiple
+    x2, W2, RW2, b2, p2, h2, c2 = _inputs(jnp.float32, H=96)
+    assert lstm_fused_or_none(x2, W2, RW2, b2, p2, h2, c2,
+                              gate_is_sigmoid=True, cell_is_tanh=True,
+                              interpret=True) is None
+    # T == 1 (single-step path belongs to lstm_step)
+    assert lstm_fused_or_none(x[:, :1], W, RW, b, peep, h0, c0,
+                              gate_is_sigmoid=True, cell_is_tanh=True,
+                              interpret=True) is None
+
+
+def test_zero_initial_state_defaults():
+    x, W, RW, b, peep, _, _ = _inputs(jnp.float32)
+    ref, (rh, rc) = lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid,
+                                 jnp.tanh)
+    out, (hT, cT) = _fused(x, W, RW, b, peep, None, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rc), atol=2e-6)
+
+
+def test_batch_not_multiple_of_8_declines():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float32, B=6)
+    assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0,
+                              gate_is_sigmoid=True, cell_is_tanh=True,
+                              interpret=True) is None
