@@ -320,9 +320,14 @@ def bench_gpt_long():
     mfu = _mfu((xla_flops + flash_flops) / (batch_size * T), value,
                bf16=True)
 
-    # kernel-level flash vs XLA-blockwise A/B at the bench shape (full-net
-    # A/B is impossible: the blockwise scan's saved residuals alone exceed
-    # HBM at T=4096, which is the flash kernel's point)
+    # kernel-level flash vs XLA-blockwise A/B at the bench shape AND the
+    # dispatched tile size (full-net A/B is impossible: the blockwise
+    # scan's saved residuals alone exceed HBM at T=4096, which is the
+    # flash kernel's point). Skipped when the dispatch declined flash —
+    # hardcoding a tile the probe rejected would crash the whole bench.
+    if blk is None:
+        bench_gpt_long.flash_speedup = None
+        return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu
     from deeplearning4j_tpu.ops.attention import blockwise_attention
     from deeplearning4j_tpu.ops.pallas_attention import flash_attention
 
@@ -345,7 +350,7 @@ def bench_gpt_long():
         return g_scalar
 
     flash = mk_loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, block_q=1024, block_k=1024))
+        q, k, v, causal=True, block_q=blk, block_k=blk))
     xla = mk_loss(lambda q, k, v: blockwise_attention(
         q, k, v, causal=True, block_size=512))
     times = {}

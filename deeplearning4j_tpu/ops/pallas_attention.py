@@ -43,31 +43,15 @@ logger = logging.getLogger("deeplearning4j_tpu")
 NEG_INF = -1e30
 
 
-def _mxu_dtype(ref_dtype):
-    """bf16 inputs feed the MXU natively; f32 stays f32; f64 (interpret
-    mode on CPU, gradient checks) stays f64."""
-    return jnp.bfloat16 if ref_dtype == jnp.bfloat16 else ref_dtype
-
-
-def _stat_dtype(dt):
-    """Accumulator/statistic dtype: f32 for bf16/f32 inputs, f64 for f64
-    (interpret-mode gradient checks need the whole pipeline at f64, or
-    eps-scale central differences drown in f32 forward noise)."""
-    return jnp.float64 if dt == jnp.float64 else jnp.float32
-
-
-def _dot_precision(dt):
-    """f32 operands multiply at HIGHEST precision (bf16x3 passes on the
-    MXU) — measured ~100x more accurate gradients than the XLA
-    default-precision einsum; bf16 takes the native single-pass feed."""
-    return (jax.lax.Precision.DEFAULT if dt == jnp.bfloat16
-            else jax.lax.Precision.HIGHEST)
-
-
-def _dot(a, b, dims, dt):
-    return jax.lax.dot_general(a, b, dimension_numbers=(dims, ((), ())),
-                               preferred_element_type=_stat_dtype(dt),
-                               precision=_dot_precision(dt))
+# shared kernel-dispatch policy helpers (kept under the historical private
+# names — this module's kernels use them pervasively)
+from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
+    dot as _dot,
+    mxu_dtype as _mxu_dtype,
+    probe_verdict as _probe_verdict,
+    run_probe_out_of_trace as _run_probe_out_of_trace,
+    stat_dtype as _stat_dtype,
+)
 
 
 def _masked_scores(q_ref, k_ref, qi, ki, *, sm_scale, causal, block_q,
@@ -395,20 +379,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 _probe_cache: dict = {}  # (dtype name, block, head_dim) -> probe verdict
 
 
-def _run_probe_out_of_trace(fn, *args) -> bool:
-    """Run an eager compile probe OUTSIDE any live jit trace. Dispatch
-    usually happens while the caller's step function is being traced, and
-    JAX trace contexts are dynamic: ops on concrete probe arrays would be
-    staged into the caller's jaxpr and the probe's `bool()` would raise
-    TracerBoolConversionError (silently caching a False verdict). Trace
-    state is thread-local, so a worker thread gives the probe a clean
-    eval context."""
-    from concurrent.futures import ThreadPoolExecutor
-
-    with ThreadPoolExecutor(1) as ex:
-        return ex.submit(fn, *args).result()
-
-
 def _platform_supported() -> bool:
     import os
 
@@ -452,17 +422,8 @@ def _probed_block(dtype, Tq: int, Tk: int, D: int) -> Optional[int]:
         if Tq % block or Tk % block:
             continue
         key = (jnp.dtype(dtype).name, block, D)
-        ok = _probe_cache.get(key)
-        if ok is None:
-            try:
-                ok = _run_probe_out_of_trace(_eager_probe, dtype, block, D)
-            except Exception as e:  # Mosaic/compile failure: remember
-                logger.warning(
-                    "pallas flash-attention unavailable for %s (%s); "
-                    "trying smaller tiles / XLA blockwise", key, e)
-                ok = False
-            _probe_cache[key] = ok
-        if ok:
+        if _probe_verdict(_probe_cache, key, _eager_probe,
+                          (dtype, block, D), "pallas flash-attention"):
             return block
     return None
 

@@ -48,11 +48,11 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.ops.pallas_attention import (
-    _dot,
-    _mxu_dtype,
-    _run_probe_out_of_trace,
-    _stat_dtype,
+from deeplearning4j_tpu.ops.kernel_dispatch import (
+    dot as _dot,
+    mxu_dtype as _mxu_dtype,
+    probe_verdict as _probe_verdict,
+    stat_dtype as _stat_dtype,
 )
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -344,17 +344,9 @@ def lstm_fused_or_none(x, W, RW, b, peephole, h0, c0, *,
         return None
     if not interpret:
         key = (jnp.dtype(x.dtype).name, _batch_block(B), H)
-        ok = _probe_cache.get(key)
-        if ok is None:
-            try:
-                ok = _run_probe_out_of_trace(_eager_probe, x.dtype,
-                                             _batch_block(B), H)
-            except Exception as e:
-                logger.warning("pallas fused LSTM unavailable for %s (%s); "
-                               "using lax.scan path", key, e)
-                ok = False
-            _probe_cache[key] = ok
-        if not ok:
+        if not _probe_verdict(_probe_cache, key, _eager_probe,
+                              (x.dtype, _batch_block(B), H),
+                              "pallas fused LSTM"):
             return None
     # time-major input projection: ONE big GEMM, with the transpose to the
     # layout the kernel streams fused into the GEMM output
