@@ -400,3 +400,57 @@ class DigitsDataSetIterator(DataSetIterator):
 
     def num_examples(self):
         return len(self._X)
+
+
+class RealPatchesDataSetIterator(DataSetIterator):
+    """REAL natural-image pixels at CIFAR geometry (32x32x3 uint8): 1,950
+    patches cut stride-16 from the two real photographs that ship inside
+    scikit-learn (`sklearn.datasets.load_sample_images`: china.jpg /
+    flower.jpg), committed as `datasets/data/real_patches32.npz`,
+    pre-shuffled at export, 2 balanced classes (source photograph).
+
+    Role: the zero-egress stand-in for a real-CIFAR convergence fixture
+    (reference `CifarDataSetIterator.java` downloads the archive; this
+    environment has no egress, so the synthetic `CifarDataSetIterator`
+    above covers throughput and THIS iterator covers learning on real
+    pixels — a conv net must learn actual color/texture statistics to
+    separate the classes). `train=True`: first 1,560 patches;
+    `train=False`: the held-out 390."""
+
+    _TRAIN = 1560
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 one_hot: bool = True, raw_uint8: bool = False):
+        cached = DATA_DIR / "real_patches32.npz"
+        p = cached if cached.exists() else (
+            Path(__file__).resolve().parent / "data" / "real_patches32.npz")
+        data = np.load(p)
+        X = data["images"]
+        y = data["labels"].astype(np.int64)
+        if train:
+            X, y = X[:self._TRAIN], y[:self._TRAIN]
+        else:
+            X, y = X[self._TRAIN:], y[self._TRAIN:]
+        # raw uint8 stages 4x fewer bytes; pair with ImagePreProcessingScaler
+        self._X = X if raw_uint8 else X.astype(np.float32) / 255.0
+        self._y = (np.eye(2, dtype=np.float32)[y] if one_hot
+                   else y.astype(np.int32))
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._X)
+
+    def next(self):
+        lo, hi = self._pos, min(self._pos + self.batch_size, len(self._X))
+        self._pos = hi
+        return DataSet(self._X[lo:hi], self._y[lo:hi])
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self.batch_size
+
+    def num_examples(self):
+        return len(self._X)

@@ -60,6 +60,27 @@ class ParameterServer:
             return self._pushes
 
 
+def run_worker_protocol(store, replica, batches, sync_frequency: int) -> None:
+    """THE worker half of the PS contract — pull, fit `sync_frequency`
+    minibatches locally, push (new - pulled) as a delta, flush the tail.
+    One definition shared by the in-process wrapper threads and both
+    OS-process CLI modes, so the transport-parity test compares transports
+    and can never drift on protocol details (sync cadence, tail flush)."""
+    pending = 0
+    pulled: Optional[np.ndarray] = None
+    for ds in batches:
+        if pending == 0:
+            pulled = store.pull()
+            replica.set_params(pulled)
+        replica.fit(ds)
+        pending += 1
+        if pending >= sync_frequency:
+            store.push_update(replica.params() - pulled)
+            pending = 0
+    if pending and pulled is not None:
+        store.push_update(replica.params() - pulled)
+
+
 class ParameterServerParallelWrapper:
     """Async multi-worker trainer (reference
     `ParameterServerParallelWrapper.java`).
@@ -73,7 +94,13 @@ class ParameterServerParallelWrapper:
     _STOP = object()
 
     def __init__(self, net, workers: int = 2, sync_frequency: int = 1,
-                 queue_capacity: int = 8):
+                 queue_capacity: int = 8, server=None):
+        """`server`: any object with the ParameterServer pull/push contract
+        — pass a `RemoteParameterServerClient` to train against a
+        `NetworkParameterServer` in another process/host (the reference's
+        `ParameterServerClient`-per-worker wiring,
+        `ParameterServerParallelWrapper.java:215-218`). Default: a fresh
+        in-process store seeded from the net."""
         if workers < 1:
             raise ValueError("workers must be >= 1")
         net._ensure_init()
@@ -82,7 +109,8 @@ class ParameterServerParallelWrapper:
         self.sync_frequency = max(1, sync_frequency)
         self._queues: List[queue.Queue] = [
             queue.Queue(maxsize=queue_capacity) for _ in range(workers)]
-        self.server = ParameterServer(net.params())
+        self.server = (ParameterServer(net.params()) if server is None
+                       else server)
 
     def fit(self, data: Union[DataSet, DataSetIterator],
             epochs: int = 1) -> None:
@@ -115,22 +143,16 @@ class ParameterServerParallelWrapper:
     def _worker_loop(self, idx: int) -> None:
         replica = self.net.clone()
         q = self._queues[idx]
-        pending = 0
-        pulled: Optional[np.ndarray] = None
-        while True:
-            item = q.get()
-            if item is self._STOP:
-                break
-            if pending == 0:
-                pulled = self.server.pull()
-                replica.set_params(pulled)
-            replica.fit(item)
-            pending += 1
-            if pending >= self.sync_frequency:
-                self.server.push_update(replica.params() - pulled)
-                pending = 0
-        if pending and pulled is not None:
-            self.server.push_update(replica.params() - pulled)
+
+        def batches():
+            while True:
+                item = q.get()
+                if item is self._STOP:
+                    return
+                yield item
+
+        run_worker_protocol(self.server, replica, batches(),
+                            self.sync_frequency)
         # propagate the last score for listener/reporting purposes
         if replica.score_value is not None:
             self.net.score_value = replica.score_value
@@ -289,3 +311,85 @@ class RemoteParameterServerClient:
             self._sock.close()
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# OS-process worker entry (test/dryrun rig for the network transport)
+
+
+def _network_worker_main() -> None:
+    """Train the shared parity fixture against a NetworkParameterServer in
+    ANOTHER process: `python -m deeplearning4j_tpu.parallel.parameter_server
+    <host> <port> <worker_id> <n_workers> <sync_frequency> <mode>`.
+
+    mode 'train': pull -> fit this worker's slice of the fixture stream
+    (round-robin, the wrapper's dispatch order) -> push deltas every
+    `sync_frequency` batches — the real worker protocol over TCP.
+    mode 'hammer': push 50 constant 0.5-deltas (exactly representable, so
+    the aggregate under CONCURRENT pushes has one correct answer — proves
+    the per-connection handler threads don't drop or double-apply).
+    mode 'local': no network at all — run EVERY worker's sequence against
+    an in-process ParameterServer and save the final params to the path
+    in argv[7]; the parity test diffs this against the TCP result from an
+    identically-configured interpreter, isolating the transport."""
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    host, port, wid, n_workers, sync_freq, mode = sys.argv[1:7]
+    port, wid = int(port), int(wid)
+    n_workers, sync_freq = int(n_workers), int(sync_freq)
+
+    if mode == "local":
+        from deeplearning4j_tpu.parallel.multiprocess import (
+            _parity_fixture_data,
+            _parity_fixture_net,
+        )
+
+        # argv[8] (optional): EXACT initial params of the server being
+        # compared against — re-deriving them from the fixture net here
+        # would differ by ~1 ulp across interpreter configs (x64 flag,
+        # platform) and diverge the whole trajectory
+        init = (np.load(sys.argv[8]) if len(sys.argv) > 8
+                else _parity_fixture_net().params())
+        store = ParameterServer(init)
+        feats, labels = _parity_fixture_data()
+        for w in range(n_workers):
+            run_worker_protocol(
+                store, _parity_fixture_net(),
+                [DataSet(feats[i], labels[i])
+                 for i in range(feats.shape[0]) if i % n_workers == w],
+                sync_freq)
+        np.save(sys.argv[7], store.pull())
+        print("PS_LOCAL_REF_DONE")
+        return
+
+    client = RemoteParameterServerClient(host, port)
+    if mode == "hammer":
+        import jax  # noqa: F401  (mirror train-mode import cost)
+
+        size = len(client.pull())
+        for _ in range(50):
+            client.push_update(np.full((size,), 0.5, np.float32))
+        client.close()
+        print(f"PS_WORKER_{wid}_DONE hammer")
+        return
+
+    from deeplearning4j_tpu.parallel.multiprocess import (
+        _parity_fixture_data,
+        _parity_fixture_net,
+    )
+
+    net = _parity_fixture_net()
+    feats, labels = _parity_fixture_data()
+    run_worker_protocol(
+        client, net,
+        [DataSet(feats[i], labels[i])
+         for i in range(feats.shape[0]) if i % n_workers == wid],
+        sync_freq)
+    client.close()
+    print(f"PS_WORKER_{wid}_DONE train score={net.score_value:.6f}")
+
+
+if __name__ == "__main__":
+    _network_worker_main()

@@ -143,6 +143,24 @@ class PipelineParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
 
         self.trunk_start, self.trunk_end = find_trunk(net, self.n_stages)
+        # norm-based gradient normalization computes a PER-LAYER norm; on
+        # the stage-STACKED trunk that norm would span all S stages jointly
+        # and silently diverge from single-device training — refuse it
+        from deeplearning4j_tpu.nn.updater import GradientNormalization
+
+        _norm_kinds = {GradientNormalization.RENORMALIZE_L2_PER_LAYER,
+                       GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE,
+                       GradientNormalization.CLIP_L2_PER_LAYER,
+                       GradientNormalization.CLIP_L2_PER_PARAM_TYPE}
+        for i in range(self.trunk_start, self.trunk_end):
+            cfg = net.layers[i].updater_cfg
+            gn = getattr(cfg, "gradient_normalization", None)
+            if gn in _norm_kinds:
+                raise ValueError(
+                    f"pipeline stages cannot use norm-based gradient "
+                    f"normalization ({gn.value}): the norm would be "
+                    "computed across all stacked stages instead of per "
+                    "layer; use elementwise clipping or ParallelWrapper")
         self.layers_per_stage = (self.trunk_end
                                  - self.trunk_start) // self.n_stages
         logger.info(
@@ -227,41 +245,51 @@ class PipelineParallelWrapper:
                 (head_p, trunk_p, tail_p), net.compute_dtype)
             if not getattr(net.layers[0], "integer_input", False):
                 features = features.astype(net.compute_dtype)
+        from deeplearning4j_tpu.ops.aux_loss import aux_loss_scope
+
         new_state = list(lstate)
-        x = features
-        for i in range(self.trunk_start):
-            layer = net.layers[i]
-            lrng = None if rng is None else jax.random.fold_in(rng, i)
-            if i in net.conf.preprocessors:
-                x = net.conf.preprocessors[i].preprocess(x, rng=lrng,
-                                                         train=train)
-            mask = fmask if x.ndim == 3 else None
-            x, new_state[i] = layer.forward(head_p[i], lstate[i], x,
-                                            train=train, rng=lrng, mask=mask)
+        with aux_loss_scope() as aux_terms:
+            # mid-network aux losses (e.g. a replicated MoE head/tail
+            # block's load-balancing term) collect exactly as in
+            # `_loss_pure`; the trunk itself is MoE-free by construction
+            x = features
+            for i in range(self.trunk_start):
+                layer = net.layers[i]
+                lrng = None if rng is None else jax.random.fold_in(rng, i)
+                if i in net.conf.preprocessors:
+                    x = net.conf.preprocessors[i].preprocess(x, rng=lrng,
+                                                             train=train)
+                mask = fmask if x.ndim == 3 else None
+                x, new_state[i] = layer.forward(head_p[i], lstate[i], x,
+                                                train=train, rng=lrng,
+                                                mask=mask)
 
-        k = self.layers_per_stage
-        trunk_layers = [net.layers[self.trunk_start + j] for j in range(k)]
+            k = self.layers_per_stage
+            trunk_layers = [net.layers[self.trunk_start + j]
+                            for j in range(k)]
 
-        def block_fn(stage_p, xb):
-            for j in range(k):
-                xb, _ = trunk_layers[j].forward(stage_p[j], {}, xb,
-                                                train=train, rng=None,
-                                                mask=None)
-            return xb
+            def block_fn(stage_p, xb):
+                for j in range(k):
+                    xb, _ = trunk_layers[j].forward(stage_p[j], {}, xb,
+                                                    train=train, rng=None,
+                                                    mask=None)
+                return xb
 
-        x = pipeline_apply(block_fn, trunk_p, x, self.mesh,
-                           axis_name=self.pipe_axis,
-                           microbatches=self.microbatches)
+            x = pipeline_apply(block_fn, trunk_p, x, self.mesh,
+                               axis_name=self.pipe_axis,
+                               microbatches=self.microbatches)
 
-        for idx, i in enumerate(range(self.trunk_end, len(net.layers) - 1)):
-            layer = net.layers[i]
-            lrng = None if rng is None else jax.random.fold_in(rng, i)
-            if i in net.conf.preprocessors:
-                x = net.conf.preprocessors[i].preprocess(x, rng=lrng,
-                                                        train=train)
-            mask = fmask if x.ndim == 3 else None
-            x, new_state[i] = layer.forward(tail_p[idx], lstate[i], x,
-                                            train=train, rng=lrng, mask=mask)
+            for idx, i in enumerate(range(self.trunk_end,
+                                          len(net.layers) - 1)):
+                layer = net.layers[i]
+                lrng = None if rng is None else jax.random.fold_in(rng, i)
+                if i in net.conf.preprocessors:
+                    x = net.conf.preprocessors[i].preprocess(x, rng=lrng,
+                                                            train=train)
+                mask = fmask if x.ndim == 3 else None
+                x, new_state[i] = layer.forward(tail_p[idx], lstate[i], x,
+                                                train=train, rng=lrng,
+                                                mask=mask)
         if net.compute_dtype is not None:
             from deeplearning4j_tpu.nn.precision import restore_dtypes
 
@@ -278,6 +306,8 @@ class PipelineParallelWrapper:
         loss = out_layer.loss_score(tail_pi[-1], x, labels, train=train,
                                     rng=out_rng, mask=mask)
         loss = loss + self._reg_score(head_pi, trunk_pi, tail_pi)
+        for term in aux_terms:  # replicated head/tail MoE load balancing
+            loss = loss + term
         return loss, new_state
 
     def _reg_score(self, head_p, trunk_p, tail_p):

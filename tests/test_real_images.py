@@ -1,0 +1,66 @@
+"""ResNet convergence evidence on REAL 32x32x3 pixels (BASELINE config 2's
+learning half).
+
+The reference proves CIFAR learning with downloaded real images
+(`CifarDataSetIterator.java`); this environment has zero egress, so the
+committed fixture is real natural-image patches at CIFAR geometry
+(`RealPatchesDataSetIterator` — photographs bundled inside scikit-learn).
+The synthetic CIFAR iterator keeps covering throughput; THIS test covers
+learning: loss strictly decreasing across epochs and held-out accuracy
+far above chance with a ResNet (basic-block, ResNet-18 layout at reduced
+width for the CPU CI mesh)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import RealPatchesDataSetIterator
+from deeplearning4j_tpu.models.resnet import resnet_configuration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.updater import Updater
+
+pytestmark = pytest.mark.slow
+
+
+def test_resnet_learns_real_pixels():
+    net = ComputationGraph(resnet_configuration(
+        depth=18, n_classes=2, stage_filters=(8, 16, 32, 64),
+        learning_rate=0.008, updater=Updater.ADAM, seed=3))
+    net.init()
+
+    epoch_losses = []
+    for _ in range(3):
+        it = RealPatchesDataSetIterator(batch_size=128, train=True)
+        losses = []
+        while it.has_next():
+            net.fit(it.next())
+            losses.append(net.score_value)
+        epoch_losses.append(float(np.mean(losses)))
+
+    # loss strictly decreasing epoch over epoch
+    assert epoch_losses[0] > epoch_losses[1] > epoch_losses[2], epoch_losses
+
+    ev = net.evaluate(RealPatchesDataSetIterator(batch_size=390,
+                                                 train=False))
+    # chance is 0.5 on the balanced 2-class held-out split; require >= 1.8x
+    assert ev.accuracy() >= 0.9, f"held-out accuracy {ev.accuracy()}"
+
+
+def test_real_patches_fixture_integrity():
+    tr = RealPatchesDataSetIterator(batch_size=64, train=True)
+    te = RealPatchesDataSetIterator(batch_size=64, train=False,
+                                    one_hot=False)
+    assert tr.num_examples() == 1560 and te.num_examples() == 390
+    ds = tr.next()
+    assert ds.features.shape == (64, 32, 32, 3)
+    assert ds.features.dtype == np.float32
+    # real pixels: non-trivial per-image variance (synthetic noise or
+    # constant fills would fail one of these)
+    stds = ds.features.reshape(64, -1).std(axis=1)
+    assert stds.min() > 0.005 and stds.max() < 0.5
+    # raw uint8 mode stages the native storage dtype
+    raw = RealPatchesDataSetIterator(batch_size=16, raw_uint8=True).next()
+    assert raw.features.dtype == np.uint8
+    # held-out labels cover both classes
+    labs = []
+    while te.has_next():
+        labs.append(te.next().labels)
+    assert set(np.concatenate(labs).tolist()) == {0, 1}
