@@ -47,6 +47,76 @@ class Lexicon:
         IPADIC-style dictionary."""
         return cls(LexEntry(w, p, cost) for w, p in words)
 
+    @classmethod
+    def from_mecab_csv(cls, lines: Iterable[str],
+                       base: Optional["Lexicon"] = None) -> "Lexicon":
+        """Parse MeCab/IPADIC dictionary CSV rows into a Lexicon (the
+        loader for real dictionary assets the reference vendors under
+        `deeplearning4j-nlp-japanese/`). Format per row:
+
+            surface,left_id,right_id,word_cost,POS1,POS2,...
+
+        Only surface, word_cost, and POS1 are consumed (the lattice here
+        is unigram — no connection matrix), so truncated rows with >= 5
+        fields load fine. IPADIC word costs (~ -3000..15000, lower =
+        more common) map monotonically onto this module's float scale so
+        loaded words interoperate with embedded entries and stay cheaper
+        than the OOV fallback. `base`: merge on top of an existing
+        lexicon (loaded rows win on surface collisions)."""
+        import csv
+
+        entries: List[LexEntry] = []
+        if base is not None:
+            entries.extend(base._by_surface.values())
+        stripped = (ln for ln in (l.strip() for l in lines)
+                    if ln and not ln.startswith("#"))
+        # csv.reader, not split(','): real MeCab dictionaries QUOTE
+        # surfaces containing commas (Symbol.csv's ',' entry, many
+        # neologd rows) — naive splitting would shift every column
+        for parts in csv.reader(stripped):
+            if len(parts) < 5:
+                raise ValueError(
+                    f"not a MeCab dictionary row (need >= 5 comma fields, "
+                    f"got {len(parts)}): {','.join(parts)[:80]!r}")
+            surface = parts[0]
+            try:
+                word_cost = int(parts[3])
+            except ValueError as e:
+                raise ValueError(
+                    f"bad word_cost in row {','.join(parts)[:80]!r}") from e
+            pos = parts[4] or "unknown"
+            # -3000..15000 -> ~0.25..1.15: monotone, clipped into the
+            # known-word band (below _UNKNOWN_BASE)
+            cost = min(1.15, max(0.15, 0.4 + word_cost / 20000.0))
+            entries.append(LexEntry(surface, pos, cost))
+        return cls(entries)
+
+    @classmethod
+    def from_mecab_path(cls, path,
+                        base: Optional["Lexicon"] = None) -> "Lexicon":
+        """Load a MeCab CSV file, or a DIRECTORY of them (the layout of an
+        unpacked mecab-ipadic distribution: Noun.csv, Verb.csv, ...) —
+        the downloadable-dictionary seam: point this at real IPADIC
+        assets and the full dictionary drops in."""
+        import pathlib
+
+        p = pathlib.Path(path)
+        files = sorted(p.glob("*.csv")) if p.is_dir() else [p]
+        if not files:
+            raise ValueError(f"no dictionary CSVs under {p}")
+
+        def rows():
+            for f in files:
+                # euc-jp is upstream ipadic's encoding; utf-8 the common
+                # re-encode. Try utf-8 first, fall back per file.
+                try:
+                    text = f.read_text(encoding="utf-8")
+                except UnicodeDecodeError:
+                    text = f.read_text(encoding="euc-jp")
+                yield from text.splitlines()
+
+        return cls.from_mecab_csv(rows(), base=base)
+
     def lookup(self, surface: str) -> Optional[LexEntry]:
         return self._by_surface.get(surface)
 
@@ -169,6 +239,24 @@ JAPANESE_LEXICON = Lexicon(
     + [LexEntry(w, "noun", 0.7) for w in _JA_NOUNS]
     + [LexEntry(w, "verb", 0.7) for w in _JA_VERBS]
     + [LexEntry(w, "adjective", 0.7) for w in _JA_ADJ])
+
+
+def load_bundled_ipadic_sample(base: Optional[Lexicon] = JAPANESE_LEXICON
+                               ) -> Lexicon:
+    """The committed IPADIC-format sample dictionary
+    (`nlp/data/ipadic_sample.csv`, ~450 entries: common nouns, verbs,
+    adjectives, katakana loanwords) merged over the embedded
+    mini-lexicon — the in-repo stand-in for pointing
+    `Lexicon.from_mecab_path` at a full unpacked mecab-ipadic; also
+    honors `DL4J_TPU_IPADIC_DIR` to load real assets instead."""
+    import os
+    import pathlib
+
+    override = os.environ.get("DL4J_TPU_IPADIC_DIR")
+    if override:
+        return Lexicon.from_mecab_path(override, base=base)
+    p = pathlib.Path(__file__).resolve().parent / "data" / "ipadic_sample.csv"
+    return Lexicon.from_mecab_path(p, base=base)
 
 
 # ---------------------------------------------------------------------------

@@ -6,9 +6,11 @@ online training and model serving (`DL4jServeRouteBuilder.java`,
 Python callables/iterables bridged through a bounded queue; the train route
 feeds the SAME jitted step as offline `fit()` (one compiled step, batches
 stream through it), and the serve route runs the jitted `output()`.
-Kafka transport is a thin gated adapter (`KafkaSource`/`KafkaSink`) so the
-pipeline logic is testable in-process — the reference tests do the same
-with an embedded Kafka fake (`EmbeddedKafkaCluster.java`).
+Kafka transport (`KafkaSource`/`KafkaSink`) dispatches between the real
+kafka-python client (`client='kafka'`, when installed) and the in-repo
+embedded TCP broker (`streaming/embedded_kafka.py`) — the reference's
+`EmbeddedKafkaCluster.java` strategy — so the wire serde and consume
+loops are exercised end-to-end without an external cluster.
 """
 from deeplearning4j_tpu.streaming.pipeline import (
     KafkaSink,

@@ -48,46 +48,100 @@ class QueueSink:
             self.items.append(item)
 
 
+def _resolve_client(client: str) -> str:
+    """Client dispatch rule, shared by consumer and producer factories:
+    `'kafka'` = the real kafka-python package; `'embedded'` = the in-repo
+    broker (`streaming/embedded_kafka.py`, the reference's
+    `EmbeddedKafkaCluster` strategy); `'auto'` = kafka if importable,
+    embedded otherwise. Both clients expose the same consumed surface
+    (including `auto_offset_reset` semantics), so the serde/consume loops
+    are identical either way."""
+    if client == "auto":
+        try:
+            import kafka  # type: ignore # noqa: F401
+
+            return "kafka"
+        except ImportError:
+            return "embedded"
+    if client in ("kafka", "embedded"):
+        return client
+    raise ValueError(f"unknown kafka client {client!r} "
+                     "(choose 'kafka', 'embedded', or 'auto')")
+
+
+def _make_consumer(topic: str, bootstrap_servers: str, client: str,
+                   **kwargs):
+    if _resolve_client(client) == "kafka":
+        from kafka import KafkaConsumer  # type: ignore
+
+        return KafkaConsumer(topic, bootstrap_servers=bootstrap_servers,
+                             **kwargs)
+    from deeplearning4j_tpu.streaming.embedded_kafka import (
+        EmbeddedKafkaConsumer,
+    )
+
+    return EmbeddedKafkaConsumer(topic, bootstrap_servers, **kwargs)
+
+
+def _make_producer(bootstrap_servers: str, client: str, **kwargs):
+    if _resolve_client(client) == "kafka":
+        from kafka import KafkaProducer  # type: ignore
+
+        return KafkaProducer(bootstrap_servers=bootstrap_servers, **kwargs)
+    from deeplearning4j_tpu.streaming.embedded_kafka import (
+        EmbeddedKafkaProducer,
+    )
+
+    return EmbeddedKafkaProducer(bootstrap_servers, **kwargs)
+
+
+def encode_dataset(feats, labels) -> bytes:
+    """(features, labels) → one Kafka record (the reference serializes
+    NDArray pairs per message, `NDArrayKafkaClient.java`)."""
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(feats), allow_pickle=False)
+    np.save(buf, np.asarray(labels), allow_pickle=False)
+    return buf.getvalue()
+
+
+def decode_dataset(record: bytes) -> DataSet:
+    import io
+
+    buf = io.BytesIO(record)
+    feats = np.load(buf, allow_pickle=False)
+    labels = np.load(buf, allow_pickle=False)
+    return DataSet(feats, labels)
+
+
 class KafkaSource:
     """Kafka topic → DataSet stream (reference `NDArrayKafkaClient.java`).
-    Gated: requires the `kafka-python` package (not bundled in this image)."""
+    `client='auto'` uses kafka-python when installed and the embedded
+    broker client otherwise (the exercised path in this image)."""
 
     def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
-                 **consumer_kwargs):
-        try:
-            from kafka import KafkaConsumer  # type: ignore
-        except ImportError as e:
-            raise ImportError(
-                "KafkaSource requires the kafka-python package; in this "
-                "environment use QueueSource or any iterable of DataSets "
-                "instead") from e
-        self._consumer = KafkaConsumer(topic,
-                                       bootstrap_servers=bootstrap_servers,
-                                       **consumer_kwargs)
+                 client: str = "auto", **consumer_kwargs):
+        self._consumer = _make_consumer(topic, bootstrap_servers, client,
+                                        **consumer_kwargs)
+
+    def close(self) -> None:
+        self._consumer.close()
 
     def __iter__(self):
-        import io
-
         for msg in self._consumer:
-            buf = io.BytesIO(msg.value)
-            feats = np.load(buf, allow_pickle=False)
-            labels = np.load(buf, allow_pickle=False)
-            yield DataSet(feats, labels)
+            yield decode_dataset(msg.value)
 
 
 class KafkaSink:
-    """Prediction stream → Kafka topic. Gated like KafkaSource."""
+    """Stream → Kafka topic: `__call__` publishes single arrays
+    (predictions, the serve route); `send_dataset` publishes
+    (features, labels) training pairs consumed by `KafkaSource`."""
 
     def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
-                 **producer_kwargs):
-        try:
-            from kafka import KafkaProducer  # type: ignore
-        except ImportError as e:
-            raise ImportError(
-                "KafkaSink requires the kafka-python package; in this "
-                "environment use QueueSink or any callable instead") from e
-        self._producer = KafkaProducer(bootstrap_servers=bootstrap_servers,
-                                       **producer_kwargs)
+                 client: str = "auto", **producer_kwargs):
+        self._producer = _make_producer(bootstrap_servers, client,
+                                        **producer_kwargs)
         self._topic = topic
 
     def __call__(self, item) -> None:
@@ -96,6 +150,17 @@ class KafkaSink:
         buf = io.BytesIO()
         np.save(buf, np.asarray(item), allow_pickle=False)
         self._producer.send(self._topic, buf.getvalue())
+
+    def send_dataset(self, feats, labels) -> None:
+        self._producer.send(self._topic, encode_dataset(feats, labels))
+
+    def flush(self) -> None:
+        self._producer.flush()
+
+    def close(self) -> None:
+        close = getattr(self._producer, "close", None)
+        if close is not None:
+            close()
 
 
 Source = Iterable

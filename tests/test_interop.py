@@ -123,13 +123,19 @@ def test_streaming_serve_route():
     assert sink.items[0].shape == (4, 3)
 
 
-def test_kafka_gated():
+def test_kafka_real_client_gated_embedded_not():
+    """client='kafka' still requires the real package; 'auto' falls back
+    to the embedded broker client (exercised in test_streaming_kafka.py)
+    and so fails on CONNECTION, not import, when no broker listens."""
     from deeplearning4j_tpu.streaming import KafkaSink, KafkaSource
 
-    with pytest.raises(ImportError, match="kafka-python"):
-        KafkaSource("topic")
-    with pytest.raises(ImportError, match="kafka-python"):
-        KafkaSink("topic")
+    with pytest.raises(ImportError, match="kafka"):
+        KafkaSource("topic", client="kafka")
+    with pytest.raises(ImportError, match="kafka"):
+        KafkaSink("topic", client="kafka")
+    with pytest.raises(OSError):
+        KafkaSource("topic", bootstrap_servers="localhost:1",
+                    client="auto")
 
 
 # ------------------------------------------------------------------ cloud

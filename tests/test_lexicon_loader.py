@@ -1,0 +1,143 @@
+"""MeCab/IPADIC dictionary loading + measured segmentation-accuracy gain.
+
+Reference: `deeplearning4j-nlp-japanese/` vendors a Kuromoji fork with
+IPADIC-class assets; this build's lattice is pluggable and this module
+proves the loader end-to-end: CSV/directory parsing, cost mapping into
+the lattice's scale, the `DL4J_TPU_IPADIC_DIR` seam, and — the point —
+that loading the committed ~450-entry IPADIC sample measurably improves
+segmentation over the embedded mini-lexicon on gold-segmented text."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.dictionary import (
+    JAPANESE_LEXICON,
+    Lexicon,
+    load_bundled_ipadic_sample,
+    viterbi_segment,
+)
+
+# Gold segmentations over vocabulary the MINI lexicon mostly lacks but
+# the IPADIC sample covers (particles come from the mini lexicon either
+# way — the merged lexicon must keep them working).
+GOLD = [
+    # particle-bounded sentences: the script-run fallback already finds
+    # most boundaries here; the dictionary fixes token identity
+    ("朝は寒い", ["朝", "は", "寒い"]),
+    ("図書館で雑誌を読む", ["図書館", "で", "雑誌", "を", "読む"]),
+    ("コンピュータは便利です", ["コンピュータ", "は", "便利", "です"]),
+    ("野菜と果物を買う", ["野菜", "と", "果物", "を", "買う"]),
+    ("病院の医者に相談します",
+     ["病院", "の", "医者", "に", "相談", "します"]),
+    # same-script COMPOUNDS: without the dictionary the whole run is one
+    # OOV token — these are where the loaded lexicon must earn its keep
+    ("世界経済の問題", ["世界", "経済", "の", "問題"]),
+    ("情報技術を学ぶ", ["情報", "技術", "を", "学ぶ"]),
+    ("科学技術の研究", ["科学", "技術", "の", "研究"]),
+    ("旅行計画を作る", ["旅行", "計画", "を", "作る"]),
+    ("朝食の準備をする", ["朝食", "の", "準備", "を", "する"]),
+    ("コンピュータゲームで遊ぶ", ["コンピュータ", "ゲーム", "で", "遊ぶ"]),
+    ("インターネットニュースを読む",
+     ["インターネット", "ニュース", "を", "読む"]),
+    ("会議室の予約をします",
+     ["会議", "室", "の", "予約", "を", "します"]),
+]
+
+
+def _f1(pred, gold):
+    """Token F1 by span positions (the standard segmentation metric)."""
+    def spans(toks):
+        out, pos = set(), 0
+        for t in toks:
+            out.add((pos, pos + len(t)))
+            pos += len(t)
+        return out
+
+    p, g = spans(pred), spans(gold)
+    if not p or not g:
+        return 0.0
+    prec = len(p & g) / len(p)
+    rec = len(p & g) / len(g)
+    return 0.0 if prec + rec == 0 else 2 * prec * rec / (prec + rec)
+
+
+def _score(lexicon):
+    return float(np.mean([
+        _f1([s for s, _ in viterbi_segment(text, lexicon)], gold)
+        for text, gold in GOLD]))
+
+
+def test_ipadic_sample_improves_segmentation():
+    mini = _score(JAPANESE_LEXICON)
+    full = _score(load_bundled_ipadic_sample())
+    # the gain is the point: the mini lexicon OOVs most content words
+    assert full > mini + 0.15, (mini, full)
+    assert full >= 0.9, full
+
+
+def test_from_mecab_csv_parses_ipadic_rows():
+    lex = Lexicon.from_mecab_csv([
+        "走る,992,992,5065,動詞,自立,*,*,五段・ラ行,基本形,走る,ハシル,ハシル",
+        "静寂,1285,1285,4467,名詞,一般,*,*,*,*,静寂,セイジャク,セイジャク",
+        "",
+        "# comment",
+    ])
+    assert len(lex) == 2
+    e = lex.lookup("走る")
+    assert e.pos == "動詞"
+    # cost mapping: monotone in word_cost, inside the known-word band
+    lo = Lexicon.from_mecab_csv(["常,0,0,-2000,名詞"]).lookup("常").cost
+    hi = Lexicon.from_mecab_csv(["常,0,0,12000,名詞"]).lookup("常").cost
+    assert 0.1 < lo < hi <= 1.15
+
+
+def test_from_mecab_csv_rejects_garbage():
+    with pytest.raises(ValueError, match="comma fields"):
+        Lexicon.from_mecab_csv(["not a dictionary line"])
+    with pytest.raises(ValueError, match="word_cost"):
+        Lexicon.from_mecab_csv(["a,b,c,not_an_int,名詞"])
+
+
+def test_from_mecab_path_directory_layout(tmp_path):
+    """An unpacked mecab-ipadic directory: every *.csv loads, merged over
+    the base lexicon with loaded rows winning collisions."""
+    (tmp_path / "Noun.csv").write_text(
+        "銀河,0,0,5000,名詞,一般,*,*,*,*,銀河,ギンガ,ギンガ\n",
+        encoding="utf-8")
+    (tmp_path / "Verb.csv").write_text(
+        "輝く,0,0,6000,動詞,自立,*,*,五段・カ行,基本形,輝く,カガヤク,カガヤク\n",
+        encoding="utf-8")
+    lex = Lexicon.from_mecab_path(tmp_path, base=JAPANESE_LEXICON)
+    assert lex.lookup("銀河").pos == "名詞"
+    assert lex.lookup("輝く").pos == "動詞"
+    assert lex.lookup("は").pos == "particle"  # base preserved
+    toks = [s for s, _ in viterbi_segment("銀河は輝く", lex)]
+    assert toks == ["銀河", "は", "輝く"]
+
+
+def test_ipadic_dir_env_seam(tmp_path, monkeypatch):
+    (tmp_path / "Custom.csv").write_text(
+        "獏,0,0,4000,名詞,一般,*,*,*,*,獏,バク,バク\n", encoding="utf-8")
+    monkeypatch.setenv("DL4J_TPU_IPADIC_DIR", str(tmp_path))
+    lex = load_bundled_ipadic_sample()
+    assert lex.lookup("獏") is not None
+    assert lex.lookup("ニュース") is None  # override replaces the sample
+
+
+def test_tokenizer_factory_accepts_loaded_lexicon():
+    from deeplearning4j_tpu.nlp.language import JapaneseTokenizerFactory
+
+    fac = JapaneseTokenizerFactory(lexicon=load_bundled_ipadic_sample())
+    toks = fac.create("図書館で雑誌を読む").get_tokens()
+    assert "図書館" in toks and "雑誌" in toks
+
+
+def test_from_mecab_csv_quoted_surface():
+    """Real MeCab dictionaries quote surfaces containing commas
+    (Symbol.csv's ',' entry, many neologd rows) — csv parsing, not
+    split(',')."""
+    lex = Lexicon.from_mecab_csv([
+        '",",0,0,3000,記号,読点,*,*,*,*,",",、,、',
+        '"1,000",0,0,5000,名詞,数,*,*,*,*,"1,000",*,*',
+    ])
+    assert lex.lookup(",").pos == "記号"
+    assert lex.lookup("1,000").pos == "名詞"
