@@ -66,3 +66,87 @@ class TpuPodSpec:
         if gen in ("v2", "v3", "v4"):
             return n // 2
         return n
+
+
+class ClusterSetup:
+    """Executes the rendered provisioning commands (the reference's
+    `ClusterSetup.java` actually stands up the cluster; rendering-only was
+    this module's r2 state). `execute=False` stays the review path: the
+    command is returned, nothing runs. `execute=True` runs it via
+    subprocess and raises with the tool's stderr on failure.
+
+    `gcloud_binary`: override the executable — CI proves the execute path
+    against a fake gcloud double without egress
+    (`tests/test_cloud_execute.py`), the same seam a bastion/wrapper
+    script would use in production."""
+
+    def __init__(self, spec: TpuPodSpec, gcloud_binary: str = "gcloud"):
+        self.spec = spec
+        self.gcloud_binary = gcloud_binary
+
+    def _run(self, cmd: List[str], execute: bool):
+        if not execute:
+            return cmd
+        import subprocess
+
+        cmd = [self.gcloud_binary] + cmd[1:]
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"provisioning command failed ({res.returncode}): "
+                f"{' '.join(cmd)}\n{res.stderr.strip()}")
+        return res
+
+    def create(self, execute: bool = False):
+        return self._run(self.spec.create_command(), execute)
+
+    def delete(self, execute: bool = False):
+        return self._run(self.spec.delete_command(), execute)
+
+    def ssh(self, command: str = "", worker: str = "all",
+            execute: bool = False):
+        return self._run(self.spec.ssh_command(worker, command), execute)
+
+
+def _main() -> None:
+    """CLI: render (default) or --execute the provisioning commands.
+
+        python -m deeplearning4j_tpu.cloud.provision create \
+            --name pod0 --accelerator-type v5litepod-8 [--execute]
+    """
+    import argparse
+    import shlex
+    import sys
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("action", choices=["create", "delete", "ssh"])
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--accelerator-type", default="v5litepod-8")
+    ap.add_argument("--zone", default="us-central1-a")
+    ap.add_argument("--runtime-version", default="tpu-ubuntu2204-base")
+    ap.add_argument("--project", default="")
+    ap.add_argument("--preemptible", action="store_true")
+    ap.add_argument("--command", default="", help="ssh remote command")
+    ap.add_argument("--worker", default="all")
+    ap.add_argument("--execute", action="store_true",
+                    help="actually run the command (default: render only)")
+    ap.add_argument("--gcloud", default="gcloud",
+                    help="gcloud executable (test doubles / wrappers)")
+    args = ap.parse_args()
+    spec = TpuPodSpec(name=args.name, accelerator_type=args.accelerator_type,
+                      zone=args.zone, runtime_version=args.runtime_version,
+                      project=args.project, preemptible=args.preemptible)
+    setup = ClusterSetup(spec, gcloud_binary=args.gcloud)
+    fn = {"create": setup.create, "delete": setup.delete,
+          "ssh": lambda execute: setup.ssh(args.command, args.worker,
+                                           execute)}[args.action]
+    out = fn(execute=args.execute)
+    if args.execute:
+        sys.stdout.write(out.stdout)
+        print(f"EXECUTED rc={out.returncode}")
+    else:
+        print(shlex.join(out))
+
+
+if __name__ == "__main__":
+    _main()

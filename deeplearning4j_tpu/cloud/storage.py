@@ -112,17 +112,25 @@ class LocalStorage(DataSetStorage):
 
 
 class GCSStorage(DataSetStorage):
-    """Google Cloud Storage backend. Gated: requires google-cloud-storage
-    (not bundled; this environment has no egress)."""
+    """Google Cloud Storage backend. `client=None` imports the real
+    google-cloud-storage package (not bundled here — no egress); inject
+    any object with the client surface this class consumes
+    (`bucket().blob().upload_from_string/download_as_bytes/exists`,
+    `bucket().list_blobs`) to run the SAME key-prefixing/serde code
+    against a fake — how CI exercises this path
+    (`tests/test_cloud_execute.py::FakeGCSClient`)."""
 
-    def __init__(self, bucket: str, prefix: str = ""):
-        try:
-            from google.cloud import storage  # type: ignore
-        except ImportError as e:
-            raise ImportError(
-                "GCSStorage requires the google-cloud-storage package; use "
-                "LocalStorage in this environment") from e
-        self._bucket = storage.Client().bucket(bucket)
+    def __init__(self, bucket: str, prefix: str = "", client=None):
+        if client is None:
+            try:
+                from google.cloud import storage  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "GCSStorage requires the google-cloud-storage package "
+                    "(or pass client=); use LocalStorage in this "
+                    "environment") from e
+            client = storage.Client()
+        self._bucket = client.bucket(bucket)
         self._prefix = prefix.rstrip("/")
 
     def _key(self, key: str) -> str:

@@ -10,11 +10,34 @@ Two implementations, mirroring the reference pair but TPU-first:
   treats exact as the slow legacy path.
 - `BarnesHutTsne` — the θ-approximate host algorithm (VP-tree sparse input
   similarities + SpTree repulsion), kept for CPU parity and very large N.
+
+Feature parity vs `BarnesHutTsne.java` (builder fields at :96-116):
+
+| reference knob               | here                                      |
+|------------------------------|-------------------------------------------|
+| theta                        | `BarnesHutTsne(theta=)`                   |
+| perplexity                   | `perplexity=`                             |
+| learningRate                 | `learning_rate=`                          |
+| maxIter                      | `n_iter=`                                 |
+| initialMomentum/finalMomentum| `initial_momentum=` / `final_momentum=`   |
+| switchMomentumIteration :71  | `switch_momentum_iteration=`              |
+| stopLyingIteration :74       | `stop_lying_iteration=` (early exag off)  |
+| minGain :69                  | `min_gain=`                               |
+| normalize :72                | `normalize=` (zero-mean / max-abs scale)  |
+| IterationListener :95        | `listeners=` + per-iteration KL reporting |
+| error reporting (logs)       | `error_every=`, `error_history_`, logger  |
+| realMin                      | the 1e-12 clamps (fixed)                  |
+| similarityFunction/invert    | not carried: input P is always the        |
+|                              | Gaussian-perplexity kernel (the only mode |
+|                              | the reference's fit path exercises)       |
+| usePca / tolerance           | out of scope: pre-reduce with your own    |
+|                              | PCA; the sigma search tol is `1e-5` fixed |
 """
 from __future__ import annotations
 
+import logging
 from functools import partial
-from typing import Optional
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +45,8 @@ import numpy as np
 
 from deeplearning4j_tpu.clustering.sptree import SpTree
 from deeplearning4j_tpu.clustering.vptree import VPTree
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 # ---------------------------------------------------------------- shared: P
@@ -67,36 +92,105 @@ def _binary_search_sigmas(D2: np.ndarray, perplexity: float,
 # ----------------------------------------------------------------- exact/XLA
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
-def _tsne_step(Y, velocity, gains, P, momentum, lr):
+def _tsne_step(Y, velocity, gains, P, momentum, lr, min_gain):
     n = Y.shape[0]
     y2 = jnp.sum(Y * Y, axis=1)
-    d2 = y2[:, None] - 2.0 * (Y @ Y.T) + y2[None, :]
+    # HIGHEST precision: the TPU MXU's default bf16-pass matmul feeds the
+    # cancellation-prone ||yi-yj||^2 expansion enough noise to destabilize
+    # the gradient late in training (measured: CPU converges, TPU f32
+    # default diverges after ~250 iters on the same inputs)
+    yyt = jnp.matmul(Y, Y.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = y2[:, None] - 2.0 * yyt + y2[None, :]
     num = 1.0 / (1.0 + d2)
     num = num * (1.0 - jnp.eye(n, dtype=Y.dtype))
     Q = num / jnp.maximum(jnp.sum(num), 1e-12)
     PQ = (P - jnp.maximum(Q, 1e-12)) * num               # (N, N)
     grad = 4.0 * ((jnp.diag(jnp.sum(PQ, axis=1)) - PQ) @ Y)
-    cost = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12)
-                               / jnp.maximum(Q, 1e-12)))
     same_sign = (grad * velocity) > 0
-    gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
+    gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2),
+                     min_gain)
     velocity = momentum * velocity - lr * gains * grad
     Y = Y + velocity
     Y = Y - jnp.mean(Y, axis=0)
-    return Y, velocity, gains, cost
+    return Y, velocity, gains
+
+
+@jax.jit
+def _tsne_kl(Y, P):
+    """KL(P || Q) at the CURRENT positions with the UNEXAGGERATED P —
+    what reports and `kl_divergence_` must describe (the lying-phase
+    objective and pre-update positions would both misstate the returned
+    embedding's quality)."""
+    n = Y.shape[0]
+    y2 = jnp.sum(Y * Y, axis=1)
+    yyt = jnp.matmul(Y, Y.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = y2[:, None] - 2.0 * yyt + y2[None, :]
+    num = 1.0 / (1.0 + d2)
+    num = num * (1.0 - jnp.eye(n, dtype=Y.dtype))
+    Q = num / jnp.maximum(jnp.sum(num), 1e-12)
+    return jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12)
+                               / jnp.maximum(Q, 1e-12)))
 
 
 class Tsne:
+    """Exact t-SNE. Knob names mirror the reference builder (see module
+    docstring parity table). `listeners`: callables
+    `f(model, iteration, kl)` invoked every `error_every` iterations with
+    the CURRENT KL divergence (the reference's IterationListener +
+    per-iteration error log, `BarnesHutTsne.java:95/:464`); the reported
+    KLs also accumulate in `error_history_`."""
+
     def __init__(self, n_components: int = 2, perplexity: float = 30.0,
                  learning_rate: float = 200.0, n_iter: int = 1000,
-                 early_exaggeration: float = 12.0, seed: int = 0):
+                 early_exaggeration: float = 12.0, seed: int = 0,
+                 initial_momentum: float = 0.5,
+                 final_momentum: float = 0.8,
+                 switch_momentum_iteration: int = 250,
+                 stop_lying_iteration: Optional[int] = None,
+                 min_gain: float = 0.01,
+                 normalize: bool = False,
+                 error_every: int = 50,
+                 listeners: Sequence[Callable] = ()):
         self.n_components = n_components
         self.perplexity = perplexity
         self.learning_rate = learning_rate
         self.n_iter = n_iter
         self.early_exaggeration = early_exaggeration
         self.seed = seed
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.min_gain = min_gain
+        self.normalize = normalize
+        self.error_every = max(1, error_every)
+        self.listeners: List[Callable] = list(listeners)
         self.kl_divergence_: float = float("nan")
+        self.error_history_: List[float] = []
+
+    # shared schedule/reporting helpers ----------------------------------
+    def _stop_lying(self) -> int:
+        if self.stop_lying_iteration is not None:
+            return self.stop_lying_iteration
+        return min(250, self.n_iter // 4)
+
+    def _momentum(self, it: int) -> float:
+        return (self.initial_momentum
+                if it < self.switch_momentum_iteration
+                else self.final_momentum)
+
+    def _normalize_input(self, X: np.ndarray) -> np.ndarray:
+        """Reference `normalize` flag: zero-mean, max-abs scale."""
+        if not self.normalize:
+            return X
+        X = X - X.mean(axis=0)
+        return X / max(np.abs(X).max(), 1e-12)
+
+    def _report(self, it: int, kl: float) -> None:
+        self.error_history_.append(kl)
+        logger.info("t-SNE iteration %d: KL = %.6f", it, kl)
+        for listener in self.listeners:
+            listener(self, it, kl)
 
     def _input_probabilities(self, X: np.ndarray) -> np.ndarray:
         x2 = np.sum(X * X, axis=1)
@@ -107,7 +201,7 @@ class Tsne:
         return P / np.maximum(P.sum(), 1e-12)
 
     def fit_transform(self, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, np.float32)
+        X = self._normalize_input(np.asarray(X, np.float32))
         n = X.shape[0]
         P = self._input_probabilities(X).astype(np.float32)
         rng = np.random.default_rng(self.seed)
@@ -116,15 +210,21 @@ class Tsne:
         vel = jnp.zeros_like(Y)
         gains = jnp.ones_like(Y)
         Pd = jnp.asarray(P)
-        stop_exag = min(250, self.n_iter // 4)
-        cost = float("nan")  # n_iter=0: no iterations, no KL
+        stop_exag = self._stop_lying()
+        self.error_history_ = []
         for it in range(self.n_iter):
             exag = self.early_exaggeration if it < stop_exag else 1.0
-            momentum = 0.5 if it < 250 else 0.8
-            Y, vel, gains, cost = _tsne_step(
-                Y, vel, gains, Pd * exag, jnp.float32(momentum),
-                jnp.float32(self.learning_rate))
-        self.kl_divergence_ = float(cost)
+            Y, vel, gains = _tsne_step(
+                Y, vel, gains, Pd * exag, jnp.float32(self._momentum(it)),
+                jnp.float32(self.learning_rate),
+                jnp.float32(self.min_gain))
+            if (it + 1) % self.error_every == 0 or it == self.n_iter - 1:
+                # post-update KL with the unexaggerated P, materialized
+                # only at report boundaries (a per-iteration sync would
+                # serialize the step pipeline)
+                self._report(it + 1, float(np.asarray(_tsne_kl(Y, Pd))))
+        self.kl_divergence_ = (self.error_history_[-1]
+                               if self.error_history_ else float("nan"))
         return np.asarray(Y)
 
 
@@ -133,14 +233,35 @@ class Tsne:
 class BarnesHutTsne(Tsne):
     """θ-approximate t-SNE (reference `plot/BarnesHutTsne.java`): sparse
     kNN input similarities (VP-tree, 3·perplexity neighbors) + SpTree
-    repulsion. Host-side; prefer `Tsne` on TPU."""
+    repulsion. Host-side; prefer `Tsne` on TPU. Shares every schedule /
+    reporting / normalization knob with `Tsne` (parity table in the
+    module docstring)."""
 
     def __init__(self, theta: float = 0.5, **kwargs):
         super().__init__(**kwargs)
         self.theta = theta
 
+    def _kl_given_z(self, Y, Z, rows_u, cols_u, Pv) -> float:
+        """KL on the sparse support given an already-computed Barnes-Hut
+        normalizer Z for these positions."""
+        diff = Y[rows_u] - Y[cols_u]
+        qn = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        Q = qn / max(Z, 1e-12)
+        return float(np.sum(
+            Pv * np.log(np.maximum(Pv, 1e-12) / np.maximum(Q, 1e-12))))
+
+    def _sparse_kl(self, Y, rows_u, cols_u, Pv) -> float:
+        """KL at the CURRENT positions, with its own repulsion pass (used
+        only where no force pass follows — the final iteration)."""
+        sp = SpTree.build(Y)
+        Z = 0.0
+        for i in range(Y.shape[0]):
+            Z += sp.compute_non_edge_forces(Y[i], self.theta,
+                                            np.zeros(self.n_components))
+        return self._kl_given_z(Y, Z, rows_u, cols_u, Pv)
+
     def fit_transform(self, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, np.float64)
+        X = self._normalize_input(np.asarray(X, np.float64))
         n = X.shape[0]
         k = min(n - 1, int(3 * self.perplexity))
         tree = VPTree(X)
@@ -175,10 +296,12 @@ class BarnesHutTsne(Tsne):
         Y = rng.normal(scale=1e-4, size=(n, self.n_components))
         vel = np.zeros_like(Y)
         gains = np.ones_like(Y)
-        stop_exag = min(250, self.n_iter // 4)
+        stop_exag = self._stop_lying()
+        self.error_history_ = []
+        pending_report: Optional[int] = None
         for it in range(self.n_iter):
             exag = self.early_exaggeration if it < stop_exag else 1.0
-            momentum = 0.5 if it < 250 else 0.8
+            momentum = self._momentum(it)
             # attractive forces (sparse)
             diff = Y[rows_u] - Y[cols_u]
             q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
@@ -193,23 +316,29 @@ class BarnesHutTsne(Tsne):
                 negf = np.zeros(self.n_components)
                 Z += sp.compute_non_edge_forces(Y[i], self.theta, negf)
                 rep[i] = negf
+            if pending_report is not None:
+                # a report fell due after the PREVIOUS update; this force
+                # pass just computed Z for exactly those positions, so the
+                # report reuses it instead of paying a second O(N log N)
+                # repulsion sweep
+                self._report(pending_report,
+                             self._kl_given_z(Y, Z, rows_u, cols_u, Pv))
+                pending_report = None
             grad = 4.0 * (attr - rep / max(Z, 1e-12))
             same_sign = (grad * vel) > 0
             gains = np.clip(np.where(same_sign, gains * 0.8, gains + 0.2),
-                            0.01, None)
+                            self.min_gain, None)
             vel = momentum * vel - self.learning_rate * gains * grad
             Y = Y + vel
             Y = Y - Y.mean(axis=0)
-        # final KL on the sparse support, with Z recomputed at the FINAL
-        # positions (the in-loop Z predates the last Y update)
-        sp = SpTree.build(Y)
-        Z = 0.0
-        for i in range(n):
-            Z += sp.compute_non_edge_forces(Y[i], self.theta,
-                                            np.zeros(self.n_components))
-        diff = Y[rows_u] - Y[cols_u]
-        qn = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
-        Q = qn / max(Z, 1e-12)
-        self.kl_divergence_ = float(np.sum(
-            Pv * np.log(np.maximum(Pv, 1e-12) / np.maximum(Q, 1e-12))))
+            if (it + 1) % self.error_every == 0 or it == self.n_iter - 1:
+                pending_report = it + 1
+        if pending_report is not None:
+            # final-iteration report: no force pass follows, recompute
+            # the normalizer at the final positions (the reference does
+            # the same for its terminal error)
+            self._report(pending_report,
+                         self._sparse_kl(Y, rows_u, cols_u, Pv))
+        self.kl_divergence_ = (self.error_history_[-1]
+                               if self.error_history_ else float("nan"))
         return Y

@@ -153,3 +153,42 @@ def test_barnes_hut_tsne_runs_and_separates():
     d01 = np.linalg.norm(Y[y == 0].mean(0) - Y[y == 1].mean(0))
     spread0 = np.linalg.norm(Y[y == 0] - Y[y == 0].mean(0), axis=1).mean()
     assert d01 > 2 * spread0
+
+
+@pytest.mark.slow
+def test_tsne_error_reporting_and_schedules():
+    """Reference parity knobs (BarnesHutTsne.java builder): listener hook
+    + per-iteration KL reporting, momentum switch, stop-lying iteration,
+    min_gain, normalize — and KL must DECREASE over training."""
+    from deeplearning4j_tpu.clustering.tsne import Tsne
+
+    rng = np.random.RandomState(4)
+    X = np.concatenate([rng.randn(25, 6) + 4.0, rng.randn(25, 6) - 4.0])
+    seen = []
+    ts = Tsne(perplexity=10.0, n_iter=240, learning_rate=100.0, seed=2,
+              normalize=True, error_every=60,
+              switch_momentum_iteration=120, stop_lying_iteration=80,
+              listeners=[lambda model, it, kl: seen.append((it, kl))])
+    ts.fit_transform(X)
+    assert [it for it, _ in seen] == [60, 120, 180, 240]
+    assert ts.error_history_ == [kl for _, kl in seen]
+    # KL decreases as the embedding settles (early-exaggeration phase
+    # reports a different objective, so compare post-lying reports)
+    assert seen[-1][1] < seen[1][1]
+    assert np.isfinite(ts.kl_divergence_)
+    assert ts.kl_divergence_ == seen[-1][1]
+
+
+@pytest.mark.slow
+def test_barnes_hut_reports_decreasing_kl():
+    from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+
+    rng = np.random.RandomState(5)
+    X = np.concatenate([rng.randn(20, 5) + 3.0, rng.randn(20, 5) - 3.0])
+    ts = BarnesHutTsne(theta=0.5, perplexity=8.0, n_iter=120,
+                       learning_rate=80.0, seed=1, error_every=40,
+                       stop_lying_iteration=30)
+    ts.fit_transform(X)
+    assert len(ts.error_history_) == 3
+    assert ts.error_history_[-1] < ts.error_history_[0]
+    assert np.isfinite(ts.kl_divergence_)
