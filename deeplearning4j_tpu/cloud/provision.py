@@ -85,11 +85,15 @@ class ClusterSetup:
         self.gcloud_binary = gcloud_binary
 
     def _run(self, cmd: List[str], execute: bool):
+        # substitute the binary in BOTH paths: the rendered command must
+        # be exactly what --execute would run (an operator copy-pasting a
+        # render that said plain `gcloud` while execute used a wrapper
+        # would invoke the wrong tool)
+        cmd = [self.gcloud_binary] + cmd[1:]
         if not execute:
             return cmd
         import subprocess
 
-        cmd = [self.gcloud_binary] + cmd[1:]
         res = subprocess.run(cmd, capture_output=True, text=True)
         if res.returncode != 0:
             raise RuntimeError(

@@ -220,17 +220,33 @@ class UimaSentenceIterator(SentenceIterator):
 
 
 class UimaTokenizerFactory(TokenizerFactory):
-    """Tokenizer over UIMA-style analysis (reference `deeplearning4j-nlp-
-    uima`'s `UimaTokenizerFactory`). Without an analysis engine, falls back
-    to script-aware word segmentation."""
+    """Tokenizer driven by a UIMA-style analysis engine (reference
+    `deeplearning4j-nlp-uima`'s `UimaTokenizerFactory`: create an
+    AnalysisEngine, process the text into a CAS, read Token annotations
+    back out). `analysis_engine` may be an `nlp/uima.AnalysisEngine`
+    (anything with `.process(cas)`) or a plain `str -> [tokens]`
+    callable; `with_default_engine()` builds the bundled
+    sentence→token→lattice-morpheme→POS aggregate. Without an engine,
+    falls back to script-aware word segmentation."""
 
-    def __init__(self, analysis_engine: Optional[Callable[[str], List[str]]] = None):
+    def __init__(self, analysis_engine=None):
         super().__init__()
         self.analysis_engine = analysis_engine
 
+    @classmethod
+    def with_default_engine(cls, lexicon=None) -> "UimaTokenizerFactory":
+        from deeplearning4j_tpu.nlp.uima import default_analysis_engine
+
+        return cls(default_analysis_engine(lexicon))
+
     def create(self, text: str) -> Tokenizer:
         norm = unicodedata.normalize("NFKC", text)
-        if self.analysis_engine:
+        if self.analysis_engine is not None:
+            if hasattr(self.analysis_engine, "process"):
+                from deeplearning4j_tpu.nlp.uima import engine_tokens
+
+                return Tokenizer(engine_tokens(self.analysis_engine, norm),
+                                 self._pre)
             return Tokenizer(self.analysis_engine(norm), self._pre)
         tokens = [t for raw in norm.split() for t in segment_by_script(raw)]
         return Tokenizer(tokens, self._pre)

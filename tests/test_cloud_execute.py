@@ -44,7 +44,8 @@ def test_render_only_by_default(tmp_path):
     spec = TpuPodSpec(name="pod0", project="proj")
     setup = ClusterSetup(spec, gcloud_binary=str(script))
     cmd = setup.create(execute=False)
-    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create",
+    # render shows EXACTLY what --execute would run, incl. the binary
+    assert cmd[:6] == [str(script), "compute", "tpus", "tpu-vm", "create",
                        "pod0"]
     assert not log.exists()  # nothing ran
 

@@ -1,0 +1,104 @@
+"""UIMA-style analysis engines: CAS/annotator pipeline driven end-to-end
+(reference `deeplearning4j-nlp-uima`'s `UimaTokenizerFactory.java` —
+an AnalysisEngine writing typed annotations into a CAS, tokens read
+back out)."""
+import pytest
+
+from deeplearning4j_tpu.nlp.dictionary import load_bundled_ipadic_sample
+from deeplearning4j_tpu.nlp.language import UimaTokenizerFactory
+from deeplearning4j_tpu.nlp.uima import (
+    AggregateAnalysisEngine,
+    Annotation,
+    CAS,
+    LatticeTokenAnnotator,
+    PosAnnotator,
+    SentenceAnnotator,
+    TokenAnnotator,
+    default_analysis_engine,
+    engine_tokens,
+)
+
+
+def test_cas_annotation_store():
+    cas = CAS("hello world")
+    cas.add(Annotation(0, 5, "token"))
+    cas.add(Annotation(6, 11, "token"))
+    cas.add(Annotation(0, 11, "sentence"))
+    toks = cas.select("token")
+    assert [t.covered_text(cas) for t in toks] == ["hello", "world"]
+    sent = cas.select("sentence")[0]
+    assert cas.select_covered("token", sent) == toks
+    with pytest.raises(ValueError, match="outside document"):
+        cas.add(Annotation(5, 99, "token"))
+
+
+def test_sentence_annotator_spans():
+    cas = SentenceAnnotator()("First one. Second one! 三番目です。たしかに")
+    sents = [a.covered_text(cas) for a in cas.select("sentence")]
+    assert sents == ["First one.", "Second one!", "三番目です。", "たしかに"]
+    # abbreviations mid-token survive (no split inside "U.S.")
+    cas2 = SentenceAnnotator()("The U.S. economy grew.")
+    assert [a.covered_text(cas2) for a in cas2.select("sentence")] == [
+        "The U.S. economy grew."]
+
+
+def test_token_annotator_offsets_exact():
+    eng = AggregateAnalysisEngine([SentenceAnnotator(), TokenAnnotator()])
+    cas = eng("good morning  world")
+    for t in cas.select("token"):
+        assert cas.text[t.begin:t.end] == t.covered_text(cas)
+    assert [t.covered_text(cas) for t in cas.select("token")] == [
+        "good", "morning", "world"]
+
+
+def test_lattice_annotator_splits_cjk_with_pos():
+    cas = default_analysis_engine()("日本語を勉強します。")
+    toks = cas.select("token")
+    surfaces = [t.covered_text(cas) for t in toks]
+    assert surfaces == ["日本語", "を", "勉強", "します"]
+    pos = {t.covered_text(cas): t.features.get("pos") for t in toks}
+    assert pos["を"] == "particle" and pos["日本語"] == "noun"
+    # offsets survive the morpheme split
+    for t in toks:
+        assert cas.text[t.begin:t.end] == t.covered_text(cas)
+
+
+def test_pos_annotator_tags_known_latin_as_unknown():
+    cas = default_analysis_engine()("hello 日本")
+    pos = {t.covered_text(cas): t.features.get("pos")
+           for t in cas.select("token")}
+    assert pos["hello"] == "unknown"  # honest: no trained latin tagger
+    assert pos["日本"] == "noun"
+
+
+def test_tokenizer_factory_drives_engine():
+    fac = UimaTokenizerFactory.with_default_engine()
+    toks = fac.create("今日は日本語を勉強します。明日も勉強します。").get_tokens()
+    assert "日本語" in toks and "勉強" in toks and "を" in toks
+
+
+def test_tokenizer_factory_with_loaded_lexicon_engine():
+    fac = UimaTokenizerFactory.with_default_engine(
+        load_bundled_ipadic_sample())
+    toks = fac.create("世界経済の問題を調べる").get_tokens()
+    assert "世界" in toks and "経済" in toks
+
+
+def test_callable_engine_still_supported():
+    fac = UimaTokenizerFactory(lambda text: text.split("-"))
+    assert fac.create("a-b-c").get_tokens() == ["a", "b", "c"]
+
+
+def test_mixed_script_document_end_to_end():
+    eng = default_analysis_engine()
+    toks = engine_tokens(eng, "I study 日本語 every day.")
+    assert toks == ["I", "study", "日本語", "every", "day"]
+
+
+def test_lattice_merges_adjacent_cjk_runs():
+    """Dictionary entries span kanji↔kana boundaries (調べる): the lattice
+    annotator must merge the script-run tokens back into one CJK run."""
+    fac = UimaTokenizerFactory.with_default_engine(
+        load_bundled_ipadic_sample())
+    toks = fac.create("私は世界経済の問題を調べる。").get_tokens()
+    assert "調べる" in toks and "経済" in toks
