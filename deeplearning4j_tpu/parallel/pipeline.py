@@ -32,19 +32,39 @@ from jax import shard_map
 
 def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
                    mesh: Mesh, *, axis_name: str = "pipe",
-                   microbatches: int = None) -> jnp.ndarray:
+                   microbatches: int = None,
+                   data_axis: str = None) -> jnp.ndarray:
     """Apply S stacked stages as a pipeline over the mesh axis.
 
     block_fn(params_i, x) -> y with y.shape == x.shape (homogeneous stages);
     stacked_params: pytree whose leaves have leading dim S (stage axis);
     x: (B, ...) global batch, split into `microbatches` equal chunks
     (default: S — the minimum for a full pipeline).
+
+    `data_axis`: 2-D parallelism — each microbatch's batch dimension is
+    additionally sharded over this mesh axis (dp x pp: the pipeline hops
+    ride `axis_name` per data shard, activations never cross the data
+    axis; gradient reduction over `data_axis` is inserted by the SPMD
+    partitioner at the parameter level outside this function).
     """
     S = mesh.shape[axis_name]
     M = microbatches if microbatches is not None else S
     B = x.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if data_axis is not None:
+        if data_axis == axis_name:
+            raise ValueError(
+                f"data_axis must differ from the pipeline axis "
+                f"{axis_name!r}: sharding the batch over the axis the "
+                "stage loop ppermutes over would silently mix shards")
+        if data_axis not in mesh.shape:
+            raise ValueError(f"mesh has no {data_axis!r} axis: "
+                             f"{dict(mesh.shape)}")
+        if (B // M) % mesh.shape[data_axis] != 0:
+            raise ValueError(
+                f"microbatch size {B // M} not divisible over data axis "
+                f"'{data_axis}' of size {mesh.shape[data_axis]}")
     leaf = jax.tree_util.tree_leaves(stacked_params)[0]
     if leaf.shape[0] != S:
         raise ValueError(
@@ -82,10 +102,13 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
         # them to every device (replicated output spec)
         return lax.psum(jnp.where(d == S - 1, outs, 0.0), axis_name)
 
-    repl = P()
+    # batch dim of each microbatch rides the data axis (if any); the
+    # stage loop and collectives above only ever name `axis_name`, so the
+    # same body serves 1-D pp and 2-D dp x pp
+    xspec = P(None, data_axis) if data_axis is not None else P()
     out = shard_map(local, mesh=mesh,
-                    in_specs=(P(axis_name), repl),
-                    out_specs=repl, check_vma=False)(stacked_params, xs)
+                    in_specs=(P(axis_name), xspec),
+                    out_specs=xspec, check_vma=False)(stacked_params, xs)
     return out.reshape(B, *x.shape[1:])
 
 

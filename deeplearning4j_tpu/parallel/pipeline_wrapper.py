@@ -125,7 +125,13 @@ class PipelineParallelWrapper:
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  pipe_axis: str = "pipe",
                  microbatches: Optional[int] = None,
+                 data_axis: Optional[str] = None,
                  prefetch_buffer: int = 2):
+        """`data_axis`: 2-D dp x pp — give a mesh with BOTH axes (e.g.
+        `make_mesh({"data": 2, "pipe": 4})`); batches shard over `data`,
+        stages over `pipe`, and the SPMD partitioner inserts the gradient
+        all-reduce over the data axis inside the step (the reference's
+        averaging step, at ICI speed, composed with the pipeline)."""
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
         net._ensure_init()
@@ -137,7 +143,16 @@ class PipelineParallelWrapper:
         if pipe_axis not in self.mesh.shape:
             raise ValueError(f"mesh has no '{pipe_axis}' axis: "
                              f"{dict(self.mesh.shape)}")
+        if data_axis is not None and data_axis not in self.mesh.shape:
+            raise ValueError(f"mesh has no '{data_axis}' axis: "
+                             f"{dict(self.mesh.shape)}")
+        if data_axis == pipe_axis:
+            raise ValueError("data_axis must differ from pipe_axis "
+                             f"({pipe_axis!r})")
         self.pipe_axis = pipe_axis
+        self.data_axis = data_axis
+        self.n_data = (1 if data_axis is None
+                       else self.mesh.shape[data_axis])
         self.n_stages = self.mesh.shape[pipe_axis]
         self.microbatches = microbatches or self.n_stages
         self.prefetch_buffer = prefetch_buffer
@@ -171,6 +186,8 @@ class PipelineParallelWrapper:
 
         self._repl = NamedSharding(self.mesh, P())
         self._stage_sh = NamedSharding(self.mesh, P(pipe_axis))
+        self._batch_sh = (self._repl if data_axis is None
+                          else NamedSharding(self.mesh, P(data_axis)))
 
         # wrapper-owned layout: (head list, stacked trunk, tail list)
         self._split_from_net()
@@ -277,7 +294,8 @@ class PipelineParallelWrapper:
 
             x = pipeline_apply(block_fn, trunk_p, x, self.mesh,
                                axis_name=self.pipe_axis,
-                               microbatches=self.microbatches)
+                               microbatches=self.microbatches,
+                               data_axis=self.data_axis)
 
             for idx, i in enumerate(range(self.trunk_end,
                                           len(net.layers) - 1)):
@@ -362,11 +380,11 @@ class PipelineParallelWrapper:
                 ut.append(u)
             return nh, ntr, nt, uh, utr, ut, new_lstate, iteration + 1, loss
 
-        repl, st = self._repl, self._stage_sh
+        repl, st, bsh = self._repl, self._stage_sh, self._batch_sh
         return jax.jit(
             step,
             in_shardings=(repl, st, repl, repl, st, repl, repl, repl,
-                          repl, repl, repl, repl),
+                          bsh, bsh, bsh, bsh),
             out_shardings=(repl, st, repl, repl, st, repl, repl, repl, repl),
             donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7),
         )
@@ -394,15 +412,17 @@ class PipelineParallelWrapper:
                             "PipelineParallelWrapper does not support "
                             "masked batches; use ParallelWrapper")
                     B = ds.num_examples()
-                    if B % self.microbatches:
-                        usable = (B // self.microbatches) * self.microbatches
+                    quantum = self.microbatches * self.n_data
+                    if B % quantum:
+                        usable = (B // quantum) * quantum
                         if not usable:
                             logger.warning("dropping batch of %d < %d "
-                                           "microbatches", B,
-                                           self.microbatches)
+                                           "(microbatches x data shards)",
+                                           B, quantum)
                             continue
                         logger.warning("trimming batch %d -> %d "
-                                       "(microbatch divisibility)", B, usable)
+                                       "(microbatch/data divisibility)",
+                                       B, usable)
                         ds = DataSet(ds.features[:usable],
                                      None if ds.labels is None
                                      else ds.labels[:usable])

@@ -158,3 +158,56 @@ def test_tbptt_nets_are_rejected():
     net.init()
     with pytest.raises(ValueError, match="tBPTT"):
         PipelineParallelWrapper(net, make_mesh({"pipe": 8}))
+
+
+def test_2d_data_pipeline_parallel_matches_single_device():
+    """dp x pp on one mesh: batches shard over 'data', stages over 'pipe';
+    same-seed parity vs single-device training (the 2-D composition the
+    reference cannot express — its only axis is data)."""
+    batches = _data()
+    ref = dl4j.MultiLayerNetwork(_mlp_conf(depth=4))
+    ref.init()
+    for _ in range(2):
+        for ds in batches:
+            ref.fit(ds)
+
+    net = dl4j.MultiLayerNetwork(_mlp_conf(depth=4))
+    net.init()
+    mesh = make_mesh({"data": 2, "pipe": 4})
+    pw = PipelineParallelWrapper(net, mesh, data_axis="data")
+    assert pw.n_stages == 4 and pw.n_data == 2
+    for _ in range(2):
+        for ds in batches:
+            pw.fit(ds)
+
+    np.testing.assert_allclose(net.score_value, ref.score_value,
+                               rtol=2e-4, atol=2e-5)
+    for pr, pp_ in zip(jax.tree_util.tree_leaves(ref._params),
+                       jax.tree_util.tree_leaves(net._params)):
+        np.testing.assert_allclose(np.asarray(pp_), np.asarray(pr),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_2d_requires_data_axis_in_mesh():
+    net = dl4j.MultiLayerNetwork(_mlp_conf(depth=4))
+    net.init()
+    with pytest.raises(ValueError, match="no 'data' axis"):
+        PipelineParallelWrapper(net, make_mesh({"pipe": 8}),
+                                data_axis="data")
+
+
+def test_data_axis_must_differ_from_pipe_axis():
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
+
+    net = dl4j.MultiLayerNetwork(_mlp_conf(depth=4))
+    net.init()
+    with pytest.raises(ValueError, match="differ from"):
+        PipelineParallelWrapper(net, make_mesh({"pipe": 8}),
+                                data_axis="pipe")
+    mesh = make_mesh({"pipe": 8})
+    with pytest.raises(ValueError, match="differ from"):
+        pipeline_apply(lambda p, x: x, [jnp.zeros((8, 1))],
+                       jnp.zeros((8, 4)), mesh, data_axis="pipe")
+    with pytest.raises(ValueError, match="no 'data' axis"):
+        pipeline_apply(lambda p, x: x, [jnp.zeros((8, 1))],
+                       jnp.zeros((8, 4)), mesh, data_axis="data")
