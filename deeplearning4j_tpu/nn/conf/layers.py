@@ -1108,6 +1108,10 @@ class LayerNormalization(FeedForwardLayer):
 
 
 def layer_norm(x, gamma, beta, eps=1e-5):
+    # NOTE (r3): a one-pass E[x^2]-mean^2 variant with bf16 application
+    # (the BatchNormalization treatment) was measured at NO gain here on
+    # either GPT bench config — XLA already fuses the f32 upcast into the
+    # row-wise LN computation, so the straightforward form stays.
     stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
     xs = x.astype(stat_dtype)
     mean = jnp.mean(xs, axis=-1, keepdims=True)

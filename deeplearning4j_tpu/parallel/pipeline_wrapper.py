@@ -134,6 +134,12 @@ class PipelineParallelWrapper:
         averaging step, at ICI speed, composed with the pipeline)."""
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
+        if not hasattr(net, "layers"):
+            raise ValueError(
+                "PipelineParallelWrapper takes a MultiLayerNetwork (a "
+                "linear layer stack to partition into stages); for a "
+                "ComputationGraph express the trunk as an MLN or use "
+                "ParallelWrapper (dp/tp), which supports both containers")
         net._ensure_init()
         if net.conf.tbptt_fwd_length > 0:
             raise ValueError("pipeline parallelism does not support tBPTT; "

@@ -211,3 +211,13 @@ def test_data_axis_must_differ_from_pipe_axis():
     with pytest.raises(ValueError, match="no 'data' axis"):
         pipeline_apply(lambda p, x: x, [jnp.zeros((8, 1))],
                        jnp.zeros((8, 4)), mesh, data_axis="data")
+
+
+def test_computation_graph_rejected_with_guidance():
+    from deeplearning4j_tpu.models.resnet import resnet_configuration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    cg = ComputationGraph(resnet_configuration(depth=18, n_classes=2,
+                                               stage_filters=(8, 16, 32, 64)))
+    with pytest.raises(ValueError, match="MultiLayerNetwork"):
+        PipelineParallelWrapper(cg, make_mesh({"pipe": 8}))
