@@ -116,10 +116,6 @@ def test_numeric_gradient_check_f64():
 
 def test_dispatch_declines_unsupported_calls():
     x, W, RW, b, peep, h0, c0 = _inputs(jnp.float32)
-    mask = jnp.ones(x.shape[:2])
-    assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0, mask=mask,
-                              gate_is_sigmoid=True, cell_is_tanh=True,
-                              interpret=True) is None
     assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0,
                               gate_is_sigmoid=False, cell_is_tanh=True,
                               interpret=True) is None
@@ -148,3 +144,68 @@ def test_batch_not_multiple_of_8_declines():
     assert lstm_fused_or_none(x, W, RW, b, peep, h0, c0,
                               gate_is_sigmoid=True, cell_is_tanh=True,
                               interpret=True) is None
+
+
+def _mask(B=8, T=5, seed=3):
+    rng = np.random.default_rng(seed)
+    # variable-length: each row valid for a prefix, plus one interior hole
+    m = np.ones((B, T), np.float64)
+    lens = rng.integers(2, T + 1, B)
+    for b in range(B):
+        m[b, lens[b]:] = 0.0
+    m[0, 1] = 0.0  # interior masked step: state must pass through
+    return m
+
+
+def test_masked_forward_matches_scan():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float64)
+    m = jnp.asarray(_mask())
+    ref, (rh, rc) = lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid,
+                                 jnp.tanh, h0, c0, mask=m)
+    out, (hT, cT) = _fused(x, W, RW, b, peep, h0, c0, mask=m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rh), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rc), atol=1e-12)
+
+
+def test_masked_reverse_matches_scan():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float64)
+    m = jnp.asarray(_mask(seed=4))
+    ref, (rh, rc) = lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid,
+                                 jnp.tanh, h0, c0, mask=m, reverse=True)
+    out, (hT, cT) = _fused(x, W, RW, b, peep, h0, c0, mask=m,
+                           reverse=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rh), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rc), atol=1e-12)
+
+
+def test_masked_gradients_match_scan_f64():
+    x, W, RW, b, peep, h0, c0 = _inputs(jnp.float64)
+    m = jnp.asarray(_mask(seed=5))
+    weights = jnp.asarray(
+        np.random.default_rng(6).standard_normal((8, 5, 128)))
+
+    def loss(fwd, W, RW, b, peep, h0, c0, x):
+        out, (hT, cT) = fwd(x, W, RW, b, peep, h0, c0)
+        return (jnp.sum(out * weights) + jnp.sum(hT * cT)
+                + jnp.sum(jnp.tanh(cT)))
+
+    def scan_fwd(x, W, RW, b, peep, h0, c0):
+        return lstm_forward(x, W, RW, b, peep, jax.nn.sigmoid, jnp.tanh,
+                            h0, c0, mask=m)
+
+    def fused_fwd(x, W, RW, b, peep, h0, c0):
+        return _fused(x, W, RW, b, peep, h0, c0, mask=m)
+
+    args = (W, RW, b, peep, h0, c0, x)
+    g_ref = jax.grad(lambda *a: loss(scan_fwd, *a),
+                     argnums=tuple(range(7)))(*args)
+    g_fus = jax.grad(lambda *a: loss(fused_fwd, *a),
+                     argnums=tuple(range(7)))(*args)
+    for r, f in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_fus)):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(r),
+                                   rtol=1e-9, atol=1e-11)
