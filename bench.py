@@ -17,13 +17,10 @@ real barrier over the remote-tunnel backend this build runs on, and the r1
 numbers taken with it overstated throughput up to ~25x. Batches are staged
 in HBM up front (DeviceCacheDataSetIterator) and the timed pass is a
 steady-state epoch, so the figures measure the chip, not the ~33 MB/s
-tunnel. Honest steady-state per-chip numbers (v5e, 2026-07-30 r3):
-lenet ~460k samples/s, resnet50 ~7.7-8k samples/s (~29-30% MFU, one-pass
-folded BN), lstm ~123k samples/s (~8% MFU, Pallas fused cell at B=8192),
-gpt train ~1.4M tok/s (~16% MFU, toy scale), gpt_long (T=4096, d=1024)
-~127k tok/s (~42% MFU, Pallas flash fwd+bwd, measured 2.9x the XLA
-blockwise path at the bench shape), word2vec ~116-128k words/s,
-gpt generate ~34-36k tok/s.
+tunnel. r4: every config repeats the timed pass 3x and reports the MEDIAN
+plus a "spread" (max/min) field — one-shot numbers on the shared tunnel
+host swung ±45% between the r3 builder run and the driver capture, so any
+number quoted without a spread is a single-run observation, not a claim.
 """
 from __future__ import annotations
 
@@ -44,8 +41,22 @@ def _sync(net) -> float:
     return float(np.asarray(net._score))
 
 
+_REPEATS = 3
+
+
+def _median_spread(dts):
+    """Median + run-to-run spread (max/min) of repeated timings. One-shot
+    numbers on the shared-host tunnel backend swung ±45% between the r3
+    builder run and the driver capture; the median is the number of record
+    and the spread is its error bar (the reference's PerformanceListener
+    reports per-interval rates for the same reason,
+    `optimize/listeners/PerformanceListener.java`)."""
+    return float(np.median(dts)), float(max(dts) / min(dts))
+
+
 def _throughput(net, batches, warmup, bench, scan_steps=1):
-    """Time `bench` training steps. Batches are staged in HBM up front
+    """Time `bench` training steps, `_REPEATS` times; return
+    (median seconds, spread). Batches are staged in HBM up front
     (DeviceCacheDataSetIterator) — the realistic pipeline for benchmark-
     sized datasets, and the only way the measurement reflects the chip
     rather than this build's ~33 MB/s remote tunnel. `scan_steps` is an
@@ -66,11 +77,14 @@ def _throughput(net, batches, warmup, bench, scan_steps=1):
     # timed pass measures the chip, not the tunnel bookkeeping
     net.fit(bench_it, scan_steps=scan_steps)
     _sync(net)
-    bench_it.reset()
-    t0 = time.perf_counter()
-    net.fit(bench_it, scan_steps=scan_steps)
-    _sync(net)
-    return time.perf_counter() - t0
+    dts = []
+    for _ in range(_REPEATS):
+        bench_it.reset()
+        t0 = time.perf_counter()
+        net.fit(bench_it, scan_steps=scan_steps)
+        _sync(net)
+        dts.append(time.perf_counter() - t0)
+    return _median_spread(dts)
 
 
 # v5e peak: 197 TFLOP/s bf16 (MXU native). f32 matmuls run at roughly half
@@ -131,10 +145,10 @@ def bench_lenet():
     it = MnistDataSetIterator(batch_size, num_examples=batch_size * (warmup + bench),
                               raw_uint8=True)
     batches = list(it)
-    dt = _throughput(net, batches, warmup, bench, scan_steps=scan)
+    dt, spread = _throughput(net, batches, warmup, bench, scan_steps=scan)
     value = bench * batch_size / dt
     mfu = _mfu(_step_flops(net, batches[0]) / batch_size, value, bf16=True)
-    return "lenet_mnist_train_samples_per_sec_per_chip", value, mfu
+    return "lenet_mnist_train_samples_per_sec_per_chip", value, mfu, spread
 
 
 def bench_resnet50():
@@ -162,10 +176,10 @@ def bench_resnet50():
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch_size)]
     batches = [DataSet(rng.integers(0, 256, (batch_size, 32, 32, 3)).astype(np.uint8), y)
                for _ in range(warmup + bench)]
-    dt = _throughput(net, batches, warmup, bench, scan_steps=scan)
+    dt, spread = _throughput(net, batches, warmup, bench, scan_steps=scan)
     value = bench * batch_size / dt
     mfu = _mfu(_step_flops(net, batches[0]) / batch_size, value, bf16=True)
-    return "resnet50_cifar10_train_samples_per_sec_per_chip", value, mfu
+    return "resnet50_cifar10_train_samples_per_sec_per_chip", value, mfu, spread
 
 
 def bench_lstm():
@@ -211,20 +225,40 @@ def bench_lstm():
     batches = [DataSet(ids[i, :, :-1].astype(np.uint8),
                        ids[i, :, 1:].astype(np.int32))
                for i in range(warmup + bench)]
-    dt = _throughput(net, batches, warmup, bench, scan_steps=scan)
+    dt, spread = _throughput(net, batches, warmup, bench, scan_steps=scan)
     value = bench * batch_size / dt
     # count step FLOPs on the lax.scan path, not the Pallas one: XLA's cost
     # analysis can't see inside custom-call kernels, and the MFU metric
-    # should not change just because the implementation moved into one
+    # should not change just because the implementation moved into one.
+    # Also time the scan path at THIS batch size: vs_baseline compares
+    # against the r2 B=512 scan baseline, so it conflates the fused-kernel
+    # win with the batch-size change — fused_speedup_vs_scan is the
+    # kernel-only ratio at matched batch/shape, measured in-bench.
     import os
 
-    os.environ["DL4J_TPU_NO_PALLAS_LSTM"] = "1"
+    prior = os.environ.get("DL4J_TPU_NO_PALLAS_LSTM")  # never clobber a
+    os.environ["DL4J_TPU_NO_PALLAS_LSTM"] = "1"        # user-set override
     try:
         flops = _step_flops(net, batches[0])  # traces fresh under the env
+        if prior is None:
+            scan_net = MultiLayerNetwork(conf, compute_dtype=jnp.bfloat16)
+            scan_net.init()
+            scan_net.set_normalizer(OneHotEncoder(vocab))
+            scan_dt, _ = _throughput(scan_net, batches, warmup, bench,
+                                     scan_steps=scan)
+            bench_lstm.fused_speedup_vs_scan = round(scan_dt / dt, 3)
+        else:
+            # the main net already ran the scan path under the user's
+            # override — a scan-vs-scan ratio labeled "fused_speedup"
+            # would be misleading
+            bench_lstm.fused_speedup_vs_scan = None
     finally:
-        del os.environ["DL4J_TPU_NO_PALLAS_LSTM"]
+        if prior is None:
+            del os.environ["DL4J_TPU_NO_PALLAS_LSTM"]
+        else:
+            os.environ["DL4J_TPU_NO_PALLAS_LSTM"] = prior
     mfu = _mfu(flops / batch_size, value, bf16=True)
-    return "lstm_charrnn_train_samples_per_sec_per_chip", value, mfu
+    return "lstm_charrnn_train_samples_per_sec_per_chip", value, mfu, spread
 
 
 def bench_gpt():
@@ -254,11 +288,11 @@ def bench_gpt():
     batches = [DataSet(ids[i, :, :-1].astype(np.int32),
                        ids[i, :, 1:].astype(np.int32))
                for i in range(warmup + bench)]
-    dt = _throughput(net, batches, warmup, bench, scan_steps=scan)
+    dt, spread = _throughput(net, batches, warmup, bench, scan_steps=scan)
     value = bench * batch_size * T / dt
     mfu = _mfu(_step_flops(net, batches[0]) / (batch_size * T), value,
                bf16=True)
-    return "gpt_causal_lm_train_tokens_per_sec_per_chip", value, mfu
+    return "gpt_causal_lm_train_tokens_per_sec_per_chip", value, mfu, spread
 
 
 def bench_gpt_long():
@@ -295,7 +329,7 @@ def bench_gpt_long():
     batches = [DataSet(ids[i, :, :-1].astype(np.int32),
                        ids[i, :, 1:].astype(np.int32))
                for i in range(warmup + bench)]
-    dt = _throughput(net, batches, warmup, bench)
+    dt, spread = _throughput(net, batches, warmup, bench)
     value = bench * batch_size * T / dt
 
     # MFU accounting: XLA's cost analysis counts everything EXCEPT inside
@@ -327,7 +361,7 @@ def bench_gpt_long():
     # hardcoding a tile the probe rejected would crash the whole bench.
     if blk is None:
         bench_gpt_long.flash_speedup = None
-        return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu
+        return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu, spread
     from deeplearning4j_tpu.ops.attention import blockwise_attention
     from deeplearning4j_tpu.ops.pallas_attention import flash_attention
 
@@ -362,41 +396,79 @@ def bench_gpt_long():
         float(s)  # true host sync (scalar)
         times[name] = (time.perf_counter() - t0) / 6
     bench_gpt_long.flash_speedup = round(times["xla"] / times["flash"], 3)
-    return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu
+    return "gpt_long_t4096_train_tokens_per_sec_per_chip", value, mfu, spread
+
+
+def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
+    """Synthetic Zipf corpus as pre-tokenized sentences."""
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab_size + 1)
+    probs /= probs.sum()
+    words = np.array([f"w{i}" for i in range(vocab_size)])
+    draws = rng.choice(vocab_size, (n_sentences, sent_len), p=probs)
+    return [list(words[row]) for row in draws]
+
+
+def _time_w2v(w2v, sentences):
+    """Median/spread of 3 full training passes; each pass ends with a true
+    host sync (table materialization — block_until_ready is not a real
+    barrier over the remote tunnel)."""
+    w2v.fit(sentences[:300])  # warm-up: compile the scanned NS kernel
+    # one untimed full pass: the remote transport resolves buffer handles
+    # on first contact (~100 ms each, serialized), which otherwise lands in
+    # the first timed pass and inflates the spread
+    w2v.fit(sentences)
+    float(np.asarray(w2v.lookup_table.syn0).sum())
+    dts = []
+    for _ in range(_REPEATS):
+        t0 = time.perf_counter()
+        w2v.fit(sentences)
+        float(np.asarray(w2v.lookup_table.syn0).sum())
+        dts.append(time.perf_counter() - t0)
+    return _median_spread(dts)
 
 
 def bench_word2vec():
     """Skip-gram with negative sampling (BASELINE config 4: the reference's
     `SkipGram.iterateSample` / `AggregateSkipGram` native-op path, here a
     batched XLA scatter step). Metric: corpus words/sec trained."""
-    import time
-
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
     # synthetic corpus with Zipf-ish structure — vocab ~2k, 200k words
-    rng = np.random.default_rng(0)
-    vocab_size, n_sentences, sent_len = 2000, 10_000, 20
-    probs = 1.0 / np.arange(1, vocab_size + 1)
-    probs /= probs.sum()
-    words = [f"w{i}" for i in range(vocab_size)]
-    sentences = [[words[j] for j in rng.choice(vocab_size, sent_len, p=probs)]
-                 for i in range(n_sentences)]
+    n_sentences, sent_len = 10_000, 20
+    sentences = _zipf_corpus(2000, n_sentences, sent_len)
     w2v = Word2Vec(layer_size=128, window=5, negative=5,
                    min_word_frequency=1, epochs=1, seed=1)
     w2v.build_vocab(sentences)
-    import jax
-
-    w2v.fit(sentences[:300])  # warm-up: compile the scanned NS kernel
-    float(np.asarray(w2v.lookup_table.syn0).sum())  # true host sync
-    t0 = time.perf_counter()
-    w2v.fit(sentences)
-    # count real device work: materialize the table (block_until_ready is
-    # not a real barrier over the remote tunnel)
-    float(np.asarray(w2v.lookup_table.syn0).sum())
-    dt = time.perf_counter() - t0
+    dt, spread = _time_w2v(w2v, sentences)
     total_words = n_sentences * sent_len
     # scatter/bandwidth-bound by design: MFU is not a meaningful figure
-    return "word2vec_skipgram_train_words_per_sec_per_chip", total_words / dt, None
+    return ("word2vec_skipgram_train_words_per_sec_per_chip",
+            total_words / dt, None, spread)
+
+
+def bench_word2vec_50k():
+    """Skip-gram NS at a realistic vocabulary (50k types, 2M corpus words —
+    the r3 verdict's scale ask: at vocab 2k/200k words, vocab build and
+    host looping dominated and the number measured host contention). Same
+    training path as `word2vec`, new metric name so baselines stay
+    comparable."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    n_sentences, sent_len = 50_000, 40
+    sentences = _zipf_corpus(50_000, n_sentences, sent_len)
+    # batch sweep on chip (vectorized host path): 1024->102k, 4096->134k,
+    # 8192->132k, 16384->144k, 32768->141k, 65536->118k words/s — 16384 is
+    # the knee (enough scatter rows per dispatch; beyond that the staged
+    # (scan_k, B) transfer grows faster than the dispatch savings)
+    w2v = Word2Vec(layer_size=128, window=5, negative=5,
+                   min_word_frequency=1, epochs=1, seed=1,
+                   batch_size=16384, scan_flushes=32)
+    w2v.build_vocab(sentences)
+    dt, spread = _time_w2v(w2v, sentences)
+    total_words = n_sentences * sent_len
+    return ("word2vec_skipgram_50kvocab_train_words_per_sec_per_chip",
+            total_words / dt, None, spread)
 
 
 def bench_generate():
@@ -418,17 +490,23 @@ def bench_generate():
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, vocab, (B, T0)).astype(np.int32)
     generate(net, prompt, n_new, temperature=0.0)  # compile
-    t0 = time.perf_counter()
-    out = generate(net, prompt, n_new, temperature=0.0)
-    dt = time.perf_counter() - t0
+    dts = []
+    for _ in range(_REPEATS):
+        t0 = time.perf_counter()
+        out = generate(net, prompt, n_new, temperature=0.0)
+        out = np.asarray(out)  # host sync
+        dts.append(time.perf_counter() - t0)
+    dt, spread = _median_spread(dts)
     assert out.shape == (B, n_new)
-    return "gpt_generate_tokens_per_sec_per_chip", B * n_new / dt, None
+    return "gpt_generate_tokens_per_sec_per_chip", B * n_new / dt, None, spread
 
 
 _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "lstm": bench_lstm, "gpt": bench_gpt,
             "gpt_long": bench_gpt_long,
-            "word2vec": bench_word2vec, "generate": bench_generate}
+            "word2vec": bench_word2vec,
+            "word2vec_50k": bench_word2vec_50k,
+            "generate": bench_generate}
 
 
 def _unit(metric: str) -> str:
@@ -458,7 +536,7 @@ def main() -> None:
     entries = {}
     ratios = []
     for name in names:
-        metric, value, mfu = _CONFIGS[name]()
+        metric, value, mfu, spread = _CONFIGS[name]()
         # baselines are chip numbers: only a real-chip run may set or be
         # compared against one; CPU smoke runs report vs_baseline=1.0
         baseline = baselines.get(metric, value) if on_chip else value
@@ -470,10 +548,14 @@ def main() -> None:
             "metric": metric, "value": round(value, 1),
             "unit": _unit(metric), "vs_baseline": round(ratio, 3),
             "mfu": None if mfu is None else round(mfu, 4),
+            "spread": round(spread, 3),
         }
         extra = getattr(_CONFIGS[name], "flash_speedup", None)
         if extra is not None:
             entries[name]["flash_speedup_vs_xla_blockwise"] = extra
+        extra = getattr(_CONFIGS[name], "fused_speedup_vs_scan", None)
+        if extra is not None:
+            entries[name]["fused_speedup_vs_scan"] = extra
     if on_chip:
         baseline_file.write_text(json.dumps(baselines))
 

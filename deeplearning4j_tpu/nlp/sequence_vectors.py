@@ -74,6 +74,7 @@ class SequenceVectors:
         self.vocab: Optional[AbstractCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.default_rng(seed)
+        self._keep_cache: Optional[np.ndarray] = None
         self._unigram: Optional[np.ndarray] = None
         self._unigram_cdf: Optional[np.ndarray] = None
         self._ns_cdf_dev = None  # device copy of the cdf (NS-on-device)
@@ -101,6 +102,7 @@ class SequenceVectors:
     # -- vocab/init ---------------------------------------------------------
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
         self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(sequences)
+        self._keep_cache = None
         if self.use_hs:
             build_huffman_tree(self.vocab)
         self.lookup_table = InMemoryLookupTable(
@@ -118,9 +120,16 @@ class SequenceVectors:
             self.build_vocab(seqs)
         total_words = max(
             1.0, self.vocab.total_word_occurrences * self.epochs * self.iterations)
-        words_seen = 0.0
         self._reset_loss()
         batch = _PairBatcher(self)
+        if self.algorithm == "skipgram" and self.negative > 0 \
+                and not self.use_hs:
+            # NS skip-gram (the common configuration — BASELINE config 4):
+            # fully vectorized host pipeline, see _fit_vectorized
+            self._fit_vectorized(seqs, total_words, batch)
+            batch.flush()
+            return
+        words_seen = 0.0
         for _ in range(self.epochs * self.iterations):
             for seq in seqs:
                 ids = self._to_ids(seq)
@@ -132,19 +141,108 @@ class SequenceVectors:
                 words_seen += len(ids)
         batch.flush()
 
+    # chunk size (tokens) for the vectorized pipeline: big enough that the
+    # per-chunk numpy fixed costs amortize, small enough that the (L, 2W)
+    # windowing grid stays ~20 MB and alpha decay keeps per-chunk
+    # granularity (the reference decays per sentence batch,
+    # `SequenceVectors.java:260`)
+    _CHUNK_TOKENS = 262_144
+
+    def _encode_corpus(self, seqs):
+        """token→id for the whole corpus in ONE pass (OOV dropped): flat
+        int32 id array + per-sentence kept lengths. The per-token dict
+        lookup — the irreducible host cost — happens exactly once per fit,
+        not once per epoch, and everything downstream is numpy array math.
+        This finishes the `AggregateSkipGram` replacement host-side
+        (reference `SkipGram.java:216` made windowing a native op because
+        interpreted per-pair loops cannot keep an accelerator fed)."""
+        lookup = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        flat: List[int] = []
+        lens = np.empty(len(seqs), np.int64)
+        for si, seq in enumerate(seqs):
+            ids = [i for i in map(lookup.get, seq) if i is not None]
+            flat.extend(ids)
+            lens[si] = len(ids)
+        return np.asarray(flat, np.int32), lens
+
+    def _keep_probs(self) -> np.ndarray:
+        """Per-vocab-index subsampling keep probability
+        P(keep) = sqrt(t/f) + t/f (word2vec's formula), computed once per
+        vocab and cached (both the vectorized and the per-sentence paths
+        index this array, so the two cannot drift)."""
+        if self._keep_cache is None:
+            # vocab_words() is index-ordered, so position == vocab index
+            counts = np.array([vw.count for vw in self.vocab.vocab_words()],
+                              np.float64)
+            f = counts / self.vocab.total_word_occurrences
+            self._keep_cache = np.minimum(
+                1.0, np.sqrt(self.sampling / f) + self.sampling / f)
+        return self._keep_cache
+
+    def _fit_vectorized(self, seqs, total_words: float,
+                        batch: "_PairBatcher") -> None:
+        """Corpus-level vectorized NS skip-gram training: encode once, then
+        per epoch run chunked whole-corpus windowing (subsampling and the
+        shrinking window drawn as arrays, sentence boundaries enforced by a
+        mask) and ship the (center, context) id arrays straight to the
+        scanned device kernel. Replaces the per-sentence Python loop that
+        made r3's word2vec number measure host CPU contention instead of
+        the chip."""
+        flat, lens = self._encode_corpus(seqs)
+        if flat.size == 0:
+            return
+        starts = np.zeros(lens.size + 1, np.int64)
+        np.cumsum(lens, out=starts[1:])
+        keep = self._keep_probs() if self.sampling > 0 else None
+        # chunk edges in sentence space, each chunk ~_CHUNK_TOKENS ids
+        edges = [0]
+        tok = 0
+        for si in range(lens.size):
+            tok += int(lens[si])
+            if tok >= self._CHUNK_TOKENS:
+                edges.append(si + 1)
+                tok = 0
+        if edges[-1] != lens.size:
+            edges.append(lens.size)
+        words_seen = 0.0
+        for _ in range(self.epochs * self.iterations):
+            for ci in range(len(edges) - 1):
+                i, j = edges[ci], edges[ci + 1]
+                ids = flat[starts[i]:starts[j]]
+                lens_c = lens[i:j]
+                if ids.size == 0:
+                    continue
+                if keep is not None:
+                    m = self._rng.random(ids.size) < keep[ids]
+                    sent_idx = np.repeat(np.arange(j - i), lens_c)
+                    ids = ids[m]
+                    lens_c = np.bincount(sent_idx[m], minlength=j - i)
+                centers, contexts, counts = _window_pairs(
+                    ids, lens_c, self.window, self._rng)
+                if centers.size:
+                    # per-PAIR linear alpha decay, indexed by the word
+                    # position each pair's center occupies — finer than the
+                    # reference's per-sentence decay
+                    # (`SequenceVectors.java:260`), and in particular still
+                    # decaying inside a single-chunk corpus
+                    pos = np.repeat(np.arange(ids.size), counts)
+                    alphas = np.maximum(
+                        self.min_learning_rate,
+                        self.learning_rate
+                        * (1.0 - (words_seen + pos) / total_words)
+                    ).astype(np.float32)
+                    batch.add_pairs(centers, contexts, alphas)
+                words_seen += float(ids.size)
+
     def _to_ids(self, seq: Sequence[str]) -> List[int]:
+        keep = self._keep_probs() if self.sampling > 0 else None
         ids = []
         for tok in seq:
             i = self.vocab.index_of(tok)
             if i < 0:
                 continue
-            if self.sampling > 0:
-                # word2vec subsampling: P(keep) = sqrt(t/f) + t/f
-                f = (self.vocab.element_at_index(i).count
-                     / self.vocab.total_word_occurrences)
-                keep = min(1.0, np.sqrt(self.sampling / f) + self.sampling / f)
-                if self._rng.random() > keep:
-                    continue
+            if keep is not None and self._rng.random() > keep[i]:
+                continue
             ids.append(i)
         return ids
 
@@ -155,17 +253,12 @@ class SequenceVectors:
             # vectorized fast path (the common NS configuration): build the
             # whole sentence's (center, context) pair list with array ops —
             # the per-pair Python loop was the training bottleneck, not the
-            # XLA scatter step
-            L = len(ids)
+            # XLA scatter step. (SequenceVectors.fit no longer comes here —
+            # it runs the chunked corpus-level _fit_vectorized — but
+            # ParagraphVectors DBOW word training still does, per document.)
             arr = np.asarray(ids, np.int32)
-            b = self._rng.integers(1, window + 1, L)  # shrinking windows
-            offs = np.concatenate([np.arange(-window, 0),
-                                   np.arange(1, window + 1)])
-            grid = np.arange(L)[:, None] + offs[None, :]
-            valid = ((np.abs(offs)[None, :] <= b[:, None])
-                     & (grid >= 0) & (grid < L))
-            centers = np.repeat(arr, valid.sum(1))
-            contexts = arr[grid[valid]]  # row-major: aligned with repeat
+            centers, contexts, _ = _window_pairs(
+                arr, np.array([len(ids)], np.int64), window, self._rng)
             batch.add_pairs(centers, contexts, alpha)
             return
         for pos, center in enumerate(ids):
@@ -313,6 +406,33 @@ class SequenceVectors:
         return self.lookup_table.vector(word)
 
 
+def _window_pairs(ids: np.ndarray, lens: np.ndarray, window: int,
+                  rng) -> tuple:
+    """Skip-gram windowing over a chunk of concatenated sentences, fully
+    vectorized: per-position shrinking windows b ~ U[1, window] drawn as one
+    array, an (L, 2*window) index grid, and a validity mask that enforces
+    both the window radius and same-sentence bounds. Returns aligned
+    (centers, contexts) int32 arrays plus the per-position pair count —
+    the host half of the reference's `AggregateSkipGram` native op
+    (`SkipGram.java:216`)."""
+    L = ids.size
+    if L == 0:
+        return (np.empty(0, np.int32),) * 2 + (np.empty(0, np.int64),)
+    b = rng.integers(1, window + 1, L)  # shrinking windows
+    offs = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    grid = np.arange(L)[:, None] + offs[None, :]
+    ends = np.cumsum(lens)
+    sent_of = np.repeat(np.arange(lens.size), lens)
+    lo = (ends - lens)[sent_of][:, None]
+    hi = ends[sent_of][:, None]
+    valid = ((np.abs(offs)[None, :] <= b[:, None])
+             & (grid >= lo) & (grid < hi))
+    counts = valid.sum(1)
+    centers = np.repeat(ids, counts)
+    contexts = ids[grid[valid]]  # row-major: aligned with repeat
+    return centers, contexts, counts
+
+
 class _PairBatcher:
     """Accumulates training examples into fixed-shape arrays and flushes
     them through the jitted kernels (fixed batch shape ⇒ one XLA
@@ -375,10 +495,12 @@ class _PairBatcher:
                 k += 1
 
     def add_pairs(self, centers: np.ndarray, contexts: np.ndarray,
-                  alpha: float):
+                  alpha):
         """Bulk skip-gram add (NS-only fast path): stages just the
         (center, context) id pairs — negatives, labels, and masks are built
-        on device by `skipgram_ns_scan`."""
+        on device by `skipgram_ns_scan`. `alpha` is a scalar or a per-pair
+        array (the kernel applies one learning rate per flush-row of B
+        pairs; an array alpha sets each row's rate from its first pair)."""
         if self._mode == "generic":
             raise RuntimeError("batcher already in generic mode")
         self._mode = "pairs"
@@ -390,7 +512,13 @@ class _PairBatcher:
             rows = slice(self.n, self.n + take)
             self.pair_center[rows] = centers[i:i + take]
             self.pair_context[rows] = contexts[i:i + take]
-            self.row_alpha[self.n // B:(self.n + take - 1) // B + 1] = alpha
+            r0, r1 = self.n // B, (self.n + take - 1) // B + 1
+            if np.ndim(alpha) == 0:
+                self.row_alpha[r0:r1] = alpha
+            else:
+                firsts = np.maximum(np.arange(r0, r1) * B, self.n) \
+                    - self.n + i
+                self.row_alpha[r0:r1] = alpha[firsts]
             self.n += take
             i += take
             if self.n == cap:

@@ -268,6 +268,107 @@ def test_word2vec_with_japanese_tokenizer():
     assert "犬" in w2v.vocab.words()
 
 
+def test_window_pairs_respect_sentence_boundaries():
+    """The chunked corpus-level windowing must never pair tokens across a
+    sentence boundary, and every pair must be within the window radius.
+    Distinct id ranges per sentence make violations detectable."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import _window_pairs
+
+    rng = np.random.default_rng(0)
+    lens = np.array([7, 1, 12, 3], np.int64)
+    ids = np.concatenate([np.arange(100 * i, 100 * i + L)
+                          for i, L in enumerate(lens)]).astype(np.int32)
+    centers, contexts, counts = _window_pairs(ids, lens, window=4, rng=rng)
+    assert centers.size == contexts.size and centers.size > 0
+    assert counts.sum() == centers.size
+    assert np.all(centers // 100 == contexts // 100)  # same sentence
+    assert np.all(np.abs(centers - contexts) <= 4)    # window radius
+    assert np.all(centers != contexts)                # never self-pairs
+
+
+def test_vectorized_fit_alpha_decays_within_single_chunk():
+    """Per-pair alpha decay: even a corpus far smaller than one chunk with
+    epochs=1 must train its last pairs at a lower rate than its first
+    (regression: chunk-level alpha left a <=262k-token corpus entirely at
+    the initial learning rate)."""
+    from deeplearning4j_tpu.nlp import sequence_vectors as sv_mod
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    sentences = [[words[j] for j in rng.integers(0, 40, 15)]
+                 for _ in range(80)]
+    w2v = Word2Vec(layer_size=16, negative=3, min_word_frequency=1,
+                   epochs=1, learning_rate=0.05, min_learning_rate=1e-4,
+                   seed=1)
+    alphas = []
+    orig = sv_mod._PairBatcher.add_pairs
+
+    def spy(self, centers, contexts, alpha):
+        alphas.append(np.asarray(alpha))
+        return orig(self, centers, contexts, alpha)
+
+    sv_mod._PairBatcher.add_pairs = spy
+    try:
+        w2v.fit(sentences)
+    finally:
+        sv_mod._PairBatcher.add_pairs = orig
+    per_pair = np.concatenate([np.atleast_1d(a) for a in alphas])
+    assert per_pair[0] > per_pair[-1]            # decayed end to end
+    assert per_pair[-1] >= 1e-4                  # floored at min rate
+    # linear decay by words processed: the final alpha should be close to
+    # lr * (1 - fraction_of_corpus_seen)
+    assert per_pair[-1] < 0.6 * per_pair[0]
+
+
+def test_vectorized_fit_subsampling():
+    """sampling>0 through the vectorized NS path: the keep-mask + bincount
+    length remap must stay sentence-aligned (no cross-sentence pairs) and
+    frequent words must be dropped more often than rare ones."""
+    from deeplearning4j_tpu.nlp import sequence_vectors as sv_mod
+
+    rng = np.random.default_rng(5)
+    # "the" dominates every sentence; each sentence has a disjoint rare set
+    sentences = []
+    for si in range(60):
+        rare = [f"s{si}_r{j}" for j in range(3)]
+        s = []
+        for j in range(12):
+            s.append("the" if rng.random() < 0.5 else rare[j % 3])
+        sentences.append(s)
+    w2v = Word2Vec(layer_size=16, negative=3, min_word_frequency=1,
+                   sampling=1e-2, epochs=2, seed=2)
+    w2v.build_vocab(sentences)
+    the_id = w2v.vocab.index_of("the")
+    seen = {"pairs": 0, "the": 0}
+    orig = sv_mod._PairBatcher.add_pairs
+
+    def spy(self, centers, contexts, alpha):
+        # rare ids are unique to one sentence: a cross-sentence pair would
+        # put two different sentences' rare ids together
+        rare_c = centers != the_id
+        rare_x = contexts != the_id
+        both = rare_c & rare_x
+        if both.any():
+            c_sent = [w2v.vocab.word_at_index(i).split("_")[0]
+                      for i in centers[both]]
+            x_sent = [w2v.vocab.word_at_index(i).split("_")[0]
+                      for i in contexts[both]]
+            assert c_sent == x_sent
+        seen["pairs"] += centers.size
+        seen["the"] += int(np.sum(centers == the_id))
+        return orig(self, centers, contexts, alpha)
+
+    sv_mod._PairBatcher.add_pairs = spy
+    try:
+        w2v.fit(sentences)
+    finally:
+        sv_mod._PairBatcher.add_pairs = orig
+    assert seen["pairs"] > 0
+    # "the" is ~half the corpus; aggressive subsampling must cut its share
+    # of centers well below its raw frequency
+    assert seen["the"] / seen["pairs"] < 0.35
+
+
 def test_refit_resets_loss_accumulator():
     """A second fit() must not inherit the previous fit's undrained
     device-side loss accumulator (regression: mean_loss doubled)."""
