@@ -236,11 +236,22 @@ def bench_lstm():
     # kernel-only ratio at matched batch/shape, measured in-bench.
     import os
 
+    # Did the main timed net actually ride the fused kernel? (pallas_lstm
+    # treats a falsy env value as unset, so mirror its truthiness; the
+    # probe verdict covers platform fallback and failed tile compiles.)
+    from deeplearning4j_tpu.ops.pallas_lstm import (
+        _platform_ok,
+        _probed_batch_block,
+    )
+
+    fused_ran = (_platform_ok()
+                 and _probed_batch_block(jnp.bfloat16, batch_size, hidden,
+                                         False) is not None)
     prior = os.environ.get("DL4J_TPU_NO_PALLAS_LSTM")  # never clobber a
     os.environ["DL4J_TPU_NO_PALLAS_LSTM"] = "1"        # user-set override
     try:
         flops = _step_flops(net, batches[0])  # traces fresh under the env
-        if prior is None:
+        if fused_ran:
             scan_net = MultiLayerNetwork(conf, compute_dtype=jnp.bfloat16)
             scan_net.init()
             scan_net.set_normalizer(OneHotEncoder(vocab))
@@ -248,9 +259,9 @@ def bench_lstm():
                                      scan_steps=scan)
             bench_lstm.fused_speedup_vs_scan = round(scan_dt / dt, 3)
         else:
-            # the main net already ran the scan path under the user's
-            # override — a scan-vs-scan ratio labeled "fused_speedup"
-            # would be misleading
+            # the main net already ran the scan path (user override, CPU
+            # platform, or every tile probe failed) — a scan-vs-scan
+            # ratio labeled "fused_speedup" would be misleading
             bench_lstm.fused_speedup_vs_scan = None
     finally:
         if prior is None:

@@ -281,21 +281,25 @@ def _lstm_bwd_kernel_masked(gates_ref, cprev_ref, dh_out_ref,
         dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
 
 
+_BLOCK_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
+
+
 def _batch_block(B: int) -> Optional[int]:
-    """Largest batch block that keeps the kernel comfortably inside VMEM."""
-    for bb in (512, 256, 128, 64, 32, 16, 8):
+    """Largest batch block dividing B (the starting candidate — the
+    dispatch probes downward from here, see _probed_batch_block)."""
+    for bb in _BLOCK_CANDIDATES:
         if B % bb == 0:
             return bb
     return None
 
 
-def _fwd_call(xw, rw, peep, h0, c0, *, with_stash: bool, interpret: bool):
+def _fwd_call(xw, rw, peep, h0, c0, *, bb: int, with_stash: bool,
+              interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, B, G = xw.shape
     H = G // 4
-    bb = _batch_block(B)
     sdt = _stat_dtype(xw.dtype)
     kernel = functools.partial(_lstm_fwd_kernel, n_out=H,
                                with_stash=with_stash)
@@ -332,14 +336,13 @@ def _fwd_call(xw, rw, peep, h0, c0, *, with_stash: bool, interpret: bool):
     return h_out, cT, c_stash, gates
 
 
-def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *,
+def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *, bb: int,
               interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, B, G = gates.shape
     H = G // 4
-    bb = _batch_block(B)
     sdt = _stat_dtype(gates.dtype)
     kernel = functools.partial(_lstm_bwd_kernel, n_out=H)
     rev = lambda shape: pl.BlockSpec(shape, lambda b, t: (T - 1 - t, b, 0))
@@ -372,29 +375,29 @@ def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *,
     return dz, dhc0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _lstm_core(xw, rw, peep, h0, c0, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _lstm_core(xw, rw, peep, h0, c0, interpret, bb):
     """(T,B,4H) projected inputs -> ((T,B,H) hidden states, cT (B,H))."""
-    h_out, cT, _, _ = _fwd_call(xw, rw, peep, h0, c0, with_stash=False,
-                                interpret=interpret)
+    h_out, cT, _, _ = _fwd_call(xw, rw, peep, h0, c0, bb=bb,
+                                with_stash=False, interpret=interpret)
     return h_out, cT
 
 
-def _lstm_core_fwd(xw, rw, peep, h0, c0, interpret):
-    h_out, cT, c_stash, gates = _fwd_call(xw, rw, peep, h0, c0,
+def _lstm_core_fwd(xw, rw, peep, h0, c0, interpret, bb):
+    h_out, cT, c_stash, gates = _fwd_call(xw, rw, peep, h0, c0, bb=bb,
                                           with_stash=True,
                                           interpret=interpret)
     return (h_out, cT), (gates, c_stash, h_out, rw, peep, h0, c0)
 
 
-def _lstm_core_bwd(interpret, res, cots):
+def _lstm_core_bwd(interpret, bb, res, cots):
     dh_out, dcT = cots
     gates, c_stash, h_out, rw, peep, h0, c0 = res
     T, B, G = gates.shape
     H = G // 4
     sdt = _stat_dtype(gates.dtype)
     dz, dhc0 = _bwd_call(gates, c_stash, dh_out, dcT.astype(gates.dtype),
-                         rw, peep, c0, interpret=interpret)
+                         rw, peep, c0, bb=bb, interpret=interpret)
     # batched contractions over the full (T*B) slab — big single XLA GEMMs,
     # the MXU-friendly shape the per-step kernel deliberately leaves out
     dt = _mxu_dtype(gates.dtype)
@@ -415,14 +418,13 @@ def _lstm_core_bwd(interpret, res, cots):
 _lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
 
 
-def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, with_stash: bool,
-                     interpret: bool):
+def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, bb: int,
+                     with_stash: bool, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, B, G = xw.shape
     H = G // 4
-    bb = _batch_block(B)
     sdt = _stat_dtype(xw.dtype)
     kernel = functools.partial(_lstm_fwd_kernel_masked, n_out=H,
                                with_stash=with_stash)
@@ -464,13 +466,12 @@ def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, with_stash: bool,
 
 
 def _bwd_call_masked(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0,
-                     *, interpret: bool):
+                     *, bb: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, B, G = gates.shape
     H = G // 4
-    bb = _batch_block(B)
     sdt = _stat_dtype(gates.dtype)
     kernel = functools.partial(_lstm_bwd_kernel_masked, n_out=H)
     rev = lambda shape: pl.BlockSpec(shape, lambda b, t: (T - 1 - t, b, 0))
@@ -504,21 +505,23 @@ def _bwd_call_masked(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0,
     return dz, dhc0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _lstm_core_masked(xw, rw, peep, h0, c0, mask, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _lstm_core_masked(xw, rw, peep, h0, c0, mask, interpret, bb):
     """Masked variant: returns (masked outputs (T,B,H), hT, cT)."""
     h_out, hT, cT, _, _, _ = _fwd_call_masked(
-        xw, rw, peep, h0, c0, mask, with_stash=False, interpret=interpret)
+        xw, rw, peep, h0, c0, mask, bb=bb, with_stash=False,
+        interpret=interpret)
     return h_out, hT, cT
 
 
-def _lstm_core_masked_fwd(xw, rw, peep, h0, c0, mask, interpret):
+def _lstm_core_masked_fwd(xw, rw, peep, h0, c0, mask, interpret, bb):
     h_out, hT, cT, h_sel, c_sel, gates = _fwd_call_masked(
-        xw, rw, peep, h0, c0, mask, with_stash=True, interpret=interpret)
+        xw, rw, peep, h0, c0, mask, bb=bb, with_stash=True,
+        interpret=interpret)
     return (h_out, hT, cT), (gates, h_sel, c_sel, mask, rw, peep, h0, c0)
 
 
-def _lstm_core_masked_bwd(interpret, res, cots):
+def _lstm_core_masked_bwd(interpret, bb, res, cots):
     dh_out, dhT, dcT = cots
     gates, h_sel, c_sel, mask, rw, peep, h0, c0 = res
     T, B, G = gates.shape
@@ -527,7 +530,7 @@ def _lstm_core_masked_bwd(interpret, res, cots):
     dz, dhc0 = _bwd_call_masked(gates, c_sel, dh_out,
                                 dhT.astype(gates.dtype),
                                 dcT.astype(gates.dtype), mask, rw, peep,
-                                c0, interpret=interpret)
+                                c0, bb=bb, interpret=interpret)
     dt = _mxu_dtype(gates.dtype)
     h_prev = jnp.concatenate([h0[None], h_sel[:-1]], axis=0)
     drw = _dot(h_prev.reshape(T * B, H).astype(dt).T,
@@ -583,16 +586,32 @@ def _eager_probe(dtype, bb, H, masked: bool = False) -> bool:
     def loss(xw, rw):
         if masked:
             m = jnp.ones((T, bb, H), dtype)
-            h, hT, cT = _lstm_core_masked(xw, rw, peep, z, z, m, False)
+            h, hT, cT = _lstm_core_masked(xw, rw, peep, z, z, m, False, bb)
             return (jnp.sum(h.astype(jnp.float32))
                     + jnp.sum(hT.astype(jnp.float32))
                     + jnp.sum(cT.astype(jnp.float32)))
-        h, cT = _lstm_core(xw, rw, peep, z, z, False)
+        h, cT = _lstm_core(xw, rw, peep, z, z, False, bb)
         return jnp.sum(h.astype(jnp.float32)) + jnp.sum(
             cT.astype(jnp.float32))
 
     g = jax.grad(loss, argnums=(0, 1))(xw, rw)
     return bool(jnp.all(jnp.isfinite(g[1].astype(jnp.float32))))
+
+
+def _probed_batch_block(dtype, B: int, H: int, masked: bool) -> Optional[int]:
+    """Largest batch block dividing B whose (compile + run) probe passes.
+    Falls through to the next smaller candidate on failure — a bb that
+    overflows VMEM at a large H must not disqualify the kernel outright
+    (per-candidate verdicts are cached, so the fallback probes run once
+    per shape class)."""
+    for bb in _BLOCK_CANDIDATES:
+        if B % bb:
+            continue
+        key = (jnp.dtype(dtype).name, bb, H, masked)
+        if _probe_verdict(_probe_cache, key, _eager_probe,
+                          (dtype, bb, H, masked), "pallas fused LSTM"):
+            return bb
+    return None
 
 
 def lstm_fused_or_none(x, W, RW, b, peephole, h0, c0, *,
@@ -616,11 +635,11 @@ def lstm_fused_or_none(x, W, RW, b, peephole, h0, c0, *,
     if not interpret and not _platform_ok():
         return None
     masked = mask is not None
-    if not interpret:
-        key = (jnp.dtype(x.dtype).name, _batch_block(B), H, masked)
-        if not _probe_verdict(_probe_cache, key, _eager_probe,
-                              (x.dtype, _batch_block(B), H, masked),
-                              "pallas fused LSTM"):
+    if interpret:
+        bb = _batch_block(B)  # no probe: the interpreter always works
+    else:
+        bb = _probed_batch_block(x.dtype, B, H, masked)
+        if bb is None:
             return None
     # time-major input projection: ONE big GEMM, with the transpose to the
     # layout the kernel streams fused into the GEMM output
@@ -644,9 +663,9 @@ def lstm_fused_or_none(x, W, RW, b, peephole, h0, c0, *,
             m_slab = jnp.broadcast_to(m[..., None].astype(x.dtype),
                                       (T, B, H))
             h_tbh, hT, cT = _lstm_core_masked(xw, RW, peep, h0, c0,
-                                              m_slab, interpret)
+                                              m_slab, interpret, bb)
         else:
-            h_tbh, cT = _lstm_core(xw, RW, peep, h0, c0, interpret)
+            h_tbh, cT = _lstm_core(xw, RW, peep, h0, c0, interpret, bb)
             hT = None
     except Exception as e:  # per-shape staging failure: fall back
         logger.warning("pallas fused LSTM declined for shape %s (%s)",

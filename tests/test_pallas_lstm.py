@@ -209,3 +209,34 @@ def test_masked_gradients_match_scan_f64():
                     jax.tree_util.tree_leaves(g_fus)):
         np.testing.assert_allclose(np.asarray(f), np.asarray(r),
                                    rtol=1e-9, atol=1e-11)
+
+
+def test_probe_falls_back_to_smaller_batch_block(monkeypatch):
+    """A failed probe at the largest batch block must fall through to the
+    next dividing candidate instead of declining the kernel outright
+    (advisor r3: a VMEM overflow at bb=512 with large H cached False and
+    disabled the fused path entirely)."""
+    from deeplearning4j_tpu.ops import pallas_lstm as mod
+
+    calls = []
+
+    def fake_probe(dtype, bb, H, masked=False):
+        calls.append(bb)
+        return bb <= 64  # big tiles "overflow VMEM"
+
+    monkeypatch.setattr(mod, "_eager_probe", fake_probe)
+    monkeypatch.setattr(mod, "_probe_cache", {})
+    bb = mod._probed_batch_block(jnp.float32, 512, 128, False)
+    assert bb == 64
+    assert calls == [512, 256, 128, 64]
+    # verdicts cached per candidate: a second call probes nothing
+    calls.clear()
+    assert mod._probed_batch_block(jnp.float32, 512, 128, False) == 64
+    assert calls == []
+    # the smallest candidate still dispatches when it alone passes
+    assert mod._probed_batch_block(jnp.float32, 8, 128, False) == 8
+    # every dividing candidate failing -> decline
+    monkeypatch.setattr(mod, "_eager_probe",
+                        lambda dtype, bb, H, masked=False: False)
+    monkeypatch.setattr(mod, "_probe_cache", {})
+    assert mod._probed_batch_block(jnp.float32, 512, 128, False) is None
