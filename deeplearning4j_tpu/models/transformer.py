@@ -123,6 +123,19 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
     H = layers[block_is[0]].n_heads if block_is else 1
     params = net._params
     dtype = net.dtype
+    # mixed-precision decode: embedding/block math and the KV caches run
+    # in the net's compute dtype (bf16 halves cache bandwidth — the
+    # decode step's dominant cost); the logits head and sampling stay in
+    # the param dtype, mirroring the training step's precision policy
+    cdt = net.compute_dtype or dtype
+
+    def cast_blocks(params):
+        if cdt == dtype:
+            return params
+        from deeplearning4j_tpu.nn.precision import tree_cast
+
+        return [tree_cast(p, cdt) if i in (emb_i, *block_is) else p
+                for i, p in enumerate(params)]
 
     def block_heads(layer, p, x):
         """(B, T, d) -> per-head q, k, v (B, T, H, hd) for one block."""
@@ -150,12 +163,17 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             ffn = jax.nn.gelu(h2 @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
         return x + ffn
 
-    def final_logits(params, x):
-        """Trailing LN(s) + output head W/b on (..., d) -> (..., vocab)."""
+    def final_logits(bp, params, x):
+        """Trailing LN(s) in the compute dtype (`bp`), then the output
+        head in the param dtype — the same precision boundary the training
+        step draws (`MultiLayerNetwork._loss_pure` casts hidden layers,
+        including trailing LNs, and restores the param dtype only for the
+        loss head)."""
         for i in ln_is:
             if i > max(block_is, default=-1):
-                x = layer_norm(x, params[i]["gamma"], params[i]["beta"],
+                x = layer_norm(x, bp[i]["gamma"], bp[i]["beta"],
                                layers[i].eps)
+        x = x.astype(dtype)
         return x @ params[out_i]["W"] + params[out_i]["b"]
 
     def sample(logits, key):
@@ -181,52 +199,67 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
     def prefill(params, ids, key):
         from deeplearning4j_tpu.ops.attention import full_attention
 
-        x = params[emb_i]["W"][ids] + params[emb_i]["P"][:T0]
-        x = x.astype(dtype)
+        bp = cast_blocks(params)
+        x = bp[emb_i]["W"][ids] + bp[emb_i]["P"][:T0]
+        x = x.astype(cdt)
         caches = []
         for i in block_is:
-            p = params[i]
+            p = bp[i]
             q, k, v = block_heads(layers[i], p, x)
             att = full_attention(q, k, v, causal=True)
             d = x.shape[-1]
             att = att.reshape(B, T0, d) @ p["Wo"] + p["bo"]
             x = block_ffn(layers[i], p, x + att)
-            # fixed-size (B, L, H, hd) caches so the decode scan has one
-            # static shape; rows >= T0 are filled during decode
-            pad = jnp.zeros((B, L - T0, H, k.shape[-1]), k.dtype)
-            caches.append((jnp.concatenate([k, pad], axis=1),
-                           jnp.concatenate([v, pad], axis=1)))
-        logits = final_logits(params, x[:, -1])
+            # fixed-size caches so the decode scan has one static shape;
+            # positions >= T0 are filled during decode. Layouts are the
+            # TPU decode-friendly ones: K as (B, H, hd, L) so the score
+            # einsum contracts hd with L on the minor (lane) axis, V as
+            # (B, H, L, hd) so the weighted sum contracts L with hd minor
+            # — the (B, L, H, hd) layout made each step's cache read a
+            # strided transpose and dominated decode device time
+            hd = k.shape[-1]
+            kc = jnp.transpose(k, (0, 2, 3, 1))          # (B, H, hd, T0)
+            vc = jnp.transpose(v, (0, 2, 1, 3))          # (B, H, T0, hd)
+            kc = jnp.concatenate(
+                [kc, jnp.zeros((B, H, hd, L - T0), k.dtype)], axis=3)
+            vc = jnp.concatenate(
+                [vc, jnp.zeros((B, H, L - T0, hd), v.dtype)], axis=2)
+            caches.append((kc, vc))
+        logits = final_logits(bp, params, x[:, -1])
         return sample(logits, key), caches
 
     @jax.jit
     def decode(params, tok0, caches, key0):
+        bp = cast_blocks(params)
+
         def body(carry, t):
             tok, caches, key = carry
             key, sub = jax.random.split(key)
             pos = T0 + t  # position of the token being consumed
-            x = params[emb_i]["W"][tok] + params[emb_i]["P"][pos]
-            x = x.astype(dtype)
+            x = bp[emb_i]["W"][tok] + bp[emb_i]["P"][pos]
+            x = x.astype(cdt)
             new_caches = []
             for bi, i in enumerate(block_is):
-                p = params[i]
+                p = bp[i]
                 q, k, v = block_heads(layers[i], p, x[:, None, :])
                 kc, vc = caches[bi]
-                kc = jax.lax.dynamic_update_slice(
-                    kc, k, (0, pos, 0, 0))
-                vc = jax.lax.dynamic_update_slice(
-                    vc, v, (0, pos, 0, 0))
                 hd = q.shape[-1]
-                s = jnp.einsum("bhd,blhd->bhl", q[:, 0],
+                # k (B,1,H,hd) -> one (B,H,hd,1) lane column at pos;
+                # v -> one (B,H,1,hd) row at pos
+                kc = jax.lax.dynamic_update_slice(
+                    kc, jnp.transpose(k, (0, 2, 3, 1)), (0, 0, 0, pos))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, jnp.transpose(v, (0, 2, 1, 3)), (0, 0, pos, 0))
+                s = jnp.einsum("bhd,bhdl->bhl", q[:, 0],
                                kc) / jnp.sqrt(jnp.asarray(hd, q.dtype))
                 s = jnp.where(jnp.arange(L)[None, None, :] <= pos, s,
                               -jnp.inf)
                 w = jax.nn.softmax(s, axis=-1)
-                att = jnp.einsum("bhl,blhd->bhd", w, vc)
+                att = jnp.einsum("bhl,bhld->bhd", w, vc)
                 att = att.reshape(B, -1) @ p["Wo"] + p["bo"]
                 x = block_ffn(layers[i], p, x + att)
                 new_caches.append((kc, vc))
-            logits = final_logits(params, x)
+            logits = final_logits(bp, params, x)
             nxt = sample(logits, sub)
             return (nxt, new_caches, key), nxt
         _, toks = jax.lax.scan(
