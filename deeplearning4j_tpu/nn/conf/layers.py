@@ -1305,9 +1305,15 @@ class MoELayer(FeedForwardLayer):
     No counterpart in the reference. Math is
     `parallel/experts.moe_apply_reference` (global-capacity semantics); the
     load-balancing loss is contributed via `ops/aux_loss.add_aux_loss`, so
-    it only takes effect during training (`_loss_pure` collects it). For
-    expert-PARALLEL execution over a mesh use `parallel/experts.moe_apply`
-    directly in a custom step."""
+    it only takes effect during training (`_loss_pure` collects it).
+
+    Expert-PARALLEL execution is a network feature: set
+    `expert_axis="expert"` and train through `ParallelWrapper` over a mesh
+    with that axis (sized n_experts). The wrapper shards the stacked
+    expert weights over the axis and this layer routes tokens through
+    `moe_apply`'s all_to_all inside the compiled step; without a wrapper
+    (or off-mesh) the layer falls back to the replicated path, so the same
+    config runs anywhere."""
 
     TYPE = "moe"
     input_kind = "rnn"
@@ -1317,6 +1323,7 @@ class MoELayer(FeedForwardLayer):
     hidden_mult: int = 4
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    expert_axis: Optional[str] = None
     # expert hidden activation; a dedicated field (not `activation`) so the
     # builder's global activation default (sigmoid) cannot silently change
     # the expert nonlinearity — set explicitly to override
@@ -1347,7 +1354,11 @@ class MoELayer(FeedForwardLayer):
         }
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.parallel.experts import switch_ffn
+        from deeplearning4j_tpu.parallel.experts import (
+            current_expert_mesh,
+            switch_ffn,
+            switch_ffn_sharded,
+        )
 
         x = self._maybe_dropout(x, train, rng)
         shape = x.shape
@@ -1357,6 +1368,21 @@ class MoELayer(FeedForwardLayer):
         token_mask = (mask.reshape(-1) if mask is not None
                       and len(shape) == 3 else None)
         act = activation_fn(self.expert_activation)
+        scope = current_expert_mesh()
+        if (self.expert_axis and scope is not None
+                and self.expert_axis in scope[0].shape):
+            if token_mask is not None:
+                raise NotImplementedError(
+                    "masked sequences are not supported on the expert-"
+                    "parallel path yet — train unmasked batches, or drop "
+                    "expert_axis to use the replicated path")
+            mesh, data_axis = scope
+            y = switch_ffn_sharded(
+                params, tokens, mesh, axis_name=self.expert_axis,
+                data_axis=data_axis, act=act,
+                capacity_factor=self.capacity_factor,
+                aux_weight=self.aux_loss_weight, train=train)
+            return y.reshape(shape), state
         y = switch_ffn(params, tokens, act=act,
                        capacity_factor=self.capacity_factor,
                        aux_weight=self.aux_loss_weight,

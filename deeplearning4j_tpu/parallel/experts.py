@@ -16,7 +16,8 @@ routing is by sort/scatter, no data-dependent control flow.
 """
 from __future__ import annotations
 
-from typing import Callable, Tuple
+import contextlib
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,28 @@ import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# -- expert-parallel mesh scope ------------------------------------------
+# ParallelWrapper enters this scope inside its (traced) step so that
+# MoELayer.forward — which has no mesh in its signature — can discover the
+# mesh and route through the all_to_all path. Trace-time state: the scope
+# is active while jit traces the step, and costs nothing afterwards.
+_MESH_SCOPE: list = []
+
+
+@contextlib.contextmanager
+def expert_mesh_scope(mesh: Mesh, data_axis: Optional[str] = None):
+    """Declare the active mesh (and its data axis, if any) for expert-
+    parallel MoE layers traced within the scope."""
+    _MESH_SCOPE.append((mesh, data_axis))
+    try:
+        yield
+    finally:
+        _MESH_SCOPE.pop()
+
+
+def current_expert_mesh() -> Optional[Tuple[Mesh, Optional[str]]]:
+    return _MESH_SCOPE[-1] if _MESH_SCOPE else None
 
 
 def router_probs(x: jnp.ndarray, router_w: jnp.ndarray) -> jnp.ndarray:
@@ -109,6 +132,7 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
               router_w: jnp.ndarray, mesh: Mesh, *,
               axis_name: str = "expert", capacity_factor: float = 1.25,
               passthrough: str = "identity",
+              data_axis: Optional[str] = None,
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel MoE: experts sharded over `axis_name`, token
     dispatch/return via all_to_all. `passthrough` as in
@@ -122,6 +146,11 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     valid Switch semantics; don't expect bitwise agreement when routing is
     skewed and capacity is tight.
 
+    `data_axis`: composes ep with data parallelism on a 2-D mesh — tokens
+    shard over (data_axis, axis_name) jointly, the all_to_all rides the
+    expert axis within each data row, and the load-balancing loss means
+    over both axes (the network path ParallelWrapper drives).
+
     x: (N, D) tokens (flatten (B, T, D) first); stacked_params: pytree with
     leading expert dim E == mesh axis size; router_w: (D, E).
     """
@@ -130,14 +159,19 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
     if leaf.shape[0] != E:
         raise ValueError(f"{leaf.shape[0]} experts but mesh axis "
                          f"'{axis_name}' has size {E}")
+    dp = mesh.shape.get(data_axis, 1) if data_axis else 1
     N, D = x.shape
-    if N % E:
-        raise ValueError(f"token count {N} not divisible by expert axis {E}")
-    capacity = int(np.ceil(N / E * capacity_factor))
+    if N % (E * dp):
+        raise ValueError(f"token count {N} not divisible by expert axis "
+                         f"{E} x data axis {dp}")
+    # capacity derives from the tokens ONE DATA ROW routes among E experts
+    # (dp=1 reduces to the global formula)
+    capacity = int(np.ceil(N / dp / E * capacity_factor))
     # per-device capacity slice must be whole
     capacity = int(np.ceil(capacity / E) * E)
     if passthrough not in ("identity", "zero"):
         raise ValueError(f"unknown passthrough {passthrough!r}")
+    reduce_axes = (data_axis, axis_name) if dp > 1 else axis_name
 
     def local(stage_p, x_local, rw):
         # x_local: (N/E, D) this device's token shard; stage_p: this
@@ -166,16 +200,42 @@ def moe_apply(expert_fn: Callable, stacked_params, x: jnp.ndarray,
         y = jnp.where(keep[:, None], gate[:, None] * y_expert, dropped)
         frac = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
         mean_prob = jnp.mean(probs, axis=0)
-        aux = E * jnp.sum(lax.pmean(frac, axis_name)
-                          * lax.pmean(mean_prob, axis_name))
+        aux = E * jnp.sum(lax.pmean(frac, reduce_axes)
+                          * lax.pmean(mean_prob, reduce_axes))
         return y, aux
 
-    tok = P(axis_name)
+    tok = P((data_axis, axis_name)) if dp > 1 else P(axis_name)
     y, aux = shard_map(local, mesh=mesh,
                        in_specs=(P(axis_name), tok, P()),
                        out_specs=(tok, P()), check_vma=False)(
         stacked_params, x, router_w)
     return y, aux
+
+
+def switch_ffn_sharded(params, tokens: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: str, data_axis: Optional[str],
+                       act: Callable, capacity_factor: float,
+                       aux_weight: float, train: bool = False,
+                       passthrough: str = "identity") -> jnp.ndarray:
+    """Expert-PARALLEL twin of `switch_ffn`: same stacked router/W1/b1/W2/b2
+    params and aux-loss contract, dispatch through `moe_apply`'s
+    all_to_all over `axis_name` (composing with data parallelism over
+    `data_axis`). This is the network-step path MoELayer(expert_axis=...)
+    takes under ParallelWrapper."""
+    from deeplearning4j_tpu.ops.aux_loss import add_aux_loss
+
+    def expert_fn(p, t):
+        return act(t @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+
+    stacked = {"W1": params["W1"], "b1": params["b1"],
+               "W2": params["W2"], "b2": params["b2"]}
+    y, aux = moe_apply(expert_fn, stacked, tokens, params["router"], mesh,
+                       axis_name=axis_name, data_axis=data_axis,
+                       capacity_factor=capacity_factor,
+                       passthrough=passthrough)
+    if train:
+        add_aux_loss(aux_weight * aux)
+    return y
 
 
 def switch_ffn(params, tokens: jnp.ndarray, *, act: Callable,

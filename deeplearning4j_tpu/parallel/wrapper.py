@@ -64,7 +64,55 @@ class ParallelWrapper:
         # per-layer dicts for MultiLayerNetwork and a DICT keyed by vertex
         # name for ComputationGraph — param_specs keys follow the same scheme
         # (layer index or vertex name).
-        specs = param_specs or {}
+        specs = {k: dict(v) for k, v in (param_specs or {}).items()}
+
+        # expert parallelism as a network feature: a MoELayer carrying
+        # expert_axis gets its stacked expert weights sharded one-per-device
+        # over that axis (router replicated), and the step is traced inside
+        # expert_mesh_scope so the layer routes via moe_apply's all_to_all
+        # (reference seam analogue: `ParallelWrapper.java:46-52` — every
+        # parallelism axis hangs off the unchanged user API)
+        self._expert_layers = []
+        self._expert_axes = set()
+        if isinstance(net._params, dict):
+            # ComputationGraph: the expert-sharding seam below indexes MLN
+            # layer positions; fail fast rather than silently training
+            # E-times-replicated experts the user asked to shard
+            for name, node in getattr(net.conf, "nodes", {}).items():
+                if (getattr(node, "is_layer", False)
+                        and getattr(node.layer, "expert_axis", None)):
+                    raise NotImplementedError(
+                        f"vertex '{name}': expert_axis on a "
+                        "ComputationGraph is not supported yet — use a "
+                        "MultiLayerNetwork for expert-parallel MoE, or "
+                        "drop expert_axis to train replicated experts")
+        for i, layer in enumerate(getattr(net, "layers", []) or []):
+            ax = getattr(layer, "expert_axis", None)
+            if not ax:
+                continue
+            if ax not in self.mesh.shape:
+                raise ValueError(
+                    f"layer {i} wants expert_axis '{ax}' but the mesh axes "
+                    f"are {dict(self.mesh.shape)}")
+            if layer.n_experts != self.mesh.shape[ax]:
+                raise ValueError(
+                    f"layer {i} has {layer.n_experts} experts but mesh axis "
+                    f"'{ax}' has size {self.mesh.shape[ax]} — expert-"
+                    f"parallel execution shards one expert per device")
+            self._expert_layers.append(i)
+            self._expert_axes.add(ax)
+            ep = specs.setdefault(i, {})
+            for name in ("W1", "b1", "W2", "b2"):
+                ep.setdefault(name, P(ax))
+        if self._expert_layers and net.conf.tbptt_fwd_length > 0:
+            # tBPTT pads the tail window with a synthesized mask, which the
+            # expert-parallel path rejects — mid-epoch, after partial
+            # updates. Reject the combination up front instead.
+            raise NotImplementedError(
+                "expert_axis with truncated BPTT is not supported yet "
+                "(the padded tail window is masked, and masked tokens "
+                "cannot ride the expert-parallel dispatch) — drop "
+                "expert_axis or disable tbptt")
 
         def _layer_sh(key, p):
             return {name: NamedSharding(self.mesh, specs.get(key, {}).get(name, P()))
@@ -93,7 +141,7 @@ class ParallelWrapper:
 
         self._jit_step_tbptt = None
         self._tbptt_lstate_sh = None
-        step = self._wrap_step(net.train_step_fn())
+        step = self._with_expert_scope(self._wrap_step(net.train_step_fn()))
         self._jit_step = jax.jit(
             step,
             in_shardings=(self._param_sh, self._upd_sh, self._lstate_sh,
@@ -128,6 +176,22 @@ class ParallelWrapper:
     def _wrap_step(self, step):
         return step
 
+    def _with_expert_scope(self, step):
+        """Trace the step inside expert_mesh_scope when the net has
+        expert-parallel MoE layers (the scope is consulted at trace time;
+        compiled steps carry no runtime cost)."""
+        if not self._expert_layers:
+            return step
+        from deeplearning4j_tpu.parallel.experts import expert_mesh_scope
+
+        data_axis = (self.data_axis if self.data_axis in self.mesh.shape
+                     else None)
+
+        def scoped(*args):
+            with expert_mesh_scope(self.mesh, data_axis):
+                return step(*args)
+        return scoped
+
     def _batch_shardings(self):
         """(features, labels, fmask, lmask) shardings."""
         return (self._batch_sh,) * 4
@@ -138,10 +202,21 @@ class ParallelWrapper:
 
     def _shard_batch(self, ds):
         """Trim the batch to a multiple of the data-axis size (DataSet or
-        MultiDataSet)."""
+        MultiDataSet). With expert-parallel layers the token count must
+        also divide by every expert axis x dp (moe_apply's all_to_all is
+        static-shaped), so trim further until B*T satisfies it — otherwise
+        an uneven final iterator batch would crash mid-epoch."""
         n_data = self.mesh.shape.get(self.data_axis, 1)
         B = ds.num_examples()
         usable = (B // n_data) * n_data
+        if self._expert_layers and usable:
+            f = ds.features[0] if isinstance(ds.features, list) else ds.features
+            T = f.shape[1] if f.ndim == 3 else 1
+            need = n_data
+            for ax in self._expert_axes:
+                need = int(np.lcm(need, self.mesh.shape[ax] * n_data))
+            while usable and (usable * T) % need:
+                usable -= n_data
         if usable == 0:
             logger.warning("dropping batch of %d < %d devices", B, n_data)
             return None
@@ -226,7 +301,8 @@ class ParallelWrapper:
             for key in saved:
                 lstate_sh[key] = {"h": self._batch_sh, "c": self._batch_sh}
             self._tbptt_lstate_sh = lstate_sh
-            step = self._wrap_step(net.train_step_fn())
+            step = self._with_expert_scope(
+                self._wrap_step(net.train_step_fn()))
             self._jit_step_tbptt = jax.jit(
                 step,
                 in_shardings=(self._param_sh, self._upd_sh, lstate_sh,
