@@ -25,8 +25,29 @@ stateless (no BatchNormalization — per-microbatch batch stats would
 change semantics), dropout-free, and MoE-free (the aux-loss side channel
 doesn't thread through the pipeline loop); masks and tBPTT stay on
 ParallelWrapper. Same-seed loss parity vs single-device training is the
-correctness bar (`tests/test_pipeline_wrapper.py`), the analogue of the
-reference's `TestCompareParameterAveragingSparkVsSingleMachine`.
+correctness bar (`tests/test_pipeline_wrapper.py`, incl. the GPT
+TransformerBlock trunk — the model class this wrapper exists for), the
+analogue of the reference's
+`TestCompareParameterAveragingSparkVsSingleMachine`.
+
+Schedule & bubble: GPipe with M microbatches over S stages runs
+S + M - 1 pipeline ticks, of which S - 1 are fill/drain — the bubble
+fraction is (S - 1) / (S + M - 1), and jax.grad mirrors the same
+schedule backward, so the end-to-end bubble is ~2(S-1)/(2(S+M-1)) ==
+the forward fraction. Microbatch guidance: M defaults to S (bubble
+(S-1)/(2S-1), just under 50% idle); raise M toward 4S for a <20%
+bubble when the global batch allows (per-microbatch size B/M must stay
+large enough to feed the MXU — shrinking below ~128 rows per stage
+trades bubble for underutilized matmuls). Memory: GPipe stashes
+activations for all M in-flight microbatches; set TransformerBlock
+remat=True to rematerialize blocks in the backward and hold O(1)
+residuals per stage instead. A 1F1B schedule would cap the stash at S
+microbatches WITHOUT remat's recompute — but its bubble is the same
+(S-1)/(S+M-1), and under jax.grad the interleaved one-forward-one-
+backward ordering requires hand-staging the backward through the loop
+(custom_vjp over the whole schedule); remat already provides the
+memory bound at ~1/3 extra trunk FLOPs, so GPipe+remat is the chosen
+design point here.
 """
 from __future__ import annotations
 

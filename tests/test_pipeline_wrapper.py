@@ -221,3 +221,85 @@ def test_computation_graph_rejected_with_guidance():
                                                stage_filters=(8, 16, 32, 64)))
     with pytest.raises(ValueError, match="MultiLayerNetwork"):
         PipelineParallelWrapper(cg, make_mesh({"pipe": 8}))
+
+
+def _gpt_data(vocab=17, B=16, T=8, n=2, seed=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, vocab, (B, T + 1))
+        x = ids[:, :-1].astype(np.int32)
+        y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+        out.append(DataSet(x, y))
+    return out
+
+
+def test_pipeline_gpt_trunk_matches_single_device():
+    """THE flagship-pipeline bar (r3 verdict ask #5): find_trunk must
+    partition a TransformerBlock stack (the GPT trunk — embedding head and
+    LN+output tail replicated) and train with same-seed parity vs a single
+    device, attention riding the usual flash/blockwise dispatch inside the
+    pipelined stage (the dispatch probe declines Pallas on CPU and serves
+    the XLA path — the same decision path taken on chip)."""
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+
+    vocab, T = 17, 8
+    conf = lambda: gpt_configuration(vocab_size=vocab, d_model=32,
+                                     n_heads=2, n_layers=4, max_length=T,
+                                     seed=9)
+    batches = _gpt_data(vocab=vocab, T=T)
+    ref = dl4j.MultiLayerNetwork(conf())
+    ref.init()
+    ref_losses = []
+    for _ in range(2):
+        for ds in batches:
+            ref.fit(ds)
+            ref_losses.append(ref.score_value)
+
+    net = dl4j.MultiLayerNetwork(conf())
+    net.init()
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    pw = PipelineParallelWrapper(net, mesh)
+    # the trunk is exactly the 4 TransformerBlocks: head = TokenEmbedding,
+    # tail = trailing LayerNorm + RnnOutputLayer
+    assert (pw.trunk_start, pw.trunk_end) == (1, 5)
+    pipe_losses = []
+    for _ in range(2):
+        for ds in batches:
+            pw.fit(ds)
+            pipe_losses.append(net.score_value)
+
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=2e-4,
+                               atol=2e-5)
+    for pr, pp in zip(jax.tree_util.tree_leaves(ref._params),
+                      jax.tree_util.tree_leaves(net._params)):
+        np.testing.assert_allclose(np.asarray(pp), np.asarray(pr),
+                                   rtol=3e-4, atol=3e-5)
+    # the synced net serves inference (generate-style output) unchanged
+    probs = net.output(np.asarray(batches[0].features)[:4])
+    assert probs.shape == (4, T, vocab)
+
+
+def test_pipeline_gpt_trunk_2d_dp_pp():
+    """GPT trunk over a 2-D {data, pipe} mesh: batches shard over data,
+    TransformerBlock stages over pipe."""
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+
+    vocab, T = 17, 8
+    conf = lambda: gpt_configuration(vocab_size=vocab, d_model=32,
+                                     n_heads=2, n_layers=2, max_length=T,
+                                     seed=9)
+    batches = _gpt_data(vocab=vocab, T=T, n=1)
+    ref = dl4j.MultiLayerNetwork(conf())
+    ref.init()
+    for _ in range(3):
+        ref.fit(batches[0])
+
+    net = dl4j.MultiLayerNetwork(conf())
+    net.init()
+    mesh = make_mesh({"data": 2, "pipe": 2}, devices=jax.devices()[:4])
+    pw = PipelineParallelWrapper(net, mesh, data_axis="data")
+    for _ in range(3):
+        pw.fit(batches[0])
+    np.testing.assert_allclose(net.score_value, ref.score_value,
+                               rtol=2e-4, atol=2e-5)
