@@ -30,15 +30,48 @@ class LexEntry:
     cost: float = 0.7
 
 
+# leaf sentinel for the trie: a key that can never collide with a single
+# character edge
+_LEAF = ""
+
+
 class Lexicon:
-    """Surface-form dictionary with per-entry cost/POS."""
+    """Surface-form dictionary with per-entry cost/POS.
+
+    Lookup structure: a character trie (the `kuromoji/trie/DoubleArrayTrie`
+    role — reference `deeplearning4j-nlp-japanese/.../kuromoji/trie/`).
+    The lattice asks "which dictionary entries start at position i?", and
+    the trie answers with ONE incremental traversal that stops at the
+    first missing child — per-position cost is bounded by the longest
+    real prefix in the text, not by `max_len` probes each allocating a
+    substring, so a 50k+-entry dictionary with long surfaces costs the
+    same per position as a toy one (`tests/test_lexicon_loader.py`
+    latency bound)."""
 
     def __init__(self, entries: Iterable[LexEntry]):
         self._by_surface: Dict[str, LexEntry] = {}
+        self._trie: Dict = {}
         self.max_len = 1
         for e in entries:
             self._by_surface[e.surface] = e
             self.max_len = max(self.max_len, len(e.surface))
+            node = self._trie
+            for ch in e.surface:
+                node = node.setdefault(ch, {})
+            node[_LEAF] = e
+
+    def prefixes(self, text: str, i: int, end: int):
+        """Yield (j, entry) for every dictionary entry matching
+        text[i:j] — one trie walk, no substring allocation."""
+        node = self._trie
+        while i < end:
+            node = node.get(text[i])
+            if node is None:
+                return
+            i += 1
+            e = node.get(_LEAF)
+            if e is not None:
+                yield i, e
 
     @classmethod
     def from_entries(cls, words: Iterable[Tuple[str, str]],
@@ -172,16 +205,14 @@ def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
     for i in range(n):
         if best[i] == INF:
             continue
-        # dictionary matches starting at i
-        for ln in range(1, min(lexicon.max_len, n - i) + 1):
-            surf = chunk[i:i + ln]
-            e = lexicon.lookup(surf)
-            if e is None:
-                continue
-            c = best[i] + max(0.1, e.cost - _KNOWN_LEN_BONUS * (ln - 1))
-            if c < best[i + ln]:
-                best[i + ln] = c
-                back[i + ln] = (i, surf, e.pos)
+        # dictionary matches starting at i: ONE trie traversal yields
+        # every matching prefix (stops at the first missing child — cost
+        # no longer max_len probes x substring allocations per position)
+        for j, e in lexicon.prefixes(chunk, i, n):
+            c = best[i] + max(0.1, e.cost - _KNOWN_LEN_BONUS * (j - i - 1))
+            if c < best[j]:
+                best[j] = c
+                back[j] = (i, e.surface, e.pos)
         # unknown fallbacks: the maximal script run starting at i (never
         # zero-length, so the lattice always reaches n) AND a single-char
         # edge, so an OOV prefix cannot swallow in-vocabulary words later
