@@ -49,6 +49,40 @@ class DataSet:
         if self.labels_mask is not None:
             self.labels_mask = self.labels_mask[idx]
 
+    def save(self, path) -> None:
+        """Write this DataSet to one file (reference
+        `org.nd4j.linalg.dataset.DataSet.save` — the unit of the
+        batch-and-export distributed training seam). npz: the arrays keep
+        dtype/shape exactly; absent masks/labels are simply omitted."""
+        arrays = {"features": self.features}
+        for name in ("labels", "features_mask", "labels_mask"):
+            a = getattr(self, name)
+            if a is not None:
+                arrays[name] = a
+        # np.savez appends .npz when absent but np.load does not — pin the
+        # suffix here so save(p); load(p) round-trips for any p
+        import os
+
+        path = os.fspath(path)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        np.savez(path, **arrays)
+
+    @staticmethod
+    def load(path) -> "DataSet":
+        """Read a DataSet written by `save` (lazy file handle closed
+        eagerly — path-based iterators open thousands of these)."""
+        import os
+
+        path = os.fspath(path)
+        if not path.endswith(".npz"):
+            path += ".npz"  # mirror of save's normalization
+        with np.load(path, allow_pickle=False) as z:
+            return DataSet(z["features"],
+                           z["labels"] if "labels" in z else None,
+                           z["features_mask"] if "features_mask" in z else None,
+                           z["labels_mask"] if "labels_mask" in z else None)
+
     def batch_by(self, batch_size: int) -> List["DataSet"]:
         out = []
         n = self.num_examples()

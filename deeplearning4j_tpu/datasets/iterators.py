@@ -120,6 +120,46 @@ class ExistingDataSetIterator(DataSetIterator):
         return -1
 
 
+class FileDataSetIterator(DataSetIterator):
+    """Iterate DataSets lazily from exported files — the path-based half
+    of the reference's export-staged training (reference
+    `FileSplitDataSetIterator.java` / `ExistingMiniBatchDataSetIterator`):
+    only one file's arrays are in memory at a time, so the training set
+    may be far larger than host RAM.
+
+    `paths`: an iterable of file paths, or a directory (every `*.npz`
+    inside, sorted by name — the order `batch_and_export` numbers them)."""
+
+    def __init__(self, paths):
+        import os
+
+        if isinstance(paths, (str, os.PathLike)) and os.path.isdir(paths):
+            self.paths = sorted(
+                os.path.join(paths, f) for f in os.listdir(paths)
+                if f.endswith(".npz"))
+        else:
+            self.paths = [os.fspath(p) for p in paths]
+        if not self.paths:
+            raise ValueError("no exported dataset files to iterate")
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.paths)
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        ds = DataSet.load(self.paths[self._pos])
+        self._pos += 1
+        return ds
+
+    def batch(self):
+        return -1
+
+
 class MultipleEpochsIterator(DataSetIterator):
     """Replay an underlying iterator N times (reference
     `MultipleEpochsIterator.java`)."""

@@ -215,6 +215,17 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def get_training_stats(self) -> Optional[TrainingStats]:
         return self._stats
 
+    def execute_training_paths(self, net, paths) -> None:
+        """Train from EXPORTED dataset shards (files written by
+        `parallel/export.batch_and_export`) — the reference's second RDD
+        training approach (`RDDTrainingApproach.Export`,
+        `executeTrainingPathsHelper:506`): workers stream batches from
+        paths one file at a time, so the training set never has to fit in
+        memory. Same averaging schedule as `execute_training`."""
+        from deeplearning4j_tpu.datasets.iterators import FileDataSetIterator
+
+        self.execute_training(net, FileDataSetIterator(paths))
+
     def execute_training(self, net, iterator: DataSetIterator) -> None:
         net._ensure_init()
         worker = self._worker_factory or ParameterAveragingTrainingWorker(net)

@@ -269,3 +269,109 @@ def test_repartition_never_still_trains():
     before = net.params().copy()
     master.execute_training(net, ListDataSetIterator(_batches(5)))
     assert not np.allclose(before, net.params())
+
+
+def test_export_staged_training_parity(tmp_path):
+    """The reference's second RDD training approach
+    (RDDTrainingApproach.Export / BatchAndExportDataSetsFunction): batch,
+    export to files, train from paths — must EXACTLY equal training from
+    the in-memory iterator (same batches, same order)."""
+    from deeplearning4j_tpu.datasets.iterators import FileDataSetIterator
+    from deeplearning4j_tpu.parallel.export import batch_and_export
+
+    batches = _batches(6)
+    paths = batch_and_export(batches, tmp_path / "exported", batch_size=16)
+    assert len(paths) == 6
+    # round-trip fidelity: the exported stream is the original stream
+    for ds, rt in zip(batches, FileDataSetIterator(tmp_path / "exported")):
+        np.testing.assert_array_equal(rt.features, ds.features)
+        np.testing.assert_array_equal(rt.labels, ds.labels)
+
+    mem_net = _net()
+    ParameterAveragingTrainingMaster(
+        num_workers=1, averaging_frequency=3).execute_training(
+        mem_net, ListDataSetIterator(batches))
+
+    path_net = _net()
+    ParameterAveragingTrainingMaster(
+        num_workers=1, averaging_frequency=3).execute_training_paths(
+        path_net, paths)
+    np.testing.assert_allclose(path_net.params(), mem_net.params(),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_batch_and_export_rebatches_uneven_input(tmp_path):
+    """Uneven incoming batches are re-cut to a uniform size with one
+    partial tail file (the BatchAndExportDataSetsFunction contract)."""
+    from deeplearning4j_tpu.parallel.export import batch_and_export
+
+    rng = np.random.RandomState(3)
+    sizes = [10, 7, 16, 5]  # 38 examples -> 16, 16, 6
+    batches = [DataSet(rng.randn(s, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, s)])
+               for s in sizes]
+    paths = batch_and_export(batches, tmp_path / "exp", batch_size=16)
+    ns = [DataSet.load(p).num_examples() for p in paths]
+    assert ns == [16, 16, 6]
+    # example stream preserved in order
+    feats = np.concatenate([DataSet.load(p).features for p in paths])
+    np.testing.assert_array_equal(
+        feats, np.concatenate([b.features for b in batches]))
+
+
+def test_export_masks_roundtrip(tmp_path):
+    """Masked recurrent DataSets export/load with masks intact."""
+    from deeplearning4j_tpu.parallel.export import batch_and_export
+
+    rng = np.random.RandomState(4)
+    ds = DataSet(rng.randn(8, 5, 4).astype(np.float32),
+                 rng.randn(8, 5, 3).astype(np.float32),
+                 (rng.rand(8, 5) > 0.3).astype(np.float32),
+                 (rng.rand(8, 5) > 0.3).astype(np.float32))
+    paths = batch_and_export([ds], tmp_path / "m", batch_size=4)
+    assert len(paths) == 2
+    back = DataSet.load(paths[0])
+    np.testing.assert_array_equal(back.features_mask, ds.features_mask[:4])
+    np.testing.assert_array_equal(back.labels_mask, ds.labels_mask[:4])
+
+
+def test_batch_and_export_clears_stale_shards(tmp_path):
+    """Re-export to the same directory must not leave stale shards for
+    directory-mode FileDataSetIterator to silently mix in."""
+    from deeplearning4j_tpu.datasets.iterators import FileDataSetIterator
+    from deeplearning4j_tpu.parallel.export import batch_and_export
+
+    d = tmp_path / "exp"
+    batch_and_export(_batches(6), d, batch_size=16)
+    paths = batch_and_export(_batches(2, seed=9), d, batch_size=16)
+    assert len(paths) == 2
+    assert len(FileDataSetIterator(d).paths) == 2
+
+
+def test_export_mixed_mask_stream(tmp_path):
+    """Mixed masked/unmasked batches export via DataSet.merge semantics
+    (absent mask == all valid), same as in-memory re-batching."""
+    from deeplearning4j_tpu.parallel.export import batch_and_export
+
+    rng = np.random.RandomState(5)
+    a = DataSet(rng.randn(10, 5, 4).astype(np.float32),
+                rng.randn(10, 5, 3).astype(np.float32),
+                (rng.rand(10, 5) > 0.3).astype(np.float32))
+    b = DataSet(rng.randn(6, 5, 4).astype(np.float32),
+                rng.randn(6, 5, 3).astype(np.float32))
+    paths = batch_and_export([a, b], tmp_path / "mix", batch_size=16)
+    assert len(paths) == 1
+    back = DataSet.load(paths[0])
+    np.testing.assert_array_equal(back.features_mask[:10], a.features_mask)
+    np.testing.assert_array_equal(back.features_mask[10:],
+                                  np.ones((6, 5), np.float32))
+
+
+def test_dataset_save_load_suffixless_roundtrip(tmp_path):
+    rng = np.random.RandomState(6)
+    ds = DataSet(rng.randn(4, 3).astype(np.float32))
+    p = tmp_path / "shard"          # no .npz suffix
+    ds.save(p)
+    back = DataSet.load(p)
+    np.testing.assert_array_equal(back.features, ds.features)
+    assert back.labels is None
