@@ -254,10 +254,17 @@ class ComponentDiv(Component):
         return "".join(c._svg() for c in self._children())
 
 
-def render_html(component: Component, title: str = "deeplearning4j_tpu report") -> str:
+def render_html(component: Component, title: str = "deeplearning4j_tpu report",
+                refresh_seconds: int = 0) -> str:
     """Standalone HTML document for a component tree (the
-    `EvaluationTools.exportevaluation`-style artifact)."""
-    return (f"<!DOCTYPE html><html><head><title>{html.escape(title)}</title>"
+    `EvaluationTools.exportevaluation`-style artifact). `refresh_seconds`
+    > 0 adds a meta-refresh so server-rendered dashboard pages update
+    during a running fit (the Play UI's pages poll; meta-refresh is the
+    zero-asset equivalent)."""
+    meta = (f'<meta http-equiv="refresh" content="{int(refresh_seconds)}">'
+            if refresh_seconds > 0 else "")
+    return (f"<!DOCTYPE html><html><head>{meta}"
+            f"<title>{html.escape(title)}</title>"
             f"<style>body{{font-family:sans-serif;margin:2em}}"
             f"table{{border-collapse:collapse}}</style></head>"
             f"<body>{component._svg()}</body></html>")

@@ -15,22 +15,10 @@ from typing import List, Optional
 
 from deeplearning4j_tpu.ui.storage import StatsRecord, StatsStorage
 
-_PAGE = """<!DOCTYPE html>
-<html><head><title>deeplearning4j_tpu training UI</title>
-<style>
- body {{ font-family: sans-serif; margin: 2em; }}
- .chart {{ border: 1px solid #ccc; margin-bottom: 1.5em; }}
- h2 {{ margin-bottom: 0.2em; }}
-</style></head>
-<body>
-<h1>Training overview</h1>
-<div id="meta"></div>
-<h2>Score vs iteration</h2>
-<svg id="score" class="chart" width="800" height="300"></svg>
-<h2>Parameter mean magnitudes</h2>
-<svg id="params" class="chart" width="800" height="300"></svg>
-<script>
-function poly(svg, xs, ys, color) {{
+# shared chart + poll scaffolding, interpolated into every live page so
+# a fix lands once (the doubled-brace bug had to be fixed twice before)
+_CHART_JS = """
+function poly(svg, xs, ys, color) {
   if (xs.length < 2) return;
   const W = svg.clientWidth || 800, H = svg.clientHeight || 300, pad = 30;
   const xmin = Math.min(...xs), xmax = Math.max(...xs);
@@ -43,8 +31,27 @@ function poly(svg, xs, ys, color) {{
   p.setAttribute('fill', 'none');
   p.setAttribute('stroke', color);
   svg.appendChild(p);
-}}
-async function refresh() {{
+}
+const COLORS = ['#d62728', '#2ca02c', '#9467bd', '#ff7f0e', '#17becf',
+                '#1f77b4', '#8c564b', '#e377c2'];
+"""
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; }
+ .chart { border: 1px solid #ccc; margin-bottom: 1.5em; }
+ h2 { margin-bottom: 0.2em; }
+</style></head>
+<body>
+<h1>Training overview</h1>
+<div id="meta"></div>
+<h2>Score vs iteration</h2>
+<svg id="score" class="chart" width="800" height="300"></svg>
+<h2>Parameter mean magnitudes</h2>
+<svg id="params" class="chart" width="800" height="300"></svg>
+<script>""" + _CHART_JS + """
+async function refresh() {
   const r = await fetch('/train/overview/data');
   const d = await r.json();
   document.getElementById('meta').textContent =
@@ -54,13 +61,74 @@ async function refresh() {{
   poly(svg, d.iterations, d.scores, '#1f77b4');
   const ps = document.getElementById('params');
   ps.innerHTML = '';
-  const colors = ['#d62728', '#2ca02c', '#9467bd', '#ff7f0e', '#17becf'];
   let ci = 0;
-  for (const [name, series] of Object.entries(d.param_mean_magnitudes)) {{
-    poly(ps, d.iterations.slice(-series.length), series, colors[ci++ % colors.length]);
-  }}
-}}
+  for (const [name, series] of Object.entries(d.param_mean_magnitudes)) {
+    poly(ps, d.iterations.slice(-series.length), series, COLORS[ci++ % COLORS.length]);
+  }
+}
 refresh(); setInterval(refresh, 5000);
+</script>
+</body></html>
+"""
+
+
+_MODEL_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu model</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; }
+ .chart { border: 1px solid #ccc; margin-bottom: 1.5em; }
+ table { border-collapse: collapse; margin-bottom: 1.5em; }
+ td, th { border: 1px solid #ccc; padding: 4px 10px; text-align: right; }
+ th { background: #f4f4f4; }
+ td:first-child, th:first-child { text-align: left; }
+</style></head>
+<body>
+<h1>Model</h1>
+<div id="meta"></div>
+<h2>Parameter table (latest iteration)</h2>
+<table id="ptable"><thead><tr><th>parameter</th><th>mean</th>
+<th>stdev</th><th>mean |w|</th></tr></thead><tbody></tbody></table>
+<h2>Mean |w| vs iteration (per parameter)</h2>
+<svg id="pchart" class="chart" width="800" height="300"></svg>
+<div id="legend"></div>
+<script>""" + _CHART_JS + """
+function cell(row, text) {
+  const td = document.createElement('td');
+  td.textContent = text;     // names come from untrusted remote stats
+  row.appendChild(td);       // records: textContent, never innerHTML
+}
+async function refresh() {
+  const r = await fetch('/train/model/data');
+  const d = await r.json();
+  document.getElementById('meta').textContent =
+    'session: ' + d.session_id + '  model: ' + (d.static.model_class || '?')
+    + '  params: ' + (d.static.n_params || '?')
+    + '  iteration: ' + d.latest_iteration;
+  const tb = document.querySelector('#ptable tbody');
+  tb.innerHTML = '';
+  const svg = document.getElementById('pchart');
+  svg.innerHTML = '';
+  const legend = document.getElementById('legend');
+  legend.innerHTML = '';
+  let ci = 0;
+  for (const [name, s] of Object.entries(d.params)) {
+    const last = i => (s[i] && s[i].length ? s[i][s[i].length - 1] : NaN);
+    const row = document.createElement('tr');
+    cell(row, name);
+    cell(row, Number(last('mean')).toPrecision(4));
+    cell(row, Number(last('stdev')).toPrecision(4));
+    cell(row, Number(last('mean_magnitude')).toPrecision(4));
+    tb.appendChild(row);
+    const color = COLORS[ci++ % COLORS.length];
+    poly(svg, d.iterations.slice(-s.mean_magnitude.length),
+         s.mean_magnitude, color);
+    const span = document.createElement('span');
+    span.style.color = color;
+    span.textContent = '\u25A0 ' + name + '  ';
+    legend.appendChild(span);
+  }
+}
+refresh(); setInterval(refresh, 3000);
 </script>
 </body></html>
 """
@@ -99,6 +167,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"sessions": ui._session_ids()})
         if self.path == "/train/model":
             return self._json(ui._model_data())
+        if self.path == "/train/model/page":
+            return self._html(_MODEL_PAGE)
+        if self.path == "/train/model/data":
+            return self._json(ui._model_series())
         if self.path == "/train/system":
             return self._json(ui._system_data())
         if self.path == "/train/histograms":
@@ -220,6 +292,28 @@ class UIServer:
                 "static": static[-1].data if static else {},
                 "latest": latest.data if latest else {}}
 
+    def _model_series(self):
+        """Model-page feed: static info + full per-parameter stat series
+        (the reference TrainModule model tab's per-layer charts)."""
+        storage, sid = self._latest_session()
+        if storage is None:
+            return {"session_id": None, "static": {}, "iterations": [],
+                    "params": {}, "latest_iteration": None}
+        static = storage.get_records(sid, type_id="static_info")
+        recs = storage.get_records(sid, type_id="stats")
+        iterations = [r.data.get("iteration") for r in recs]
+        params: dict = {}
+        for r in recs:
+            for name, st in (r.data.get("parameters") or {}).items():
+                slot = params.setdefault(
+                    name, {"mean": [], "stdev": [], "mean_magnitude": []})
+                for k in slot:
+                    slot[k].append(st.get(k))
+        return {"session_id": sid,
+                "static": static[-1].data if static else {},
+                "iterations": iterations, "params": params,
+                "latest_iteration": iterations[-1] if iterations else None}
+
     def _system_data(self):
         """System page feed (reference TrainModule system tab: JVM/GC; here
         host RSS + device HBM per iteration)."""
@@ -269,7 +363,8 @@ class UIServer:
             for i, c in enumerate(counts):
                 ch.add_bin(lo + i * width, lo + (i + 1) * width, c)
             div.add(ch)
-        return render_html(div, title="parameter histograms")
+        return render_html(div, title="parameter histograms",
+                           refresh_seconds=5)
 
     def _latest_of_type(self, type_id: str):
         """Most recent record of a type across all sessions/storages (flow
@@ -314,4 +409,4 @@ class UIServer:
             chart.add_series("points", [c[0] for c in coords],
                              [c[1] for c in coords],
                              labels=self._tsne.get("labels"))
-        return render_html(chart, title="t-SNE")
+        return render_html(chart, title="t-SNE", refresh_seconds=10)

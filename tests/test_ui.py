@@ -323,3 +323,45 @@ def test_time_sources():
     TimeSourceProvider.reset()
     assert isinstance(TimeSourceProvider.get_instance(), MonotonicTimeSource)
     TimeSourceProvider.reset()
+
+
+def test_live_pages_serve_valid_js_and_model_series():
+    """The overview/model pages are served RAW (never .format()-ed): their
+    JS must use single braces (regression: doubled {{ }} intended for
+    .format reached the browser and made the fetch-loop a syntax error,
+    so the 'live' page never rendered), contain the poll loop, and the
+    model data feed must grow as training proceeds."""
+    import re
+
+    server = UIServer(port=0)
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        _train_with_listener(storage, n_iters=3)
+        base = f"http://127.0.0.1:{server.port}"
+        for path, feed in (("/train/overview", "/train/overview/data"),
+                           ("/train/model/page", "/train/model/data")):
+            with urllib.request.urlopen(base + path) as r:
+                page = r.read().decode()
+            js = re.search(r"<script>(.*?)</script>", page, re.S).group(1)
+            assert "{{" not in js and "}}" not in js
+            assert js.count("{") == js.count("}")
+            assert f"fetch('{feed}')" in js
+            assert "setInterval(refresh" in js
+        with urllib.request.urlopen(f"{base}/train/model/data") as r:
+            d1 = json.loads(r.read())
+        assert d1["latest_iteration"] is not None
+        assert d1["params"]  # per-parameter series present
+        series = next(iter(d1["params"].values()))
+        assert len(series["mean_magnitude"]) == len(d1["iterations"])
+        _train_with_listener(storage, n_iters=5)  # training continues...
+        with urllib.request.urlopen(f"{base}/train/model/data") as r:
+            d2 = json.loads(r.read())
+        # ...and the poll feed reflects it without any server restart
+        assert len(d2["iterations"]) > len(d1["iterations"])
+        # server-rendered pages carry a meta-refresh so they too update
+        with urllib.request.urlopen(f"{base}/train/histograms/page") as r:
+            hist = r.read().decode()
+        assert 'http-equiv="refresh"' in hist
+    finally:
+        server.stop()
