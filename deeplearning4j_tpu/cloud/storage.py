@@ -151,9 +151,25 @@ class GCSStorage(DataSetStorage):
         return self._bucket.blob(self._key(key)).exists()
 
 
+def _natural_key(key: str):
+    """Sort key treating digit runs numerically: s_9 < s_10 < s_11."""
+    import re
+
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", key)]
+
+
 class StorageDataSetIterator(DataSetIterator):
-    """Iterate DataSets stored under a key prefix (reference
-    `BaseS3DataSetIterator.java`)."""
+    """STREAM DataSets from a key prefix, one object in memory at a time
+    (reference `BaseS3DataSetIterator.java` — its `next()` opens the next
+    S3 object): the training set lives in the bucket and is never
+    downloaded up front, so it may be far larger than host storage.
+
+    `async_supported` is True — wrap in `AsyncDataSetIterator` and the
+    next object's download overlaps the current batch's device step (the
+    same producer/consumer overlap the host infeed pipeline uses).
+    `reset()` re-lists the prefix, so shards appended between epochs
+    become visible on the next pass."""
 
     def __init__(self, storage: DataSetStorage, prefix: str = ""):
         self.storage = storage
@@ -162,7 +178,12 @@ class StorageDataSetIterator(DataSetIterator):
         self._pos = 0
 
     def reset(self) -> None:
-        self._keys = self.storage.list_keys(self.prefix)
+        # natural sort: shard writers number keys, often WITHOUT zero
+        # padding ("shard_10" must follow "shard_9", not "shard_1") —
+        # iteration order must be the write order regardless of backend
+        # listing order
+        self._keys = sorted(self.storage.list_keys(self.prefix),
+                            key=_natural_key)
         self._pos = 0
 
     def has_next(self) -> bool:

@@ -261,8 +261,10 @@ def render_html(component: Component, title: str = "deeplearning4j_tpu report",
     > 0 adds a meta-refresh so server-rendered dashboard pages update
     during a running fit (the Play UI's pages poll; meta-refresh is the
     zero-asset equivalent)."""
-    meta = (f'<meta http-equiv="refresh" content="{int(refresh_seconds)}">'
-            if refresh_seconds > 0 else "")
+    refresh = int(refresh_seconds)  # gate on the NORMALIZED value: 0.5
+    # would pass a raw >0 check but render content="0" (instant reload)
+    meta = (f'<meta http-equiv="refresh" content="{refresh}">'
+            if refresh > 0 else "")
     return (f"<!DOCTYPE html><html><head>{meta}"
             f"<title>{html.escape(title)}</title>"
             f"<style>body{{font-family:sans-serif;margin:2em}}"
