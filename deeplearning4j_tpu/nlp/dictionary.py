@@ -28,11 +28,20 @@ class LexEntry:
     surface: str
     pos: str = "unknown"
     cost: float = 0.7
+    # MeCab context-class ids for bigram connection costs (0 = the
+    # default/BOS/unknown class; unigram lattices ignore them)
+    left_id: int = 0
+    right_id: int = 0
 
 
 # leaf sentinel for the trie: a key that can never collide with a single
 # character edge
 _LEAF = ""
+
+
+def _ctx_id(s: str) -> int:
+    """MeCab context-class id column → int (blank/garbage → class 0)."""
+    return int(s) if s.strip().isdigit() else 0
 
 
 class Lexicon:
@@ -46,12 +55,37 @@ class Lexicon:
     real prefix in the text, not by `max_len` probes each allocating a
     substring, so a 50k+-entry dictionary with long surfaces costs the
     same per position as a toy one (`tests/test_lexicon_loader.py`
-    latency bound)."""
+    latency bound).
 
-    def __init__(self, entries: Iterable[LexEntry]):
+    `connections`: optional (R, L) numpy matrix of bigram connection
+    costs on this module's float scale, indexed [prev.right_id,
+    next.left_id] — the `matrix.def` half of a MeCab dictionary
+    (Kuromoji's `viterbi/ViterbiSearcher` adds exactly this term between
+    adjacent lattice nodes). With a matrix loaded the lattice runs a
+    BIGRAM Viterbi (states keyed by context class); without one it stays
+    unigram."""
+
+    def __init__(self, entries: Iterable[LexEntry], connections=None):
         self._by_surface: Dict[str, LexEntry] = {}
         self._trie: Dict = {}
+        self.connections = connections
         self.max_len = 1
+        entries = list(entries)
+        if connections is not None:
+            # dimension mismatch (CSV from one distribution, matrix.def
+            # from another) must fail HERE: masking it per-lookup would
+            # give out-of-range entries free transitions and let them
+            # systematically win Viterbi paths
+            R, L = connections.shape
+            bad = next((e for e in entries
+                        if e.right_id >= R or e.left_id >= L
+                        or e.right_id < 0 or e.left_id < 0), None)
+            if bad is not None:
+                raise ValueError(
+                    f"entry {bad.surface!r} has context ids "
+                    f"(left={bad.left_id}, right={bad.right_id}) outside "
+                    f"the {R}x{L} connection matrix — the dictionary CSVs "
+                    "and matrix.def are from different distributions")
         for e in entries:
             self._by_surface[e.surface] = e
             self.max_len = max(self.max_len, len(e.surface))
@@ -80,22 +114,29 @@ class Lexicon:
         IPADIC-style dictionary."""
         return cls(LexEntry(w, p, cost) for w, p in words)
 
+    # MeCab integer costs (word and connection) map onto this module's
+    # float scale by this divisor; word costs additionally offset+clip
+    # into the known-word band
+    _COST_SCALE = 20000.0
+
     @classmethod
     def from_mecab_csv(cls, lines: Iterable[str],
-                       base: Optional["Lexicon"] = None) -> "Lexicon":
+                       base: Optional["Lexicon"] = None,
+                       connections=None) -> "Lexicon":
         """Parse MeCab/IPADIC dictionary CSV rows into a Lexicon (the
         loader for real dictionary assets the reference vendors under
         `deeplearning4j-nlp-japanese/`). Format per row:
 
             surface,left_id,right_id,word_cost,POS1,POS2,...
 
-        Only surface, word_cost, and POS1 are consumed (the lattice here
-        is unigram — no connection matrix), so truncated rows with >= 5
-        fields load fine. IPADIC word costs (~ -3000..15000, lower =
-        more common) map monotonically onto this module's float scale so
-        loaded words interoperate with embedded entries and stay cheaper
-        than the OOV fallback. `base`: merge on top of an existing
-        lexicon (loaded rows win on surface collisions)."""
+        surface, left/right context ids, word_cost, and POS1 are
+        consumed, so truncated rows with >= 5 fields load fine. IPADIC
+        word costs (~ -3000..15000, lower = more common) map
+        monotonically onto this module's float scale so loaded words
+        interoperate with embedded entries and stay cheaper than the OOV
+        fallback. `base`: merge on top of an existing lexicon (loaded
+        rows win on surface collisions). `connections`: a pre-scaled
+        matrix (see `parse_matrix_def`) enabling the bigram lattice."""
         import csv
 
         entries: List[LexEntry] = []
@@ -120,9 +161,45 @@ class Lexicon:
             pos = parts[4] or "unknown"
             # -3000..15000 -> ~0.25..1.15: monotone, clipped into the
             # known-word band (below _UNKNOWN_BASE)
-            cost = min(1.15, max(0.15, 0.4 + word_cost / 20000.0))
-            entries.append(LexEntry(surface, pos, cost))
-        return cls(entries)
+            cost = min(1.15, max(0.15, 0.4 + word_cost / cls._COST_SCALE))
+            entries.append(LexEntry(surface, pos, cost,
+                                    _ctx_id(parts[1]), _ctx_id(parts[2])))
+        if connections is None and base is not None:
+            connections = base.connections
+        return cls(entries, connections=connections)
+
+    @classmethod
+    def parse_matrix_def(cls, lines: Iterable[str]):
+        """Parse a MeCab `matrix.def` (bigram connection costs — the
+        Kuromoji `ConnectionCosts` role): first line "R L", then
+        "right_id left_id cost" rows. Returns an (R, L) float matrix on
+        this module's cost scale (signed: negative = preferred
+        transition), ready for `Lexicon(..., connections=...)`."""
+        import numpy as np
+
+        it = iter(ln for ln in (l.strip() for l in lines) if ln)
+        try:
+            r, l = (int(x) for x in next(it).split())
+        except (StopIteration, ValueError) as e:
+            raise ValueError("matrix.def must start with 'R L'") from e
+        if r < 1 or l < 1:
+            raise ValueError(
+                f"matrix.def declares a {r}x{l} matrix; class 0 (BOS/EOS/"
+                "unknown) requires at least 1x1")
+        m = np.zeros((r, l), np.float32)
+        for row in it:
+            parts = row.split()
+            if len(parts) != 3:
+                raise ValueError(
+                    f"matrix.def row needs 'right_id left_id cost', got "
+                    f"{row[:60]!r}")
+            ri, li = int(parts[0]), int(parts[1])
+            if not (0 <= ri < r and 0 <= li < l):
+                raise ValueError(
+                    f"matrix.def row {row[:60]!r} indexes outside the "
+                    f"declared {r}x{l} matrix")
+            m[ri, li] = float(parts[2]) / cls._COST_SCALE
+        return m
 
     @classmethod
     def from_mecab_path(cls, path,
@@ -130,7 +207,8 @@ class Lexicon:
         """Load a MeCab CSV file, or a DIRECTORY of them (the layout of an
         unpacked mecab-ipadic distribution: Noun.csv, Verb.csv, ...) —
         the downloadable-dictionary seam: point this at real IPADIC
-        assets and the full dictionary drops in."""
+        assets and the full dictionary drops in. A `matrix.def` in the
+        directory loads too, switching the lattice to bigram Viterbi."""
         import pathlib
 
         p = pathlib.Path(path)
@@ -138,17 +216,24 @@ class Lexicon:
         if not files:
             raise ValueError(f"no dictionary CSVs under {p}")
 
+        def _read(f):
+            # euc-jp is upstream ipadic's encoding; utf-8 the common
+            # re-encode. Try utf-8 first, fall back per file.
+            try:
+                return f.read_text(encoding="utf-8")
+            except UnicodeDecodeError:
+                return f.read_text(encoding="euc-jp")
+
         def rows():
             for f in files:
-                # euc-jp is upstream ipadic's encoding; utf-8 the common
-                # re-encode. Try utf-8 first, fall back per file.
-                try:
-                    text = f.read_text(encoding="utf-8")
-                except UnicodeDecodeError:
-                    text = f.read_text(encoding="euc-jp")
-                yield from text.splitlines()
+                yield from _read(f).splitlines()
 
-        return cls.from_mecab_csv(rows(), base=base)
+        connections = None
+        if p.is_dir() and (p / "matrix.def").exists():
+            connections = cls.parse_matrix_def(
+                _read(p / "matrix.def").splitlines())
+        return cls.from_mecab_csv(rows(), base=base,
+                                  connections=connections)
 
     def lookup(self, surface: str) -> Optional[LexEntry]:
         return self._by_surface.get(surface)
@@ -165,7 +250,12 @@ _KNOWN_LEN_BONUS = 0.05  # longer dictionary matches cost slightly less
 def viterbi_segment(text: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
     """Minimum-cost segmentation of `text` into (surface, pos) tokens.
     Whitespace and punctuation separate the lattice; unknown spans fall
-    back to script runs tagged pos='unknown'."""
+    back to script runs tagged pos='unknown'. Unigram lattice by
+    default; BIGRAM (word costs + connection costs between adjacent
+    context classes, Kuromoji's `ViterbiSearcher` model) when the
+    lexicon carries a connection matrix."""
+    chunk_fn = (_viterbi_chunk_bigram if lexicon.connections is not None
+                else _viterbi_chunk)
     out: List[Tuple[str, str]] = []
     n = len(text)
     i = 0
@@ -175,7 +265,7 @@ def viterbi_segment(text: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
             i += 1
             continue
         j = _chunk_end(text, i)
-        out.extend(_viterbi_chunk(text[i:j], lexicon))
+        out.extend(chunk_fn(text[i:j], lexicon))
         i = j
     return out
 
@@ -187,21 +277,34 @@ def _chunk_end(text: str, i: int) -> int:
     return j
 
 
-def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
+def _run_ends(chunk: str) -> List[int]:
+    """run_end[i]: end of the maximal same-script run starting at i,
+    precomputed right-to-left in ONE pass (recomputing per position
+    would make long same-script chunks quadratic). Shared by the unigram
+    and bigram lattices so the OOV fallback edges are identical."""
     n = len(chunk)
-    INF = float("inf")
-    best = [INF] * (n + 1)
-    back: List[Optional[Tuple[int, str, str]]] = [None] * (n + 1)
-    best[0] = 0.0
-    # run_end[i]: end of the maximal same-script run starting at i,
-    # precomputed right-to-left in ONE pass (recomputing per position
-    # would make long same-script chunks quadratic)
     scripts = [_script(c) for c in chunk]
     run_end = [0] * n
     for i in range(n - 1, -1, -1):
         run_end[i] = (run_end[i + 1]
                       if i + 1 < n and scripts[i + 1] == scripts[i]
                       else i + 1)
+    return run_end
+
+
+def _word_cost(e: LexEntry, i: int, j: int) -> float:
+    """Dictionary-edge cost with the length bonus — ONE definition so
+    unigram and bigram lattices can never drift apart."""
+    return max(0.1, e.cost - _KNOWN_LEN_BONUS * (j - i - 1))
+
+
+def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
+    n = len(chunk)
+    INF = float("inf")
+    best = [INF] * (n + 1)
+    back: List[Optional[Tuple[int, str, str]]] = [None] * (n + 1)
+    best[0] = 0.0
+    run_end = _run_ends(chunk)
     for i in range(n):
         if best[i] == INF:
             continue
@@ -209,7 +312,7 @@ def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
         # every matching prefix (stops at the first missing child — cost
         # no longer max_len probes x substring allocations per position)
         for j, e in lexicon.prefixes(chunk, i, n):
-            c = best[i] + max(0.1, e.cost - _KNOWN_LEN_BONUS * (j - i - 1))
+            c = best[i] + _word_cost(e, i, j)
             if c < best[j]:
                 best[j] = c
                 back[j] = (i, e.surface, e.pos)
@@ -233,6 +336,60 @@ def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
         prev, surf, pos = back[i]
         toks.append((surf, pos))
         i = prev
+    toks.reverse()
+    return toks
+
+
+def _viterbi_chunk_bigram(chunk: str, lexicon: Lexicon
+                          ) -> List[Tuple[str, str]]:
+    """Bigram lattice: path cost = Σ word costs + Σ connection costs
+    between adjacent (prev.right_id, next.left_id) context-class pairs —
+    the Kuromoji `ViterbiSearcher` model over `ConnectionCosts`
+    (matrix.def). DP states are (position, arriving right_id); BOS/EOS
+    and unknown tokens use class 0 (MeCab's convention). Per position the
+    state count is bounded by the distinct right_ids of incoming edges,
+    so cost stays near the unigram lattice for real dictionaries."""
+    # entry ids are validated against the matrix shape at Lexicon
+    # construction, so no per-lookup bounds checks; plain nested lists
+    # index ~100 ns faster than numpy scalar extraction in this
+    # states x edges hot loop
+    conn: List[List[float]] = lexicon.connections.tolist()
+    n = len(chunk)
+    run_end = _run_ends(chunk)
+    # states[i]: rid -> (cost, back) with back = (i_prev, rid_prev,
+    # surface, pos)
+    states: List[Dict[int, Tuple[float, Optional[tuple]]]] = \
+        [dict() for _ in range(n + 1)]
+    states[0][0] = (0.0, None)  # BOS carries context class 0
+    for i in range(n):
+        if not states[i]:
+            continue
+        edges = []  # (j, surface, pos, lid, rid, word_cost)
+        for j, e in lexicon.prefixes(chunk, i, n):
+            edges.append((j, e.surface, e.pos, e.left_id, e.right_id,
+                          _word_cost(e, i, j)))
+        for j in {run_end[i], i + 1}:  # unknown fallbacks (class 0)
+            edges.append((j, chunk[i:j], "unknown", 0, 0,
+                          _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)))
+        for rid_prev, (c_prev, _) in list(states[i].items()):
+            row = conn[rid_prev]
+            for j, surf, pos, lid, rid, wc in edges:
+                c = c_prev + wc + row[lid]
+                cur = states[j].get(rid)
+                if cur is None or c < cur[0]:
+                    states[j][rid] = (c, (i, rid_prev, surf, pos))
+    if not states[n]:  # unreachable in practice (unknown edges advance)
+        return [(chunk, "unknown")]
+    # EOS transition: class 0
+    end_rid = min(states[n],
+                  key=lambda rid: states[n][rid][0] + conn[rid][0])
+    toks: List[Tuple[str, str]] = []
+    i, rid = n, end_rid
+    while i > 0:
+        _, back = states[i][rid]
+        i_prev, rid_prev, surf, pos = back
+        toks.append((surf, pos))
+        i, rid = i_prev, rid_prev
     toks.reverse()
     return toks
 
