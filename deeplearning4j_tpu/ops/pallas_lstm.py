@@ -281,7 +281,17 @@ def _lstm_bwd_kernel_masked(gates_ref, cprev_ref, dh_out_ref,
         dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
 
 
-_BLOCK_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
+# v5e cores carry 128 MiB of VMEM but Mosaic's default scoped-stack limit
+# is 16 MiB, which caps the batch block at 512 for H=256 (bb=1024 needs
+# 18.4 MiB for its double-buffered xw/gates slabs) and rejects H=1024
+# outright (the bwd kernel's slabs need 100.1 MiB at bb=1024). Raising the
+# per-kernel limit lets the probe ladder serve MXU-width hidden sizes; the
+# probe fall-through still lands on whatever block the hardware accepts
+# (e.g. bb=2048 at H=1024 wants 145 MiB > the physical 128 and falls to
+# 1024).
+_VMEM_LIMIT = 112 * 1024 * 1024
+
+_BLOCK_CANDIDATES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 
 
 def _batch_block(B: int) -> Optional[int]:
@@ -330,7 +340,8 @@ def _fwd_call(xw, rw, peep, h0, c0, *, bb: int, with_stash: bool,
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xw, rw, peep, h0, c0)
     return h_out, cT, c_stash, gates
@@ -369,7 +380,8 @@ def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *, bb: int,
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(gates, c_stash, c_stash, dh_out, dcT, rw, peep, c0)
     return dz, dhc0
@@ -459,7 +471,8 @@ def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, bb: int,
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xw, rw, peep, h0, c0, mask)
     return outs
@@ -499,7 +512,8 @@ def _bwd_call_masked(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0,
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0)
     return dz, dhc0

@@ -170,8 +170,9 @@ class SequenceParallelWrapper(ParallelWrapper):
 
     Subclasses ParallelWrapper so the whole training loop (batch trimming,
     tBPTT guard, listener/epoch bookkeeping) is shared; the overrides are
-    the batch shardings — features (B, T) on (data, seq), labels (B, T, V)
-    on (data, seq, None) — and the step wrapper that opens
+    the batch shardings — features and labels on P(data, seq), trailing
+    dims replicated, so one-hot (B, T, V) and sparse-id (B, T) labels
+    both shard — and the step wrapper that opens
     `sequence_parallel_scope`, so every attention layer traced inside the
     jitted step computes via ring attention (KV blocks rotating over ICI).
 
@@ -211,8 +212,10 @@ class SequenceParallelWrapper(ParallelWrapper):
         from jax.sharding import NamedSharding
 
         d = self.data_axis if self.data_axis in self.mesh.shape else None
+        # P(d, seq) leaves any trailing dims replicated, so one spec serves
+        # both one-hot (B, T, V) and sparse-id (B, T) labels/features
         feat = NamedSharding(self.mesh, P(d, self.seq_axis))
-        lab = NamedSharding(self.mesh, P(d, self.seq_axis, None))
+        lab = NamedSharding(self.mesh, P(d, self.seq_axis))
         return (feat, lab, self._repl, self._repl)
 
     def _shard_batch(self, ds):

@@ -190,6 +190,35 @@ def test_sequence_parallel_wrapper_guards():
         spw.fit(DataSet(x2, y2, np.ones((4, 16), np.float32)))
 
 
+def test_sequence_parallel_sparse_labels():
+    """Sparse integer (B, T) next-token labels — the staging format the GPT
+    bench path uses — must shard under sequence parallelism exactly like
+    one-hot (B, T, V) labels (the P(data, seq) spec replicates trailing
+    dims, so one spec serves both ranks)."""
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.sequence import SequenceParallelWrapper
+
+    kw = dict(vocab_size=11, d_model=16, n_heads=2, n_layers=1,
+              max_length=16, learning_rate=3e-3)
+    x, y1h = _lm_data(11, 8, 16)
+    sparse = np.argmax(y1h, axis=-1).astype(np.int32)
+
+    dense_net = MultiLayerNetwork(gpt_configuration(**kw))
+    dense_net.init()
+    mesh = make_mesh({"data": 2, "seq": 4})
+    SequenceParallelWrapper(dense_net, mesh).fit(DataSet(x, y1h))
+
+    sparse_net = MultiLayerNetwork(gpt_configuration(**kw))
+    sparse_net.init()
+    SequenceParallelWrapper(sparse_net, mesh).fit(DataSet(x, sparse))
+
+    # same-seed: the sparse-id batch is the same labels, so the steps match
+    np.testing.assert_allclose(dense_net.params(), sparse_net.params(),
+                               atol=1e-5)
+    np.testing.assert_allclose(dense_net.score_value,
+                               sparse_net.score_value, atol=1e-6)
+
+
 def test_moe_gpt_learns_copy_task():
     """Sparse-expert GPT (TransformerBlock with a Switch MoE FFN) trains on
     the copy task; router params move (aux + task gradients flow)."""
