@@ -41,13 +41,34 @@ large enough to feed the MXU — shrinking below ~128 rows per stage
 trades bubble for underutilized matmuls). Memory: GPipe stashes
 activations for all M in-flight microbatches; set TransformerBlock
 remat=True to rematerialize blocks in the backward and hold O(1)
-residuals per stage instead. A 1F1B schedule would cap the stash at S
-microbatches WITHOUT remat's recompute — but its bubble is the same
-(S-1)/(S+M-1), and under jax.grad the interleaved one-forward-one-
-backward ordering requires hand-staging the backward through the loop
-(custom_vjp over the whole schedule); remat already provides the
-memory bound at ~1/3 extra trunk FLOPs, so GPipe+remat is the chosen
-design point here.
+residuals per stage instead.
+
+Why not 1F1B: under a single-jit SPMD schedule it is strictly
+dominated by GPipe+remat, and the reason is quantitative, not
+taste. 1F1B's selling point is capping the activation stash at S
+in-flight microbatches (vs GPipe's M) without remat's recompute.
+But lock-step execution — the only form a single jitted fori_loop
+with ppermute barriers can express — quantizes the schedule into
+global ticks, and in 1F1B's steady state each stage runs its
+forward on every OTHER tick (f(d,m) = 2m + 2d - S + 1: adjacent
+stages alternate parity, and the one-tick hop latency in BOTH
+directions forces the 2m stride), so half of every device's slots
+idle even at peak. Counting fwd = 1, bwd = 2 units: lock-step 1F1B
+needs 2(S+M-2) ticks x 3 units = 6(S+M-2) per batch, while GPipe
+is 3(S+M-1) and GPipe+remat — which already achieves a BETTER
+memory bound (O(1) stashed microbatch inputs per stage, blocks
+recomputed in the backward) — is 4(S+M-1). The asynchronous MPMD
+execution that makes real 1F1B pay (each stage free-running its
+own program, fwd/bwd packed back-to-back with no tick barrier)
+is exactly what XLA's single-program model does not express; a
+double-pumped variant (two interleaved 1F1B streams filling the
+alternate-parity slots) restores utilization but doubles the stash
+to 2S and only beats GPipe+remat's wall clock once M >> 8S, a
+regime where per-microbatch MXU feed (B/M rows) has usually
+collapsed first. Hence the chosen design point: GPipe for the
+schedule, remat for the memory bound, ~1/3 extra trunk FLOPs as
+the price — cheaper than lock-step 1F1B's idle slots in every
+regime this wrapper targets.
 """
 from __future__ import annotations
 
