@@ -320,10 +320,10 @@ class UIServer:
         storage, sid = self._latest_session()
         if storage is None:
             return {"session_id": None, "iterations": [], "host_rss_mb": [],
-                    "device_bytes_in_use": []}
+                    "device_bytes_in_use": [], "gc_gen2_collections": []}
         recs = storage.get_records(sid, type_id="stats")
         out = {"session_id": sid, "iterations": [], "host_rss_mb": [],
-               "device_bytes_in_use": []}
+               "device_bytes_in_use": [], "gc_gen2_collections": []}
         for r in recs:
             sysd = r.data.get("system") or {}
             out["iterations"].append(r.data.get("iteration"))
@@ -331,6 +331,9 @@ class UIServer:
             out["host_rss_mb"].append(sysd.get("host_rss_mb",
                                                sysd.get("host_rss_peak_mb")))
             out["device_bytes_in_use"].append(sysd.get("device_bytes_in_use"))
+            gens = sysd.get("gc_collections")
+            # gen-2 cumulative count — the reference system tab's GC trace
+            out["gc_gen2_collections"].append(gens[-1] if gens else None)
         return out
 
     def _histogram_data(self):

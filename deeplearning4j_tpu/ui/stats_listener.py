@@ -68,6 +68,18 @@ def _system_stats() -> Dict[str, Any]:
             out["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
     except Exception:
         pass  # CPU backend / no memory_stats: host stats only
+    try:
+        import gc
+
+        # collector activity per report window (the reference records GC
+        # count/time deltas via JMX, `BaseStatsListener.java:356-370`; the
+        # CPython analogue is cycle-collector runs per generation — a
+        # rising gen-2 rate during fit() flags host-side churn)
+        stats = gc.get_stats()  # ONE snapshot: both series must agree
+        out["gc_collections"] = [s["collections"] for s in stats]
+        out["gc_collected"] = [s["collected"] for s in stats]
+    except Exception:
+        pass
     return out
 
 
