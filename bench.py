@@ -471,7 +471,7 @@ def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
 
 
 def _time_w2v(w2v, sentences):
-    """Median/spread of 3 full training passes; each pass ends with a true
+    """Median/spread of _REPEATS full training passes; each pass ends with a true
     host sync (table materialization — block_until_ready is not a real
     barrier over the remote tunnel)."""
     w2v.fit(sentences[:300])  # warm-up: compile the scanned NS kernel

@@ -127,16 +127,24 @@ class FileDataSetIterator(DataSetIterator):
     only one file's arrays are in memory at a time, so the training set
     may be far larger than host RAM.
 
-    `paths`: an iterable of file paths, or a directory (every `*.npz`
-    inside, sorted by name — the order `batch_and_export` numbers them)."""
+    `paths`: an iterable of file paths, a single file path, or a
+    directory (every `*.npz` inside, digit runs sorted numerically so
+    externally produced unpadded names keep write order: shard_9 <
+    shard_10 — same rule as `StorageDataSetIterator`)."""
 
     def __init__(self, paths):
         import os
 
-        if isinstance(paths, (str, os.PathLike)) and os.path.isdir(paths):
-            self.paths = sorted(
-                os.path.join(paths, f) for f in os.listdir(paths)
-                if f.endswith(".npz"))
+        from deeplearning4j_tpu.cloud.storage import _natural_key
+
+        if isinstance(paths, (str, os.PathLike)):
+            if os.path.isdir(paths):
+                self.paths = sorted(
+                    (os.path.join(paths, f) for f in os.listdir(paths)
+                     if f.endswith(".npz")), key=_natural_key)
+            else:
+                # a single exported shard, not an iterable of its chars
+                self.paths = [os.fspath(paths)]
         else:
             self.paths = [os.fspath(p) for p in paths]
         if not self.paths:

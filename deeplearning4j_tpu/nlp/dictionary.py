@@ -40,8 +40,10 @@ _LEAF = ""
 
 
 def _ctx_id(s: str) -> int:
-    """MeCab context-class id column → int (blank/garbage → class 0)."""
-    return int(s) if s.strip().isdigit() else 0
+    """MeCab context-class id column → int (blank/garbage → class 0).
+    isdecimal, not isdigit: isdigit accepts characters int() rejects
+    (superscripts like '²'), which would crash the loader mid-file."""
+    return int(s) if s.strip().isdecimal() else 0
 
 
 class Lexicon:
@@ -69,6 +71,12 @@ class Lexicon:
         self._by_surface: Dict[str, LexEntry] = {}
         self._trie: Dict = {}
         self.connections = connections
+        # nested-list form of the matrix, memoized: the bigram lattice
+        # indexes it per (state, edge) — see _viterbi_chunk_bigram — and
+        # a per-chunk tolist() of an IPADIC-size (1316x1316) matrix costs
+        # ~100 ms, dominating multi-chunk documents
+        self._conn_rows = (None if connections is None
+                           else connections.tolist())
         self.max_len = 1
         entries = list(entries)
         if connections is not None:
@@ -352,8 +360,9 @@ def _viterbi_chunk_bigram(chunk: str, lexicon: Lexicon
     # entry ids are validated against the matrix shape at Lexicon
     # construction, so no per-lookup bounds checks; plain nested lists
     # index ~100 ns faster than numpy scalar extraction in this
-    # states x edges hot loop
-    conn: List[List[float]] = lexicon.connections.tolist()
+    # states x edges hot loop (memoized on the Lexicon — converting per
+    # chunk dominated multi-chunk documents)
+    conn: List[List[float]] = lexicon._conn_rows
     n = len(chunk)
     run_end = _run_ends(chunk)
     # states[i]: rid -> (cost, back) with back = (i_prev, rid_prev,

@@ -211,7 +211,14 @@ class ParallelWrapper:
         usable = (B // n_data) * n_data
         if self._expert_layers and usable:
             f = ds.features[0] if isinstance(ds.features, list) else ds.features
-            T = f.shape[1] if f.ndim == 3 else 1
+            # time length: (B, T, F) dense sequences, or (B, T) integer
+            # token ids (TokenEmbedding nets) — for the latter dim 1 is
+            # TIME, not features, and counting it as 1 would over-trim
+            # batches whose true token count B*T already divides
+            int_ids = (f.ndim == 2
+                       and getattr(self.net.layers[0], "integer_input",
+                                   False))
+            T = f.shape[1] if (f.ndim == 3 or int_ids) else 1
             need = n_data
             for ax in self._expert_axes:
                 need = int(np.lcm(need, self.mesh.shape[ax] * n_data))

@@ -421,3 +421,39 @@ def test_expert_parallel_rejects_graph_and_tbptt():
     tnet.init()
     with pytest.raises(NotImplementedError, match="truncated BPTT"):
         ParallelWrapper(tnet, mesh=mesh)
+
+
+def test_expert_parallel_token_ids_batch_not_overtrimmed():
+    """(B, T) integer-id features (TokenEmbedding nets): the expert
+    token-divisibility trim must count B*T tokens, not B — a (4, 2) id
+    batch has 8 tokens, which divides E*dp=8 exactly; counting T as 1
+    used to trim 4->0 and silently drop the whole batch."""
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (MoELayer, RnnOutputLayer,
+                                                   TokenEmbedding)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(3)
+            .learning_rate(0.05).list()
+            .layer(TokenEmbedding(n_in=7, n_out=16))
+            .layer(MoELayer(n_in=16, n_out=16, n_experts=4,
+                            capacity_factor=8.0, expert_axis="expert"))
+            .layer(RnnOutputLayer(n_in=16, n_out=7,
+                                  activation=Activation.SOFTMAX,
+                                  loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(7))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    pw = ParallelWrapper(net, mesh=make_mesh({"data": 2, "expert": 4}))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 7, (4, 3))
+    ds = DataSet(ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32))
+    pw.fit(ds)
+    assert net.iteration == 1, "token-id batch was dropped by the trimmer"
+    assert np.isfinite(net.score_value)

@@ -281,3 +281,12 @@ def test_matrix_dimension_mismatch_fails_at_load():
         Lexicon.parse_matrix_def(["2 2", "1 2"])
     with pytest.raises(ValueError, match="at least 1x1"):
         Lexicon.parse_matrix_def(["0 0"])
+
+
+def test_ctx_id_nondecimal_digit_maps_to_class_zero():
+    """str.isdigit() accepts characters int() rejects (e.g. superscript
+    two); such a context-id column must map to class 0 per the
+    blank/garbage contract instead of crashing the CSV loader."""
+    lex = Lexicon.from_mecab_csv(["ab,²,⁵,1000,x"])
+    e = lex.lookup("ab")
+    assert e is not None and e.left_id == 0 and e.right_id == 0

@@ -375,3 +375,26 @@ def test_dataset_save_load_suffixless_roundtrip(tmp_path):
     back = DataSet.load(p)
     np.testing.assert_array_equal(back.features, ds.features)
     assert back.labels is None
+
+
+def test_file_iterator_single_path_and_natural_order(tmp_path):
+    """A single file path (str or Path) is one shard, not an iterable of
+    characters; directory mode orders unpadded numeric names numerically
+    (shard_9 before shard_10 — same rule as StorageDataSetIterator)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import FileDataSetIterator
+
+    d = tmp_path / "shards"
+    d.mkdir()
+    for i in (1, 2, 9, 10, 11):
+        DataSet(np.full((2, 3), float(i), np.float32),
+                np.ones((2, 1), np.float32)).save(d / f"shard_{i}.npz")
+
+    one = FileDataSetIterator(str(d / "shard_9.npz"))
+    assert one.paths == [str(d / "shard_9.npz")]
+    assert float(one.next().features[0, 0]) == 9.0
+    # pathlib.Path works too
+    assert FileDataSetIterator(d / "shard_10.npz").next() is not None
+
+    order = [float(ds.features[0, 0]) for ds in FileDataSetIterator(d)]
+    assert order == [1.0, 2.0, 9.0, 10.0, 11.0], order
