@@ -151,12 +151,9 @@ class GCSStorage(DataSetStorage):
         return self._bucket.blob(self._key(key)).exists()
 
 
-def _natural_key(key: str):
-    """Sort key treating digit runs numerically: s_9 < s_10 < s_11."""
-    import re
-
-    return [int(p) if p.isdigit() else p
-            for p in re.split(r"(\d+)", key)]
+# canonical home: datasets/iterators.py (this module already imports it
+# at module level, so the shared key lives there to avoid a cycle)
+from deeplearning4j_tpu.datasets.iterators import natural_key as _natural_key  # noqa: E402
 
 
 class StorageDataSetIterator(DataSetIterator):

@@ -120,6 +120,17 @@ class ExistingDataSetIterator(DataSetIterator):
         return -1
 
 
+def natural_key(key: str):
+    """Sort key treating digit runs numerically: s_9 < s_10 < s_11 —
+    shard writers number files, often without zero padding; lexicographic
+    order would interleave them. Shared by FileDataSetIterator and
+    cloud.storage.StorageDataSetIterator."""
+    import re
+
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", key)]
+
+
 class FileDataSetIterator(DataSetIterator):
     """Iterate DataSets lazily from exported files — the path-based half
     of the reference's export-staged training (reference
@@ -135,13 +146,11 @@ class FileDataSetIterator(DataSetIterator):
     def __init__(self, paths):
         import os
 
-        from deeplearning4j_tpu.cloud.storage import _natural_key
-
         if isinstance(paths, (str, os.PathLike)):
             if os.path.isdir(paths):
                 self.paths = sorted(
                     (os.path.join(paths, f) for f in os.listdir(paths)
-                     if f.endswith(".npz")), key=_natural_key)
+                     if f.endswith(".npz")), key=natural_key)
             else:
                 # a single exported shard, not an iterable of its chars
                 self.paths = [os.fspath(paths)]

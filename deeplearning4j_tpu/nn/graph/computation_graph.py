@@ -1022,6 +1022,30 @@ class ComputationGraph:
     def num_params(self) -> int:
         return int(self.params().shape[0])
 
+    def summary(self) -> str:
+        """Human-readable vertex table in topological order: vertex kind,
+        inputs, resolved output type, parameter count (the
+        MultiLayerNetwork.summary() analogue for graphs)."""
+        self._ensure_init()
+        conf = self.conf
+        rows = [("vertex", "kind", "inputs", "out", "params")]
+        total = 0
+        for name in conf.topological_order:
+            node = conf.nodes[name]
+            kind = (type(node.layer).__name__ if node.is_layer
+                    else type(node.vertex).__name__
+                    if getattr(node, "vertex", None) is not None
+                    else "GraphVertex")
+            n = sum(int(np.prod(v.shape))
+                    for v in self._params.get(name, {}).values())
+            total += n
+            it = conf.resolved_types.get(name)
+            rows.append((name, kind, ",".join(node.inputs or ["(input)"]),
+                         str(it), f"{n:,}"))
+        from deeplearning4j_tpu.util.text_table import format_table
+
+        return format_table(rows, f"total parameters: {total:,}")
+
     def compute_gradient_and_score(self, ds) -> Tuple[np.ndarray, float]:
         """For GradientCheckUtil parity (reference `GradientCheckUtil:194`
         ComputationGraph variant)."""

@@ -862,6 +862,28 @@ class MultiLayerNetwork:
     def num_params(self) -> int:
         return int(self.params().shape[0])
 
+    def summary(self) -> str:
+        """Human-readable architecture table: per-layer type, in/out types,
+        and parameter count (a UX convenience the 0.7.x reference lacks;
+        later reference versions added the same shape under this name)."""
+        self._ensure_init()
+        rows = [("idx", "layer", "in", "out", "params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            it_in = self._input_types[i]
+            it_out = layer.output_type(it_in)
+            n = sum(int(np.prod(v.shape)) for v in self._params[i].values())
+            total += n
+            pre = "* " if i in self.conf.preprocessors else ""
+            rows.append((str(i), pre + type(layer).__name__, str(it_in),
+                         str(it_out), f"{n:,}"))
+        from deeplearning4j_tpu.util.text_table import format_table
+
+        return format_table(
+            rows, f"total parameters: {total:,}"
+            + ("  (* = input preprocessor applied)"
+               if self.conf.preprocessors else ""))
+
     def compute_gradient_and_score(self, ds: DataSet) -> Tuple[np.ndarray, float]:
         """Analytic flat gradient + score at current params (reference
         `Model.computeGradientAndScore` / `gradient()` used by

@@ -621,3 +621,19 @@ def test_mln_tbptt_token_id_sequences():
     labels = rng.randint(0, 12, (2, 10)).astype(np.int32)
     net.fit(DataSet(ids, labels))
     assert np.isfinite(net.score_value)
+
+
+def test_graph_summary_table():
+    """ComputationGraph.summary(): topo-ordered vertex table, non-layer
+    vertices named by their vertex class, total matches num_params()."""
+    from deeplearning4j_tpu.models.resnet import resnet_configuration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    net = ComputationGraph(resnet_configuration(depth=18, n_classes=10))
+    net.init()
+    s = net.summary()
+    assert "ElementWiseVertex" in s  # residual adds
+    assert "BatchNormalization" in s
+    total = int(s.splitlines()[-1].split("total parameters:")[1].split()[0]
+                .replace(",", ""))
+    assert total == net.num_params()

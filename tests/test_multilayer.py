@@ -257,3 +257,22 @@ def test_drop_connect_masks_weights_not_inputs():
         net.fit(DataSet(X, y))
         first = first if first is not None else net.score_value
     assert net.score_value < first
+
+
+def test_summary_table():
+    """summary(): one row per layer with resolved in/out types and param
+    counts; the total matches num_params(); preprocessor-bearing layers
+    are starred."""
+    from deeplearning4j_tpu.models.lenet import lenet_configuration
+
+    net = MultiLayerNetwork(lenet_configuration())
+    net.init()
+    s = net.summary()
+    lines = s.splitlines()
+    assert "ConvolutionLayer" in s and "OutputLayer" in s
+    assert "* " in s  # CNN input preprocessor star
+    total = int(lines[-1].split("total parameters:")[1].split()[0]
+                .replace(",", ""))
+    assert total == net.num_params()
+    # 6 layers + header + rule + total line
+    assert len(lines) == 6 + 3
