@@ -9,6 +9,9 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.datasets.iterators import (
+    natural_key as _natural_key,  # canonical home for the shard sort key
+)
 
 
 def _ds_to_bytes(ds: DataSet) -> bytes:
@@ -151,9 +154,7 @@ class GCSStorage(DataSetStorage):
         return self._bucket.blob(self._key(key)).exists()
 
 
-# canonical home: datasets/iterators.py (this module already imports it
-# at module level, so the shared key lives there to avoid a cycle)
-from deeplearning4j_tpu.datasets.iterators import natural_key as _natural_key  # noqa: E402
+
 
 
 class StorageDataSetIterator(DataSetIterator):

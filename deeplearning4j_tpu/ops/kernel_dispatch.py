@@ -74,3 +74,12 @@ def probe_verdict(cache: dict, key, probe_fn, args, what: str) -> bool:
             ok = False
         cache[key] = ok
     return bool(ok)
+
+
+# Mosaic's default scoped-VMEM stack limit is 16 MiB; v5e cores carry
+# 128 MiB. Kernels whose double-buffered slabs exceed the default (the
+# fused LSTM at H=1024 needs 100.1 MiB; 2048-wide attention tiles carry
+# 16 MiB f32 score slabs) pass this shared ceiling via
+# CompilerParams(vmem_limit_bytes=...). One constant so a new TPU
+# generation retunes every kernel family at once.
+VMEM_LIMIT_BYTES = 112 * 1024 * 1024

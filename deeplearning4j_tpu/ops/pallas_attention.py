@@ -421,9 +421,11 @@ def _eager_probe(dtype, block: int, head_dim: int) -> bool:
     return bool(jnp.all(jnp.isfinite(g[0].astype(jnp.float32))))
 
 
-# raised like pallas_lstm._VMEM_LIMIT: the default 16 MiB scoped-stack
-# limit rejects 2048-wide tiles whose f32 score slabs alone are 16 MiB
-_VMEM_LIMIT = 112 * 1024 * 1024
+# the default 16 MiB scoped-stack limit rejects 2048-wide tiles whose
+# f32 score slabs alone are 16 MiB (shared ceiling: kernel_dispatch)
+from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
+    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
+)
 
 _BLOCK_CANDIDATES = (1024, 512, 256, 128)
 

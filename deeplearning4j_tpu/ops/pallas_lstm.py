@@ -281,15 +281,15 @@ def _lstm_bwd_kernel_masked(gates_ref, cprev_ref, dh_out_ref,
         dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
 
 
-# v5e cores carry 128 MiB of VMEM but Mosaic's default scoped-stack limit
-# is 16 MiB, which caps the batch block at 512 for H=256 (bb=1024 needs
-# 18.4 MiB for its double-buffered xw/gates slabs) and rejects H=1024
-# outright (the bwd kernel's slabs need 100.1 MiB at bb=1024). Raising the
-# per-kernel limit lets the probe ladder serve MXU-width hidden sizes; the
-# probe fall-through still lands on whatever block the hardware accepts
-# (e.g. bb=2048 at H=1024 wants 145 MiB > the physical 128 and falls to
-# 1024).
-_VMEM_LIMIT = 112 * 1024 * 1024
+# the default 16 MiB scoped-stack limit caps the batch block at 512 for
+# H=256 (bb=1024 needs 18.4 MiB of double-buffered xw/gates slabs) and
+# rejects H=1024 outright (100.1 MiB at bb=1024); the raised shared
+# ceiling lets the probe ladder serve MXU-width hidden sizes, and the
+# fall-through still lands on whatever the hardware accepts (bb=2048 at
+# H=1024 wants 145 MiB > the physical 128 and falls to 1024)
+from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
+    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
+)
 
 _BLOCK_CANDIDATES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 

@@ -1,0 +1,105 @@
+"""Serialize a trained network's inference path as portable StableHLO.
+
+The reference's deployment story is JVM serialization (`ModelSerializer`) —
+the artifact only runs where DL4J runs. The TPU-native analogue exports the
+COMPILED program: `jax.export` lowers the network's forward pass (params
+baked in as constants, device-side normalizer and mixed-precision casts
+included — exactly what `net.output()` computes) to versioned, serialized
+StableHLO that any XLA runtime can load and run with no Python, no
+framework, and no pickle on the serving side. Complements
+`util/serialization.py` (the training checkpoint): the zip restores a
+trainable net; this exports a frozen serving function.
+
+Round-trip and numeric parity vs `net.output()` are tested in
+`tests/test_stablehlo_export.py`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def export_inference(net, example_features, path: Optional[str] = None,
+                     platforms: Optional[Sequence[str]] = None) -> bytes:
+    """Lower `net.output(features)` (eval mode) to serialized StableHLO.
+
+    `net`: an initialized MultiLayerNetwork or ComputationGraph.
+    `example_features`: one features array (MLN) or a sequence of arrays
+    (CG, one per network input) fixing the serving shapes/dtypes — the
+    wire format, e.g. uint8 pixels when a device-side normalizer is
+    attached, int32 ids for embedding nets.
+    `path`: optionally also write the blob to this file.
+    `platforms`: target platforms for the artifact (e.g. `("tpu", "cpu")`
+    to serve the same blob on both); default = the exporting platform.
+
+    Returns the serialized bytes. Parameters and layer state are baked
+    into the artifact as constants; the exported function takes ONLY the
+    feature array(s)."""
+    from jax import export as jexport
+
+    net._ensure_init()
+    from deeplearning4j_tpu.nn.precision import wire_asarray
+
+    if hasattr(net, "layers"):  # MultiLayerNetwork
+        x = wire_asarray(example_features, net.dtype,
+                         net._features_are_ids())
+
+        def serve(xx):
+            xx = net._prep_features(xx)
+            return net._forward_pure(net._params, net._layer_state, xx,
+                                     train=False, rng=None, fmask=None)[0]
+
+        args = (jax.ShapeDtypeStruct(x.shape, x.dtype),)
+    else:  # ComputationGraph
+        feats = (list(example_features)
+                 if isinstance(example_features, (list, tuple))
+                 else [example_features])
+        if len(feats) != len(net.conf.network_inputs):
+            raise ValueError(
+                f"graph has {len(net.conf.network_inputs)} inputs "
+                f"({net.conf.network_inputs}); got {len(feats)} example "
+                "feature arrays")
+        xs = tuple(wire_asarray(x, net.dtype, ids)
+                   for x, ids in zip(feats, net._inputs_are_ids()))
+
+        def serve(*xxs):
+            prepped = net._prep_inputs(tuple(xxs))
+            acts, _ = net._forward_pure(net._params, net._layer_state,
+                                        prepped, train=False, rng=None)
+            return tuple(acts[o] for o in net.conf.network_outputs)
+
+        args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in xs)
+
+    exp = jexport.export(jax.jit(serve),
+                         platforms=(None if platforms is None
+                                    else list(platforms)))(*args)
+    blob = exp.serialize()
+    if path is not None:
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+    return bytes(blob)
+
+
+def load_inference(src):
+    """Load a serialized StableHLO artifact (bytes, or a str/PathLike
+    file path) and
+    return a callable running it on the default backend — no network
+    object, config, or checkpoint needed."""
+    import os
+
+    from jax import export as jexport
+
+    if isinstance(src, (str, os.PathLike)):
+        with open(src, "rb") as f:
+            src = f.read()
+    exp = jexport.deserialize(bytearray(src))
+
+    def run(*features):
+        out = exp.call(*[np.asarray(f) for f in features])
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o) for o in out]
+        return np.asarray(out)
+
+    return run
