@@ -154,9 +154,6 @@ class GCSStorage(DataSetStorage):
         return self._bucket.blob(self._key(key)).exists()
 
 
-
-
-
 class StorageDataSetIterator(DataSetIterator):
     """STREAM DataSets from a key prefix, one object in memory at a time
     (reference `BaseS3DataSetIterator.java` — its `next()` opens the next

@@ -54,6 +54,7 @@ NEG_INF = -1e30
 # shared kernel-dispatch policy helpers (kept under the historical private
 # names — this module's kernels use them pervasively)
 from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
+    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
     dot as _dot,
     mxu_dtype as _mxu_dtype,
     probe_verdict as _probe_verdict,
@@ -421,11 +422,9 @@ def _eager_probe(dtype, block: int, head_dim: int) -> bool:
     return bool(jnp.all(jnp.isfinite(g[0].astype(jnp.float32))))
 
 
-# the default 16 MiB scoped-stack limit rejects 2048-wide tiles whose
-# f32 score slabs alone are 16 MiB (shared ceiling: kernel_dispatch)
-from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
-    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
-)
+# _VMEM_LIMIT (shared ceiling, kernel_dispatch): the default 16 MiB
+# scoped-stack limit rejects 2048-wide tiles whose f32 score slabs
+# alone are 16 MiB
 
 _BLOCK_CANDIDATES = (1024, 512, 256, 128)
 

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.kernel_dispatch import (
+    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
     dot as _dot,
     mxu_dtype as _mxu_dtype,
     probe_verdict as _probe_verdict,
@@ -281,15 +282,13 @@ def _lstm_bwd_kernel_masked(gates_ref, cprev_ref, dh_out_ref,
         dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
 
 
-# the default 16 MiB scoped-stack limit caps the batch block at 512 for
-# H=256 (bb=1024 needs 18.4 MiB of double-buffered xw/gates slabs) and
-# rejects H=1024 outright (100.1 MiB at bb=1024); the raised shared
-# ceiling lets the probe ladder serve MXU-width hidden sizes, and the
-# fall-through still lands on whatever the hardware accepts (bb=2048 at
-# H=1024 wants 145 MiB > the physical 128 and falls to 1024)
-from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
-    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
-)
+# _VMEM_LIMIT (shared ceiling, kernel_dispatch): the default 16 MiB
+# scoped-stack limit caps the batch block at 512 for H=256 (bb=1024
+# needs 18.4 MiB of double-buffered xw/gates slabs) and rejects H=1024
+# outright (100.1 MiB at bb=1024); the raised ceiling lets the probe
+# ladder serve MXU-width hidden sizes, and the fall-through still lands
+# on whatever the hardware accepts (bb=2048 at H=1024 wants 145 MiB >
+# the physical 128 and falls to 1024)
 
 _BLOCK_CANDIDATES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 

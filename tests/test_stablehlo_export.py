@@ -106,10 +106,9 @@ def test_graph_export_multi_input(tmp_path):
 
 
 def test_graph_export_input_count_mismatch():
-    net, x = _trained_mln()
-    from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    # MLN path takes a single array; graphs validate input counts
+    # graphs validate example-feature counts against network inputs
     b = (dl4j.NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
          .graph_builder()
          .add_inputs("a")
