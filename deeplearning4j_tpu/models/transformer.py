@@ -43,9 +43,12 @@ def gpt_configuration(vocab_size: int,
                       attention_block_size: int = 1024,
                       moe_experts: int = 0,
                       remat: bool = False,
+                      n_kv_heads: int = 0,
                       ) -> MultiLayerConfiguration:
     """Causal LM over int token ids (B, T) with next-token targets
-    (B, T, vocab) one-hot (per-timestep MCXENT, masked)."""
+    (B, T, vocab) one-hot (per-timestep MCXENT, masked). `n_kv_heads`:
+    grouped-query attention (0 = full MHA, 1 = MQA) — `generate()`'s KV
+    caches shrink by n_heads/n_kv_heads."""
     b = (NeuralNetConfiguration.Builder()
          .seed(seed)
          .learning_rate(learning_rate)
@@ -60,7 +63,7 @@ def gpt_configuration(vocab_size: int,
                                      causal=True,
                                      block_size=attention_block_size,
                                      moe_experts=moe_experts,
-                                     remat=remat))
+                                     remat=remat, n_kv_heads=n_kv_heads))
     return (b
             .layer(LayerNormalization(n_in=d_model, n_out=d_model,
                                       dropout=0.0))
@@ -120,7 +123,6 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
     if L > emb.max_length:
         raise ValueError(f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds "
                          f"max_length {emb.max_length}")
-    H = layers[block_is[0]].n_heads if block_is else 1
     params = net._params
     dtype = net.dtype
     # mixed-precision decode: embedding/block math and the KV caches run
@@ -138,13 +140,19 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
                 for i, p in enumerate(params)]
 
     def block_heads(layer, p, x):
-        """(B, T, d) -> per-head q, k, v (B, T, H, hd) for one block."""
+        """(B, T, d) -> q (B, T, H, hd) and k/v (B, T, Hkv, hd) for one
+        block — K/V stay at the layer's (possibly grouped) head count, so
+        GQA caches carry only Hkv heads."""
         d = x.shape[-1]
+        hd = d // layer.n_heads
+        Hkv = layer._kv_heads
+        kvw = Hkv * hd
         h1 = layer_norm(x, p["ln1_g"], p["ln1_b"], layer.eps)
         qkv = h1 @ p["Wqkv"] + p["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (*x.shape[:-1], H, d // H)
-        return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        q = qkv[..., :d].reshape(*x.shape[:-1], layer.n_heads, hd)
+        k = qkv[..., d:d + kvw].reshape(*x.shape[:-1], Hkv, hd)
+        v = qkv[..., d + kvw:].reshape(*x.shape[:-1], Hkv, hd)
+        return q, k, v
 
     def block_ffn(layer, p, x):
         """Post-attention half of the block on (B, T, d) or (B, d)."""
@@ -205,25 +213,34 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         caches = []
         for i in block_is:
             p = bp[i]
-            q, k, v = block_heads(layers[i], p, x)
-            att = full_attention(q, k, v, causal=True)
+            layer = layers[i]
+            q, k, v = block_heads(layer, p, x)
+            kf, vf = k, v
+            if layer._kv_heads != layer.n_heads:  # GQA: widen for prefill
+                g = layer.n_heads // layer._kv_heads
+                kf = jnp.repeat(k, g, axis=2)
+                vf = jnp.repeat(v, g, axis=2)
+            att = full_attention(q, kf, vf, causal=True)
             d = x.shape[-1]
             att = att.reshape(B, T0, d) @ p["Wo"] + p["bo"]
-            x = block_ffn(layers[i], p, x + att)
+            x = block_ffn(layer, p, x + att)
             # fixed-size caches so the decode scan has one static shape;
             # positions >= T0 are filled during decode. Layouts are the
-            # TPU decode-friendly ones: K as (B, H, hd, L) so the score
+            # TPU decode-friendly ones: K as (B, Hkv, hd, L) so the score
             # einsum contracts hd with L on the minor (lane) axis, V as
-            # (B, H, L, hd) so the weighted sum contracts L with hd minor
-            # — the (B, L, H, hd) layout made each step's cache read a
-            # strided transpose and dominated decode device time
+            # (B, Hkv, L, hd) so the weighted sum contracts L with hd
+            # minor — the (B, L, H, hd) layout made each step's cache read
+            # a strided transpose and dominated decode device time. Under
+            # GQA the caches hold only the Hkv grouped heads: cache bytes
+            # — the decode bandwidth bound — shrink by H/Hkv.
             hd = k.shape[-1]
-            kc = jnp.transpose(k, (0, 2, 3, 1))          # (B, H, hd, T0)
-            vc = jnp.transpose(v, (0, 2, 1, 3))          # (B, H, T0, hd)
+            Hkv = layer._kv_heads
+            kc = jnp.transpose(k, (0, 2, 3, 1))          # (B, Hkv, hd, T0)
+            vc = jnp.transpose(v, (0, 2, 1, 3))          # (B, Hkv, T0, hd)
             kc = jnp.concatenate(
-                [kc, jnp.zeros((B, H, hd, L - T0), k.dtype)], axis=3)
+                [kc, jnp.zeros((B, Hkv, hd, L - T0), k.dtype)], axis=3)
             vc = jnp.concatenate(
-                [vc, jnp.zeros((B, H, L - T0, hd), v.dtype)], axis=2)
+                [vc, jnp.zeros((B, Hkv, L - T0, hd), v.dtype)], axis=2)
             caches.append((kc, vc))
         logits = final_logits(bp, params, x[:, -1])
         return sample(logits, key), caches
@@ -241,23 +258,30 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             new_caches = []
             for bi, i in enumerate(block_is):
                 p = bp[i]
-                q, k, v = block_heads(layers[i], p, x[:, None, :])
+                layer = layers[i]
+                q, k, v = block_heads(layer, p, x[:, None, :])
                 kc, vc = caches[bi]
                 hd = q.shape[-1]
-                # k (B,1,H,hd) -> one (B,H,hd,1) lane column at pos;
-                # v -> one (B,H,1,hd) row at pos
+                # k (B,1,Hkv,hd) -> one (B,Hkv,hd,1) lane column at pos;
+                # v -> one (B,Hkv,1,hd) row at pos
                 kc = jax.lax.dynamic_update_slice(
                     kc, jnp.transpose(k, (0, 2, 3, 1)), (0, 0, 0, pos))
                 vc = jax.lax.dynamic_update_slice(
                     vc, jnp.transpose(v, (0, 2, 1, 3)), (0, 0, pos, 0))
-                s = jnp.einsum("bhd,bhdl->bhl", q[:, 0],
+                # (B, Hkv, G, hd): query heads grouped by the KV head they
+                # share — the einsums batch over Hkv and contract against
+                # the UN-repeated caches (this is GQA's decode win: each
+                # cache byte is read once and serves G query heads)
+                G = layer.n_heads // layer._kv_heads
+                qg = q[:, 0].reshape(B, layer._kv_heads, G, hd)
+                s = jnp.einsum("bkgd,bkdl->bkgl", qg,
                                kc) / jnp.sqrt(jnp.asarray(hd, q.dtype))
-                s = jnp.where(jnp.arange(L)[None, None, :] <= pos, s,
+                s = jnp.where(jnp.arange(L)[None, None, None, :] <= pos, s,
                               -jnp.inf)
                 w = jax.nn.softmax(s, axis=-1)
-                att = jnp.einsum("bhl,bhld->bhd", w, vc)
+                att = jnp.einsum("bkgl,bkld->bkgd", w, vc)
                 att = att.reshape(B, -1) @ p["Wo"] + p["bo"]
-                x = block_ffn(layers[i], p, x + att)
+                x = block_ffn(layer, p, x + att)
                 new_caches.append((kc, vc))
             logits = final_logits(bp, params, x)
             nxt = sample(logits, sub)

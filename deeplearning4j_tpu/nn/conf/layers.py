@@ -701,6 +701,9 @@ class SelfAttention(FeedForwardLayer):
     n_in: int = 0
     n_out: int = 0
     n_heads: int = 1
+    # grouped-query attention: K/V head count (0 = n_heads; 1 = MQA).
+    # Requires project_input (unprojected GQA has nothing to narrow).
+    n_kv_heads: int = 0
     causal: bool = False
     # blockwise path kicks in beyond this length; None = always full attention
     block_size: Optional[int] = 1024
@@ -715,10 +718,24 @@ class SelfAttention(FeedForwardLayer):
         if qkv % self.n_heads != 0:
             raise ValueError(
                 f"attention width {qkv} not divisible by n_heads={self.n_heads}")
+        if self.n_kv_heads:
+            if self.n_kv_heads < 0:
+                raise ValueError(f"n_kv_heads must be >= 0, got "
+                                 f"{self.n_kv_heads}")
+            if not self.project_input:
+                raise ValueError("n_kv_heads requires project_input=True")
+            if self.n_heads % self.n_kv_heads:
+                raise ValueError(
+                    f"n_heads {self.n_heads} not divisible by n_kv_heads "
+                    f"{self.n_kv_heads}")
 
     @property
     def _width(self) -> int:
         return self.n_out or self.n_in
+
+    @property
+    def _kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     def output_type(self, it: InputType) -> InputType:
         t = it.timeseries_length if isinstance(it, InputTypeRecurrent) else -1
@@ -726,14 +743,17 @@ class SelfAttention(FeedForwardLayer):
 
     def init_params(self, key, it, dtype=jnp.float32) -> Params:
         w = self._width
+        kvw = self._kv_heads * (w // self.n_heads)
         kq, kk, kv, ko = jax.random.split(key, 4)
         p = {}
         if self.project_input:
-            for name, kk_ in (("Wq", kq), ("Wk", kk), ("Wv", kv)):
-                p[name] = self._winit(kk_, (self.n_in, w), self.n_in, w, dtype)
+            for name, kk_, cols in (("Wq", kq, w), ("Wk", kk, kvw),
+                                    ("Wv", kv, kvw)):
+                p[name] = self._winit(kk_, (self.n_in, cols), self.n_in,
+                                      cols, dtype)
             p["bq"] = jnp.zeros((w,), dtype)
-            p["bk"] = jnp.zeros((w,), dtype)
-            p["bv"] = jnp.zeros((w,), dtype)
+            p["bk"] = jnp.zeros((kvw,), dtype)
+            p["bv"] = jnp.zeros((kvw,), dtype)
         p["Wo"] = self._winit(ko, (w, w), w, w, dtype)
         p["bo"] = jnp.zeros((w,), dtype)
         return p
@@ -742,18 +762,22 @@ class SelfAttention(FeedForwardLayer):
         is_bias = name.startswith("b")
         return {"is_bias": is_bias, "regularizable": not is_bias}
 
-    def _heads(self, x):
+    def _heads(self, x, n_heads=None):
         B, T, _ = x.shape
-        return x.reshape(B, T, self.n_heads, -1)
+        return x.reshape(B, T, n_heads or self.n_heads, -1)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         from deeplearning4j_tpu.ops.attention import multi_head_attention
 
         x = self._maybe_dropout(x, train, rng)
         if self.project_input:
+            Hkv = self._kv_heads
             q = self._heads(x @ params["Wq"] + params["bq"])
-            k = self._heads(x @ params["Wk"] + params["bk"])
-            v = self._heads(x @ params["Wv"] + params["bv"])
+            k = self._heads(x @ params["Wk"] + params["bk"], Hkv)
+            v = self._heads(x @ params["Wv"] + params["bv"], Hkv)
+            if Hkv != self.n_heads:
+                k = jnp.repeat(k, self.n_heads // Hkv, axis=2)
+                v = jnp.repeat(v, self.n_heads // Hkv, axis=2)
         else:
             q = k = v = self._heads(x)
         out = multi_head_attention(q, k, v, causal=self.causal, key_mask=mask,
@@ -1181,6 +1205,14 @@ class TransformerBlock(FeedForwardLayer):
     n_in: int = 0          # d_model
     n_out: int = 0
     n_heads: int = 4
+    # grouped-query attention: number of K/V heads (0 = n_heads, i.e.
+    # full MHA; 1 = MQA). Each KV head serves n_heads/n_kv_heads query
+    # heads. Training repeats KV heads to full width before the attention
+    # kernels (flash/ring/Ulysses paths unchanged); the payoff is DECODE,
+    # where the KV cache — the bandwidth bound of autoregressive
+    # generation — shrinks by the group factor (models/transformer.py
+    # caches only the n_kv_heads heads).
+    n_kv_heads: int = 0
     ffn_mult: int = 4
     causal: bool = True
     block_size: Optional[int] = 1024
@@ -1203,10 +1235,23 @@ class TransformerBlock(FeedForwardLayer):
                              f"{self.n_heads}")
         if self.n_in and self.n_out and self.n_in != self.n_out:
             raise ValueError("TransformerBlock keeps width: n_in == n_out")
+        if self.n_kv_heads:
+            if self.n_kv_heads < 0:
+                raise ValueError(f"n_kv_heads must be >= 0, got "
+                                 f"{self.n_kv_heads}")
+            if self.n_heads % self.n_kv_heads:
+                raise ValueError(
+                    f"n_heads {self.n_heads} not divisible by n_kv_heads "
+                    f"{self.n_kv_heads} (each KV head serves an equal "
+                    "group of query heads)")
 
     @property
     def _d(self) -> int:
         return self.n_out or self.n_in
+
+    @property
+    def _kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     def output_type(self, it: InputType) -> InputType:
         return it
@@ -1219,10 +1264,15 @@ class TransformerBlock(FeedForwardLayer):
         # whether or not the MoE branch exists in this version
         ks = jax.random.split(key, 4)
         mk = lambda k, shape, fi, fo: self._winit(k, shape, fi, fo, dtype)
+        # q takes d columns; k and v take kvw = n_kv_heads * head_dim each
+        # (== d for full MHA, where this reduces to the historical (d, 3d)
+        # fused projection with bit-identical seeded init)
+        kvw = self._kv_heads * (d // self.n_heads)
+        w3 = d + 2 * kvw
         params = {
             "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
-            "Wqkv": mk(ks[0], (d, 3 * d), d, 3 * d),
-            "bqkv": jnp.zeros((3 * d,), dtype),
+            "Wqkv": mk(ks[0], (d, w3), d, w3),
+            "bqkv": jnp.zeros((w3,), dtype),
             "Wo": mk(ks[1], (d, d), d, d), "bo": jnp.zeros((d,), dtype),
             "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
         }
@@ -1256,12 +1306,20 @@ class TransformerBlock(FeedForwardLayer):
 
         B, T, d = x.shape
         H = self.n_heads
+        Hkv = self._kv_heads
+        hd = d // H
         h1 = layer_norm(x, params["ln1_g"], params["ln1_b"], self.eps)
         qkv = h1 @ params["Wqkv"] + params["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (B, T, H, d // H)
-        att = multi_head_attention(q.reshape(shape), k.reshape(shape),
-                                   v.reshape(shape), causal=self.causal,
+        kvw = Hkv * hd
+        q = qkv[..., :d].reshape(B, T, H, hd)
+        k = qkv[..., d:d + kvw].reshape(B, T, Hkv, hd)
+        v = qkv[..., d + kvw:].reshape(B, T, Hkv, hd)
+        if Hkv != H:
+            # query head j attends through KV head j // (H // Hkv); the
+            # kernels (flash/blockwise/ring) see equal head counts
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
+        att = multi_head_attention(q, k, v, causal=self.causal,
                                    key_mask=mask,
                                    block_size=self.block_size)
         att = att.reshape(B, T, d) @ params["Wo"] + params["bo"]
