@@ -44,11 +44,14 @@ def gpt_configuration(vocab_size: int,
                       moe_experts: int = 0,
                       remat: bool = False,
                       n_kv_heads: int = 0,
+                      rope: bool = False,
                       ) -> MultiLayerConfiguration:
     """Causal LM over int token ids (B, T) with next-token targets
     (B, T, vocab) one-hot (per-timestep MCXENT, masked). `n_kv_heads`:
     grouped-query attention (0 = full MHA, 1 = MQA) — `generate()`'s KV
-    caches shrink by n_heads/n_kv_heads."""
+    caches shrink by n_heads/n_kv_heads. `rope`: rotary position
+    embeddings in every block, and NO learned positional table (position
+    is relative, encoded in the attention rotation)."""
     b = (NeuralNetConfiguration.Builder()
          .seed(seed)
          .learning_rate(learning_rate)
@@ -56,14 +59,16 @@ def gpt_configuration(vocab_size: int,
          .drop_out(dropout)
          .list()
          .layer(TokenEmbedding(n_in=vocab_size, n_out=d_model,
-                               max_length=max_length)))
+                               max_length=max_length,
+                               positional=not rope)))
     for _ in range(n_layers):
         b = b.layer(TransformerBlock(n_in=d_model, n_out=d_model,
                                      n_heads=n_heads, ffn_mult=ffn_mult,
                                      causal=True,
                                      block_size=attention_block_size,
                                      moe_experts=moe_experts,
-                                     remat=remat, n_kv_heads=n_kv_heads))
+                                     remat=remat, n_kv_heads=n_kv_heads,
+                                     rope=rope))
     return (b
             .layer(LayerNormalization(n_in=d_model, n_out=d_model,
                                       dropout=0.0))
@@ -120,7 +125,9 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         prompt = prompt[None, :]
     B, T0 = prompt.shape
     L = T0 + n_tokens
-    if L > emb.max_length:
+    if emb.positional and L > emb.max_length:
+        # RoPE models (positional=False) have no table to outgrow; the
+        # caches size to L directly
         raise ValueError(f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds "
                          f"max_length {emb.max_length}")
     params = net._params
@@ -139,10 +146,12 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         return [tree_cast(p, cdt) if i in (emb_i, *block_is) else p
                 for i, p in enumerate(params)]
 
-    def block_heads(layer, p, x):
+    def block_heads(layer, p, x, positions=None):
         """(B, T, d) -> q (B, T, H, hd) and k/v (B, T, Hkv, hd) for one
         block — K/V stay at the layer's (possibly grouped) head count, so
-        GQA caches carry only Hkv heads."""
+        GQA caches carry only Hkv heads. `positions`: RoPE rotation
+        positions (prefill: arange(T0); decode: the current scalar pos) —
+        keys enter the cache already rotated at their absolute position."""
         d = x.shape[-1]
         hd = d // layer.n_heads
         Hkv = layer._kv_heads
@@ -152,6 +161,12 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         q = qkv[..., :d].reshape(*x.shape[:-1], layer.n_heads, hd)
         k = qkv[..., d:d + kvw].reshape(*x.shape[:-1], Hkv, hd)
         v = qkv[..., d + kvw:].reshape(*x.shape[:-1], Hkv, hd)
+        if layer.rope:
+            from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
+
+            cos, sin = rope_angles(positions, hd, layer.rope_base)
+            q = rope_rotate(q, cos, sin)
+            k = rope_rotate(k, cos, sin)
         return q, k, v
 
     def block_ffn(layer, p, x):
@@ -208,13 +223,15 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         from deeplearning4j_tpu.ops.attention import full_attention
 
         bp = cast_blocks(params)
-        x = bp[emb_i]["W"][ids] + bp[emb_i]["P"][:T0]
+        x = bp[emb_i]["W"][ids]
+        if emb.positional:
+            x = x + bp[emb_i]["P"][:T0]
         x = x.astype(cdt)
         caches = []
         for i in block_is:
             p = bp[i]
             layer = layers[i]
-            q, k, v = block_heads(layer, p, x)
+            q, k, v = block_heads(layer, p, x, jnp.arange(T0))
             kf, vf = k, v
             if layer._kv_heads != layer.n_heads:  # GQA: widen for prefill
                 g = layer.n_heads // layer._kv_heads
@@ -253,13 +270,15 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             tok, caches, key = carry
             key, sub = jax.random.split(key)
             pos = T0 + t  # position of the token being consumed
-            x = bp[emb_i]["W"][tok] + bp[emb_i]["P"][pos]
+            x = bp[emb_i]["W"][tok]
+            if emb.positional:
+                x = x + bp[emb_i]["P"][pos]
             x = x.astype(cdt)
             new_caches = []
             for bi, i in enumerate(block_is):
                 p = bp[i]
                 layer = layers[i]
-                q, k, v = block_heads(layer, p, x[:, None, :])
+                q, k, v = block_heads(layer, p, x[:, None, :], pos)
                 kc, vc = caches[bi]
                 hd = q.shape[-1]
                 # k (B,1,Hkv,hd) -> one (B,Hkv,hd,1) lane column at pos;

@@ -1159,6 +1159,9 @@ class TokenEmbedding(FeedForwardLayer):
     n_in: int = 0          # vocabulary size
     n_out: int = 0         # d_model
     max_length: int = 512
+    # False: tokens only — for RoPE models, where position lives in the
+    # attention rotation and a learned absolute table would fight it
+    positional: bool = True
 
     def output_type(self, it: InputType) -> InputType:
         t = it.timeseries_length if isinstance(it, InputTypeRecurrent) else -1
@@ -1168,6 +1171,8 @@ class TokenEmbedding(FeedForwardLayer):
         k1, k2 = jax.random.split(key)
         tok = self._winit(k1, (self.n_in, self.n_out), self.n_in, self.n_out,
                           dtype)
+        if not self.positional:
+            return {"W": tok}
         pos = 0.02 * jax.random.normal(k2, (self.max_length, self.n_out),
                                        dtype)
         return {"W": tok, "P": pos}
@@ -1177,10 +1182,14 @@ class TokenEmbedding(FeedForwardLayer):
         if idx.ndim == 3:  # (B, T, 1) convenience
             idx = idx[..., 0]
         T = idx.shape[1]
-        if T > self.max_length:
+        if self.positional and T > self.max_length:
+            # only the learned table bounds length; positional=False
+            # (RoPE models) extrapolates freely — position is relative
             raise ValueError(f"sequence length {T} exceeds max_length "
                              f"{self.max_length}")
-        y = params["W"][idx] + params["P"][:T]
+        y = params["W"][idx]
+        if self.positional:
+            y = y + params["P"][:T]
         y = self._maybe_dropout(y, train, rng)
         return y, state
 
@@ -1213,6 +1222,12 @@ class TransformerBlock(FeedForwardLayer):
     # generation — shrinks by the group factor (models/transformer.py
     # caches only the n_kv_heads heads).
     n_kv_heads: int = 0
+    # rotary position embeddings (relative-position attention; pair with
+    # TokenEmbedding(positional=False) — gpt_configuration(rope=True)
+    # wires both). Keys rotate at their absolute position, so the q.k
+    # product depends only on relative distance; needs even head_dim.
+    rope: bool = False
+    rope_base: float = 10000.0
     ffn_mult: int = 4
     causal: bool = True
     block_size: Optional[int] = 1024
@@ -1244,6 +1259,10 @@ class TransformerBlock(FeedForwardLayer):
                     f"n_heads {self.n_heads} not divisible by n_kv_heads "
                     f"{self.n_kv_heads} (each KV head serves an equal "
                     "group of query heads)")
+        if self.rope and d and (d // self.n_heads) % 2:
+            raise ValueError(
+                f"RoPE rotates feature PAIRS: head_dim {d // self.n_heads} "
+                "must be even")
 
     @property
     def _d(self) -> int:
@@ -1314,6 +1333,12 @@ class TransformerBlock(FeedForwardLayer):
         q = qkv[..., :d].reshape(B, T, H, hd)
         k = qkv[..., d:d + kvw].reshape(B, T, Hkv, hd)
         v = qkv[..., d + kvw:].reshape(B, T, Hkv, hd)
+        if self.rope:
+            from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
+
+            cos, sin = rope_angles(jnp.arange(T), hd, self.rope_base)
+            q = rope_rotate(q, cos, sin)
+            k = rope_rotate(k, cos, sin)
         if Hkv != H:
             # query head j attends through KV head j // (H // Hkv); the
             # kernels (flash/blockwise/ring) see equal head counts

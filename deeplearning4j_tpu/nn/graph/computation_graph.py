@@ -756,9 +756,11 @@ class ComputationGraph:
                             # so far (clamped at the table end)
                             idx = (x if x.ndim == 1 else x[:, 0]).astype(
                                 jnp.int32)
-                            p = jnp.minimum(pos, layer.max_length - 1)
-                            acts[name] = (params[name]["W"][idx]
-                                          + params[name]["P"][p])
+                            emb = params[name]["W"][idx]
+                            if layer.positional:  # rope: no learned table
+                                p = jnp.minimum(pos, layer.max_length - 1)
+                                emb = emb + params[name]["P"][p]
+                            acts[name] = emb
                             continue
                         if x.ndim == 1:
                             # single-step token ids (B,) -> (B, 1) so the

@@ -796,8 +796,10 @@ class MultiLayerNetwork:
                     if isinstance(layer, TokenEmbedding):
                         idx = (h if h.ndim == 1 else h[:, 0]).astype(
                             jnp.int32)
-                        p = jnp.minimum(pos, layer.max_length - 1)
-                        h = params[i]["W"][idx] + params[i]["P"][p]
+                        h = params[i]["W"][idx]
+                        if layer.positional:  # rope models carry no table
+                            p = jnp.minimum(pos, layer.max_length - 1)
+                            h = h + params[i]["P"][p]
                         continue
                     if h.ndim == 1:
                         h = h[:, None]   # single-step ids -> one timestep

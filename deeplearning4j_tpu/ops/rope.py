@@ -1,0 +1,47 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021 — rotate-half form).
+
+No counterpart in the reference (no transformer tier); included because
+relative-position attention is how modern long-context decoders encode
+order: each head's feature pairs (x_a, x_b) rotate by angle pos * base
+^(-2a/hd), so the q·k inner product depends only on the RELATIVE
+distance between query and key — attention generalizes past the trained
+context window, and there is no learned positional table to bound
+`max_length`. TPU-friendly: pure elementwise mul/add on (B, T, H, hd)
+slabs, fused by XLA into the surrounding projections; the precomputed
+cos/sin tables are (T, hd/2) and broadcast over batch and heads.
+
+Decode contract (models/transformer.py): keys are rotated at their own
+absolute position BEFORE entering the KV cache — a cached key never
+needs re-rotation — and each step's query rotates at the current
+position.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions, head_dim: int, base: float = 10000.0):
+    """cos/sin tables for `positions` (any shape P...): ((P..., hd/2) x 2).
+    `head_dim` must be even (pairs rotate together)."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def rope_rotate(x, cos, sin):
+    """Rotate (..., T, H, hd) by per-position tables (T, hd/2) — or a
+    single position's (hd/2,) tables for one decode step. Computed in
+    f32 (angles are precision-sensitive at long range) and cast back."""
+    half = x.shape[-1] // 2
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    if cos.ndim == 1:            # single position: broadcast over heads
+        c, s = cos, sin
+    else:                        # (T, half) -> (T, 1, half) over heads
+        c, s = cos[:, None, :], sin[:, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
+                           axis=-1).astype(dt)

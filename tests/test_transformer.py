@@ -433,3 +433,110 @@ def test_gqa_validation():
     with pytest.raises(ValueError, match="project_input"):
         SelfAttention(n_in=32, n_out=32, n_heads=4, n_kv_heads=2,
                       project_input=False)
+
+
+def test_rope_inner_products_are_relative():
+    """The defining RoPE property: <rot(q, i), rot(k, j)> depends only on
+    i - j, so shifting both positions by any offset preserves attention
+    scores exactly."""
+    from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
+
+    rng = np.random.default_rng(0)
+    hd = 16
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, hd)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, hd)).astype(np.float32))
+
+    def score(i, j):
+        ci, si = rope_angles(np.array([i]), hd)
+        cj, sj = rope_angles(np.array([j]), hd)
+        return float(jnp.sum(rope_rotate(q, ci, si) * rope_rotate(k, cj, sj)))
+
+    for off in (1, 7, 100):
+        np.testing.assert_allclose(score(3, 1), score(3 + off, 1 + off),
+                                   rtol=1e-5)
+    # and scores DO change with relative distance
+    assert abs(score(3, 1) - score(4, 1)) > 1e-6
+
+
+def test_rope_gpt_trains_and_is_causal():
+    """rope=True (no learned positional table): the model still resolves
+    order (cyclic next-token task needs it) and stays causal."""
+    conf = gpt_configuration(vocab_size=11, d_model=32, n_heads=2,
+                             n_layers=2, max_length=16, learning_rate=3e-3,
+                             rope=True)
+    net = MultiLayerNetwork(conf)
+    net.init()
+    assert "P" not in net._params[0], "rope model must not carry a learned table"
+    x, y = _lm_data(11, 32, 12)
+    first = None
+    for _ in range(60):
+        net.fit(DataSet(x, y))
+        if first is None:
+            first = net.score_value
+    assert net.score_value < 0.3 < first
+    out1 = net.output(x[:2])
+    x2 = np.array(x[:2])
+    x2[:, 8:] = (x2[:, 8:] + 3) % 11
+    np.testing.assert_allclose(out1[:, :8], net.output(x2)[:, :8], atol=1e-5)
+
+
+def test_rope_gqa_generate_greedy_matches_naive_loop():
+    """RoPE + GQA decode: cached keys are pre-rotated at their absolute
+    positions and queries rotate per step — must reproduce the
+    full-context argmax loop exactly."""
+    from deeplearning4j_tpu.models.transformer import generate
+
+    net = MultiLayerNetwork(gpt_configuration(
+        vocab_size=31, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        max_length=32, rope=True))
+    net.init()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 31, (2, 5)).astype(np.int32)
+    n_new = 8
+    fast = generate(net, prompt, n_new, temperature=0.0)
+    ids = prompt.copy()
+    naive = []
+    for _ in range(n_new):
+        nxt = np.argmax(net.output(ids)[:, -1], axis=-1).astype(np.int32)
+        naive.append(nxt)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(fast, np.stack(naive, axis=1))
+
+
+def test_rope_serde_and_validation():
+    from deeplearning4j_tpu.nn.conf.layers import TransformerBlock
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+
+    conf = gpt_configuration(vocab_size=7, d_model=16, n_heads=2,
+                             n_layers=1, max_length=8, rope=True)
+    c2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert c2.layers[1].rope is True
+    assert c2.layers[0].positional is False
+
+    with pytest.raises(ValueError, match="must be even"):
+        TransformerBlock(n_in=6, n_out=6, n_heads=2, rope=True)
+
+
+def test_rope_extrapolates_past_max_length():
+    """positional=False (RoPE): nothing bounds sequence length — output
+    and generate run past max_length, while a learned-table model raises."""
+    from deeplearning4j_tpu.models.transformer import generate
+
+    rope_net = MultiLayerNetwork(gpt_configuration(
+        vocab_size=11, d_model=16, n_heads=2, n_layers=1, max_length=8,
+        rope=True))
+    rope_net.init()
+    x = np.arange(24, dtype=np.float32)[None, :] % 11  # T=24 > max_length=8
+    out = rope_net.output(x)
+    assert out.shape == (1, 24, 11) and np.isfinite(out).all()
+    toks = generate(rope_net, x[:, :6].astype(np.int32), 8,
+                    temperature=0.0)  # 6 + 8 > 8
+    assert toks.shape == (1, 8)
+
+    learned = MultiLayerNetwork(gpt_configuration(
+        vocab_size=11, d_model=16, n_heads=2, n_layers=1, max_length=8))
+    learned.init()
+    with pytest.raises(ValueError, match="max_length"):
+        learned.output(x)
