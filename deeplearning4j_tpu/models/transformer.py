@@ -45,6 +45,7 @@ def gpt_configuration(vocab_size: int,
                       remat: bool = False,
                       n_kv_heads: int = 0,
                       rope: bool = False,
+                      ffn_activation: str = "gelu",
                       ) -> MultiLayerConfiguration:
     """Causal LM over int token ids (B, T) with next-token targets
     (B, T, vocab) one-hot (per-timestep MCXENT, masked). `n_kv_heads`:
@@ -68,7 +69,8 @@ def gpt_configuration(vocab_size: int,
                                      block_size=attention_block_size,
                                      moe_experts=moe_experts,
                                      remat=remat, n_kv_heads=n_kv_heads,
-                                     rope=rope))
+                                     rope=rope,
+                                     ffn_activation=ffn_activation))
     return (b
             .layer(LayerNormalization(n_in=d_model, n_out=d_model,
                                       dropout=0.0))
@@ -182,6 +184,9 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
                              aux_weight=layer.moe_aux_weight,
                              train=False,
                              passthrough="zero").reshape(*lead, -1)
+        elif layer.ffn_activation == "swiglu":
+            ffn = (jax.nn.silu(h2 @ p["W1"])
+                   * (h2 @ p["W3"])) @ p["W2"] + p["b2"]
         else:
             ffn = jax.nn.gelu(h2 @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
         return x + ffn

@@ -1229,6 +1229,12 @@ class TransformerBlock(FeedForwardLayer):
     rope: bool = False
     rope_base: float = 10000.0
     ffn_mult: int = 4
+    # "gelu": h = gelu(x W1 + b1) W2 + b2 (the historical default).
+    # "swiglu": h = (silu(x W1) * (x W3)) W2 — gated linear unit with a
+    # third projection; with rope + n_kv_heads this is the llama-style
+    # decoder block. (Dense FFN only; the Switch-MoE expert FFN keeps
+    # gelu.)
+    ffn_activation: str = "gelu"
     causal: bool = True
     block_size: Optional[int] = 1024
     eps: float = 1e-5
@@ -1263,6 +1269,12 @@ class TransformerBlock(FeedForwardLayer):
             raise ValueError(
                 f"RoPE rotates feature PAIRS: head_dim {d // self.n_heads} "
                 "must be even")
+        if self.ffn_activation not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown ffn_activation "
+                             f"{self.ffn_activation!r}: gelu | swiglu")
+        if self.ffn_activation == "swiglu" and self.moe_experts > 0:
+            raise ValueError("swiglu applies to the dense FFN only; the "
+                             "Switch-MoE expert FFN keeps gelu")
 
     @property
     def _d(self) -> int:
@@ -1303,6 +1315,12 @@ class TransformerBlock(FeedForwardLayer):
                 "b1": jnp.zeros((E, h), dtype),
                 "W2": mk(ks[3], (E, h, d), h, d),
                 "b2": jnp.zeros((E, d), dtype),
+            })
+        elif self.ffn_activation == "swiglu":
+            params.update({
+                "W1": mk(ks[2], (d, h), d, h),
+                "W3": mk(jax.random.fold_in(key, 5), (d, h), d, h),
+                "W2": mk(ks[3], (h, d), h, d), "b2": jnp.zeros((d,), dtype),
             })
         else:
             params.update({
@@ -1365,6 +1383,9 @@ class TransformerBlock(FeedForwardLayer):
                              token_mask=token_mask,
                              train=train,
                              passthrough="zero").reshape(B, T, d)
+        elif self.ffn_activation == "swiglu":
+            ffn = (jax.nn.silu(h2 @ params["W1"])
+                   * (h2 @ params["W3"])) @ params["W2"] + params["b2"]
         else:
             ffn = jax.nn.gelu(h2 @ params["W1"] + params["b1"]) @ params["W2"] \
                 + params["b2"]

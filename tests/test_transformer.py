@@ -540,3 +540,46 @@ def test_rope_extrapolates_past_max_length():
     learned.init()
     with pytest.raises(ValueError, match="max_length"):
         learned.output(x)
+
+
+def test_swiglu_gpt_trains_and_decodes():
+    """The llama-style block (rope + GQA + SwiGLU FFN): learns the copy
+    task, serde round-trips, and the KV-cache decode matches the
+    full-context loop."""
+    from deeplearning4j_tpu.models.transformer import generate
+    from deeplearning4j_tpu.nn.conf.layers import TransformerBlock
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+
+    conf = gpt_configuration(vocab_size=11, d_model=32, n_heads=4,
+                             n_kv_heads=2, n_layers=2, max_length=16,
+                             learning_rate=3e-3, rope=True,
+                             ffn_activation="swiglu")
+    c2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert c2.layers[1].ffn_activation == "swiglu"
+
+    net = MultiLayerNetwork(conf)
+    net.init()
+    assert "W3" in net._params[1] and "b1" not in net._params[1]
+    x, y = _lm_data(11, 32, 12)
+    first = None
+    for _ in range(60):
+        net.fit(DataSet(x, y))
+        if first is None:
+            first = net.score_value
+    assert net.score_value < 0.3 < first
+
+    prompt = np.argmax(y[:2, :5], axis=-1).astype(np.int32)
+    fast = generate(net, prompt, 6, temperature=0.0)
+    ids = prompt.copy()
+    for _ in range(6):
+        nxt = np.argmax(net.output(ids)[:, -1], axis=-1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(fast, ids[:, 5:])
+
+    with pytest.raises(ValueError, match="gelu | swiglu"):
+        TransformerBlock(n_in=32, n_out=32, n_heads=4, ffn_activation="relu")
+    with pytest.raises(ValueError, match="dense FFN only"):
+        TransformerBlock(n_in=32, n_out=32, n_heads=4, moe_experts=4,
+                         ffn_activation="swiglu")
