@@ -180,12 +180,27 @@ class Layer:
     def _maybe_dropout(self, x, train, rng):
         """Input dropout (reference applies dropout to layer INPUT in
         `BaseLayer.preOutput:354` via `Dropout.applyDropout`). DL4J keeps
-        E[x] by inverted dropout: scale by 1/keep at train time."""
+        E[x] by inverted dropout: scale by 1/keep at train time.
+
+        The mask is drawn from per-ROW keys (`fold_in(rng, global_row)`,
+        see `ops/rng_rows`) so the realization is invariant to how the
+        batch is partitioned — a GPipe microbatch inside a manual
+        shard_map reproduces exactly the rows a single-device step would
+        draw, which is what makes pipeline training with dropout hold
+        same-seed parity."""
         p = self.dropout or 0.0
         if not train or p <= 0.0 or rng is None:
             return x
+        from deeplearning4j_tpu.ops.rng_rows import current_row_offset
+
         keep = 1.0 - p
-        m = jax.random.bernoulli(rng, keep, x.shape)
+        off = current_row_offset()
+        rows = jnp.arange(x.shape[0], dtype=jnp.int32)
+        if off is not None:
+            rows = rows + jnp.asarray(off, jnp.int32)
+        keys = jax.vmap(lambda r: jax.random.fold_in(rng, r))(rows)
+        m = jax.vmap(
+            lambda kk: jax.random.bernoulli(kk, keep, x.shape[1:]))(keys)
         return jnp.where(m, x / keep, 0.0)
 
     def _maybe_drop_connect(self, W, train, rng):

@@ -1,0 +1,42 @@
+"""Batch-row-indexed PRNG scope: partition-invariant dropout masks.
+
+Dropout draws its mask from per-ROW keys — `fold_in(layer_rng, global_row)`
+— instead of one bulk draw over the whole batch. The realization for a
+given (seed, iteration, layer, row) is then identical no matter how the
+batch is partitioned: single device, dp shards under the global-view jit,
+or GPipe microbatches inside a manual `shard_map` (where each microbatch
+sees only a SLICE of the batch and a bulk draw could not reproduce the
+single-device mask). This is what lets pipeline stages run dropout with
+exact same-seed parity vs single-device training
+(`parallel/pipeline_wrapper.py`) — the reference has no analogous problem
+because its only strategy is whole-model replicas (`ParallelWrapper.java`,
+each worker holds the full net and draws locally).
+
+The scope communicates the first global row index of the slice currently
+being processed; it is trace-time state (set while tracing the pipeline
+step), never runtime state. Outside any scope the offset is 0 — the
+single-device/global-view case.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+_ROW_OFFSET = None  # trace-time only; a traced int32 scalar inside scopes
+
+
+@contextmanager
+def row_offset_scope(offset):
+    """While tracing: batch rows seen by dropout are global rows
+    [offset, offset + local_rows)."""
+    global _ROW_OFFSET
+    prev = _ROW_OFFSET
+    _ROW_OFFSET = offset
+    try:
+        yield
+    finally:
+        _ROW_OFFSET = prev
+
+
+def current_row_offset():
+    """The active slice's first global row index, or None (== row 0)."""
+    return _ROW_OFFSET

@@ -33,7 +33,8 @@ from jax import shard_map
 def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
                    mesh: Mesh, *, axis_name: str = "pipe",
                    microbatches: int = None,
-                   data_axis: str = None) -> jnp.ndarray:
+                   data_axis: str = None,
+                   block_ctx: bool = False) -> jnp.ndarray:
     """Apply S stacked stages as a pipeline over the mesh axis.
 
     block_fn(params_i, x) -> y with y.shape == x.shape (homogeneous stages);
@@ -46,6 +47,19 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
     ride `axis_name` per data shard, activations never cross the data
     axis; gradient reduction over `data_axis` is inserted by the SPMD
     partitioner at the parameter level outside this function).
+
+    `block_ctx`: call `block_fn(params_i, x, stage, row_offset)` instead —
+    `stage` is this device's (traced) pipeline-stage index and
+    `row_offset` the first GLOBAL batch-row index of the microbatch slice
+    `x` holds. Lets the block derive per-layer PRNG keys (fold the true
+    layer index) and partition-invariant dropout masks (`ops/rng_rows`).
+
+    Tensor parallelism composes through the AUTO mesh axes: only
+    `axis_name` (and `data_axis`) are manual inside the shard_map — any
+    other mesh axis (e.g. 'model') is left to the SPMD partitioner, so
+    stacked param leaves sharded P(pipe, ..., 'model') at the jit level
+    keep their tensor sharding inside each stage and XLA inserts the
+    model-axis collectives (3-D dp x tp x pp in one mesh).
     """
     S = mesh.shape[axis_name]
     M = microbatches if microbatches is not None else S
@@ -79,6 +93,11 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
         perm = [(i, (i + 1) % S) for i in range(S)]
         mb_shape = xs_local.shape[1:]
         n_steps = S + M - 1
+        # global row offset of this device's slice of microbatch m:
+        # m * (global microbatch rows) + this data shard's offset within it
+        local_rows = xs_local.shape[1]
+        di_rows = (lax.axis_index(data_axis) * local_rows
+                   if data_axis is not None else 0)
 
         def step(t, carry):
             buf, outs = carry
@@ -86,7 +105,13 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
             # consume what arrived from the previous stage
             inj = xs_local[jnp.clip(t, 0, M - 1)]
             inp = jnp.where(d == 0, inj, buf)
-            y = block_fn(p, inp)
+            if block_ctx:
+                # stage d processes microbatch t - d at tick t (garbage
+                # during fill/drain; outputs masked below)
+                m = jnp.clip(t - d, 0, M - 1)
+                y = block_fn(p, inp, d, m * (B // M) + di_rows)
+            else:
+                y = block_fn(p, inp)
             # last stage owns the finished microbatch t-(S-1)
             out_idx = t - (S - 1)
             oc = jnp.clip(out_idx, 0, M - 1)
@@ -104,11 +129,17 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
 
     # batch dim of each microbatch rides the data axis (if any); the
     # stage loop and collectives above only ever name `axis_name`, so the
-    # same body serves 1-D pp and 2-D dp x pp
+    # same body serves 1-D pp and 2-D dp x pp. Any OTHER mesh axis stays
+    # AUTO (partial-manual shard_map): tensor-sharded stage params keep
+    # their model-axis sharding inside the body and the SPMD partitioner
+    # inserts the tensor collectives — pp composes with tp for free.
     xspec = P(None, data_axis) if data_axis is not None else P()
+    manual = {axis_name} | ({data_axis} if data_axis is not None else set())
+    extra = set(mesh.axis_names) - manual
+    kw = {"axis_names": frozenset(manual)} if extra else {}
     out = shard_map(local, mesh=mesh,
                     in_specs=(P(axis_name), xspec),
-                    out_specs=xspec, check_vma=False)(stacked_params, xs)
+                    out_specs=xspec, check_vma=False, **kw)(stacked_params, xs)
     return out.reshape(B, *x.shape[1:])
 
 

@@ -20,15 +20,43 @@ and sharded over `pipe`, so each device holds only its own stage's
 weights; the updater math (elementwise over leaves) runs directly on the
 stacked/sharded pytrees — no gather, no per-stage hosts.
 
-Restrictions (declined loudly in __init__): the trunk layers must be
-stateless (no BatchNormalization — per-microbatch batch stats would
-change semantics), dropout-free, and MoE-free (the aux-loss side channel
-doesn't thread through the pipeline loop); masks and tBPTT stay on
-ParallelWrapper. Same-seed loss parity vs single-device training is the
-correctness bar (`tests/test_pipeline_wrapper.py`, incl. the GPT
-TransformerBlock trunk — the model class this wrapper exists for), the
-analogue of the reference's
-`TestCompareParameterAveragingSparkVsSingleMachine`.
+Dropout IS supported in the trunk (r5): each stage derives its true
+layer's PRNG key (`fold_in(rng, trunk_start + stage*k + j)` with the
+traced stage index) and dropout draws partition-invariant per-row masks
+(`ops/rng_rows`), so a pipelined dropout>0 net reproduces single-device
+training bit-for-seed. Tensor parallelism composes in the same mesh
+(r5): pass `model_axis="model"` and the stacked stage params are
+additionally sharded Megatron-style over that axis (Wqkv/W1 column,
+Wo/W2 row for a TransformerBlock trunk); the shard_map keeps only
+{pipe, data} manual, so the SPMD partitioner owns the model axis and
+inserts its collectives inside each stage — dp x tp x pp in one jit.
+
+Remaining restrictions (declined loudly, with the quantitative reason):
+- BatchNormalization trunks: BN computes BATCH statistics; a GPipe stage
+  sees one microbatch (B/M rows) per tick, so its normalizer would use
+  B/M-row moments where single-device training uses B-row moments — a
+  semantic change (noisier stats, different running averages), not a
+  numerical tolerance. Cross-microbatch sync inside the fori_loop would
+  serialize the pipeline (each tick would need all M microbatches'
+  activations — exactly what the schedule exists to avoid). Use
+  ParallelWrapper: under dp the global-view jit computes full-batch
+  moments regardless of sharding.
+- MoE trunks: `switch_ffn`'s load-balancing aux loss rides a trace-time
+  side channel (`ops/aux_loss`) that collects per CALL; inside the
+  pipeline fori_loop the trunk body executes once per TICK on garbage
+  fill/drain slots too, and the aux term of microbatch m exists only on
+  stage s at tick s+m — summing it correctly requires threading an
+  extra carry through the loop AND masking fill/drain ticks. Doable,
+  but the capacity-overflow semantics would still differ (per-microbatch
+  capacity vs global capacity). Replicated MoE head/tail blocks work
+  (they run in the global view); expert-parallel MoE composes with dp
+  via ParallelWrapper instead.
+- masks and tBPTT stay on ParallelWrapper.
+
+Same-seed loss parity vs single-device training is the correctness bar
+(`tests/test_pipeline_wrapper.py`, incl. the GPT TransformerBlock trunk
+with dropout and the 3-D dp x tp x pp mesh), the analogue of the
+reference's `TestCompareParameterAveragingSparkVsSingleMachine`.
 
 Schedule & bubble: GPipe with M microbatches over S stages runs
 S + M - 1 pipeline ticks, of which S - 1 are fill/drain — the bubble
@@ -131,9 +159,9 @@ def find_trunk(net, n_stages: int) -> Tuple[int, int]:
         raise ValueError(
             f"no pipeline-able trunk: need >= {n_stages} contiguous "
             "identical stateless shape-preserving layers (found a best run "
-            f"of {end - start}). BatchNormalization/dropout/MoE layers "
-            "cannot join a pipeline stage; use ParallelWrapper (dp/tp) "
-            "for such nets")
+            f"of {end - start}). BatchNormalization/MoE layers cannot join "
+            "a pipeline stage (see the module docstring for the math); "
+            "use ParallelWrapper (dp/tp) for such nets")
     return start, start + usable
 
 
@@ -149,7 +177,7 @@ def _pipelineable(net, i) -> bool:
         return False
     if net._layer_state[i]:  # stateful (BN running stats, LSTM carries)
         return False
-    if getattr(layer, "dropout", 0) or getattr(layer, "moe_experts", 0):
+    if getattr(layer, "moe_experts", 0):  # aux-loss side channel (docstring)
         return False
     sig = _layer_signature(net, i)
     return sig[4]  # shape-preserving
@@ -168,12 +196,24 @@ class PipelineParallelWrapper:
                  pipe_axis: str = "pipe",
                  microbatches: Optional[int] = None,
                  data_axis: Optional[str] = None,
+                 model_axis: Optional[str] = None,
+                 model_specs: Optional[dict] = None,
                  prefetch_buffer: int = 2):
         """`data_axis`: 2-D dp x pp — give a mesh with BOTH axes (e.g.
         `make_mesh({"data": 2, "pipe": 4})`); batches shard over `data`,
         stages over `pipe`, and the SPMD partitioner inserts the gradient
         all-reduce over the data axis inside the step (the reference's
-        averaging step, at ICI speed, composed with the pipeline)."""
+        averaging step, at ICI speed, composed with the pipeline).
+
+        `model_axis`: 3-D dp x tp x pp — stage parameters are additionally
+        TENSOR-sharded over this mesh axis inside each pipeline stage.
+        `model_specs` maps trunk param names to PartitionSpecs WITHOUT the
+        leading stage dim (e.g. {"W1": P(None, "model")}); omitted names
+        replicate over the axis. When the trunk is a TransformerBlock
+        stack the Megatron-style specs (Wqkv/W1/W3 column, Wo/W2 row) are
+        derived automatically. The model axis stays AUTO in the pipeline
+        shard_map, so XLA owns the tensor collectives — numerics are
+        exactly the single-device math."""
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
         if not hasattr(net, "layers"):
@@ -197,8 +237,17 @@ class PipelineParallelWrapper:
         if data_axis == pipe_axis:
             raise ValueError("data_axis must differ from pipe_axis "
                              f"({pipe_axis!r})")
+        if model_axis is not None:
+            if model_axis not in self.mesh.shape:
+                raise ValueError(f"mesh has no '{model_axis}' axis: "
+                                 f"{dict(self.mesh.shape)}")
+            if model_axis in (pipe_axis, data_axis):
+                raise ValueError(
+                    f"model_axis {model_axis!r} must differ from the pipe "
+                    f"and data axes")
         self.pipe_axis = pipe_axis
         self.data_axis = data_axis
+        self.model_axis = model_axis
         self.n_data = (1 if data_axis is None
                        else self.mesh.shape[data_axis])
         self.n_stages = self.mesh.shape[pipe_axis]
@@ -236,10 +285,44 @@ class PipelineParallelWrapper:
         self._stage_sh = NamedSharding(self.mesh, P(pipe_axis))
         self._batch_sh = (self._repl if data_axis is None
                           else NamedSharding(self.mesh, P(data_axis)))
+        if model_axis is None:
+            self._model_specs = {}
+        elif model_specs is not None:
+            self._model_specs = dict(model_specs)
+        else:
+            self._model_specs = self._derive_model_specs()
 
         # wrapper-owned layout: (head list, stacked trunk, tail list)
         self._split_from_net()
         self._jit_step = None
+
+    def _derive_model_specs(self) -> dict:
+        """Megatron-style tensor shardings for the trunk's param names.
+        Column-shard the up-projections, row-shard the down-projections;
+        norm scales/shifts and output biases replicate. These are HINTS on
+        an auto axis — XLA propagates and inserts the collectives, so an
+        imperfect spec costs communication, never correctness."""
+        from deeplearning4j_tpu.nn.conf.layers import TransformerBlock
+
+        ax = self.model_axis
+        layer = self.net.layers[self.trunk_start]
+        if isinstance(layer, TransformerBlock):
+            return {"Wqkv": P(None, ax), "bqkv": P(ax),
+                    "Wo": P(ax, None),
+                    "W1": P(None, ax), "b1": P(ax), "W3": P(None, ax),
+                    "W2": P(ax, None)}
+        # generic dense trunk: column-shard the weight, split the bias
+        return {"W": P(None, ax), "b": P(ax)}
+
+    def _trunk_leaf_sh(self, name, arr) -> NamedSharding:
+        """Sharding for one STACKED trunk leaf: stage axis over pipe, plus
+        the layer's model-axis spec when the leaf is param-shaped (updater
+        slots mirror their parameter; non-param-shaped slots stage-shard
+        only)."""
+        sp = self._model_specs.get(name, P())
+        if len(sp) and arr.ndim - 1 < len(sp):
+            sp = P()
+        return NamedSharding(self.mesh, P(self.pipe_axis, *sp))
 
     # ------------------------------------------------------------- layout
     def _stage_group(self, tree_list, s):
@@ -264,12 +347,24 @@ class PipelineParallelWrapper:
         # trunk layers are stateless; head/tail states stay as-is
         self.lstate = list(net._layer_state)
 
+        # per-leaf trunk shardings: stage axis over pipe + the model-axis
+        # tensor spec (identity when model_axis is None)
+        self._trunk_sh = [
+            {name: self._trunk_leaf_sh(name, arr)
+             for name, arr in grp.items()}
+            for grp in self.trunk_params]
+        self._trunk_upd_sh = [
+            {name: {slot: self._trunk_leaf_sh(name, sarr)
+                    for slot, sarr in slots.items()}
+             for name, slots in grp.items()}
+            for grp in self.trunk_upd]
+
         self.head_params = jax.device_put(self.head_params, self._repl)
         self.tail_params = jax.device_put(self.tail_params, self._repl)
-        self.trunk_params = jax.device_put(self.trunk_params, self._stage_sh)
+        self.trunk_params = jax.device_put(self.trunk_params, self._trunk_sh)
         self.head_upd = jax.device_put(self.head_upd, self._repl)
         self.tail_upd = jax.device_put(self.tail_upd, self._repl)
-        self.trunk_upd = jax.device_put(self.trunk_upd, self._stage_sh)
+        self.trunk_upd = jax.device_put(self.trunk_upd, self._trunk_upd_sh)
         self.lstate = jax.device_put(self.lstate, self._repl)
 
     def sync_to_net(self) -> None:
@@ -332,18 +427,27 @@ class PipelineParallelWrapper:
             k = self.layers_per_stage
             trunk_layers = [net.layers[self.trunk_start + j]
                             for j in range(k)]
+            from deeplearning4j_tpu.ops.rng_rows import row_offset_scope
 
-            def block_fn(stage_p, xb):
+            def block_fn(stage_p, xb, stage, row_off):
+                # stage is the traced pipeline-stage index: fold the TRUE
+                # layer index (trunk_start + stage*k + j) so per-layer keys
+                # match `_loss_pure`'s fold exactly; row_off makes dropout
+                # draw the same global-row masks a single device would
                 for j in range(k):
-                    xb, _ = trunk_layers[j].forward(stage_p[j], {}, xb,
-                                                    train=train, rng=None,
-                                                    mask=None)
+                    lrng = (None if rng is None else jax.random.fold_in(
+                        rng, self.trunk_start + stage * k + j))
+                    with row_offset_scope(row_off):
+                        xb, _ = trunk_layers[j].forward(
+                            stage_p[j], {}, xb, train=train, rng=lrng,
+                            mask=None)
                 return xb
 
             x = pipeline_apply(block_fn, trunk_p, x, self.mesh,
                                axis_name=self.pipe_axis,
                                microbatches=self.microbatches,
-                               data_axis=self.data_axis)
+                               data_axis=self.data_axis,
+                               block_ctx=True)
 
             for idx, i in enumerate(range(self.trunk_end,
                                           len(net.layers) - 1)):
@@ -428,12 +532,14 @@ class PipelineParallelWrapper:
                 ut.append(u)
             return nh, ntr, nt, uh, utr, ut, new_lstate, iteration + 1, loss
 
-        repl, st, bsh = self._repl, self._stage_sh, self._batch_sh
+        repl, bsh = self._repl, self._batch_sh
+        tsh, tush = self._trunk_sh, self._trunk_upd_sh
         return jax.jit(
             step,
-            in_shardings=(repl, st, repl, repl, st, repl, repl, repl,
+            in_shardings=(repl, tsh, repl, repl, tush, repl, repl, repl,
                           bsh, bsh, bsh, bsh),
-            out_shardings=(repl, st, repl, repl, st, repl, repl, repl, repl),
+            out_shardings=(repl, tsh, repl, repl, tush, repl, repl, repl,
+                           repl),
             donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7),
         )
 

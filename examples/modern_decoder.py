@@ -9,6 +9,12 @@ Three knobs on the same `gpt_configuration` builder:
                        decode throughput at 8->2 heads on v5e);
 - `ffn_activation="swiglu"` — gated FFN.
 
+With >= 2 devices the script also PIPELINE-trains the same decoder with
+dropout=0.1 through `PipelineParallelWrapper` and checks same-seed parity
+vs a single-device run — dropout composes with the pipeline because masks
+are drawn per GLOBAL batch row (`ops/rng_rows`), so every microbatch
+reproduces exactly the rows a single device would draw.
+
 Run: python examples/modern_decoder.py
 """
 import pathlib
@@ -55,6 +61,52 @@ def main():
                    include_prompt=True)
     print(f"sampled {out.shape[1]} tokens (trained at T={T}):")
     print("".join(chars[i] for i in out[0]))
+
+    pipeline_with_dropout(stoi, ids)
+
+
+def pipeline_with_dropout(stoi, ids):
+    """Pipeline-train the decoder WITH dropout (r5): the trunk stages
+    thread per-microbatch PRNG, so a dropout=0.1 llama-style net trains
+    through GPipe with exact same-seed parity vs one device."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.pipeline_wrapper import (
+        PipelineParallelWrapper,
+    )
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print("(single device: skipping the pipeline+dropout demo — run "
+              "under the 8-device CPU mesh to see it)")
+        return
+    n_pipe = 2
+    T, B = 32, 16
+    conf = lambda: gpt_configuration(
+        vocab_size=len(stoi), d_model=64, n_heads=4, n_kv_heads=2,
+        rope=True, ffn_activation="swiglu", n_layers=n_pipe, max_length=T,
+        dropout=0.1, learning_rate=1e-3, seed=3)
+    rng = np.random.default_rng(1)
+    starts = rng.integers(0, len(ids) - T - 1, B)
+    w = np.stack([ids[s:s + T + 1] for s in starts])
+    ds = DataSet(w[:, :-1].astype(np.int32), w[:, 1:].astype(np.int32))
+
+    ref = MultiLayerNetwork(conf())
+    ref.init()
+    for _ in range(5):
+        ref.fit(ds)
+
+    net = MultiLayerNetwork(conf())
+    net.init()
+    pw = PipelineParallelWrapper(
+        net, make_mesh({"pipe": n_pipe}, devices=jax.devices()[:n_pipe]))
+    for _ in range(5):
+        pw.fit(ds)
+    err = abs(net.score_value - ref.score_value)
+    print(f"pipeline+dropout parity: pp loss {net.score_value:.5f} vs "
+          f"single-device {ref.score_value:.5f} (|diff| {err:.2e})")
+    assert err < 1e-3, "pipeline dropout parity broke"
 
 
 if __name__ == "__main__":
