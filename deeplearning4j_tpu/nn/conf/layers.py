@@ -297,6 +297,24 @@ class OutputLayer(DenseLayer):
         return loss_score(self.loss, self.activation or Activation.IDENTITY,
                           labels, pre, mask)
 
+    def score_array(self, params, x, labels, *, mask=None):
+        """Per-EXAMPLE scores, shape (B,) — the reference's
+        `ILossFunction.computeScoreArray` consumed by
+        `MultiLayerNetwork.scoreExamples`. Time-distributed outputs sum
+        their (masked) per-timestep rows into one score per sequence
+        (reference `RnnOutputLayer` computeScoreForExamples semantics)."""
+        from deeplearning4j_tpu.ops.losses import loss_per_row
+
+        pre = self.pre_output(params, x, train=False, rng=None)
+        per_row = loss_per_row(self.loss,
+                               self.activation or Activation.IDENTITY,
+                               labels, pre)
+        if mask is not None:
+            per_row = per_row * jnp.reshape(mask, per_row.shape)
+        if per_row.ndim > 1:  # (B, T) time-distributed → sum over time
+            per_row = jnp.sum(per_row.reshape(per_row.shape[0], -1), axis=-1)
+        return per_row
+
 
 @register_layer
 @dataclass
@@ -349,6 +367,10 @@ class LossLayer(Layer):
                 mask = mask.reshape(B * T)
         return loss_score(self.loss, self.activation or Activation.IDENTITY,
                           labels, pre, mask)
+
+    # per-example scoring shares OutputLayer's implementation (it only
+    # touches pre_output/loss/activation, which LossLayer also carries)
+    score_array = OutputLayer.score_array
 
 
 # ---------------------------------------------------------------------------

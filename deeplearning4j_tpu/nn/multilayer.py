@@ -725,6 +725,33 @@ class MultiLayerNetwork:
                                   None, train)
         return float(loss)
 
+    def score_examples(self, ds: DataSet,
+                       add_regularization: bool = False) -> np.ndarray:
+        """Per-example loss scores, shape (B,) (reference
+        `MultiLayerNetwork.scoreExamples:3169`: feed forward, then the
+        output layer's computeScoreForExamples; time-distributed outputs
+        sum masked per-timestep scores per sequence). With
+        `add_regularization` the net's L1/L2 penalty is added to every
+        example's score (reference adds `calcRegularizationScore` the same
+        way). For unmasked single-step data, `mean(score_examples(ds))`
+        equals `score(ds)` minus the regularization term."""
+        self._ensure_init()
+        self._check_sparse_labels(ds)
+        f, l, fm, lm = self._batch_arrays(ds)
+        f = self._prep_features(f)
+        x, _ = self._forward_pure(self._params, self._layer_state, f,
+                                  train=False, rng=None, fmask=fm,
+                                  upto=len(self.layers) - 1)
+        out_i = len(self.layers) - 1
+        if out_i in self.conf.preprocessors:
+            x = self.conf.preprocessors[out_i].preprocess(x)
+        mask = lm if lm is not None else (fm if x.ndim == 3 else None)
+        scores = self.layers[-1].score_array(self._params[-1], x, l,
+                                             mask=mask)
+        if add_regularization:
+            scores = scores + self._reg_score(self._params)
+        return np.asarray(scores)
+
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.argmax(self.output(x), axis=-1)
 

@@ -75,20 +75,16 @@ def _elementwise_loss(loss: LossFunction, labels: jnp.ndarray, out: jnp.ndarray)
     raise ValueError(f"unknown loss {loss}")
 
 
-def loss_score(
+def loss_per_row(
     loss: LossFunction | str,
     activation: Activation | str,
     labels: jnp.ndarray,
     preout: jnp.ndarray,
-    mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Mean-per-example loss from PRE-activation outputs.
-
-    Fuses softmax+MCXENT / sigmoid+XENT into numerically-stable forms — the
-    TPU/XLA analogue of the reference's ILossFunction computeGradient
-    shortcuts for the softmax and sigmoid output-activation cases.
-    Returns a scalar: sum over output dims, mean over (unmasked) rows.
-    """
+    """Per-ROW loss from PRE-activation outputs: shape preout.shape[:-1]
+    (one score per example row, or per (b, t) position for time-distributed
+    outputs). The reference's `ILossFunction.computeScoreArray` role —
+    what `scoreExamples` aggregates and `loss_score` means over."""
     loss = LossFunction(loss) if not isinstance(loss, LossFunction) else loss
     activation = Activation(activation) if not isinstance(activation, Activation) else activation
 
@@ -106,9 +102,8 @@ def loss_score(
             # clamp into range: sentinel ids on MASKED positions must stay
             # harmless (an OOB gather yields NaN, and NaN×0 mask is NaN)
             idx = jnp.clip(labels, 0, preout.shape[-1] - 1)
-            per_row = -jnp.take_along_axis(
+            return -jnp.take_along_axis(
                 ls, idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
-            return _masked_row_mean(per_row, mask)
         raise ValueError(
             "integer class-id labels require MCXENT/NEGATIVELOGLIKELIHOOD "
             f"with SOFTMAX output (got loss={loss.value}, "
@@ -123,17 +118,32 @@ def loss_score(
         out = activation_fn(activation)(preout)
         num = jnp.sum(labels * out, axis=-1)
         den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
-        per_row = -num / jnp.clip(den, _EPS, None)
-        return _masked_row_mean(per_row, mask)
+        return -num / jnp.clip(den, _EPS, None)
     else:
         out = activation_fn(activation)(preout)
         per_elem = _elementwise_loss(loss, labels, out)
 
     if loss == LossFunction.MSE:
-        per_row = jnp.mean(per_elem, axis=-1)
-    else:
-        per_row = jnp.sum(per_elem, axis=-1)
-    return _masked_row_mean(per_row, mask)
+        return jnp.mean(per_elem, axis=-1)
+    return jnp.sum(per_elem, axis=-1)
+
+
+def loss_score(
+    loss: LossFunction | str,
+    activation: Activation | str,
+    labels: jnp.ndarray,
+    preout: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean-per-example loss from PRE-activation outputs.
+
+    Fuses softmax+MCXENT / sigmoid+XENT into numerically-stable forms — the
+    TPU/XLA analogue of the reference's ILossFunction computeGradient
+    shortcuts for the softmax and sigmoid output-activation cases.
+    Returns a scalar: sum over output dims, mean over (unmasked) rows.
+    """
+    return _masked_row_mean(loss_per_row(loss, activation, labels, preout),
+                            mask)
 
 
 def _masked_row_mean(per_row: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:

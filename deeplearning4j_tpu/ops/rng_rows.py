@@ -19,24 +19,27 @@ single-device/global-view case.
 """
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 
-_ROW_OFFSET = None  # trace-time only; a traced int32 scalar inside scopes
+# thread-local like the sibling aux_loss scope: training masters and the
+# distributed wrappers trace on ThreadPoolExecutor threads, and a traced
+# offset leaking across threads would poison an unrelated trace
+_STATE = threading.local()
 
 
 @contextmanager
 def row_offset_scope(offset):
     """While tracing: batch rows seen by dropout are global rows
     [offset, offset + local_rows)."""
-    global _ROW_OFFSET
-    prev = _ROW_OFFSET
-    _ROW_OFFSET = offset
+    prev = getattr(_STATE, "offset", None)
+    _STATE.offset = offset
     try:
         yield
     finally:
-        _ROW_OFFSET = prev
+        _STATE.offset = prev
 
 
 def current_row_offset():
     """The active slice's first global row index, or None (== row 0)."""
-    return _ROW_OFFSET
+    return getattr(_STATE, "offset", None)

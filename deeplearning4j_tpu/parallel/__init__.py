@@ -18,6 +18,7 @@ which is the foundation the long-context/sequence-parallel modules build on.
 from deeplearning4j_tpu.parallel.mesh import make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.early_stopping import (  # noqa: F401
+    EarlyStoppingDistributedTrainer,
     EarlyStoppingParallelTrainer,
 )
 from deeplearning4j_tpu.parallel.parameter_server import (  # noqa: F401

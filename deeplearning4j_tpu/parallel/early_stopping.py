@@ -3,32 +3,61 @@
 Reference: `deeplearning4j-scaleout-parallelwrapper/.../
 EarlyStoppingParallelTrainer.java` — the early-stopping epoch loop where
 each epoch's fit runs through ParallelWrapper instead of single-device
-`net.fit`.
+`net.fit` — and `spark/earlystopping/SparkEarlyStoppingTrainer.java`,
+the same loop driving the TrainingMaster's worker/averaging path.
 """
 from __future__ import annotations
 
 from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
-from deeplearning4j_tpu.earlystopping.result import EarlyStoppingResult
 from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
 
-class _ParallelFitFacade:
-    """Presents the (net + ParallelWrapper) pair as a single model whose
-    `fit` is the sharded multi-chip step; everything else (score, listeners,
-    serialization) proxies to the underlying network."""
+class _FitFacade:
+    """Presents a (fit delegate + net) pair as a single model: `fit` runs
+    the delegate's multi-device path (ParallelWrapper sharded step, or the
+    TrainingMaster's worker pool); everything else (score, listeners,
+    serialization, clone) proxies to the underlying network — so model
+    savers store real network clones, never the facade."""
 
-    def __init__(self, wrapper: ParallelWrapper):
-        object.__setattr__(self, "_wrapper", wrapper)
+    def __init__(self, fit_target, net):
+        object.__setattr__(self, "_fit_target", fit_target)
+        object.__setattr__(self, "_net", net)
 
     def fit(self, iterator, epochs: int = 1):
-        self._wrapper.fit(iterator, epochs=epochs)
+        object.__getattribute__(self, "_fit_target").fit(iterator,
+                                                         epochs=epochs)
 
     def __getattr__(self, name):
-        return getattr(object.__getattribute__(self, "_wrapper").net, name)
+        return getattr(object.__getattribute__(self, "_net"), name)
 
     def __setattr__(self, name, value):
-        setattr(object.__getattribute__(self, "_wrapper").net, name, value)
+        setattr(object.__getattribute__(self, "_net"), name, value)
+
+
+class EarlyStoppingDistributedTrainer(EarlyStoppingTrainer):
+    """Early stopping where each epoch's fit goes through the
+    TrainingMaster's worker/averaging path (reference
+    `spark/earlystopping/SparkEarlyStoppingTrainer.java` — extends
+    `BaseSparkEarlyStoppingTrainer.fit`: per-epoch
+    `trainingMaster.executeTraining`, then score calculators / termination
+    conditions on the synced net). Iteration-level termination conditions
+    fire through the master's `iteration_done` listener fan-out, exactly
+    as on the single-device trainer."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 train_iterator, training_master):
+        from deeplearning4j_tpu.parallel.training_master import (
+            DistributedMultiLayer,
+        )
+
+        self.distributed = (
+            training_master if isinstance(training_master,
+                                          DistributedMultiLayer)
+            else DistributedMultiLayer(net, training_master))
+        super().__init__(config,
+                         _FitFacade(self.distributed, self.distributed.net),
+                         train_iterator)
 
 
 class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
@@ -38,12 +67,5 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
         if wrapper is None:
             wrapper = ParallelWrapper(net, **wrapper_kwargs)
         self.wrapper = wrapper
-        super().__init__(config, _ParallelFitFacade(wrapper), train_iterator)
-
-    def fit(self) -> EarlyStoppingResult:
-        result = super().fit()
-        # unwrap the facade so callers get real networks back
-        if result.best_model is not None and isinstance(
-                result.best_model, _ParallelFitFacade):
-            result.best_model = result.best_model._wrapper.net
-        return result
+        super().__init__(config, _FitFacade(wrapper, wrapper.net),
+                         train_iterator)

@@ -74,36 +74,41 @@ class ParallelWrapper:
         # parallelism axis hangs off the unchanged user API)
         self._expert_layers = []
         self._expert_axes = set()
+
+        def _wire_expert(key, layer):
+            """Validate + shard one expert-parallel MoE layer. `key` is the
+            param_specs key: layer index (MLN) or vertex name (CG) — the
+            sharding map below is keyed the same way, so both containers
+            ride the identical seam (reference analogue:
+            `ComputationGraph.java:952` treats both containers uniformly)."""
+            ax = layer.expert_axis
+            if ax not in self.mesh.shape:
+                raise ValueError(
+                    f"layer {key!r} wants expert_axis '{ax}' but the mesh "
+                    f"axes are {dict(self.mesh.shape)}")
+            if layer.n_experts != self.mesh.shape[ax]:
+                raise ValueError(
+                    f"layer {key!r} has {layer.n_experts} experts but mesh "
+                    f"axis '{ax}' has size {self.mesh.shape[ax]} — expert-"
+                    f"parallel execution shards one expert per device")
+            self._expert_layers.append(key)
+            self._expert_axes.add(ax)
+            ep = specs.setdefault(key, {})
+            for name in ("W1", "b1", "W2", "b2"):
+                ep.setdefault(name, P(ax))
+
         if isinstance(net._params, dict):
-            # ComputationGraph: the expert-sharding seam below indexes MLN
-            # layer positions; fail fast rather than silently training
-            # E-times-replicated experts the user asked to shard
+            # ComputationGraph: layer vertices carry the same MoELayer; the
+            # expert scope + switch_ffn_sharded path is container-agnostic
+            # (MoELayer.forward consults the scope), so only the sharding
+            # keys differ — vertex names instead of layer indices (r5)
             for name, node in getattr(net.conf, "nodes", {}).items():
                 if (getattr(node, "is_layer", False)
                         and getattr(node.layer, "expert_axis", None)):
-                    raise NotImplementedError(
-                        f"vertex '{name}': expert_axis on a "
-                        "ComputationGraph is not supported yet — use a "
-                        "MultiLayerNetwork for expert-parallel MoE, or "
-                        "drop expert_axis to train replicated experts")
+                    _wire_expert(name, node.layer)
         for i, layer in enumerate(getattr(net, "layers", []) or []):
-            ax = getattr(layer, "expert_axis", None)
-            if not ax:
-                continue
-            if ax not in self.mesh.shape:
-                raise ValueError(
-                    f"layer {i} wants expert_axis '{ax}' but the mesh axes "
-                    f"are {dict(self.mesh.shape)}")
-            if layer.n_experts != self.mesh.shape[ax]:
-                raise ValueError(
-                    f"layer {i} has {layer.n_experts} experts but mesh axis "
-                    f"'{ax}' has size {self.mesh.shape[ax]} — expert-"
-                    f"parallel execution shards one expert per device")
-            self._expert_layers.append(i)
-            self._expert_axes.add(ax)
-            ep = specs.setdefault(i, {})
-            for name in ("W1", "b1", "W2", "b2"):
-                ep.setdefault(name, P(ax))
+            if getattr(layer, "expert_axis", None):
+                _wire_expert(i, layer)
         if self._expert_layers and net.conf.tbptt_fwd_length > 0:
             # tBPTT pads the tail window with a synthesized mask, which the
             # expert-parallel path rejects — mid-epoch, after partial
@@ -214,10 +219,13 @@ class ParallelWrapper:
             # time length: (B, T, F) dense sequences, or (B, T) integer
             # token ids (TokenEmbedding nets) — for the latter dim 1 is
             # TIME, not features, and counting it as 1 would over-trim
-            # batches whose true token count B*T already divides
-            int_ids = (f.ndim == 2
-                       and getattr(self.net.layers[0], "integer_input",
-                                   False))
+            # batches whose true token count B*T already divides. For a
+            # ComputationGraph 2-D input, T=1 is the safe (stricter) choice:
+            # need | B implies need | B*T, so the trim stays valid.
+            first = (self.net.layers[0]
+                     if getattr(self.net, "layers", None) else None)
+            int_ids = (f.ndim == 2 and first is not None
+                       and getattr(first, "integer_input", False))
             T = f.shape[1] if (f.ndim == 3 or int_ids) else 1
             need = n_data
             for ax in self._expert_axes:

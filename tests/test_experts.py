@@ -373,39 +373,83 @@ def test_expert_parallel_uneven_tail_batch_trims():
     pw.fit(DataSet(x3, y3))  # no crash
 
 
-def test_expert_parallel_rejects_graph_and_tbptt():
-    """Fail-fast combinations: ComputationGraph + expert_axis, and
-    tBPTT + expert_axis (padded tail windows are masked)."""
+def _moe_graph(expert_axis, E=4, seed=1):
+    import deeplearning4j_tpu as dl4j
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, MoELayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (dl4j.NeuralNetConfiguration.Builder().seed(seed)
+            .learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("proj", DenseLayer(n_in=6, n_out=16,
+                                          activation=Activation.RELU), "in")
+            .add_layer("moe", MoELayer(n_in=16, n_out=16, n_experts=E,
+                                       capacity_factor=float(2 * E),
+                                       expert_axis=expert_axis), "proj")
+            .add_layer("out", RnnOutputLayer(n_in=16, n_out=3,
+                                             activation=Activation.SOFTMAX,
+                                             loss=LossFunction.MCXENT),
+                       "moe")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(6))
+            .build())
+    net = ComputationGraph(conf)
+    net.init()
+    return net
+
+
+def test_expert_parallel_computation_graph_matches_single_device():
+    """r5 (r4 verdict ask #6): a MoELayer vertex with expert_axis inside a
+    ComputationGraph trains through ParallelWrapper.fit on {data, expert}
+    with same-seed parity vs single device — the aux-loss side channel and
+    the expert scope are container-agnostic, only the sharding keys differ
+    (vertex names). Reference seam: `ComputationGraph.java:952` — the
+    reference treats both containers uniformly."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    rng = np.random.default_rng(7)
+    c = rng.integers(0, 3, (16, 4))
+    x = (rng.normal(size=(16, 4, 6)) * 0.3 + c[..., None]).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[c]
+
+    ref = _moe_graph(expert_axis=None)
+    for _ in range(5):
+        ref.fit(DataSet(x, y))
+
+    net = _moe_graph(expert_axis="expert")
+    mesh = make_mesh({"data": 2, "expert": 4})
+    pw = ParallelWrapper(net, mesh=mesh)
+    # stacked expert weights sharded one-per-device, keyed by vertex name
+    sh = net._params["moe"]["W1"].sharding
+    assert sh.spec == jax.sharding.PartitionSpec("expert")
+    for _ in range(5):
+        pw.fit(DataSet(x, y))
+    assert np.isclose(net.score_value, ref.score_value, rtol=2e-4), (
+        net.score_value, ref.score_value)
+    for pr, pd in zip(jax.tree_util.tree_leaves(ref._params),
+                      jax.tree_util.tree_leaves(net._params)):
+        np.testing.assert_allclose(np.asarray(pd), np.asarray(pr),
+                                   rtol=5e-4, atol=5e-6)
+
+
+def test_expert_parallel_rejects_tbptt():
+    """Fail-fast: tBPTT + expert_axis (padded tail windows are masked, and
+    masked tokens cannot ride the expert dispatch)."""
     import deeplearning4j_tpu as dl4j
     from deeplearning4j_tpu.nn.conf.inputs import InputType
     from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, MoELayer,
                                                    RnnOutputLayer)
-    from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.ops.activations import Activation
     from deeplearning4j_tpu.ops.losses import LossFunction
     from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
     mesh = make_mesh({"data": 2, "expert": 4})
-
-    gconf = (dl4j.NeuralNetConfiguration.Builder().seed(1)
-             .learning_rate(0.05)
-             .graph_builder()
-             .add_inputs("in")
-             .add_layer("moe", MoELayer(n_in=6, n_out=6, n_experts=4,
-                                        expert_axis="expert"), "in")
-             .add_layer("out", RnnOutputLayer(n_in=6, n_out=3,
-                                              activation=Activation.SOFTMAX,
-                                              loss=LossFunction.MCXENT),
-                        "moe")
-             .set_outputs("out")
-             .set_input_types(InputType.recurrent(6))
-             .build())
-    gnet = ComputationGraph(gconf)
-    gnet.init()
-    with pytest.raises(NotImplementedError, match="ComputationGraph"):
-        ParallelWrapper(gnet, mesh=mesh)
-
     tconf = (dl4j.NeuralNetConfiguration.Builder().seed(1)
              .learning_rate(0.05).list()
              .layer(DenseLayer(n_in=6, n_out=16,

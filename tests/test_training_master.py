@@ -398,3 +398,156 @@ def test_file_iterator_single_path_and_natural_order(tmp_path):
 
     order = [float(ds.features[0, 0]) for ds in FileDataSetIterator(d)]
     assert order == [1.0, 2.0, 9.0, 10.0, 11.0], order
+
+
+# ---------------------------------------------------------------------------
+# r5: distributed evaluate / calculate_score / score_examples / early stop
+
+
+def test_distributed_evaluate_matches_local():
+    """Sharded evaluation (per-worker Evaluation merged) must equal the
+    single-device evaluation on the same data exactly (reference
+    `SparkDl4jMultiLayer.evaluate:511-528` + `Evaluation.merge`)."""
+    batches = _batches(7, batch=8, seed=3)
+    net = _net(seed=5)
+    for ds in batches[:2]:
+        net.fit(ds)
+
+    local = net.evaluate(ListDataSetIterator(batches))
+    dm = DistributedMultiLayer(
+        net, ParameterAveragingTrainingMaster(num_workers=3))
+    dist = dm.evaluate(ListDataSetIterator(batches))
+    assert dist.accuracy() == pytest.approx(local.accuracy())
+    assert dist.f1() == pytest.approx(local.f1())
+    np.testing.assert_array_equal(dist.confusion_matrix,
+                                  local.confusion_matrix)
+    assert dist._examples_seen == local._examples_seen
+
+
+def test_distributed_calculate_score_matches_local():
+    """Example-weighted score combine (reference `calculateScore:382`):
+    equal-size shards must reproduce the local weighted mean."""
+    batches = _batches(5, batch=8, seed=4)
+    net = _net(seed=6)
+    dm = DistributedMultiLayer(
+        net, ParameterAveragingTrainingMaster(num_workers=2))
+    local = float(np.mean([net.score(ds) for ds in batches]))
+    assert dm.calculate_score(ListDataSetIterator(batches)) == \
+        pytest.approx(local, rel=1e-6)
+    # non-averaged: sum over examples
+    total = sum(net.score(ds) * ds.num_examples() for ds in batches)
+    assert dm.calculate_score(ListDataSetIterator(batches),
+                              average=False) == pytest.approx(total, rel=1e-6)
+
+
+def test_score_examples_local_semantics():
+    """Per-example scores: mean equals the batch score minus regularization
+    (unmasked FF data), and batched == row-by-row."""
+    net = _net(seed=7)
+    ds = _batches(1, batch=10, seed=8)[0]
+    scores = net.score_examples(ds)
+    assert scores.shape == (10,)
+    assert float(np.mean(scores)) == pytest.approx(net.score(ds), rel=1e-5)
+    rows = [net.score_examples(DataSet(ds.features[i:i + 1],
+                                       ds.labels[i:i + 1]))[0]
+            for i in range(10)]
+    np.testing.assert_allclose(scores, rows, rtol=1e-5)
+
+
+def test_distributed_score_examples_preserves_order():
+    """Distributed per-example scoring returns scores in the ORIGINAL
+    example order across round-robin shards (reference
+    `scoreExamples:382-416`)."""
+    batches = _batches(5, batch=6, seed=9)
+    net = _net(seed=10)
+    dm = DistributedMultiLayer(
+        net, ParameterAveragingTrainingMaster(num_workers=3))
+    dist = dm.score_examples(ListDataSetIterator(batches))
+    local = np.concatenate([net.score_examples(ds) for ds in batches])
+    np.testing.assert_allclose(dist, local, rtol=1e-6)
+    assert dist.shape == (30,)
+
+
+def test_early_stopping_through_master_matches_single_device():
+    """EarlyStoppingDistributedTrainer with num_workers=1 must terminate
+    identically (same epoch count, reason, scores) to the plain
+    single-device EarlyStoppingTrainer (reference
+    `SparkEarlyStoppingTrainer` vs `EarlyStoppingTrainer` semantics)."""
+    from deeplearning4j_tpu.earlystopping.config import (
+        EarlyStoppingConfiguration,
+    )
+    from deeplearning4j_tpu.earlystopping.saver import InMemoryModelSaver
+    from deeplearning4j_tpu.earlystopping.score_calc import (
+        DataSetLossCalculator,
+    )
+    from deeplearning4j_tpu.earlystopping.termination import (
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+    from deeplearning4j_tpu.parallel.early_stopping import (
+        EarlyStoppingDistributedTrainer,
+    )
+
+    train = _batches(4, batch=8, seed=11)
+    test = _batches(2, batch=8, seed=12)
+
+    def config():
+        return EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            score_calculator=DataSetLossCalculator(
+                ListDataSetIterator(test)),
+            model_saver=InMemoryModelSaver())
+
+    ref = EarlyStoppingTrainer(config(), _net(seed=13),
+                               ListDataSetIterator(train))
+    ref_result = ref.fit()
+
+    master = ParameterAveragingTrainingMaster(num_workers=1,
+                                              averaging_frequency=1)
+    dist = EarlyStoppingDistributedTrainer(config(), _net(seed=13),
+                                           ListDataSetIterator(train),
+                                           master)
+    dist_result = dist.fit()
+
+    assert dist_result.termination_reason == ref_result.termination_reason
+    assert dist_result.total_epochs == ref_result.total_epochs
+    assert dist_result.best_model_epoch == ref_result.best_model_epoch
+    assert dist_result.best_model_score == pytest.approx(
+        ref_result.best_model_score, rel=1e-6)
+    for e, s in ref_result.score_vs_epoch.items():
+        assert dist_result.score_vs_epoch[e] == pytest.approx(s, rel=1e-6)
+    # the unwrapped best model is a real network
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork as MLN
+    assert isinstance(dist_result.best_model, MLN)
+
+
+def test_early_stopping_through_master_multiworker():
+    """Functional: the master path early-stops with num_workers=2 (the
+    averaged trajectory differs from single device, but termination and
+    best-model bookkeeping must work)."""
+    from deeplearning4j_tpu.earlystopping.config import (
+        EarlyStoppingConfiguration,
+    )
+    from deeplearning4j_tpu.earlystopping.result import TerminationReason
+    from deeplearning4j_tpu.earlystopping.saver import InMemoryModelSaver
+    from deeplearning4j_tpu.earlystopping.termination import (
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.parallel.early_stopping import (
+        EarlyStoppingDistributedTrainer,
+    )
+
+    train = _batches(4, batch=8, seed=14)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        model_saver=InMemoryModelSaver())
+    master = ParameterAveragingTrainingMaster(num_workers=2,
+                                              averaging_frequency=2)
+    trainer = EarlyStoppingDistributedTrainer(cfg, _net(seed=15),
+                                              ListDataSetIterator(train),
+                                              master)
+    result = trainer.fit()
+    assert result.termination_reason == \
+        TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 3
+    assert np.isfinite(result.best_model_score)
