@@ -34,6 +34,76 @@ class LexEntry:
     right_id: int = 0
 
 
+_UNKNOWN_BASE = 1.3    # an OOV run costs more than any dictionary word
+_UNKNOWN_PER_CHAR = 0.3
+_KNOWN_LEN_BONUS = 0.05  # longer dictionary matches cost slightly less
+_USER_COST = 0.1  # user-dictionary entries sit at the _word_cost floor
+
+
+@dataclass(frozen=True)
+class CharCategory:
+    """Unknown-word generation rules for one script class — the char.def
+    category row (reference
+    `com/atilika/kuromoji/dict/CharacterDefinitions.java`: INVOKE, GROUP,
+    LENGTH per category, consumed by `UnknownDictionary.java` /
+    `viterbi/ViterbiBuilder.processUnknownWord`).
+
+    invoke: generate unknown candidates even when a dictionary word
+    matches at the position (MeCab invoke=1); False = only where the
+    dictionary is silent. group: the whole maximal same-class run is a
+    candidate. length: additionally, prefixes of 1..length characters
+    (KANJI-style short candidates). Costs are per-category — a NUMERIC
+    run groups cheaply, an OOV kanji prefix stays expensive. left/right
+    ids: the unk.def context classes for the bigram lattice."""
+
+    name: str
+    invoke: bool = True
+    group: bool = True
+    length: int = 1
+    cost_base: float = _UNKNOWN_BASE
+    cost_per_char: float = _UNKNOWN_PER_CHAR
+    left_id: int = 0
+    right_id: int = 0
+
+
+class CharacterDefinitions:
+    """Script class → CharCategory table (the char.def role). Categories
+    key on this module's `_script` classes (hiragana/katakana/kanji/
+    hangul/digit/latin); unmapped classes use `default`."""
+
+    def __init__(self, categories: Dict[str, CharCategory],
+                 default: Optional[CharCategory] = None):
+        self._cats = dict(categories)
+        self._default = default or CharCategory("DEFAULT")
+
+    def category(self, ch: str) -> CharCategory:
+        return self._cats.get(_script(ch), self._default)
+
+    @classmethod
+    def ipadic_style(cls) -> "CharacterDefinitions":
+        """The IPADIC char.def flavor on this module's cost scale:
+        NUMERIC/ALPHA runs group into one cheap token (a digit string is
+        one number, not per-digit shards), KATAKANA groups (loanwords) with
+        short alternatives, KANJI does NOT group — candidates are 1-2 char
+        prefixes (real kanji words are short; whole-run unknowns would
+        swallow compounds), HIRAGANA generates only where the dictionary
+        is silent (function words are in-vocabulary)."""
+        return cls({
+            "digit": CharCategory("NUMERIC", invoke=True, group=True,
+                                  length=0, cost_per_char=0.05),
+            "latin": CharCategory("ALPHA", invoke=True, group=True,
+                                  length=0, cost_per_char=0.1),
+            "katakana": CharCategory("KATAKANA", invoke=True, group=True,
+                                     length=2, cost_per_char=0.15),
+            "kanji": CharCategory("KANJI", invoke=False, group=False,
+                                  length=2),
+            "hiragana": CharCategory("HIRAGANA", invoke=False, group=True,
+                                     length=2),
+            "hangul": CharCategory("HANGUL", invoke=True, group=True,
+                                   length=2, cost_per_char=0.15),
+        })
+
+
 # leaf sentinel for the trie: a key that can never collide with a single
 # character edge
 _LEAF = ""
@@ -67,16 +137,14 @@ class Lexicon:
     BIGRAM Viterbi (states keyed by context class); without one it stays
     unigram."""
 
-    def __init__(self, entries: Iterable[LexEntry], connections=None):
+    def __init__(self, entries: Iterable[LexEntry], connections=None,
+                 char_defs: Optional[CharacterDefinitions] = None):
         self._by_surface: Dict[str, LexEntry] = {}
         self._trie: Dict = {}
-        self.connections = connections
-        # nested-list form of the matrix, memoized: the bigram lattice
-        # indexes it per (state, edge) — see _viterbi_chunk_bigram — and
-        # a per-chunk tolist() of an IPADIC-size (1316x1316) matrix costs
-        # ~100 ms, dominating multi-chunk documents
-        self._conn_rows = (None if connections is None
-                           else connections.tolist())
+        self.connections = connections  # property: memoizes _conn_rows
+        # unknown-word generation rules (char.def role); None = the legacy
+        # script-run fallback (whole run + single char, flat cost)
+        self.char_defs = char_defs
         self.max_len = 1
         entries = list(entries)
         if connections is not None:
@@ -84,23 +152,75 @@ class Lexicon:
             # from another) must fail HERE: masking it per-lookup would
             # give out-of-range entries free transitions and let them
             # systematically win Viterbi paths
-            R, L = connections.shape
-            bad = next((e for e in entries
-                        if e.right_id >= R or e.left_id >= L
-                        or e.right_id < 0 or e.left_id < 0), None)
-            if bad is not None:
-                raise ValueError(
-                    f"entry {bad.surface!r} has context ids "
-                    f"(left={bad.left_id}, right={bad.right_id}) outside "
-                    f"the {R}x{L} connection matrix — the dictionary CSVs "
-                    "and matrix.def are from different distributions")
+            self._check_ctx_ids(entries, connections)
+            if char_defs is not None:
+                R, L = connections.shape
+                for c in list(char_defs._cats.values()) + [char_defs._default]:
+                    if not (0 <= c.right_id < R and 0 <= c.left_id < L):
+                        raise ValueError(
+                            f"char category {c.name} has context ids "
+                            f"(left={c.left_id}, right={c.right_id}) "
+                            f"outside the {R}x{L} connection matrix")
         for e in entries:
-            self._by_surface[e.surface] = e
-            self.max_len = max(self.max_len, len(e.surface))
-            node = self._trie
-            for ch in e.surface:
-                node = node.setdefault(ch, {})
-            node[_LEAF] = e
+            self._insert(e)
+
+    @property
+    def connections(self):
+        """(R, L) bigram connection-cost matrix, or None (unigram).
+        Assignment rebuilds the memoized nested-list form the bigram
+        lattice indexes (`_conn_rows`) — reassigning after construction
+        cannot leave stale costs behind."""
+        return self._connections
+
+    @connections.setter
+    def connections(self, m):
+        self._connections = m
+        # nested-list form of the matrix, memoized: the bigram lattice
+        # indexes it per (state, edge) — see _viterbi_chunk_bigram — and
+        # a per-chunk tolist() of an IPADIC-size (1316x1316) matrix costs
+        # ~100 ms, dominating multi-chunk documents
+        self._conn_rows = None if m is None else m.tolist()
+
+    @staticmethod
+    def _check_ctx_ids(entries, connections) -> None:
+        R, L = connections.shape
+        bad = next((e for e in entries
+                    if e.right_id >= R or e.left_id >= L
+                    or e.right_id < 0 or e.left_id < 0), None)
+        if bad is not None:
+            raise ValueError(
+                f"entry {bad.surface!r} has context ids "
+                f"(left={bad.left_id}, right={bad.right_id}) outside "
+                f"the {R}x{L} connection matrix — the dictionary CSVs "
+                "and matrix.def are from different distributions")
+
+    def _insert(self, e: LexEntry) -> None:
+        self._by_surface[e.surface] = e
+        self.max_len = max(self.max_len, len(e.surface))
+        node = self._trie
+        for ch in e.surface:
+            node = node.setdefault(ch, {})
+        node[_LEAF] = e
+
+    def add_user_entries(self, entries, cost: float = _USER_COST) -> None:
+        """User-dictionary overlay (reference
+        `com/atilika/kuromoji/dict/UserDictionary.java`): entries insert
+        into the SAME trie the lattice walks, replacing built-in entries
+        on surface collision, and the default cost — the `_word_cost`
+        floor — makes a user entry win Viterbi paths over any built-in
+        segmentation of the same span (Kuromoji forces user entries into
+        the lattice the same way). Accepts LexEntry objects or
+        (surface, pos) pairs."""
+        lex_entries = [e if isinstance(e, LexEntry)
+                       else LexEntry(e[0], e[1], cost)
+                       for e in entries]
+        for e in lex_entries:
+            if not e.surface:
+                raise ValueError("user-dictionary entry with empty surface")
+        if self.connections is not None:
+            self._check_ctx_ids(lex_entries, self.connections)
+        for e in lex_entries:
+            self._insert(e)
 
     def prefixes(self, text: str, i: int, end: int):
         """Yield (j, entry) for every dictionary entry matching
@@ -174,7 +294,8 @@ class Lexicon:
                                     _ctx_id(parts[1]), _ctx_id(parts[2])))
         if connections is None and base is not None:
             connections = base.connections
-        return cls(entries, connections=connections)
+        return cls(entries, connections=connections,
+                   char_defs=base.char_defs if base is not None else None)
 
     @classmethod
     def parse_matrix_def(cls, lines: Iterable[str]):
@@ -250,11 +371,6 @@ class Lexicon:
         return len(self._by_surface)
 
 
-_UNKNOWN_BASE = 1.3    # an OOV run costs more than any dictionary word
-_UNKNOWN_PER_CHAR = 0.3
-_KNOWN_LEN_BONUS = 0.05  # longer dictionary matches cost slightly less
-
-
 def viterbi_segment(text: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
     """Minimum-cost segmentation of `text` into (surface, pos) tokens.
     Whitespace and punctuation separate the lattice; unknown spans fall
@@ -306,6 +422,39 @@ def _word_cost(e: LexEntry, i: int, j: int) -> float:
     return max(0.1, e.cost - _KNOWN_LEN_BONUS * (j - i - 1))
 
 
+def _unknown_edges(chunk: str, i: int, run_end_i: int, lexicon: Lexicon,
+                   dict_matched: bool):
+    """Unknown-word candidates starting at i: [(j, cost, lid, rid)] —
+    ONE generator for both lattices (the reference's
+    `ViterbiBuilder.processUnknownWord` consuming
+    `CharacterDefinitions`/`UnknownDictionary`).
+
+    Without char_defs: the legacy fallback — the maximal script run
+    (never zero-length, so the lattice always reaches n) AND a
+    single-char edge, so an OOV prefix cannot swallow in-vocabulary
+    words later in the same run; always generated (legacy invoke=all).
+    With char_defs: the category's invoke/group/length rules decide the
+    candidate set and its per-category costs; a position where the
+    dictionary matched and invoke=False generates nothing (the
+    dictionary edges advance the lattice, so completeness holds)."""
+    cd = lexicon.char_defs
+    if cd is None:
+        return [(j, _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i), 0, 0)
+                for j in {run_end_i, i + 1}]
+    c = cd.category(chunk[i])
+    if dict_matched and not c.invoke:
+        return []
+    js = set()
+    if c.group:
+        js.add(run_end_i)
+    for L in range(1, min(c.length, run_end_i - i) + 1):
+        js.add(i + L)
+    if not js and not dict_matched:
+        js.add(i + 1)  # completeness: a silent position must advance
+    return [(j, c.cost_base + c.cost_per_char * (j - i),
+             c.left_id, c.right_id) for j in js]
+
+
 def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
     n = len(chunk)
     INF = float("inf")
@@ -319,18 +468,18 @@ def _viterbi_chunk(chunk: str, lexicon: Lexicon) -> List[Tuple[str, str]]:
         # dictionary matches starting at i: ONE trie traversal yields
         # every matching prefix (stops at the first missing child — cost
         # no longer max_len probes x substring allocations per position)
+        matched = False
         for j, e in lexicon.prefixes(chunk, i, n):
+            matched = True
             c = best[i] + _word_cost(e, i, j)
             if c < best[j]:
                 best[j] = c
                 back[j] = (i, e.surface, e.pos)
-        # unknown fallbacks: the maximal script run starting at i (never
-        # zero-length, so the lattice always reaches n) AND a single-char
-        # edge, so an OOV prefix cannot swallow in-vocabulary words later
-        # in the same run (Kuromoji generates multi-length unknown
-        # candidates for the same reason)
-        for j in {run_end[i], i + 1}:
-            c = best[i] + _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)
+        # unknown candidates per the char.def rules (legacy run+char
+        # fallback when the lexicon has no CharacterDefinitions)
+        for j, ucost, _, _ in _unknown_edges(chunk, i, run_end[i],
+                                             lexicon, matched):
+            c = best[i] + ucost
             if c < best[j]:
                 best[j] = c
                 back[j] = (i, chunk[i:j], "unknown")
@@ -377,9 +526,9 @@ def _viterbi_chunk_bigram(chunk: str, lexicon: Lexicon
         for j, e in lexicon.prefixes(chunk, i, n):
             edges.append((j, e.surface, e.pos, e.left_id, e.right_id,
                           _word_cost(e, i, j)))
-        for j in {run_end[i], i + 1}:  # unknown fallbacks (class 0)
-            edges.append((j, chunk[i:j], "unknown", 0, 0,
-                          _UNKNOWN_BASE + _UNKNOWN_PER_CHAR * (j - i)))
+        for j, ucost, lid, rid in _unknown_edges(chunk, i, run_end[i],
+                                                 lexicon, bool(edges)):
+            edges.append((j, chunk[i:j], "unknown", lid, rid, ucost))
         for rid_prev, (c_prev, _) in list(states[i].items()):
             row = conn[rid_prev]
             for j, surf, pos, lid, rid, wc in edges:
@@ -435,7 +584,8 @@ JAPANESE_LEXICON = Lexicon(
     + [LexEntry(w, "auxiliary", 0.6) for w in _JA_AUX]
     + [LexEntry(w, "noun", 0.7) for w in _JA_NOUNS]
     + [LexEntry(w, "verb", 0.7) for w in _JA_VERBS]
-    + [LexEntry(w, "adjective", 0.7) for w in _JA_ADJ])
+    + [LexEntry(w, "adjective", 0.7) for w in _JA_ADJ],
+    char_defs=CharacterDefinitions.ipadic_style())
 
 
 def load_bundled_ipadic_sample(base: Optional[Lexicon] = JAPANESE_LEXICON

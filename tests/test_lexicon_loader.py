@@ -290,3 +290,134 @@ def test_ctx_id_nondecimal_digit_maps_to_class_zero():
     lex = Lexicon.from_mecab_csv(["ab,²,⁵,1000,x"])
     e = lex.lookup("ab")
     assert e is not None and e.left_id == 0 and e.right_id == 0
+
+
+# ---------------------------------------------------------------------------
+# r5: char.def categories (unknown-word rules) + user dictionary
+
+
+def test_char_categories_change_oov_segmentation():
+    """Category rules drive unknown-word generation (reference
+    `CharacterDefinitions.java` / `UnknownDictionary.java`): NUMERIC runs
+    group into one cheap token while KANJI does not group (1-2 char
+    candidates) — the same OOV text segments differently under the legacy
+    fallback vs the ipadic-style table."""
+    from deeplearning4j_tpu.nlp.dictionary import (
+        CharacterDefinitions,
+        Lexicon,
+        viterbi_segment,
+    )
+
+    entries = [("は", "particle"), ("大きい", "adjective")]
+    legacy = Lexicon.from_entries(entries)
+    assert legacy.char_defs is None
+    styled = Lexicon.from_entries(entries)
+    styled.char_defs = CharacterDefinitions.ipadic_style()
+
+    # numeric run: legacy lattice may shard; the NUMERIC category groups
+    # the whole run as ONE cheap token
+    toks = [t for t, _ in viterbi_segment("123456は大きい", styled)]
+    assert toks == ["123456", "は", "大きい"]
+
+    # OOV 4-kanji compound: legacy groups the whole run; KANJI group=False
+    # generates only 1-2 char candidates, so the compound splits
+    legacy_toks = [t for t, _ in viterbi_segment("深層学習", legacy)]
+    styled_toks = [t for t, _ in viterbi_segment("深層学習", styled)]
+    assert legacy_toks == ["深層学習"]
+    assert styled_toks != legacy_toks
+    assert all(len(t) <= 2 for t in styled_toks)
+    assert "".join(styled_toks) == "深層学習"
+
+
+def test_char_category_invoke_gating():
+    """invoke=False suppresses unknown candidates where the dictionary
+    matched; invoke=True generates them regardless (MeCab invoke
+    semantics) — a cheap always-invoke category can beat a dictionary
+    word, the invoke=False category cannot."""
+    from deeplearning4j_tpu.nlp.dictionary import (
+        CharCategory,
+        CharacterDefinitions,
+        Lexicon,
+        viterbi_segment,
+    )
+
+    lex = Lexicon.from_entries([("ある", "verb")], cost=0.7)
+    quiet = CharacterDefinitions(
+        {"hiragana": CharCategory("HIRAGANA", invoke=False, group=True,
+                                  length=0)})
+    lex.char_defs = quiet
+    assert [t for t, _ in viterbi_segment("ある", lex)] == ["ある"]
+    loud = CharacterDefinitions(
+        {"hiragana": CharCategory("HIRAGANA", invoke=True, group=True,
+                                  length=0, cost_base=0.05,
+                                  cost_per_char=0.0)})
+    lex.char_defs = loud
+    toks = viterbi_segment("ある", lex)
+    assert toks == [("ある", "unknown")]  # the cheap unknown run wins
+
+
+def test_user_dictionary_wins_over_builtin():
+    """A user-dictionary entry overlays the trie and wins the lattice over
+    the built-in segmentation of the same span (reference
+    `UserDictionary.java`), and replaces a built-in entry on surface
+    collision."""
+    from deeplearning4j_tpu.nlp.dictionary import (
+        JAPANESE_LEXICON,
+        LexEntry,
+        Lexicon,
+        viterbi_segment,
+    )
+
+    lex = Lexicon(JAPANESE_LEXICON._by_surface.values(),
+                  char_defs=JAPANESE_LEXICON.char_defs)
+    # built-in: 日本語 + 学校 (both dictionary nouns)
+    before = [t for t, _ in viterbi_segment("日本語学校で勉強します", lex)]
+    assert before[:2] == ["日本語", "学校"]
+    lex.add_user_entries([("日本語学校", "user_noun")])
+    after = viterbi_segment("日本語学校で勉強します", lex)
+    assert after[0] == ("日本語学校", "user_noun")
+    # surface collision: the user entry replaces the built-in
+    assert lex.lookup("日本語学校").pos == "user_noun"
+    lex.add_user_entries([LexEntry("猫", "user_cat", 0.1)])
+    assert lex.lookup("猫").pos == "user_cat"
+    pos = dict(viterbi_segment("猫が鳴く", lex))
+    assert pos["猫"] == "user_cat"
+
+
+def test_user_dictionary_validates_context_ids():
+    """User entries with context ids outside a loaded connection matrix
+    fail fast (same contract as construction-time entries)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.nlp.dictionary import LexEntry, Lexicon
+
+    conn = np.zeros((2, 2), np.float32)
+    lex = Lexicon([LexEntry("あ", "x", 0.5)], connections=conn)
+    with _pytest.raises(ValueError, match="outside the 2x2"):
+        lex.add_user_entries([LexEntry("い", "y", 0.1, left_id=5)])
+    # valid ids insert fine and rebuild nothing stale
+    lex.add_user_entries([LexEntry("い", "y", 0.1, left_id=1, right_id=1)])
+    assert lex.lookup("い").pos == "y"
+
+
+def test_connections_reassignment_rebuilds_bigram_rows():
+    """Advisor r4: reassigning `lexicon.connections` must rebuild the
+    memoized row cache the bigram lattice reads — stale costs would
+    silently survive otherwise."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.dictionary import LexEntry, Lexicon, viterbi_segment
+
+    # two entries whose bigram preference flips with the matrix
+    entries = [LexEntry("ab", "x", 0.7, left_id=1, right_id=1),
+               LexEntry("a", "y", 0.5, left_id=0, right_id=0),
+               LexEntry("b", "y", 0.5, left_id=0, right_id=0)]
+    m1 = np.zeros((2, 2), np.float32)
+    m1[0, 0] = 5.0  # class-0 chains punished -> "ab" wins
+    lex = Lexicon(entries, connections=m1)
+    assert [s for s, _ in viterbi_segment("ab", lex)] == ["ab"]
+    m2 = np.zeros((2, 2), np.float32)
+    m2[0, 1] = 5.0  # entering class 1 punished -> "a"+"b" wins
+    lex.connections = m2
+    assert [s for s, _ in viterbi_segment("ab", lex)] == ["a", "b"]
