@@ -153,33 +153,59 @@ class Lexicon:
             # give out-of-range entries free transitions and let them
             # systematically win Viterbi paths
             self._check_ctx_ids(entries, connections)
-            if char_defs is not None:
-                R, L = connections.shape
-                for c in list(char_defs._cats.values()) + [char_defs._default]:
-                    if not (0 <= c.right_id < R and 0 <= c.left_id < L):
-                        raise ValueError(
-                            f"char category {c.name} has context ids "
-                            f"(left={c.left_id}, right={c.right_id}) "
-                            f"outside the {R}x{L} connection matrix")
         for e in entries:
             self._insert(e)
 
     @property
     def connections(self):
         """(R, L) bigram connection-cost matrix, or None (unigram).
-        Assignment rebuilds the memoized nested-list form the bigram
-        lattice indexes (`_conn_rows`) — reassigning after construction
-        cannot leave stale costs behind."""
+        Assignment re-validates every existing entry's and char
+        category's context ids against the NEW matrix shape (the same
+        fail-fast contract as construction — an out-of-range id must
+        raise ValueError here, not IndexError later inside the bigram
+        lattice) and rebuilds the memoized nested-list form the lattice
+        indexes (`_conn_rows`) — reassigning after construction cannot
+        leave stale costs behind."""
         return self._connections
 
     @connections.setter
     def connections(self, m):
+        if m is not None:
+            self._check_ctx_ids(self._by_surface.values(), m)
+            self._check_char_def_ids(getattr(self, "_char_defs", None), m)
         self._connections = m
         # nested-list form of the matrix, memoized: the bigram lattice
         # indexes it per (state, edge) — see _viterbi_chunk_bigram — and
         # a per-chunk tolist() of an IPADIC-size (1316x1316) matrix costs
         # ~100 ms, dominating multi-chunk documents
         self._conn_rows = None if m is None else m.tolist()
+
+    @property
+    def char_defs(self):
+        """Unknown-word generation rules, or None (legacy script-run
+        fallback). Assignment validates every category's context ids
+        against the current connection matrix — post-construction
+        mutation fails fast with ValueError, same as `__init__`."""
+        return self._char_defs
+
+    @char_defs.setter
+    def char_defs(self, cd):
+        conn = getattr(self, "_connections", None)
+        if cd is not None and conn is not None:
+            self._check_char_def_ids(cd, conn)
+        self._char_defs = cd
+
+    @staticmethod
+    def _check_char_def_ids(char_defs, connections) -> None:
+        if char_defs is None:
+            return
+        R, L = connections.shape
+        for c in list(char_defs._cats.values()) + [char_defs._default]:
+            if not (0 <= c.right_id < R and 0 <= c.left_id < L):
+                raise ValueError(
+                    f"char category {c.name} has context ids "
+                    f"(left={c.left_id}, right={c.right_id}) "
+                    f"outside the {R}x{L} connection matrix")
 
     @staticmethod
     def _check_ctx_ids(entries, connections) -> None:

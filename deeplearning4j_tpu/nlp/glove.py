@@ -40,8 +40,12 @@ class CooccurrenceCounter:
     sorted on-disk triple returned as memmaps, so neither the corpus's
     distinct-pair count nor the merge has to fit in RAM; only the cap and
     the merge chunks do. `memory_cap_pairs=None` keeps everything in
-    memory (same sorted output — the factorization is byte-identical
-    either way, which is the parity test's contract)."""
+    memory (same sorted output — the factorization is identical in
+    practice, which is the parity test's contract; when one pair's
+    occurrences straddle spill rounds, the k-way merge sums per-shard f64
+    subtotals in a different association order than the in-memory running
+    sum, so the final f32 count can differ by one ULP on unlucky
+    corpora)."""
 
     _CHUNK = 1 << 16
 
@@ -188,8 +192,10 @@ class Glove:
         `spill_dir` (or a temp dir) and merge-stream back — the reference's
         `BinaryCoOccurrenceWriter` path for corpora whose co-occurrence
         matrix exceeds RAM. None = count fully in memory. Training is
-        byte-identical either way (both paths feed the factorization the
-        same sorted pair order)."""
+        identical in practice either way (both paths feed the
+        factorization the same sorted pair order; counts straddling spill
+        rounds may differ by one ULP from the in-memory running sum — see
+        `CooccurrenceCounter`)."""
         self.layer_size = layer_size
         self.window = window
         self.min_word_frequency = min_word_frequency

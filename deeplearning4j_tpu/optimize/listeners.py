@@ -19,7 +19,13 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class IterationListener:
-    """Base hook interface (reference `IterationListener.java`)."""
+    """Base hook interface (reference `IterationListener.java`).
+
+    `on_restart` has no reference analogue: it fires when a fault-tolerant
+    driver (`parallel/fault_tolerance.FaultTolerantTrainer`) restores a
+    checkpoint after a failure, so listeners holding iteration-keyed state
+    (score curves, UI streams) can note the rollback instead of seeing the
+    iteration clock silently jump backwards."""
 
     def iteration_done(self, model, iteration: int) -> None:
         pass
@@ -28,6 +34,9 @@ class IterationListener:
         pass
 
     def on_epoch_end(self, model) -> None:
+        pass
+
+    def on_restart(self, model, restart_count: int) -> None:
         pass
 
 

@@ -21,9 +21,19 @@ from deeplearning4j_tpu.parallel.early_stopping import (  # noqa: F401
     EarlyStoppingDistributedTrainer,
     EarlyStoppingParallelTrainer,
 )
+from deeplearning4j_tpu.parallel.fault_tolerance import (  # noqa: F401
+    FaultInjectionListener,
+    FaultTolerantTrainer,
+    InjectedFault,
+    ParameterServerStallInjector,
+    SlowWorkerInjector,
+    WorkerCrashInjector,
+)
 from deeplearning4j_tpu.parallel.parameter_server import (  # noqa: F401
     ParameterServer,
     ParameterServerParallelWrapper,
+    ParameterServerTimeoutError,
+    RetryingParameterServerClient,
 )
 from deeplearning4j_tpu.parallel.repartition import (  # noqa: F401
     Repartition,
@@ -34,10 +44,14 @@ from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.training_master import (  # noqa: F401
     DistributedComputationGraph,
     DistributedMultiLayer,
+    NoHealthyWorkersError,
     ParameterAveragingTrainingMaster,
     ParameterAveragingTrainingWorker,
     TrainingHook,
     TrainingMaster,
     TrainingResult,
     TrainingWorker,
+    WorkerFailureError,
+    WorkerHealth,
+    current_worker_id,
 )

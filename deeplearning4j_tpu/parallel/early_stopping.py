@@ -35,6 +35,17 @@ class _FitFacade:
         setattr(object.__getattribute__(self, "_net"), name, value)
 
 
+class _RecoveringFit:
+    """Routes the facade's per-epoch fit through a `FaultTolerantTrainer`
+    so each distributed epoch gets checkpoint-restore-retry semantics."""
+
+    def __init__(self, fault_tolerant):
+        self.fault_tolerant = fault_tolerant
+
+    def fit(self, iterator, epochs: int = 1):
+        self.fault_tolerant.fit(epochs=epochs, iterator=iterator)
+
+
 class EarlyStoppingDistributedTrainer(EarlyStoppingTrainer):
     """Early stopping where each epoch's fit goes through the
     TrainingMaster's worker/averaging path (reference
@@ -43,20 +54,53 @@ class EarlyStoppingDistributedTrainer(EarlyStoppingTrainer):
     `trainingMaster.executeTraining`, then score calculators / termination
     conditions on the synced net). Iteration-level termination conditions
     fire through the master's `iteration_done` listener fan-out, exactly
-    as on the single-device trainer."""
+    as on the single-device trainer.
+
+    `checkpoint_dir` (optional) makes each epoch's distributed fit
+    restart-aware: a `FaultTolerantTrainer` checkpoints every
+    `checkpoint_every` iterations and, on a worker-tier failure that
+    escapes the master's own retry/degradation layer, restores the newest
+    checkpoint and resumes — up to `max_restarts` times (restart counts
+    land in the master's `TrainingStats` when it collects stats)."""
 
     def __init__(self, config: EarlyStoppingConfiguration, net,
-                 train_iterator, training_master):
+                 train_iterator, training_master,
+                 checkpoint_dir=None, checkpoint_every: int = 100,
+                 max_restarts: int = 3):
         from deeplearning4j_tpu.parallel.training_master import (
             DistributedMultiLayer,
         )
 
-        self.distributed = (
-            training_master if isinstance(training_master,
-                                          DistributedMultiLayer)
-            else DistributedMultiLayer(net, training_master))
+        if isinstance(training_master, DistributedMultiLayer):
+            if net is not None and training_master.net is not net:
+                raise ValueError(
+                    "EarlyStoppingDistributedTrainer was given BOTH an "
+                    "existing DistributedMultiLayer and a different net — "
+                    "the handle would silently train its own net, not the "
+                    "one passed. Pass net=None or the handle's own net.")
+            self.distributed = training_master
+        else:
+            self.distributed = DistributedMultiLayer(net, training_master)
+        self.fault_tolerant = None
+        fit_target = self.distributed
+        if checkpoint_dir is not None:
+            from deeplearning4j_tpu.earlystopping.trainer import (
+                _IterationAbort,
+            )
+            from deeplearning4j_tpu.parallel.fault_tolerance import (
+                FaultTolerantTrainer,
+            )
+
+            self.fault_tolerant = FaultTolerantTrainer(
+                self.distributed, train_iterator,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                max_restarts=max_restarts,
+                # iteration-condition aborts are control flow, not faults
+                propagate=(_IterationAbort,))
+            fit_target = _RecoveringFit(self.fault_tolerant)
         super().__init__(config,
-                         _FitFacade(self.distributed, self.distributed.net),
+                         _FitFacade(fit_target, self.distributed.net),
                          train_iterator)
 
 

@@ -1,32 +1,47 @@
-"""Checkpoint-based fault tolerance for training loops.
+"""Checkpoint-based fault tolerance + fault-injection framework.
 
 Reference (SURVEY §5 "Failure detection / elastic recovery"): absent — the
 reference inherits Spark task retry and nothing else; there is no
 checkpoint-based elasticity and no fault-injection framework. Both are
 table stakes for long TPU runs (preemptible pods), so this build provides:
 
-- `FaultTolerantTrainer`: drives `net.fit` epoch-by-epoch with periodic
+- `FaultTolerantTrainer`: drives `fit` epoch-by-epoch with periodic
   checkpoints; on a transient failure it restores the newest checkpoint
   (model + updater state + iteration clock) and resumes, up to
-  `max_restarts` times.
-- `FaultInjectionListener`: deterministically raises at a chosen iteration
-  — the fault-injection hook the recovery path is tested with.
+  `max_restarts` times. Works on a bare network AND on distributed
+  handles (`DistributedMultiLayer`, `ParallelWrapper`) — anything with a
+  `fit(iterator, epochs=)` whose underlying network is reachable via
+  `get_network()`.
+- Fault injectors, all logging through the `deeplearning4j_tpu` logger so
+  chaos tests assert on `caplog` rather than stdout:
+  * `FaultInjectionListener` — single-node: raise at iteration N.
+  * `WorkerCrashInjector` — distributed: worker k raises on its n-th fit.
+  * `SlowWorkerInjector` — distributed: worker k sleeps per minibatch,
+    exercising the master's straggler `worker_timeout`.
+  * `ParameterServerStallInjector` — wraps a parameter-server store so
+    push/pull block, exercising the client's timeout/backoff give-up.
 """
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from typing import Optional
 
 from deeplearning4j_tpu.optimize.listeners import (
     CheckpointListener,
     IterationListener,
 )
+from deeplearning4j_tpu.parallel.training_master import (
+    TrainingHook,
+    current_worker_id,
+)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class InjectedFault(RuntimeError):
-    """Raised by FaultInjectionListener (distinguishable from real bugs)."""
+    """Raised by fault injectors (distinguishable from real bugs)."""
 
 
 class FaultInjectionListener(IterationListener):
@@ -43,8 +58,126 @@ class FaultInjectionListener(IterationListener):
         if self.remaining > 0 and iteration >= self.fail_at_iteration:
             self.remaining -= 1
             self.fired += 1
+            logger.warning("FaultInjectionListener: injected fault at "
+                           "iteration %d", iteration)
             raise InjectedFault(
                 f"injected fault at iteration {iteration}")
+
+
+# ---------------------------------------------------------------------------
+# distributed injectors (TrainingHook seam — attach via
+# `ParameterAveragingTrainingWorker.add_hook`)
+
+
+class WorkerCrashInjector(TrainingHook):
+    """TrainingHook: worker `worker_id` raises `InjectedFault` in
+    `pre_update` once it has seen `fail_at_fit` minibatches (1-based,
+    counted across shards and retries), at most `times` times.
+    Thread-safe: hooks fire concurrently from shard threads."""
+
+    def __init__(self, worker_id: int, fail_at_fit: int = 1,
+                 times: int = 1):
+        self.worker_id = worker_id
+        self.fail_at_fit = fail_at_fit
+        self.remaining = times
+        self.fired = 0
+        self._fits = 0
+        self._lock = threading.Lock()
+
+    def pre_update(self, ds, net) -> None:
+        if current_worker_id() != self.worker_id:
+            return
+        with self._lock:
+            self._fits += 1
+            if self._fits < self.fail_at_fit or self.remaining <= 0:
+                return
+            self.remaining -= 1
+            self.fired += 1
+            fits = self._fits
+        logger.warning("WorkerCrashInjector: injected crash on worker %d "
+                       "(fit %d)", self.worker_id, fits)
+        raise InjectedFault(
+            f"injected crash on worker {self.worker_id} (fit {fits})")
+
+
+class SlowWorkerInjector(TrainingHook):
+    """TrainingHook: worker `worker_id` sleeps `delay` seconds before each
+    of its first `times` minibatches — a deterministic straggler to
+    exercise the master's `worker_timeout` path. Keep `delay` bounded in
+    tests: the hung shard thread runs to completion in the background (its
+    result is discarded), and an unbounded sleep would outlive the test."""
+
+    def __init__(self, worker_id: int, delay: float, times: int = 1):
+        self.worker_id = worker_id
+        self.delay = delay
+        self.remaining = times
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    def pre_update(self, ds, net) -> None:
+        if current_worker_id() != self.worker_id:
+            return
+        with self._lock:
+            if self.remaining <= 0:
+                return
+            self.remaining -= 1
+            self.fired += 1
+        logger.warning("SlowWorkerInjector: delaying worker %d by %.2fs",
+                       self.worker_id, self.delay)
+        time.sleep(self.delay)
+
+
+class ParameterServerStallInjector:
+    """Wraps any pull/push parameter-server store; after `stall_after`
+    successful requests, every request blocks for `stall_seconds` (or
+    until `release()`) before reaching the store — the PS-stall chaos
+    hook. Pair with `RetryingParameterServerClient` to prove a stalled
+    server raises after bounded backoff instead of deadlocking."""
+
+    def __init__(self, store, stall_after: int = 0,
+                 stall_seconds: float = 3600.0):
+        self._store = store
+        self.stall_after = stall_after
+        self.stall_seconds = stall_seconds
+        self.requests = 0
+        self.stalled_requests = 0
+        self._released = threading.Event()
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        """Un-stall (lets background threads stuck in a stalled request
+        finish promptly at test teardown)."""
+        self._released.set()
+
+    def _maybe_stall(self) -> None:
+        with self._lock:
+            self.requests += 1
+            stall = self.requests > self.stall_after
+            if stall:
+                self.stalled_requests += 1
+                n = self.requests
+        if stall and not self._released.is_set():
+            logger.warning("ParameterServerStallInjector: stalling "
+                           "request %d", n)
+            self._released.wait(self.stall_seconds)
+
+    def pull(self):
+        self._maybe_stall()
+        return self._store.pull()
+
+    def push_update(self, delta, **kwargs) -> None:
+        # kwargs (e.g. request_id) pass through so idempotent retried
+        # pushes stay idempotent with the injector in the middle
+        self._maybe_stall()
+        self._store.push_update(delta, **kwargs)
+
+    @property
+    def num_pushes(self) -> int:
+        return self._store.num_pushes
+
+
+# ---------------------------------------------------------------------------
+# restart-driving trainer
 
 
 class FaultTolerantTrainer:
@@ -54,16 +187,33 @@ class FaultTolerantTrainer:
                                        checkpoint_every=50, max_restarts=3)
         trainer.fit(epochs=10)
 
+    `net` may be a bare network OR a distributed handle
+    (`DistributedMultiLayer`, `ParallelWrapper`, ...): anything exposing
+    `fit(iterator, epochs=)` plus `get_network()` for the underlying
+    network that checkpoints/restores — so worker-pool averaging and the
+    sharded multi-chip path compose with checkpoint recovery.
+
     The iterator must be restartable (reset()-able); after a restore the
     current epoch is re-run from its start — batches before the checkpoint
     are re-applied only if they came after the last checkpoint, which is
     the at-least-once semantics checkpoint-interval recovery gives.
+
+    On every restore, listeners implementing `on_restart(model, count)`
+    are notified, and when the handle's TrainingMaster collects stats the
+    restart is counted there as `restarts`.
     """
 
     def __init__(self, net, iterator, checkpoint_dir,
                  checkpoint_every: int = 100, max_restarts: int = 3,
-                 keep_last: int = 2):
+                 keep_last: int = 2, propagate: tuple = ()):
+        # `propagate`: exception types that are CONTROL FLOW, not failures
+        # (e.g. early stopping's iteration-abort) — re-raised immediately
+        # instead of triggering a checkpoint restore
+        self.propagate = propagate
         self.net = net
+        # the restorable network behind a distributed handle/wrapper
+        self.target = net.get_network() if hasattr(net, "get_network") \
+            else net
         self.iterator = iterator
         self.checkpoint_dir = str(checkpoint_dir)
         self.max_restarts = max_restarts
@@ -72,6 +222,10 @@ class FaultTolerantTrainer:
                                         every_n_iterations=checkpoint_every,
                                         keep_last=keep_last)
 
+    def _master_stats(self):
+        master = getattr(self.net, "training_master", None)
+        return master.get_training_stats() if master is not None else None
+
     def _restore(self) -> bool:
         from deeplearning4j_tpu.util.serialization import restore_model
 
@@ -79,7 +233,7 @@ class FaultTolerantTrainer:
         if path is None:
             return False
         restored = restore_model(path)
-        net = self.net
+        net = self.target
         net.set_params(restored.params())
         net._upd_state = restored._upd_state
         net._layer_state = restored._layer_state
@@ -89,8 +243,10 @@ class FaultTolerantTrainer:
         logger.warning("restored %s (iteration %d)", path, net.iteration)
         return True
 
-    def fit(self, epochs: int = 1) -> None:
-        net = self.net
+    def fit(self, epochs: int = 1, iterator=None) -> None:
+        if iterator is not None:
+            self.iterator = iterator
+        net = self.target
         listeners = list(net.listeners)
         if self._ckpt not in listeners:
             net.set_listeners(*(listeners + [self._ckpt]))
@@ -102,9 +258,11 @@ class FaultTolerantTrainer:
         done = 0
         while done < epochs:
             try:
-                net.fit(self.iterator, epochs=1)
+                self.net.fit(self.iterator, epochs=1)
                 done += 1
             except Exception as e:
+                if isinstance(e, self.propagate):
+                    raise
                 self.restarts += 1
                 if self.restarts > self.max_restarts:
                     logger.error("giving up after %d restarts", self.restarts - 1)
@@ -115,3 +273,19 @@ class FaultTolerantTrainer:
                 if not self._restore():  # can't happen after the initial
                     raise RuntimeError(   # save; fail loudly if it does
                         "no checkpoint available to restore")
+                master = getattr(self.net, "training_master", None)
+                if master is not None and hasattr(master,
+                                                  "reset_worker_health"):
+                    # a restart is a fresh attempt: re-admit dropped
+                    # workers, otherwise a transiently-dead pool (e.g. a
+                    # brief PS outage that felled every worker) would fail
+                    # every retry against the same empty pool
+                    logger.warning("re-admitting all workers after restart")
+                    master.reset_worker_health()
+                stats = self._master_stats()
+                if stats is not None:
+                    stats.increment("restarts")
+                for listener in getattr(net, "listeners", []):
+                    listener_hook = getattr(listener, "on_restart", None)
+                    if listener_hook is not None:
+                        listener_hook(net, self.restarts)

@@ -13,16 +13,29 @@ from typing import Dict, List
 
 
 class TrainingStats:
-    """Phase wall-clock collection (ms per occurrence)."""
+    """Phase wall-clock collection (ms per occurrence) plus named event
+    counters (worker failures / retries / drops / restarts — the elastic
+    layer's observability surface; the reference's stats classes only track
+    timings because Spark owns its retry bookkeeping)."""
 
     def __init__(self) -> None:
         self._times: Dict[str, List[float]] = defaultdict(list)
+        self._counters: Dict[str, int] = defaultdict(int)
 
     def add_time(self, phase: str, ms: float) -> None:
         self._times[phase].append(ms)
 
     def timer(self, phase: str) -> "_PhaseTimer":
         return _PhaseTimer(self, phase)
+
+    def increment(self, counter: str, by: int = 1) -> None:
+        self._counters[counter] += by
+
+    def get_count(self, counter: str) -> int:
+        return self._counters.get(counter, 0)
+
+    def get_counters(self) -> Dict[str, int]:
+        return dict(self._counters)
 
     def get_keys(self) -> List[str]:
         return sorted(self._times)
@@ -39,6 +52,8 @@ class TrainingStats:
             v = self._times[k]
             lines.append(f"  {k}: n={len(v)} total={sum(v):.1f}ms "
                          f"mean={sum(v) / len(v):.2f}ms")
+        for k in sorted(self._counters):
+            lines.append(f"  {k}: count={self._counters[k]}")
         return "\n".join(lines)
 
 

@@ -23,7 +23,13 @@ from __future__ import annotations
 
 import logging
 import threading
-from concurrent.futures import ThreadPoolExecutor
+import time
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    ThreadPoolExecutor,
+    wait as _futures_wait,
+)
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -41,8 +47,88 @@ from deeplearning4j_tpu.parallel.repartition import (
     should_repartition,
 )
 from deeplearning4j_tpu.parallel.stats import TrainingStats
+from deeplearning4j_tpu.parallel.time_source import (
+    TimeSource,
+    TimeSourceProvider,
+)
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+# ---------------------------------------------------------------------------
+# worker health / elasticity
+
+
+class NoHealthyWorkersError(RuntimeError):
+    """Every worker in the pool has been dropped — training cannot proceed.
+    Raised instead of hanging on an averaging barrier no one will reach."""
+
+
+class WorkerFailureError(RuntimeError):
+    """A shard kept failing across re-dispatches (bounded attempts
+    exhausted); the last worker exception is chained as __cause__."""
+
+
+class _WindowAbort(Exception):
+    """Internal: a worker was dropped mid-window. Nothing has been committed
+    to the master net yet, so the window repartitions over the surviving
+    workers and re-runs from the same master parameters."""
+
+
+class _ShardAbandoned(Exception):
+    """Internal: raised inside an orphaned shard thread (its result was
+    discarded after a timeout/abort) so it stops training dead weight,
+    frees its pool slot, and stops stamping heartbeats for a worker the
+    master no longer trusts."""
+
+
+@dataclass
+class WorkerHealth:
+    """Per-worker liveness record the master keeps across averaging windows
+    and epochs (the elastic layer's analogue of Spark's executor liveness
+    view, which the reference delegates to the cluster manager)."""
+
+    worker_id: int
+    alive: bool = True
+    consecutive_failures: int = 0
+    total_failures: int = 0
+    shards_completed: int = 0
+    last_heartbeat_ms: Optional[int] = None
+    last_error: Optional[str] = None
+
+
+@dataclass
+class _ShardTask:
+    """One shard's dispatch bookkeeping inside a window."""
+
+    index: int
+    shard: List[DataSet]
+    health: WorkerHealth
+    attempts: int = 0
+    queued_at: float = 0.0              # monotonic; set at submit
+    started_at: Optional[float] = None  # monotonic; set by the pool thread
+    not_before: float = 0.0             # monotonic; backoff gate for retry
+    abandoned: bool = False             # result discarded; thread bails out
+
+    def deadline(self, timeout: float) -> float:
+        """Expiry instant: from actual start when the pool thread picked
+        the task up, else from submit — a task that cannot even START
+        within the timeout is starved by hung threads saturating the
+        pool, and must count as a failure of its assigned worker (the
+        drop path then converges to NoHealthyWorkersError instead of
+        spinning forever waiting for a slot that will never free)."""
+        return (self.started_at if self.started_at is not None
+                else self.queued_at) + timeout
+
+
+_worker_ctx = threading.local()
+
+
+def current_worker_id() -> Optional[int]:
+    """Worker id of the calling shard thread, or None outside a worker.
+    The seam distributed fault injectors key on (see
+    `parallel/fault_tolerance.WorkerCrashInjector`)."""
+    return getattr(_worker_ctx, "worker_id", None)
 
 
 # ---------------------------------------------------------------------------
@@ -67,9 +153,12 @@ class TrainingHook:
     integration plugs into, `ParameterServerTrainingHook.java`). Hooks run
     on worker shard threads, outside the compiled step; one worker instance
     serves all shards (unlike the reference, where each Spark executor
-    deserializes its own worker copy), so callback invocations are
-    serialized under a worker-level lock — hook state sees a consistent
-    interleaving without needing to be thread-safe."""
+    deserializes its own worker copy). The hook LIST is snapshotted under a
+    lock, but callbacks themselves run unlocked and may fire concurrently
+    from different shard threads — a hook that blocks (e.g. a straggler
+    injector sleeping) must not stall the other workers, so stateful hooks
+    guard their own mutable state. `current_worker_id()` identifies the
+    calling shard thread."""
 
     def on_training_start(self, net) -> None:
         pass
@@ -103,12 +192,13 @@ class TrainingWorker:
 
     def _run_hooks(self, method: str, *args) -> None:
         with self._hook_lock:
+            # snapshot under the lock (a hook may add/remove hooks), but
+            # invoke OUTSIDE it: a blocking hook on one shard thread — a
+            # SlowWorkerInjector, a network-backed PS hook mid-retry — must
+            # not freeze every other worker's minibatch callbacks
             hooks = list(self.training_hooks)
-            # callbacks run under the lock for the documented serialization
-            # guarantee, but over a snapshot so a hook may add/remove hooks
-            # (the lock is reentrant) without corrupting this iteration
-            for h in hooks:
-                getattr(h, method)(*args)
+        for h in hooks:
+            getattr(h, method)(*args)
 
     def get_initial_model(self):
         raise NotImplementedError
@@ -189,6 +279,33 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     (and optionally updater state) are averaged and re-broadcast — the same
     schedule as the reference's `doIteration:647` → `processResults:767`
     (`results.aggregate(Add/Combine):772` → `params.divi(aggCount):783`).
+
+    Elasticity (no reference analogue — Spark owned task retry there):
+
+    - Every shard dispatch is watched: a worker that raises, or exceeds
+      `worker_timeout` seconds on one shard, is marked failed and its shard
+      is re-dispatched to a surviving worker after exponential backoff
+      (`retry_backoff × backoff_multiplier^attempt`), with per-shard
+      attempts bounded by `max_retries` re-dispatches. Set
+      `worker_timeout` comfortably ABOVE the first-step jit-compile
+      latency: the first window pays compilation per replica, and a
+      too-tight timeout reads that as a straggler — training still
+      completes (degradation is graceful), but with needlessly shed
+      capacity.
+    - A worker accumulating more than `max_retries` CONSECUTIVE failures is
+      dropped from the pool; the in-flight window aborts (nothing was
+      committed) and re-runs repartitioned over the survivors, so a
+      degraded pool trains exactly like a master configured with the
+      smaller worker count. An empty pool raises `NoHealthyWorkersError`.
+    - Aggregation weights each worker result by its example count
+      (`example_weighted=True`, the default) so uneven shards — tail
+      windows, degraded pools — average correctly; equal shards reduce to
+      the reference's plain `divi(aggCount)` mean. Pass False for the
+      reference's unweighted behavior.
+    - Per-worker `WorkerHealth` records (heartbeat stamped per minibatch
+      from the configured `TimeSource`) persist across windows and epochs;
+      failures/retries/drops also count into `TrainingStats` when
+      `collect_training_stats=True`.
     """
 
     def __init__(self, num_workers: int, averaging_frequency: int = 5,
@@ -197,23 +314,62 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                  worker: Optional[TrainingWorker] = None,
                  repartition: Repartition = Repartition.ALWAYS,
                  repartition_strategy: RepartitionStrategy = RepartitionStrategy.ROUND_ROBIN,
-                 rng_seed: Optional[int] = None):
+                 rng_seed: Optional[int] = None,
+                 worker_timeout: Optional[float] = None,
+                 max_retries: int = 2,
+                 retry_backoff: float = 0.05,
+                 backoff_multiplier: float = 2.0,
+                 example_weighted: bool = True,
+                 time_source: Optional[TimeSource] = None):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if averaging_frequency < 1:
             raise ValueError("averaging_frequency must be >= 1")
+        if worker_timeout is not None and worker_timeout <= 0:
+            raise ValueError("worker_timeout must be positive (or None)")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
         self.num_workers = num_workers
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
         self.repartition = repartition
         self.repartition_strategy = repartition_strategy
+        self.worker_timeout = worker_timeout
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self.backoff_multiplier = backoff_multiplier
+        self.example_weighted = example_weighted
         self._rng_seed = rng_seed
         self._worker_factory = worker
         self._stats = TrainingStats() if collect_training_stats else None
+        self._time_source = time_source or TimeSourceProvider.get_instance()
+        self.worker_health: List[WorkerHealth] = [
+            WorkerHealth(i) for i in range(num_workers)]
 
     # -- SPI ---------------------------------------------------------------
     def get_training_stats(self) -> Optional[TrainingStats]:
         return self._stats
+
+    # -- health ------------------------------------------------------------
+    def alive_workers(self) -> List[WorkerHealth]:
+        return [h for h in self.worker_health if h.alive]
+
+    def reset_worker_health(self) -> None:
+        """Re-admit every worker (e.g. after replacing failed hardware)."""
+        self.worker_health = [WorkerHealth(i)
+                              for i in range(self.num_workers)]
+
+    def worker_heartbeat_age_ms(self, worker_id: int) -> Optional[int]:
+        """Milliseconds since `worker_id` last finished a minibatch, or
+        None if it never heartbeat."""
+        hb = self.worker_health[worker_id].last_heartbeat_ms
+        if hb is None:
+            return None
+        return self._time_source.current_time_millis() - hb
+
+    def _heartbeat(self, worker_id: int) -> None:
+        self.worker_health[worker_id].last_heartbeat_ms = (
+            self._time_source.current_time_millis())
 
     def execute_training_paths(self, net, paths) -> None:
         """Train from EXPORTED dataset shards (files written by
@@ -241,67 +397,255 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             if batches:  # tail window (reference tolerates short splits)
                 self._do_iteration(net, worker, batches, pool)
         finally:
-            pool.shutdown(wait=True)
+            # don't block on a hung straggler thread: shards that matter
+            # were already awaited (with timeout) inside the window loop
+            pool.shutdown(wait=False)
 
     # -- internals ---------------------------------------------------------
+    def _partition(self, batches: Sequence[DataSet],
+                   n_workers: int) -> List[List[DataSet]]:
+        """Split one window's batches over `n_workers` (reference
+        balancedRandomSplit + repartition)."""
+        if should_repartition(len(batches), n_workers, self.repartition):
+            return balanced_partitions(batches, n_workers,
+                                       self.repartition_strategy,
+                                       seed=self._rng_seed)
+        # keep arrival-order contiguous chunks (no data movement)
+        return balanced_partitions(batches, n_workers,
+                                   RepartitionStrategy.BALANCED, seed=0)
+
     def _do_iteration(self, net, worker: TrainingWorker,
                       batches: Sequence[DataSet],
                       pool: ThreadPoolExecutor) -> None:
-        """One averaging window (reference `doIteration:647`)."""
+        """One averaging window (reference `doIteration:647`), re-run over
+        the surviving pool whenever a worker is dropped mid-window."""
         stats = self._stats
-        # split: round-robin batches over workers (reference
-        # balancedRandomSplit + repartition)
-        if stats:
-            t = stats.timer("split")
-            t.__enter__()
-        if should_repartition(len(batches), self.num_workers, self.repartition):
-            shards = balanced_partitions(batches, self.num_workers,
-                                         self.repartition_strategy,
-                                         seed=self._rng_seed)
-        else:  # keep arrival-order contiguous chunks (no data movement)
-            shards = balanced_partitions(batches, self.num_workers,
-                                         RepartitionStrategy.BALANCED,
-                                         seed=0)
-        if stats:
-            t.__exit__()
-
-        def run_worker(shard: List[DataSet]) -> TrainingResult:
-            wnet = worker.get_initial_model()
-            n = 0
-            for j, ds in enumerate(shard):
-                worker.process_minibatch(ds, wnet, j == len(shard) - 1)
-                n += ds.num_examples()
-            result = worker.get_final_result(wnet)
-            result.num_examples = n
-            return result
-
-        if stats:
-            t = stats.timer("fit")
-            t.__enter__()
-        results = list(pool.map(run_worker, shards))
-        if stats:
-            t.__exit__()
+        while True:
+            alive = self.alive_workers()
+            if not alive:
+                raise NoHealthyWorkersError(
+                    f"all {self.num_workers} workers have been dropped "
+                    f"(per-worker failures: "
+                    f"{[h.total_failures for h in self.worker_health]}) — "
+                    "no healthy worker left to train on")
+            with (stats.timer("split") if stats else _nullcontext()):
+                shards = self._partition(batches, len(alive))
+            try:
+                with (stats.timer("fit") if stats else _nullcontext()):
+                    results = self._run_window(worker, shards, alive, pool)
+                break
+            except _WindowAbort:
+                if stats:
+                    stats.increment("window_reruns")
+                logger.warning(
+                    "averaging window aborted (worker dropped); re-running "
+                    "over %d surviving workers", len(self.alive_workers()))
 
         with (stats.timer("aggregate") if stats else _nullcontext()):
-            # plain average (reference `processResults:767-783`: aggregate
-            # add + divi by count, NOT example-weighted)
-            params = np.mean([r.params for r in results], axis=0)
+            # example-weighted average so uneven shards (tail windows,
+            # degraded pools) combine correctly; with equal shard sizes this
+            # IS the reference's plain mean (`processResults:767-783`:
+            # aggregate add + divi by count), which `example_weighted=False`
+            # restores exactly
+            weights = None
+            if self.example_weighted:
+                w = np.asarray([r.num_examples for r in results], np.float64)
+                if w.sum() > 0:
+                    weights = w
+            params = np.average([r.params for r in results], axis=0,
+                                weights=weights)
             upd = None
             if self.average_updaters:
                 vs = [r.updater_state for r in results]
                 if all(v is not None for v in vs) and vs:
-                    upd = np.mean(vs, axis=0)
+                    upd = np.average(vs, axis=0, weights=weights)
 
         with (stats.timer("broadcast") if stats else _nullcontext()):
             net.set_params(params)
             if upd is not None:
                 _set_updater_state_flat(net, upd)
-        net.score_value = float(np.mean([r.score for r in results]))
+        net.score_value = float(np.average([r.score for r in results],
+                                           weights=weights))
         # master clock advances by the longest worker shard (= the number of
         # sequential optimizer steps this window represents)
-        net.iteration += -(-len(batches) // self.num_workers)
+        net.iteration += max(len(s) for s in shards)
         for listener in getattr(net, "listeners", []):
             listener.iteration_done(net, net.iteration)
+
+    # -- elastic window execution ------------------------------------------
+    def _run_shard(self, worker: TrainingWorker,
+                   task: _ShardTask) -> TrainingResult:
+        task.started_at = time.monotonic()
+        wid = task.health.worker_id
+        _worker_ctx.worker_id = wid
+        self._heartbeat(wid)
+        try:
+            wnet = worker.get_initial_model()
+            n = 0
+            for j, ds in enumerate(task.shard):
+                if task.abandoned:
+                    # orphaned (timed out / window aborted): stop training
+                    # dead weight, free the slot, and above all stop
+                    # stamping heartbeats the master would misread as the
+                    # dropped worker being healthy
+                    raise _ShardAbandoned(f"shard {task.index}")
+                worker.process_minibatch(ds, wnet, j == len(task.shard) - 1)
+                n += ds.num_examples()
+                if not task.abandoned:
+                    self._heartbeat(wid)
+            result = worker.get_final_result(wnet)
+            result.num_examples = n
+            return result
+        finally:
+            _worker_ctx.worker_id = None
+
+    def _run_window(self, worker: TrainingWorker,
+                    shards: List[List[DataSet]],
+                    alive: List[WorkerHealth],
+                    pool: ThreadPoolExecutor) -> List[TrainingResult]:
+        """Dispatch shards, await with per-shard timeout, retry/re-dispatch
+        failures, drop repeat offenders (raising `_WindowAbort`)."""
+        results: List[Optional[TrainingResult]] = [None] * len(shards)
+        inflight: Dict[Future, _ShardTask] = {}
+        pending: List[_ShardTask] = []  # retries gated by backoff not_before
+        for health, (i, shard) in zip(alive, enumerate(shards)):
+            task = _ShardTask(i, shard, health, queued_at=time.monotonic())
+            inflight[pool.submit(self._run_shard, worker, task)] = task
+        try:
+            self._watch_window(worker, pool, results, inflight, pending)
+        except Exception:
+            # window abort / give-up: whatever is still running is dead
+            # weight — tell those threads to bail out and stop heartbeating
+            for t in inflight.values():
+                t.abandoned = True
+            raise
+        return results  # type: ignore[return-value]  # all slots filled
+
+    def _watch_window(self, worker: TrainingWorker,
+                      pool: ThreadPoolExecutor,
+                      results: List[Optional[TrainingResult]],
+                      inflight: Dict[Future, _ShardTask],
+                      pending: List[_ShardTask]) -> None:
+        while inflight or pending:
+            now = time.monotonic()
+            for task in [t for t in pending if now >= t.not_before]:
+                pending.remove(task)
+                task.started_at = None
+                task.queued_at = time.monotonic()
+                inflight[pool.submit(self._run_shard, worker, task)] = task
+            if not inflight:  # only backoff-gated retries remain
+                time.sleep(max(0.0, min(t.not_before for t in pending) - now))
+                continue
+            done, _ = _futures_wait(
+                set(inflight),
+                timeout=self._wait_timeout(inflight, pending),
+                return_when=FIRST_COMPLETED)
+            now = time.monotonic()
+            expired: List[Future] = []
+            if not done and self.worker_timeout is not None:
+                # a future that completed between the wait and this check
+                # is NOT expired — its (successful) result is harvested on
+                # the next loop pass instead of being discarded and
+                # charged to the worker as a phantom failure
+                expired = [f for f, t in inflight.items()
+                           if now >= t.deadline(self.worker_timeout)
+                           and not f.done()]
+                if not expired:
+                    continue
+            for f in done:
+                task = inflight.pop(f)
+                exc = f.exception()
+                if exc is None:
+                    results[task.index] = f.result()
+                    task.health.consecutive_failures = 0
+                    task.health.shards_completed += 1
+                else:
+                    self._on_shard_failure(task, exc, timed_out=False)
+                    self._schedule_retry(task, pending, exc)
+            for f in expired:
+                task = inflight.pop(f)
+                f.cancel()  # a queued task is cancelled outright; a running
+                task.abandoned = True  # thread bails at its next minibatch
+                exc = TimeoutError(
+                    f"worker {task.health.worker_id} exceeded "
+                    f"worker_timeout={self.worker_timeout}s on shard "
+                    f"{task.index}")
+                self._on_shard_failure(task, exc, timed_out=True)
+                self._schedule_retry(task, pending, exc)
+
+    def _wait_timeout(self, inflight: Dict[Future, _ShardTask],
+                      pending: List[_ShardTask]) -> Optional[float]:
+        now = time.monotonic()
+        wakeups = [t.not_before for t in pending]
+        if self.worker_timeout is not None:
+            wakeups += [t.deadline(self.worker_timeout)
+                        for t in inflight.values()]
+        if not wakeups:
+            return None
+        return max(0.0, min(wakeups) - now)
+
+    def _on_shard_failure(self, task: _ShardTask, exc: BaseException,
+                          timed_out: bool) -> None:
+        h = task.health
+        task.attempts += 1
+        h.consecutive_failures += 1
+        h.total_failures += 1
+        h.last_error = f"{type(exc).__name__}: {exc}"
+        if self._stats:
+            self._stats.increment("worker_failures")
+            if timed_out:
+                self._stats.increment("worker_timeouts")
+        logger.warning(
+            "worker %d %s on shard %d (shard attempt %d, consecutive "
+            "worker failures %d/%d): %s",
+            h.worker_id, "timed out" if timed_out else "failed", task.index,
+            task.attempts, h.consecutive_failures, self.max_retries + 1,
+            h.last_error)
+        if h.consecutive_failures > self.max_retries:
+            h.alive = False
+            if self._stats:
+                self._stats.increment("workers_dropped")
+            logger.warning(
+                "worker %d dropped after %d consecutive failures; pool "
+                "shrinks to %d healthy workers",
+                h.worker_id, h.consecutive_failures,
+                len(self.alive_workers()))
+            raise _WindowAbort(task.index)
+
+    def _schedule_retry(self, task: _ShardTask, pending: List[_ShardTask],
+                        exc: BaseException) -> None:
+        """Queue the failed shard for re-dispatch to a surviving worker
+        once its exponential backoff elapses. The backoff is a not-before
+        gate consumed by the watch loop, NOT a sleep here — sleeping
+        would stall harvesting/timeout detection for every other
+        in-flight shard."""
+        if task.attempts > self.max_retries:
+            raise WorkerFailureError(
+                f"shard {task.index} failed {task.attempts} times across "
+                f"re-dispatches (max_retries={self.max_retries}); last "
+                f"error: {type(exc).__name__}: {exc}") from exc
+        alive = self.alive_workers()
+        if not alive:
+            raise NoHealthyWorkersError(
+                "no healthy worker left to re-dispatch shard "
+                f"{task.index} to") from exc
+        # prefer a DIFFERENT surviving worker; fall back to the same one
+        # when it is the only survivor
+        candidates = [h for h in alive if h is not task.health] or alive
+        target = candidates[(task.attempts - 1) % len(candidates)]
+        delay = self.retry_backoff * (self.backoff_multiplier
+                                      ** (task.attempts - 1))
+        if self._stats:
+            self._stats.increment("worker_retries")
+        logger.warning(
+            "re-dispatching shard %d to worker %d after %.3fs backoff "
+            "(attempt %d/%d)", task.index, target.worker_id, delay,
+            task.attempts + 1, self.max_retries + 1)
+        # a FRESH task object: the old one may still be held by an orphaned
+        # thread whose bail-out check must not observe the retry's state
+        pending.append(_ShardTask(task.index, task.shard, target,
+                                  attempts=task.attempts,
+                                  not_before=time.monotonic() + delay))
 
 
 class _nullcontext:

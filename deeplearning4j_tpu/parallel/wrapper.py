@@ -156,6 +156,12 @@ class ParallelWrapper:
             donate_argnums=(0, 1, 2, 3),
         )
 
+    def get_network(self):
+        """The wrapped network — the same accessor `DistributedMultiLayer`
+        exposes, so `FaultTolerantTrainer` can drive either handle's fit
+        while checkpointing/restoring the underlying net."""
+        return self.net
+
     # -- sharded checkpointing ---------------------------------------------
     def save_checkpoint(self, path) -> None:
         """Write params/updater/layer state shard-by-shard via orbax — no

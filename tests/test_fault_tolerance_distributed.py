@@ -1,0 +1,505 @@
+"""Chaos suite for the elastic distributed-training layer.
+
+Proves the ISSUE-1 robustness contract on the TrainingMaster /
+ParameterServer tier: crashed workers re-dispatch (result parity with a
+healthy run), repeat offenders shrink the pool (parity with a master
+configured at the smaller size — example-weighted averaging), stragglers
+trip the heartbeat/timeout path, give-up paths raise cleanly instead of
+hanging an averaging barrier, and a stalled parameter server raises after
+bounded exponential backoff instead of deadlocking. Injected-fault log
+lines are asserted via caplog (logger `deeplearning4j_tpu`), not stdout.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel.fault_tolerance import (
+    FaultInjectionListener,
+    FaultTolerantTrainer,
+    InjectedFault,
+    ParameterServerStallInjector,
+    SlowWorkerInjector,
+    WorkerCrashInjector,
+)
+from deeplearning4j_tpu.parallel.parameter_server import (
+    ParameterServer,
+    ParameterServerParallelWrapper,
+    ParameterServerTimeoutError,
+    RetryingParameterServerClient,
+)
+from deeplearning4j_tpu.parallel.training_master import (
+    DistributedMultiLayer,
+    NoHealthyWorkersError,
+    ParameterAveragingTrainingMaster,
+    ParameterAveragingTrainingWorker,
+    WorkerFailureError,
+)
+
+pytestmark = pytest.mark.chaos
+
+LOGGER = "deeplearning4j_tpu"
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(lr)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_in=8, n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        f = rng.randn(batch, 4).astype(np.float32)
+        l = np.eye(3, dtype=np.float32)[rng.randint(0, 3, batch)]
+        out.append(DataSet(f, l))
+    return out
+
+
+def _master(net, num_workers, injector=None, **kw):
+    worker = ParameterAveragingTrainingWorker(net)
+    if injector is not None:
+        worker.add_hook(injector)
+    kw.setdefault("averaging_frequency", 2)
+    kw.setdefault("collect_training_stats", True)
+    return ParameterAveragingTrainingMaster(num_workers=num_workers,
+                                            worker=worker, **kw)
+
+
+# ---------------------------------------------------------------- crashes
+
+
+def test_transient_crash_redispatches_and_matches_healthy_run(caplog):
+    """Worker 3 of 4 crashes once; its shard re-dispatches to a survivor.
+    Because a retried shard re-clones from the same master params, the
+    final parameters EQUAL the healthy 4-worker run (stronger than the
+    'same loss ballpark' the acceptance criterion asks for)."""
+    batches = _batches(8, seed=2)
+    healthy_net = _net()
+    _master(healthy_net, 4).execute_training(
+        healthy_net, ListDataSetIterator(batches))
+
+    crashy_net = _net()
+    injector = WorkerCrashInjector(worker_id=3, fail_at_fit=1, times=1)
+    master = _master(crashy_net, 4, injector=injector)
+    s0 = crashy_net.score(batches[0])
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        DistributedMultiLayer(crashy_net, master).fit(
+            ListDataSetIterator(batches))
+
+    assert injector.fired == 1
+    np.testing.assert_allclose(crashy_net.params(), healthy_net.params(),
+                               rtol=1e-6, atol=1e-7)
+    assert crashy_net.score(batches[0]) < s0  # converged, not just survived
+    # nobody was dropped: the crash was transient
+    assert all(h.alive for h in master.worker_health)
+    stats = master.get_training_stats()
+    assert stats.get_count("worker_failures") == 1
+    assert stats.get_count("worker_retries") == 1
+    assert stats.get_count("workers_dropped") == 0
+    assert any("injected crash on worker 3" in r.message
+               for r in caplog.records)
+    assert any("re-dispatching shard" in r.message for r in caplog.records)
+
+
+def test_persistent_crash_shrinks_pool_with_averaging_parity(caplog):
+    """Worker 3 of 4 crashes every time with max_retries=0: it is dropped,
+    the window re-runs over the 3 survivors, and the result matches a
+    HEALTHY 3-worker master exactly (example-weighted averaging parity).
+    One tail window for both masters so the window split is identical."""
+    batches = _batches(6, seed=3)
+    healthy3 = _net()
+    _master(healthy3, 3).execute_training(
+        healthy3, ListDataSetIterator(batches))
+
+    degraded = _net()
+    injector = WorkerCrashInjector(worker_id=3, fail_at_fit=1, times=999)
+    master = _master(degraded, 4, injector=injector, max_retries=0,
+                     retry_backoff=0.0)
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        master.execute_training(degraded, ListDataSetIterator(batches))
+
+    np.testing.assert_allclose(degraded.params(), healthy3.params(),
+                               rtol=1e-6, atol=1e-7)
+    assert not master.worker_health[3].alive
+    assert len(master.alive_workers()) == 3
+    stats = master.get_training_stats()
+    assert stats.get_count("workers_dropped") == 1
+    assert stats.get_count("window_reruns") == 1
+    assert any("pool shrinks to 3 healthy workers" in r.message
+               for r in caplog.records)
+
+
+def test_all_workers_dropped_raises_cleanly(caplog):
+    """Crash every worker with max_retries=0: the pool drains worker by
+    worker and the master raises NoHealthyWorkersError instead of hanging
+    the averaging barrier."""
+
+    class CrashEveryone(WorkerCrashInjector):
+        def pre_update(self, ds, net):
+            raise InjectedFault("poisoned pool")
+
+    net = _net()
+    master = _master(net, 2, injector=CrashEveryone(worker_id=-1),
+                     max_retries=0, retry_backoff=0.0)
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        with pytest.raises(NoHealthyWorkersError):
+            master.execute_training(net, ListDataSetIterator(_batches(4)))
+    assert master.get_training_stats().get_count("workers_dropped") == 2
+    assert not master.alive_workers()
+
+
+def test_poison_shard_exhausts_redispatch_attempts():
+    """A shard that fails on EVERY worker (data-poisoned, not a worker
+    fault) raises WorkerFailureError once bounded re-dispatch attempts are
+    spent, with the injected fault chained as the cause."""
+    batches = _batches(4, seed=4)
+    poison = batches[1]
+
+    class PoisonBatch:
+        def on_training_start(self, net):
+            pass
+
+        def on_training_end(self, net):
+            pass
+
+        def post_update(self, ds, net):
+            pass
+
+        def pre_update(self, ds, net):
+            if ds is poison:
+                raise InjectedFault("poison batch")
+
+    net = _net()
+    master = _master(net, 2, injector=PoisonBatch(), max_retries=1,
+                     retry_backoff=0.0)
+    with pytest.raises(WorkerFailureError) as ei:
+        master.execute_training(net, ListDataSetIterator(batches))
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    # the fault followed the shard: both workers failed once, neither is a
+    # repeat offender, so the pool did NOT shrink
+    assert len(master.alive_workers()) == 2
+
+
+# -------------------------------------------------------------- stragglers
+
+
+def test_straggler_timeout_drops_slow_worker(caplog):
+    """Worker 1's injected delay exceeds worker_timeout: the straggler is
+    detected (instead of blocking the averaging barrier), dropped at
+    max_retries=0, and training completes on the survivor."""
+    batches = _batches(4, seed=5)
+    net = _net()
+    injector = SlowWorkerInjector(worker_id=1, delay=3.0, times=1)
+    master = _master(net, 2, injector=injector, worker_timeout=0.5,
+                     max_retries=0, retry_backoff=0.0)
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        master.execute_training(net, ListDataSetIterator(batches))
+
+    assert injector.fired == 1
+    assert not master.worker_health[1].alive
+    assert net.iteration == 4  # window re-ran on 1 worker: 4 sequential fits
+    stats = master.get_training_stats()
+    assert stats.get_count("worker_timeouts") >= 1
+    assert stats.get_count("workers_dropped") == 1
+    assert any("timed out" in r.message for r in caplog.records)
+    assert any("SlowWorkerInjector: delaying worker 1" in r.message
+               for r in caplog.records)
+    # the healthy worker heartbeat per minibatch
+    assert master.worker_health[0].last_heartbeat_ms is not None
+    assert master.worker_heartbeat_age_ms(0) >= 0
+
+
+def test_transient_straggler_redispatches_and_survives():
+    """A one-off straggle under max_retries=1 re-dispatches the shard but
+    keeps the worker in the pool (transient slowness != dead worker)."""
+    batches = _batches(4, seed=6)
+    net = _net()
+    injector = SlowWorkerInjector(worker_id=1, delay=3.0, times=1)
+    master = _master(net, 2, injector=injector, worker_timeout=0.5,
+                     max_retries=1, retry_backoff=0.0)
+    master.execute_training(net, ListDataSetIterator(batches))
+    assert all(h.alive for h in master.worker_health)
+    stats = master.get_training_stats()
+    assert stats.get_count("worker_retries") == 1
+    assert stats.get_count("workers_dropped") == 0
+
+
+def test_hung_sole_worker_raises_instead_of_livelocking():
+    """A permanently hung single worker saturates the pool: the
+    re-dispatched shard can never start, so queue starvation must count
+    as failure and converge to NoHealthyWorkersError in bounded time —
+    not spin waiting for a slot that will never free."""
+    import time as _time
+
+    net = _net()
+    injector = SlowWorkerInjector(worker_id=0, delay=2.0, times=99)
+    master = _master(net, 1, injector=injector, worker_timeout=0.3,
+                     max_retries=1, retry_backoff=0.0)
+    t0 = _time.monotonic()
+    with pytest.raises(NoHealthyWorkersError):
+        master.execute_training(net, ListDataSetIterator(_batches(2)))
+    assert _time.monotonic() - t0 < 5.0
+
+
+# ------------------------------------------------------- parameter server
+
+
+def test_stalled_ps_raises_after_bounded_backoff(caplog):
+    """Pull/push against a stalled store raise ParameterServerTimeoutError
+    after max_retries+1 bounded attempts — never a deadlock."""
+    store = ParameterServer(np.zeros(4, np.float32))
+    stalled = ParameterServerStallInjector(store, stall_after=1)
+    client = RetryingParameterServerClient(stalled, timeout=0.1,
+                                           max_retries=2, backoff=0.01)
+    try:
+        np.testing.assert_allclose(client.pull(), np.zeros(4))  # pre-stall
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            with pytest.raises(ParameterServerTimeoutError):
+                client.push_update(np.ones(4, np.float32))
+        assert client.attempts == 1 + 3  # healthy pull + bounded retries
+        assert client.timeouts == 3
+        assert stalled.stalled_requests >= 1
+        assert any("ParameterServerStallInjector: stalling" in r.message
+                   for r in caplog.records)
+        assert any("backing off" in r.message for r in caplog.records)
+    finally:
+        stalled.release()
+
+
+def test_ps_wrapper_surfaces_stall_instead_of_deadlocking():
+    """A ParameterServerParallelWrapper trained against a stalled server
+    raises from fit() (worker death surfaces) rather than wedging on the
+    dispatch queue forever."""
+    net = _net(lr=0.05)
+    store = ParameterServer(net.params())
+    stalled = ParameterServerStallInjector(store, stall_after=2)
+    psw = ParameterServerParallelWrapper(net, workers=2, sync_frequency=1,
+                                         server=stalled,
+                                         request_timeout=0.2, max_retries=1,
+                                         retry_backoff=0.01)
+    try:
+        with pytest.raises(ParameterServerTimeoutError):
+            psw.fit(ListDataSetIterator(_batches(8, seed=7)), epochs=2)
+    finally:
+        stalled.release()
+
+
+def test_retrying_client_recovers_from_transient_stall():
+    """A stall shorter than the retry budget is survived transparently."""
+    store = ParameterServer(np.zeros(3, np.float32))
+
+    class BriefStall:
+        def __init__(self):
+            self.calls = 0
+
+        def pull(self):
+            self.calls += 1
+            if self.calls == 1:
+                import time
+                time.sleep(0.3)  # first attempt times out at 0.1s
+            return store.pull()
+
+        def push_update(self, delta):
+            store.push_update(delta)
+
+    client = RetryingParameterServerClient(BriefStall(), timeout=0.1,
+                                           max_retries=2, backoff=0.01)
+    np.testing.assert_allclose(client.pull(), np.zeros(3))
+    assert client.timeouts == 1 and client.attempts == 2
+
+
+def test_parameter_server_push_dedup():
+    """request_id makes push_update idempotent (retry redelivery)."""
+    ps = ParameterServer(np.zeros(2, np.float32))
+    ps.push_update(np.ones(2, np.float32), request_id="a" * 32)
+    ps.push_update(np.ones(2, np.float32), request_id="a" * 32)  # dropped
+    ps.push_update(np.ones(2, np.float32), request_id="b" * 32)
+    assert ps.num_pushes == 2
+    np.testing.assert_allclose(ps.pull(), 2 * np.ones(2))
+
+
+def test_abandoned_push_attempts_commit_at_most_once():
+    """Timed-out push attempts eventually unblock and commit anyway; the
+    request-id dedup keeps the LOGICAL push applied at most once instead
+    of once per abandoned attempt."""
+    import time as _time
+
+    store = ParameterServer(np.zeros(2, np.float32))
+    stalled = ParameterServerStallInjector(store, stall_after=0,
+                                           stall_seconds=0.4)
+    client = RetryingParameterServerClient(stalled, timeout=0.05,
+                                           max_retries=2, backoff=0.01)
+    with pytest.raises(ParameterServerTimeoutError):
+        client.push_update(np.ones(2, np.float32))
+    _time.sleep(0.8)  # let every abandoned attempt unblock and commit
+    assert stalled.stalled_requests == 3  # all attempts reached the store
+    assert store.num_pushes == 1          # ...but the delta applied once
+    np.testing.assert_allclose(store.pull(), np.ones(2))
+
+
+def test_retrying_client_reuses_dispatcher_thread():
+    """Healthy-path requests ride one dispatcher thread, not one thread
+    per pull/push."""
+    store = ParameterServer(np.zeros(2, np.float32))
+    client = RetryingParameterServerClient(store, timeout=1.0)
+    for _ in range(5):
+        client.pull()
+        client.push_update(np.ones(2, np.float32))
+    d = client._dispatcher
+    assert d is not None and not d.abandoned
+    assert store.num_pushes == 5
+    client.close()
+
+
+# --------------------------------------------- restart-aware composition
+
+
+def test_fault_tolerant_trainer_drives_distributed_fit(tmp_path, caplog):
+    """FaultTolerantTrainer over a DistributedMultiLayer: a post-window
+    fault escaping the master's own retry layer restores the newest
+    checkpoint and resumes; the restart count lands in TrainingStats and
+    on_restart listeners fire."""
+    restarts_seen = []
+
+    class RestartRecorder:
+        def iteration_done(self, model, iteration):
+            pass
+
+        def on_restart(self, model, restart_count):
+            restarts_seen.append(restart_count)
+
+    net = _net()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=2, collect_training_stats=True)
+    handle = DistributedMultiLayer(net, master)
+    fault = FaultInjectionListener(fail_at_iteration=2)
+    net.set_listeners(fault, RestartRecorder())
+    trainer = FaultTolerantTrainer(handle, ListDataSetIterator(_batches(8)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=1, max_restarts=2)
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        trainer.fit(epochs=2)
+    assert fault.fired == 1
+    assert trainer.restarts == 1
+    assert restarts_seen == [1]
+    assert master.get_training_stats().get_count("restarts") == 1
+    assert any("FaultInjectionListener: injected fault" in r.message
+               for r in caplog.records)
+    assert np.isfinite(net.score_value)
+
+
+def test_restart_readmits_drained_pool(tmp_path):
+    """A transient fault that drains the WHOLE pool must not doom every
+    subsequent restart: FaultTolerantTrainer re-admits all workers after
+    restoring, so the retry runs against a fresh pool."""
+    from deeplearning4j_tpu.parallel.training_master import TrainingHook
+
+    class CrashUntilDisarmed(TrainingHook):
+        armed = True
+
+        def pre_update(self, ds, net):
+            if self.armed:
+                raise InjectedFault("transient pool-wide outage")
+
+    class Disarm:
+        def iteration_done(self, model, iteration):
+            pass
+
+        def on_restart(self, model, restart_count):
+            injector.armed = False  # the transient outage has passed
+
+    injector = CrashUntilDisarmed()
+    net = _net()
+    net.set_listeners(Disarm())
+    worker = ParameterAveragingTrainingWorker(net)
+    worker.add_hook(injector)
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=2, worker=worker,
+        max_retries=0, retry_backoff=0.0, collect_training_stats=True)
+    handle = DistributedMultiLayer(net, master)
+    trainer = FaultTolerantTrainer(handle, ListDataSetIterator(_batches(4)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=1, max_restarts=2)
+    trainer.fit(epochs=1)
+    assert trainer.restarts == 1
+    assert len(master.alive_workers()) == 2  # pool re-admitted and healthy
+    assert master.get_training_stats().get_count("restarts") == 1
+    assert np.isfinite(net.score_value)
+
+
+def test_early_stopping_distributed_recovers_from_fault(tmp_path):
+    """EarlyStoppingDistributedTrainer(checkpoint_dir=...) completes its
+    epochs despite an injected distributed fault mid-run."""
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        InMemoryModelSaver,
+        MaxEpochsTerminationCondition,
+        TerminationReason,
+    )
+    from deeplearning4j_tpu.parallel.early_stopping import (
+        EarlyStoppingDistributedTrainer,
+    )
+
+    net = _net()
+    fault = FaultInjectionListener(fail_at_iteration=3)
+    net.set_listeners(fault)
+    master = ParameterAveragingTrainingMaster(num_workers=2,
+                                              averaging_frequency=2)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    trainer = EarlyStoppingDistributedTrainer(
+        cfg, net, ListDataSetIterator(_batches(8, seed=8)), master,
+        checkpoint_dir=tmp_path, checkpoint_every=1, max_restarts=2)
+    result = trainer.fit()
+    assert fault.fired == 1
+    assert trainer.fault_tolerant.restarts == 1
+    assert result.termination_reason == \
+        TerminationReason.EPOCH_TERMINATION_CONDITION
+
+
+def test_early_stopping_distributed_net_mismatch_raises():
+    """ISSUE-1 satellite (ADVICE.md): passing an existing
+    DistributedMultiLayer alongside a DIFFERENT net must raise instead of
+    silently training the handle's net."""
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        InMemoryModelSaver,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.parallel.early_stopping import (
+        EarlyStoppingDistributedTrainer,
+    )
+
+    net1, net2 = _net(), _net(seed=999)
+    handle = DistributedMultiLayer(
+        net1, ParameterAveragingTrainingMaster(num_workers=2))
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(1))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    it = ListDataSetIterator(_batches(2))
+    with pytest.raises(ValueError, match="different net"):
+        EarlyStoppingDistributedTrainer(cfg, net2, it, handle)
+    # the handle's own net (or None) is accepted
+    EarlyStoppingDistributedTrainer(cfg, net1, it, handle)
+    EarlyStoppingDistributedTrainer(cfg, None, it, handle)

@@ -421,3 +421,44 @@ def test_connections_reassignment_rebuilds_bigram_rows():
     m2[0, 1] = 5.0  # entering class 1 punished -> "a"+"b" wins
     lex.connections = m2
     assert [s for s, _ in viterbi_segment("ab", lex)] == ["a", "b"]
+
+
+def test_post_construction_mutation_fails_fast():
+    """ISSUE-1 satellite (ADVICE.md): assigning `connections` or
+    `char_defs` after construction re-runs the ctx-id validation, so a
+    mismatched matrix raises ValueError immediately instead of surfacing
+    later as an IndexError inside the bigram lattice."""
+    import numpy as np
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.nlp.dictionary import (
+        CharCategory,
+        CharacterDefinitions,
+        LexEntry,
+        Lexicon,
+    )
+
+    # entry ids valid for a 4x4 matrix but not a 2x2 one
+    lex = Lexicon([LexEntry("あ", "x", 0.5, left_id=3, right_id=3)],
+                  connections=np.zeros((4, 4), np.float32))
+    with _pytest.raises(ValueError, match="outside the 2x2"):
+        lex.connections = np.zeros((2, 2), np.float32)
+    assert lex.connections.shape == (4, 4)  # rejected assignment kept none
+
+    # char categories are validated by BOTH setters
+    oob = CharacterDefinitions(
+        {"hiragana": CharCategory("HIRAGANA", invoke=True, group=True,
+                                  length=0, left_id=9, right_id=9)})
+    with _pytest.raises(ValueError, match="char category HIRAGANA"):
+        lex.char_defs = oob
+    ok = CharacterDefinitions(
+        {"hiragana": CharCategory("HIRAGANA", invoke=True, group=True,
+                                  length=0, left_id=1, right_id=1)})
+    lex.char_defs = ok
+    # shrinking the matrix under valid entries but now-invalid categories
+    # is caught by the connections setter too
+    lex2 = Lexicon([LexEntry("あ", "x", 0.5, left_id=0, right_id=0)],
+                   connections=np.zeros((4, 4), np.float32))
+    lex2.char_defs = ok
+    with _pytest.raises(ValueError, match="char category HIRAGANA"):
+        lex2.connections = np.zeros((1, 1), np.float32)

@@ -172,29 +172,27 @@ def test_glove_spill_file_counting_matches_in_memory(tmp_path):
     the corpus's distinct-pair count, counting spills sorted binary shards
     to disk and merge-streams them back (reference
     `models/glove/count/BinaryCoOccurrenceWriter.java` / `RoundCount.java`)
-    — and training matches the in-memory result EXACTLY (both paths feed
-    the factorization the same sorted pair order)."""
-    from deeplearning4j_tpu.nlp.glove import CooccurrenceCounter
-
+    — and training matches the in-memory result (both paths feed the
+    factorization the same sorted pair order; a pair straddling spill
+    rounds may differ by one ULP from the in-memory running sum, so the
+    comparison uses a tiny tolerance rather than exact equality)."""
     corpus = _topic_corpus(60)
     kw = dict(layer_size=8, window=3, epochs=5, learning_rate=0.05,
               batch_size=256, seed=11)
     ref = Glove(**kw)
     ref.fit(corpus)
 
-    # measure the in-memory distinct-pair count, then cap well below it
-    counter = CooccurrenceCounter()
-    gl_probe = Glove(**kw)
-    vocab_probe = gl_probe  # counting only; reuse fit's counting loop via cap path
     spilled = Glove(**kw, cooccurrence_memory_cap=64,
                     spill_dir=tmp_path / "spill")
     spilled.fit(corpus)
     # the cap actually forced spilling
     assert list((tmp_path / "spill").glob("shard_*.npy"))
-    assert spilled.mean_loss == ref.mean_loss
+    np.testing.assert_allclose(spilled.mean_loss, ref.mean_loss,
+                               rtol=1e-6, atol=1e-9)
     for w in ("cat", "dog", "moon"):
-        np.testing.assert_array_equal(spilled.get_word_vector(w),
-                                      ref.get_word_vector(w))
+        np.testing.assert_allclose(spilled.get_word_vector(w),
+                                   ref.get_word_vector(w),
+                                   rtol=1e-6, atol=1e-8)
 
 
 def test_cooccurrence_counter_merge_sums_across_shards(tmp_path):
