@@ -460,6 +460,73 @@ def bench_gpt_long():
     return metric, value, mfu, spread
 
 
+def bench_checkpoint():
+    """Durability tax of the checkpoint subsystem (`util/checkpoint_store`):
+    one full durable cycle = atomic save (temp + fsync + os.replace +
+    integrity manifest), manifest verify (full re-hash), restore
+    (newest-verified fallback load) for a ~1.1 M-param MLP (≈4.3 MB zip
+    payload, Adam state included). Metric: verified round-trips/sec so
+    higher stays better like every other config; per-phase medians are
+    reported alongside as `latency_ms` so BENCH_*.json tracks where the
+    tax goes (hashing vs fsync vs params host-transfer) across rounds."""
+    import tempfile
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+    from deeplearning4j_tpu.util.serialization import (
+        restore_model,
+        write_model,
+    )
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01).updater(Updater.ADAM)
+            .list()
+            .layer(DenseLayer(n_out=1024, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=512, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(512))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.standard_normal((32, 512)).astype(np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)])
+    net.fit(ds)  # populate Adam moments so the round-trip covers them
+    phases = {"save": [], "verify": [], "restore": []}
+    with tempfile.TemporaryDirectory() as d:
+        store = CheckpointStore(d, keep_last=2)
+        for i in range(_REPEATS + 1):  # +1 warmup (first save pays jit/IO
+            t0 = time.perf_counter()   # cache warm-up)
+            store.save(i, lambda tmp: write_model(net, tmp, atomic=False))
+            t1 = time.perf_counter()
+            store.verify(i)
+            t2 = time.perf_counter()
+            store.load_latest_verified(restore_model)
+            t3 = time.perf_counter()
+            if i:
+                phases["save"].append(t1 - t0)
+                phases["verify"].append(t2 - t1)
+                phases["restore"].append(t3 - t2)
+    medians = {k: float(np.median(v)) for k, v in phases.items()}
+    total = sum(medians.values())
+    spread = max(max(v) / min(v) for v in phases.values())
+    bench_checkpoint.latency_ms = {k: round(1e3 * v, 2)
+                                   for k, v in medians.items()}
+    return ("checkpoint_durable_save_verify_restore_roundtrips_per_sec",
+            1.0 / total, None, spread)
+
+
 def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
     """Synthetic Zipf corpus as pre-tokenized sentences."""
     rng = np.random.default_rng(seed)
@@ -608,10 +675,13 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "gpt_med": bench_gpt_med, "gpt_long": bench_gpt_long,
             "word2vec": bench_word2vec,
             "word2vec_50k": bench_word2vec_50k,
-            "generate": bench_generate}
+            "generate": bench_generate,
+            "checkpoint": bench_checkpoint}
 
 
 def _unit(metric: str) -> str:
+    if "roundtrips" in metric:
+        return "roundtrips/sec"
     if "words" in metric:
         return "words/sec/chip"
     return "tokens/sec/chip" if "tokens" in metric else "samples/sec/chip"
@@ -658,6 +728,9 @@ def main() -> None:
         extra = getattr(_CONFIGS[name], "fused_speedup_vs_scan", None)
         if extra is not None:
             entries[name]["fused_speedup_vs_scan"] = extra
+        extra = getattr(_CONFIGS[name], "latency_ms", None)
+        if extra is not None:
+            entries[name]["latency_ms"] = extra
     if on_chip:
         baseline_file.write_text(json.dumps(baselines))
 

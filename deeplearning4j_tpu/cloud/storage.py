@@ -98,9 +98,15 @@ class LocalStorage(DataSetStorage):
         return p
 
     def put_bytes(self, key: str, data: bytes) -> None:
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            atomic_write_bytes,
+        )
+
         p = self._path(key)
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_bytes(data)
+        # atomic publish: an interrupted put must not leave a truncated
+        # object that a later get would hand to a model restore
+        atomic_write_bytes(p, data)
 
     def get_bytes(self, key: str) -> bytes:
         return self._path(key).read_bytes()
@@ -112,6 +118,109 @@ class LocalStorage(DataSetStorage):
 
     def exists(self, key: str) -> bool:
         return self._path(key).is_file()
+
+
+class RetryingStorage(DataSetStorage):
+    """Bounded-backoff retry + post-transfer checksum re-verification for
+    ANY `DataSetStorage` backend — the cloud-transfer leg of the durable
+    checkpoint subsystem (`util/checkpoint_store.py`), under the same
+    retry discipline as PR 1's `RetryingParameterServerClient`.
+
+    - transient transport failures (`ConnectionError`/`OSError`/
+      `TimeoutError`) retry after `backoff × backoff_multiplier^attempt`
+      seconds, at most `max_retries` retries, then re-raise;
+    - with `verify=True` (default), every `put_bytes` is read back and
+      its SHA-256 compared against what was sent — an object store that
+      corrupted bytes in flight is retried like a transport failure, and
+      exhaustion raises `CheckpointCorruptError`. `get_bytes` accepts an
+      optional `expected_sha256` for the symmetric download check (used
+      by `CheckpointStore.download`, whose manifests carry the digests).
+
+    Counters (`attempts`, `retries`) are observability for chaos tests."""
+
+    def __init__(self, storage: DataSetStorage, max_retries: int = 3,
+                 backoff: float = 0.05, backoff_multiplier: float = 2.0,
+                 verify: bool = True):
+        self._storage = storage
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.backoff_multiplier = backoff_multiplier
+        self.verify = verify
+        self.attempts = 0
+        self.retries = 0
+
+    def _retry(self, what: str, fn, extra_retryable: tuple = ()):
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            retry_with_backoff,
+        )
+
+        def counted():
+            self.attempts += 1
+            return fn()
+
+        before = self.attempts
+
+        try:
+            return retry_with_backoff(
+                counted, what=what, max_retries=self.max_retries,
+                backoff=self.backoff,
+                backoff_multiplier=self.backoff_multiplier,
+                retryable=(ConnectionError, OSError, TimeoutError)
+                + extra_retryable)
+        finally:
+            self.retries += max(0, self.attempts - before - 1)
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        import hashlib
+
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            CheckpointCorruptError,
+        )
+
+        if not self.verify:
+            self._retry(f"put {key}", lambda: self._storage.put_bytes(key, data))
+            return
+        want = hashlib.sha256(data).hexdigest()
+
+        def _put_verified():
+            self._storage.put_bytes(key, data)
+            got = hashlib.sha256(self._storage.get_bytes(key)).hexdigest()
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"upload of {key!r} corrupted in transit "
+                    "(read-back digest mismatch)")
+
+        self._retry(f"put {key}", _put_verified,
+                    extra_retryable=(CheckpointCorruptError,))
+
+    def get_bytes(self, key: str,
+                  expected_sha256: "str | None" = None) -> bytes:
+        import hashlib
+
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            CheckpointCorruptError,
+        )
+
+        def _get():
+            data = self._storage.get_bytes(key)
+            if expected_sha256 is not None \
+                    and hashlib.sha256(data).hexdigest() != expected_sha256:
+                raise CheckpointCorruptError(
+                    f"download of {key!r} corrupted in transit "
+                    "(digest mismatch)")
+            return data
+
+        return self._retry(f"get {key}", _get,
+                           extra_retryable=(CheckpointCorruptError,)
+                           if expected_sha256 is not None else ())
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        return self._retry(f"list {prefix!r}",
+                           lambda: self._storage.list_keys(prefix))
+
+    def exists(self, key: str) -> bool:
+        return self._retry(f"exists {key}",
+                           lambda: self._storage.exists(key))
 
 
 class GCSStorage(DataSetStorage):

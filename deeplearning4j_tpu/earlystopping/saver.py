@@ -42,7 +42,16 @@ class InMemoryModelSaver(EarlyStoppingModelSaver):
 
 class LocalFileModelSaver(EarlyStoppingModelSaver):
     """Checkpoint zips under a directory (reference `LocalFileModelSaver`:
-    bestModel.bin / latestModel.bin)."""
+    bestModel.bin / latestModel.bin).
+
+    Durability (the reference truncated the destination in place, so a
+    crash mid-save destroyed the best model it was trying to preserve):
+    saves commit atomically (temp + fsync + `os.replace`, via
+    `util/serialization.write_model`) and publish an integrity sidecar
+    (`bestModel.bin.manifest.json`). Loads verify the sidecar and raise a
+    typed `CheckpointCorruptError` for a truncated/bit-rotted file — not
+    a raw zip/unpickling crash — so early-stopping resume logic can fall
+    back (e.g. to the best model when latest is damaged) deliberately."""
 
     def __init__(self, directory):
         self.directory = Path(directory)
@@ -50,21 +59,44 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
         self.best_path = self.directory / "bestModel.bin"
         self.latest_path = self.directory / "latestModel.bin"
 
-    def save_best_model(self, net, score):
+    def _save(self, net, path) -> None:
+        import contextlib
+
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            manifest_path_for,
+            write_manifest_for,
+        )
         from deeplearning4j_tpu.util.serialization import write_model
 
-        write_model(net, self.best_path)
+        # retire the OLD sidecar before replacing the payload: a crash
+        # between the two publishes must leave a manifest-less file that
+        # still loads, never a stale manifest vouching for bytes that are
+        # gone (which would brick an intact checkpoint on verify)
+        with contextlib.suppress(OSError):
+            manifest_path_for(path).unlink()
+        write_model(net, path)
+        write_manifest_for(path, step=net.iteration)
+
+    def save_best_model(self, net, score):
+        self._save(net, self.best_path)
 
     def save_latest_model(self, net, score):
-        from deeplearning4j_tpu.util.serialization import write_model
-
-        write_model(net, self.latest_path)
+        self._save(net, self.latest_path)
 
     def _load(self, path) -> Optional[object]:
         if not path.exists():
             return None
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            manifest_path_for,
+            verify_manifest,
+        )
         from deeplearning4j_tpu.util.serialization import restore_model
 
+        if manifest_path_for(path).exists():
+            # sidecar verification catches damage the zip CRC can't (e.g.
+            # a clobbered central directory); manifest-less files (older
+            # builds) still get the typed-error translation in restore
+            verify_manifest(path)
         return restore_model(path)
 
     def get_best_model(self):

@@ -12,6 +12,7 @@ sample every N iterations to avoid forcing device→host syncs each step.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import List, Tuple
 
@@ -173,42 +174,47 @@ class CheckpointListener(IterationListener):
     checkpoints via `ModelSerializer` and early-stopping savers; this
     listener automates it on an iteration/epoch cadence).
 
-    Files: `<dir>/checkpoint_<iteration>.zip` + a `latest` marker file the
-    resume path reads."""
+    Saves commit through `util/checkpoint_store.CheckpointStore`: each
+    `<dir>/checkpoint_<iteration>.zip` is written to a temp name,
+    fsynced, and published with `os.replace` together with an integrity
+    sidecar (`...zip.manifest.json` — per-file size/SHA-256/CRC32, step,
+    wall-clock, library version), so a crash mid-save can never destroy a
+    previously published checkpoint. The `latest` marker file remains as
+    a convenience; the restore path trusts manifest verification, not the
+    marker. `save_hooks` is the chaos seam
+    (`parallel.fault_tolerance.CheckpointCrashInjector`)."""
 
     def __init__(self, directory, every_n_iterations: int = 0,
-                 every_n_epochs: int = 0, keep_last: int = 3):
-        import os
+                 every_n_epochs: int = 0, keep_last: int = 3,
+                 save_hooks=()):
+        from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
 
         if not every_n_iterations and not every_n_epochs:
             raise ValueError("set every_n_iterations and/or every_n_epochs")
         self.directory = directory
-        os.makedirs(directory, exist_ok=True)
+        self.store = CheckpointStore(directory, keep_last=keep_last,
+                                     save_hooks=save_hooks)
         self.every_n_iterations = every_n_iterations
         self.every_n_epochs = every_n_epochs
-        self.keep_last = max(1, keep_last)
+        self.keep_last = self.store.keep_last
         self.saved: List[str] = []
         self._last_saved_iteration = -1
 
     def _save(self, model, iteration: int) -> None:
-        import os
-
         from deeplearning4j_tpu.util.serialization import write_model
 
         if iteration == self._last_saved_iteration:
             return  # iteration- and epoch-cadence fired at the same step
+        # the store owns the atomic commit, so the writer skips its own
+        # temp+fsync+replace pass (atomic=False): one fsync per save
+        path = self.store.save(
+            iteration, lambda tmp: write_model(model, tmp, atomic=False))
+        # marked saved only AFTER the publish: a crashed save must not
+        # consume this iteration's slot — the rolled-back run re-saves it
         self._last_saved_iteration = iteration
-        path = os.path.join(self.directory, f"checkpoint_{iteration}.zip")
-        write_model(model, path)
-        self.saved.append(path)
-        with open(os.path.join(self.directory, "latest"), "w") as f:
-            f.write(os.path.basename(path))
-        while len(self.saved) > self.keep_last:
-            old = self.saved.pop(0)
-            try:
-                os.remove(old)
-            except OSError:
-                pass
+        self.saved.append(str(path))
+        self.saved = [p for p in self.saved
+                      if os.path.exists(p)][-self.keep_last:]
 
     def iteration_done(self, model, iteration: int) -> None:
         if self.every_n_iterations and iteration % self.every_n_iterations == 0:
@@ -220,9 +226,30 @@ class CheckpointListener(IterationListener):
 
     @staticmethod
     def last_checkpoint(directory) -> "str | None":
-        """Path of the newest checkpoint, via the `latest` marker."""
-        import os
+        """Path of the newest VERIFIED checkpoint (manifest re-hash).
+        Falls back to the legacy `latest` marker for manifest-less
+        directories written by older builds; returns None when nothing
+        usable remains (e.g. every retained checkpoint is corrupt — the
+        caller should start fresh rather than restore damage)."""
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            CheckpointCorruptError,
+            CheckpointStore,
+            manifest_path_for,
+        )
 
+        if not os.path.isdir(directory):
+            return None  # stay a pure probe: never mkdir as a side effect
+        store = CheckpointStore(directory)
+        has_manifests = any(
+            manifest_path_for(store.path_for(s)).exists()
+            for s in store.steps())
+        if has_manifests:
+            try:
+                latest = store.latest_verified()
+            except CheckpointCorruptError:
+                return None
+            if latest is not None:
+                return str(latest[1])
         marker = os.path.join(directory, "latest")
         if not os.path.exists(marker):
             return None

@@ -60,13 +60,16 @@ class EarlyStoppingDistributedTrainer(EarlyStoppingTrainer):
     restart-aware: a `FaultTolerantTrainer` checkpoints every
     `checkpoint_every` iterations and, on a worker-tier failure that
     escapes the master's own retry/degradation layer, restores the newest
-    checkpoint and resumes — up to `max_restarts` times (restart counts
-    land in the master's `TrainingStats` when it collects stats)."""
+    VERIFIED checkpoint (durable atomic saves + integrity manifests via
+    `util/checkpoint_store`) and resumes — up to `max_restarts` times
+    (restart counts land in the master's `TrainingStats` when it collects
+    stats). `checkpoint_save_hooks` passes chaos hooks
+    (`CheckpointCrashInjector`) down to the store's save protocol."""
 
     def __init__(self, config: EarlyStoppingConfiguration, net,
                  train_iterator, training_master,
                  checkpoint_dir=None, checkpoint_every: int = 100,
-                 max_restarts: int = 3):
+                 max_restarts: int = 3, checkpoint_save_hooks=()):
         from deeplearning4j_tpu.parallel.training_master import (
             DistributedMultiLayer,
         )
@@ -96,6 +99,7 @@ class EarlyStoppingDistributedTrainer(EarlyStoppingTrainer):
                 checkpoint_dir=checkpoint_dir,
                 checkpoint_every=checkpoint_every,
                 max_restarts=max_restarts,
+                save_hooks=checkpoint_save_hooks,
                 # iteration-condition aborts are control flow, not faults
                 propagate=(_IterationAbort,))
             fit_target = _RecoveringFit(self.fault_tolerant)

@@ -20,6 +20,10 @@ table stakes for long TPU runs (preemptible pods), so this build provides:
     exercising the master's straggler `worker_timeout`.
   * `ParameterServerStallInjector` — wraps a parameter-server store so
     push/pull block, exercising the client's timeout/backoff give-up.
+  * `CheckpointCrashInjector` — kills a checkpoint SAVE at a chosen
+    phase (mid-write, pre-publish, between payload and manifest),
+    exercising the durable store's atomic-commit + last-good-fallback
+    guarantees (`util/checkpoint_store.py`).
 """
 from __future__ import annotations
 
@@ -176,6 +180,64 @@ class ParameterServerStallInjector:
         return self._store.num_pushes
 
 
+class CheckpointCrashInjector:
+    """Save-hook for `util/checkpoint_store.CheckpointStore`: kill the
+    `fail_at_save`-th checkpoint save (1-based, at most `times` times) at
+    a chosen `phase` of the commit protocol —
+
+    - ``pre_write``: die before any byte is written,
+    - ``mid_write``: truncate the temp payload to half its size (a
+      partially flushed file) and die — the classic preemption-mid-save,
+    - ``pre_publish``: payload + manifest fully written and fsynced but
+      neither published,
+    - ``post_payload``: payload published, manifest not — the narrowest
+      crash window, leaving an unverifiable orphan the fallback loader
+      must skip.
+
+    In every case the store's atomic-commit contract says previously
+    published checkpoints stay verified and loadable; the chaos suite
+    proves save-crash → restart → resume-from-last-good end to end
+    through `FaultTolerantTrainer` (wire via
+    `FaultTolerantTrainer(..., save_hooks=[injector])`)."""
+
+    PHASES = ("pre_write", "mid_write", "pre_publish", "post_payload")
+
+    def __init__(self, phase: str = "mid_write", fail_at_save: int = 1,
+                 times: int = 1):
+        if phase not in self.PHASES:
+            raise ValueError(f"unknown save phase {phase!r}; choose from "
+                             f"{self.PHASES}")
+        self.phase = phase
+        self.fail_at_save = fail_at_save
+        self.remaining = times
+        self.fired = 0
+        self.saves = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, phase: str, step: int, path) -> None:
+        with self._lock:
+            if phase == "pre_write":
+                self.saves += 1
+            if (phase != self.phase or self.remaining <= 0
+                    or self.saves < self.fail_at_save):
+                return
+            self.remaining -= 1
+            self.fired += 1
+        if phase == "mid_write":
+            # leave a half-flushed temp file behind, like a real kill -9
+            # between write() and fsync()
+            import os
+
+            size = os.path.getsize(path)
+            with open(path, "rb+") as f:
+                f.truncate(size // 2)
+        logger.warning("CheckpointCrashInjector: injected crash during "
+                       "checkpoint save (step %d, phase %s)", step, phase)
+        raise InjectedFault(
+            f"injected crash during checkpoint save (step {step}, "
+            f"phase {phase})")
+
+
 # ---------------------------------------------------------------------------
 # restart-driving trainer
 
@@ -201,11 +263,20 @@ class FaultTolerantTrainer:
     On every restore, listeners implementing `on_restart(model, count)`
     are notified, and when the handle's TrainingMaster collects stats the
     restart is counted there as `restarts`.
+
+    Checkpoints commit durably (`util/checkpoint_store.CheckpointStore`:
+    atomic publish + integrity manifest), and a restore walks backwards
+    to the newest checkpoint that still VERIFIES — a crash during a save
+    (even one injected by `CheckpointCrashInjector` via `save_hooks`)
+    costs at most the batches since the previous checkpoint, never the
+    ability to restore. `CheckpointCorruptError` is raised only when no
+    retained checkpoint survives.
     """
 
     def __init__(self, net, iterator, checkpoint_dir,
                  checkpoint_every: int = 100, max_restarts: int = 3,
-                 keep_last: int = 2, propagate: tuple = ()):
+                 keep_last: int = 2, propagate: tuple = (),
+                 save_hooks=()):
         # `propagate`: exception types that are CONTROL FLOW, not failures
         # (e.g. early stopping's iteration-abort) — re-raised immediately
         # instead of triggering a checkpoint restore
@@ -218,21 +289,29 @@ class FaultTolerantTrainer:
         self.checkpoint_dir = str(checkpoint_dir)
         self.max_restarts = max_restarts
         self.restarts = 0
+        self._snapshot_known_good = False
         self._ckpt = CheckpointListener(self.checkpoint_dir,
                                         every_n_iterations=checkpoint_every,
-                                        keep_last=keep_last)
+                                        keep_last=keep_last,
+                                        save_hooks=save_hooks)
+        self.checkpoint_store = self._ckpt.store
 
     def _master_stats(self):
         master = getattr(self.net, "training_master", None)
         return master.get_training_stats() if master is not None else None
 
     def _restore(self) -> bool:
+        """Restore the newest checkpoint that passes manifest
+        verification AND loads, skipping corrupt/partial entries
+        backwards (last-good fallback). Raises `CheckpointCorruptError`
+        when checkpoints exist but none survive; returns False only when
+        the store is empty."""
         from deeplearning4j_tpu.util.serialization import restore_model
 
-        path = CheckpointListener.last_checkpoint(self.checkpoint_dir)
-        if path is None:
+        store = self.checkpoint_store
+        if not store.steps():
             return False
-        restored = restore_model(path)
+        restored, step = store.load_latest_verified(restore_model)
         net = self.target
         net.set_params(restored.params())
         net._upd_state = restored._upd_state
@@ -240,7 +319,8 @@ class FaultTolerantTrainer:
         net.iteration = restored.iteration
         net.epoch = restored.epoch
         net._it_device = None  # resync from the host clock on next fit
-        logger.warning("restored %s (iteration %d)", path, net.iteration)
+        logger.warning("restored %s (iteration %d)", store.path_for(step),
+                       net.iteration)
         return True
 
     def fit(self, epochs: int = 1, iterator=None) -> None:
@@ -251,10 +331,27 @@ class FaultTolerantTrainer:
         if self._ckpt not in listeners:
             net.set_listeners(*(listeners + [self._ckpt]))
         net._ensure_init()
-        if CheckpointListener.last_checkpoint(self.checkpoint_dir) is None:
-            # a fault BEFORE the first cadence checkpoint must still roll
-            # back (otherwise pre-fault batches get re-applied on retry)
-            self._ckpt._save(net, net.iteration)
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            CheckpointCorruptError,
+        )
+
+        # the "do we have a restorable checkpoint" probe re-hashes full
+        # payloads, and this fit() runs once per epoch under
+        # EarlyStoppingDistributedTrainer — once a good checkpoint is
+        # known to exist it stays monotonically true (our own saves only
+        # add more), so check at most once per trainer
+        if not self._snapshot_known_good:
+            try:
+                have_good = (self.checkpoint_store.latest_verified()
+                             is not None)
+            except CheckpointCorruptError:
+                have_good = False  # all retained damaged: snapshot now
+            if not have_good:
+                # a fault BEFORE the first cadence checkpoint must still
+                # roll back (otherwise pre-fault batches get re-applied
+                # on retry)
+                self._ckpt._save(net, net.iteration)
+            self._snapshot_known_good = True
         done = 0
         while done < epochs:
             try:

@@ -1,1 +1,3 @@
-"""Utilities: conv shape math, serialization, time-series helpers."""
+"""Utilities: conv shape math, serialization, durable checkpoint store
+(atomic commits + integrity manifests + last-good fallback,
+`checkpoint_store.py`), time-series helpers."""

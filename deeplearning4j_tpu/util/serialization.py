@@ -9,18 +9,35 @@ vector), `updaterState.bin` (:120-134, flat optimizer-state view),
 exactly — the key round-trip property called out in SURVEY §5
 (checkpoint/resume). Works for both MultiLayerNetwork and ComputationGraph
 (reference `restoreMultiLayerNetwork` / `restoreComputationGraph`).
+
+Durability: `write_model` commits through `util/checkpoint_store.atomic_write`
+(temp file + fsync + `os.replace`) — the reference's `ModelSerializer`
+truncates the destination in place, so a crash mid-save destroys the very
+artifact recovery needs; here a reader sees the old zip or the new one,
+never a partial. Restores translate zip-level damage (truncation, bad
+CRC, missing entries) into a typed `CheckpointCorruptError` so recovery
+code can skip to an older checkpoint instead of dying on a raw
+`BadZipFile`/`KeyError`.
 """
 from __future__ import annotations
 
+import contextlib
 import io
 import json
+import struct
 import zipfile
+import zlib
 from pathlib import Path
 from typing import Optional, Union
 
 import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.util.checkpoint_store import (
+    CheckpointCorruptError,
+    atomic_write,
+)
 
 CONFIG_JSON = "configuration.json"
 COEFFICIENTS = "coefficients.npy"
@@ -31,35 +48,69 @@ META_JSON = "meta.json"
 
 
 def write_model(net, path: Union[str, Path], save_updater: bool = True,
-                normalizer=None) -> None:
+                normalizer=None, atomic: bool = True) -> None:
     """Save a MultiLayerNetwork or ComputationGraph (reference
-    `ModelSerializer.writeModel`; `normalizer` → `normalizer.bin`:43)."""
+    `ModelSerializer.writeModel`; `normalizer` → `normalizer.bin`:43).
+
+    `atomic=False` writes the zip straight to `path` — ONLY for callers
+    that already own an atomic commit (e.g. a `CheckpointStore.save`
+    writer targeting the store's temp scratch), where a second
+    temp+fsync+replace pass would double the per-save fsync cost for no
+    added safety."""
     net._ensure_init()
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     model_type = type(net).__name__
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr(CONFIG_JSON, net.conf.to_json())
-        z.writestr(COEFFICIENTS, _np_bytes(net.params()))
-        if save_updater and net._upd_state is not None:
-            flat, _ = ravel_pytree(net._upd_state)
-            z.writestr(UPDATER_STATE, _np_bytes(np.asarray(flat)))
-        if net._layer_state is not None:
-            flat, _ = ravel_pytree(net._layer_state)
-            z.writestr(LAYER_STATE, _np_bytes(np.asarray(flat)))
-        if normalizer is not None:
-            z.writestr(NORMALIZER, normalizer.to_bytes())
-        z.writestr(META_JSON, json.dumps({
-            "iteration": net.iteration,
-            "epoch": net.epoch,
-            "dtype": str(np.dtype(net.dtype)),
-            "model_type": model_type,
-            "format": "deeplearning4j_tpu/model/v1",
-        }))
+    # atomic commit: build the zip at a temp name, fsync, then os.replace
+    # over the destination — a crash mid-save leaves the previous
+    # checkpoint intact instead of a truncated zip
+    with (atomic_write(path) if atomic
+          else contextlib.nullcontext(path)) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(CONFIG_JSON, net.conf.to_json())
+            z.writestr(COEFFICIENTS, _np_bytes(net.params()))
+            if save_updater and net._upd_state is not None:
+                flat, _ = ravel_pytree(net._upd_state)
+                z.writestr(UPDATER_STATE, _np_bytes(np.asarray(flat)))
+            if net._layer_state is not None:
+                flat, _ = ravel_pytree(net._layer_state)
+                z.writestr(LAYER_STATE, _np_bytes(np.asarray(flat)))
+            if normalizer is not None:
+                z.writestr(NORMALIZER, normalizer.to_bytes())
+            z.writestr(META_JSON, json.dumps({
+                "iteration": net.iteration,
+                "epoch": net.epoch,
+                "dtype": str(np.dtype(net.dtype)),
+                "model_type": model_type,
+                "format": "deeplearning4j_tpu/model/v1",
+            }))
+
+
+_ZIP_DAMAGE = (zipfile.BadZipFile, KeyError, EOFError, zlib.error,
+               struct.error)
+
+
+class _corrupt_as_typed:
+    """Translate zip-level damage (truncated file, bad CRC, missing
+    member) into `CheckpointCorruptError` — deliberate ValueErrors from
+    shape/type validation pass through untouched."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and isinstance(exc, _ZIP_DAMAGE):
+            raise CheckpointCorruptError(
+                f"checkpoint {self.path} is corrupt or truncated "
+                f"({type(exc).__name__}: {exc})") from exc
+        return False
 
 
 def _restore(path, load_updater: bool, expect_type: Optional[str]):
-    with zipfile.ZipFile(path, "r") as z:
+    with _corrupt_as_typed(path), zipfile.ZipFile(path, "r") as z:
         meta = json.loads(z.read(META_JSON).decode())
         model_type = meta.get("model_type", "MultiLayerNetwork")
         if expect_type is not None and model_type != expect_type:
@@ -130,7 +181,7 @@ def restore_normalizer(path: Union[str, Path]):
     `ModelSerializer.restoreNormalizerFromFile`); None if absent."""
     from deeplearning4j_tpu.datasets.normalizers import DataNormalization
 
-    with zipfile.ZipFile(path, "r") as z:
+    with _corrupt_as_typed(path), zipfile.ZipFile(path, "r") as z:
         if NORMALIZER not in z.namelist():
             return None
         return DataNormalization.from_bytes(z.read(NORMALIZER))

@@ -21,15 +21,32 @@ RESTORING wrapper, so a checkpoint written on one mesh layout loads onto
 another (or onto more/fewer chips) without an intermediate full-model
 host copy. Updater state (Adam moments etc.) and the iteration clock
 round-trip, so training resumes exactly (the reference's key checkpoint
-property, SURVEY §5)."""
+property, SURVEY §5).
+
+Durability: orbax already publishes the checkpoint directory atomically
+(write to a temp dir, rename on finalize); on top of that, `save` writes
+an integrity manifest sidecar (`<path>.manifest.json` — per-file
+size/SHA-256/CRC32 over the finalized tree, step, wall-clock, library
+version) and `restore` re-hashes against it, raising
+`CheckpointCorruptError` on any drift. Manifest-less directories (older
+builds, foreign orbax checkpoints) restore un-verified, with a warning."""
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Any, Dict
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.util.checkpoint_store import (
+    manifest_path_for,
+    verify_manifest,
+    write_manifest_for,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 @functools.lru_cache(maxsize=1)
@@ -53,17 +70,43 @@ def _state_tree(net) -> Dict[str, Any]:
 
 def save_sharded_checkpoint(path, net) -> None:
     """Write the network's training state shard-by-shard (async under the
-    hood; this call blocks until the checkpoint is durable)."""
+    hood; this call blocks until the checkpoint is durable), then publish
+    the integrity-manifest sidecar over the finalized tree."""
+    import contextlib
+
+    abspath = os.path.abspath(os.fspath(path))
+    # retire any OLD sidecar first: overwriting an existing checkpoint
+    # must never leave a stale manifest vouching for replaced bytes
+    with contextlib.suppress(OSError):
+        manifest_path_for(abspath).unlink()
     ckptr = _checkpointer()
-    ckptr.save(os.path.abspath(os.fspath(path)), _state_tree(net))
+    ckptr.save(abspath, _state_tree(net))
     ckptr.wait_until_finished()
+    # the manifest publishes only AFTER orbax finalizes the directory
+    # rename — a crash before this line leaves an unverifiable (and
+    # therefore untrusted) checkpoint, never a manifest vouching for a
+    # partial one
+    write_manifest_for(abspath, step=int(net.iteration))
 
 
-def restore_sharded_checkpoint(path, net, shardings=None) -> None:
+def restore_sharded_checkpoint(path, net, shardings=None,
+                               verify: bool = True) -> None:
     """Restore in place. `shardings`: optional pytree of NamedShardings
     matching (params, upd_state, layer_state) — pass the restoring
     wrapper's shardings to land shards directly on its mesh; omitted, the
-    current placement of `net`'s arrays is reused."""
+    current placement of `net`'s arrays is reused. With `verify=True`
+    (default) the tree is re-hashed against its manifest sidecar first,
+    raising `CheckpointCorruptError` on damage; manifest-less
+    checkpoints restore un-verified with a warning."""
+    abspath = os.path.abspath(os.fspath(path))
+    if verify:
+        if manifest_path_for(abspath).exists():
+            verify_manifest(abspath)
+        else:
+            logger.warning(
+                "sharded checkpoint %s has no integrity manifest "
+                "(pre-durability build or foreign orbax checkpoint); "
+                "restoring UNVERIFIED", abspath)
     def _abstract(a, sh=None):
         return jax.ShapeDtypeStruct(
             a.shape, a.dtype,
@@ -81,7 +124,7 @@ def restore_sharded_checkpoint(path, net, shardings=None) -> None:
             "epoch": jax.ShapeDtypeStruct((), np.int64),
         }
     ckptr = _checkpointer()
-    restored = ckptr.restore(os.path.abspath(os.fspath(path)), abstract)
+    restored = ckptr.restore(abspath, abstract)
     net._params = restored["params"]
     net._upd_state = restored["upd_state"]
     net._layer_state = restored["layer_state"]

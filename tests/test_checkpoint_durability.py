@@ -1,0 +1,690 @@
+"""Durable-checkpoint subsystem tests (`util/checkpoint_store.py`).
+
+Proves the ISSUE-2 durability contract at the store level: atomic commit
+(a failed save never damages the previous artifact), integrity manifests
+(bit-flip / truncation / missing-file detection), last-good fallback
+(corrupt newest entries are skipped backwards; `CheckpointCorruptError`
+only when none survive), keep-last GC that removes payload + sidecar
+together, verified retrying cloud transfer, and the
+`CheckpointCrashInjector` phases that the end-to-end chaos tests
+(`tests/test_fault_tolerance_distributed.py`) drive through
+`FaultTolerantTrainer`.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util.checkpoint_store import (
+    CheckpointCorruptError,
+    CheckpointStore,
+    atomic_write,
+    atomic_write_bytes,
+    build_manifest,
+    manifest_path_for,
+    retry_with_backoff,
+    verify_manifest,
+    write_manifest_for,
+)
+
+
+def _flip_byte(path, offset=-1):
+    data = bytearray(path.read_bytes())
+    data[offset] ^= 0xFF
+    path.write_bytes(bytes(data))
+
+
+# ------------------------------------------------------------ atomic commit
+
+
+def test_atomic_write_publishes_whole_file(tmp_path):
+    p = tmp_path / "artifact.bin"
+    atomic_write_bytes(p, b"v1")
+    assert p.read_bytes() == b"v1"
+    atomic_write_bytes(p, b"v2-longer")
+    assert p.read_bytes() == b"v2-longer"
+    # no temp scratch left behind
+    assert [f.name for f in tmp_path.iterdir()] == ["artifact.bin"]
+
+
+def test_atomic_write_failure_preserves_previous_artifact(tmp_path):
+    p = tmp_path / "artifact.bin"
+    atomic_write_bytes(p, b"the good version")
+    with pytest.raises(RuntimeError, match="died mid-write"):
+        with atomic_write(p) as tmp:
+            tmp.write_bytes(b"partial garb")  # partially written...
+            raise RuntimeError("died mid-write")
+    # destination untouched, scratch cleaned up
+    assert p.read_bytes() == b"the good version"
+    assert [f.name for f in tmp_path.iterdir()] == ["artifact.bin"]
+
+
+# ------------------------------------------------------ integrity manifests
+
+
+def test_manifest_round_trip_and_contents(tmp_path):
+    p = tmp_path / "ckpt.zip"
+    p.write_bytes(b"payload bytes")
+    write_manifest_for(p, step=17)
+    manifest = verify_manifest(p)  # no raise == verified
+    assert manifest["step"] == 17
+    assert manifest["files"]["ckpt.zip"]["size"] == len(b"payload bytes")
+    assert "wall_clock" in manifest and "library_version" in manifest
+
+
+@pytest.mark.parametrize("damage", ["bitflip", "truncate", "append",
+                                    "delete"])
+def test_manifest_detects_damage(tmp_path, damage):
+    p = tmp_path / "ckpt.zip"
+    p.write_bytes(bytes(range(256)) * 16)
+    write_manifest_for(p, step=1)
+    if damage == "bitflip":
+        _flip_byte(p, offset=100)
+    elif damage == "truncate":
+        p.write_bytes(p.read_bytes()[:100])
+    elif damage == "append":
+        p.write_bytes(p.read_bytes() + b"extra")
+    else:
+        p.unlink()
+    with pytest.raises(CheckpointCorruptError):
+        verify_manifest(p)
+
+
+def test_manifest_missing_is_typed_error(tmp_path):
+    p = tmp_path / "ckpt.zip"
+    p.write_bytes(b"data")
+    with pytest.raises(CheckpointCorruptError, match="no integrity manifest"):
+        verify_manifest(p)
+
+
+def test_directory_manifest_covers_tree(tmp_path):
+    d = tmp_path / "sharded"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.bin").write_bytes(b"aaa")
+    (d / "sub" / "b.bin").write_bytes(b"bbb")
+    write_manifest_for(d, step=3)
+    m = verify_manifest(d)
+    assert set(m["files"]) == {"a.bin", "sub/b.bin"}
+    _flip_byte(d / "sub" / "b.bin")
+    with pytest.raises(CheckpointCorruptError, match="b.bin"):
+        verify_manifest(d)
+
+
+# ------------------------------------------------- store commit + fallback
+
+
+def _store(tmp_path, **kw):
+    kw.setdefault("keep_last", 10)
+    return CheckpointStore(tmp_path, **kw)
+
+
+def _save_steps(store, steps):
+    for s in steps:
+        store.save_bytes(s, f"payload-{s}".encode())
+
+
+def test_store_save_publishes_payload_manifest_and_marker(tmp_path):
+    store = _store(tmp_path)
+    path = store.save_bytes(5, b"hello")
+    assert path.read_bytes() == b"hello"
+    assert manifest_path_for(path).exists()
+    assert (tmp_path / "latest").read_text() == "checkpoint_5.zip"
+    store.verify(5)
+    assert store.steps() == [5]
+
+
+def test_store_fallback_skips_corrupt_newest(tmp_path, caplog):
+    store = _store(tmp_path)
+    _save_steps(store, [1, 2, 3])
+    _flip_byte(store.path_for(3))  # newest is bit-rotted
+    result, step = store.load_latest_verified(lambda p: p.read_bytes())
+    assert (result, step) == (b"payload-2", 2)
+    assert any("skipping checkpoint step 3" in r.message
+               for r in caplog.records)
+
+
+def test_store_fallback_skips_manifestless_orphan(tmp_path):
+    """A payload without its manifest (crash between the two publishes)
+    is unverifiable and must be skipped, not trusted."""
+    store = _store(tmp_path)
+    _save_steps(store, [1, 2])
+    manifest_path_for(store.path_for(2)).unlink()
+    result, step = store.load_latest_verified(lambda p: p.read_bytes())
+    assert (result, step) == (b"payload-1", 1)
+
+
+def test_store_no_survivor_raises_typed_error(tmp_path):
+    store = _store(tmp_path)
+    _save_steps(store, [1, 2])
+    _flip_byte(store.path_for(1))
+    store.path_for(2).write_bytes(b"trunc")
+    with pytest.raises(CheckpointCorruptError, match="no loadable"):
+        store.load_latest_verified(lambda p: p.read_bytes())
+    # latest_verified raises the same way (vs None for an empty store)
+    with pytest.raises(CheckpointCorruptError):
+        store.latest_verified()
+    assert CheckpointStore(tmp_path / "empty").latest_verified() is None
+
+
+def test_store_empty_raises_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        _store(tmp_path).load_latest_verified(lambda p: p.read_bytes())
+
+
+def test_store_loader_rejection_falls_back(tmp_path):
+    """Damage the manifest can't see (the loader itself rejects) also
+    walks backwards."""
+    store = _store(tmp_path)
+    _save_steps(store, [1, 2])
+
+    def loader(p):
+        if p.name == "checkpoint_2.zip":
+            raise CheckpointCorruptError("deflate stream damaged")
+        return p.read_bytes()
+
+    result, step = store.load_latest_verified(loader)
+    assert (result, step) == (b"payload-1", 1)
+
+
+def test_store_gc_keeps_newest_and_removes_sidecars(tmp_path):
+    store = _store(tmp_path, keep_last=2)
+    _save_steps(store, [1, 2, 3, 4])
+    assert store.steps() == [3, 4]
+    names = {f.name for f in tmp_path.iterdir()}
+    assert names == {"checkpoint_3.zip", "checkpoint_3.zip.manifest.json",
+                     "checkpoint_4.zip", "checkpoint_4.zip.manifest.json",
+                     "latest"}
+
+
+def test_store_gc_collects_orphan_sidecar_and_scratch(tmp_path):
+    store = _store(tmp_path)
+    _save_steps(store, [1])
+    (tmp_path / "checkpoint_9.zip.manifest.json").write_text("{}")
+    (tmp_path / ".checkpoint_7.zip.tmp-123-456").write_bytes(b"scratch")
+    store.gc()
+    names = {f.name for f in tmp_path.iterdir()}
+    assert names == {"checkpoint_1.zip", "checkpoint_1.zip.manifest.json",
+                     "latest"}
+
+
+# ------------------------------------------------------- crash injection
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("phase", ["pre_write", "mid_write", "pre_publish",
+                                   "post_payload"])
+def test_crash_injector_never_damages_prior_checkpoint(tmp_path, phase):
+    """Kill the save at every phase of the commit protocol: the previous
+    checkpoint must stay verified and loadable, and the aborted save must
+    never publish a manifest vouching for bad bytes."""
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+        InjectedFault,
+    )
+
+    inj = CheckpointCrashInjector(phase=phase, fail_at_save=2)
+    store = CheckpointStore(tmp_path, keep_last=5, save_hooks=[inj])
+    store.save_bytes(1, b"the last good checkpoint")
+    with pytest.raises(InjectedFault):
+        store.save_bytes(2, b"never fully committed")
+    assert inj.fired == 1
+    result, step = store.load_latest_verified(lambda p: p.read_bytes())
+    assert (result, step) == (b"the last good checkpoint", 1)
+    # no temp scratch survives the crash
+    assert not [f for f in tmp_path.iterdir() if ".tmp-" in f.name]
+    if phase == "post_payload":
+        # the published orphan payload exists but is unverifiable
+        assert store.path_for(2).exists()
+        assert not manifest_path_for(store.path_for(2)).exists()
+    else:
+        assert not store.path_for(2).exists()
+
+
+@pytest.mark.chaos
+def test_crash_injector_mid_write_truncates_temp_only(tmp_path):
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+        InjectedFault,
+    )
+
+    inj = CheckpointCrashInjector(phase="mid_write", fail_at_save=1,
+                                  times=2)
+    store = CheckpointStore(tmp_path, save_hooks=[inj])
+    with pytest.raises(InjectedFault):
+        store.save_bytes(1, b"0123456789" * 10)
+    assert store.steps() == []  # nothing published at all
+    # once `times` is spent, saves succeed again (transient preemption)
+    with pytest.raises(InjectedFault):
+        store.save_bytes(1, b"0123456789" * 10)
+    store.save_bytes(1, b"0123456789" * 10)
+    store.verify(1)
+
+
+def test_crash_injector_rejects_unknown_phase():
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+    )
+
+    with pytest.raises(ValueError, match="unknown save phase"):
+        CheckpointCrashInjector(phase="mid_flight")
+
+
+# ------------------------------------------------ retry + verified transfer
+
+
+def test_retry_with_backoff_retries_transients_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    assert retry_with_backoff(flaky, backoff=0.001) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_with_backoff_exhaustion_reraises():
+    def dead():
+        raise ConnectionError("always down")
+
+    with pytest.raises(ConnectionError):
+        retry_with_backoff(dead, max_retries=2, backoff=0.001)
+
+
+def test_retry_with_backoff_bugs_raise_immediately():
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise KeyError("a bug, not a transient")
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(buggy, backoff=0.001)
+    assert len(calls) == 1
+
+
+class _FlakyStorage:
+    """LocalStorage wrapper that injects transport failures and in-flight
+    corruption for the verified-transfer tests."""
+
+    def __init__(self, root, fail_puts=0, fail_gets=0, corrupt_gets=0,
+                 corrupt_stored=0):
+        from deeplearning4j_tpu.cloud.storage import LocalStorage
+
+        self.inner = LocalStorage(root)
+        self.fail_puts = fail_puts
+        self.fail_gets = fail_gets
+        self.corrupt_gets = corrupt_gets
+        self.corrupt_stored = corrupt_stored
+
+    def put_bytes(self, key, data):
+        if self.fail_puts > 0:
+            self.fail_puts -= 1
+            raise ConnectionError("injected put failure")
+        if self.corrupt_stored > 0:
+            self.corrupt_stored -= 1
+            data = data[:-1] + bytes([data[-1] ^ 0xFF])
+        self.inner.put_bytes(key, data)
+
+    def get_bytes(self, key):
+        if self.fail_gets > 0:
+            self.fail_gets -= 1
+            raise ConnectionError("injected get failure")
+        data = self.inner.get_bytes(key)
+        if self.corrupt_gets > 0:
+            self.corrupt_gets -= 1
+            return data[:-1] + bytes([data[-1] ^ 0xFF])
+        return data
+
+    def list_keys(self, prefix=""):
+        return self.inner.list_keys(prefix)
+
+    def exists(self, key):
+        return self.inner.exists(key)
+
+
+def test_retrying_storage_survives_transient_failures(tmp_path):
+    from deeplearning4j_tpu.cloud.storage import RetryingStorage
+
+    flaky = _FlakyStorage(tmp_path / "bucket", fail_puts=1, fail_gets=1)
+    st = RetryingStorage(flaky, backoff=0.001)
+    st.put_bytes("k", b"v")
+    assert st.get_bytes("k") == b"v"
+    assert st.retries >= 2
+
+
+def test_retrying_storage_detects_and_retries_upload_corruption(tmp_path):
+    from deeplearning4j_tpu.cloud.storage import RetryingStorage
+
+    flaky = _FlakyStorage(tmp_path / "bucket", corrupt_stored=1)
+    st = RetryingStorage(flaky, backoff=0.001)
+    st.put_bytes("k", b"important bytes")  # first attempt stores garbage
+    assert st.get_bytes("k") == b"important bytes"
+    assert st.retries == 1
+
+
+def test_retrying_storage_upload_corruption_exhaustion_is_typed(tmp_path):
+    from deeplearning4j_tpu.cloud.storage import RetryingStorage
+
+    flaky = _FlakyStorage(tmp_path / "bucket", corrupt_stored=99)
+    st = RetryingStorage(flaky, max_retries=2, backoff=0.001)
+    with pytest.raises(CheckpointCorruptError, match="corrupted in transit"):
+        st.put_bytes("k", b"important bytes")
+
+
+def test_retrying_storage_download_digest_check(tmp_path):
+    import hashlib
+
+    from deeplearning4j_tpu.cloud.storage import RetryingStorage
+
+    flaky = _FlakyStorage(tmp_path / "bucket", corrupt_gets=1)
+    st = RetryingStorage(flaky, backoff=0.001)
+    st.put_bytes("k", b"payload")
+    want = hashlib.sha256(b"payload").hexdigest()
+    # corrupt first download is retried until the digest matches
+    flaky.corrupt_gets = 1
+    assert st.get_bytes("k", expected_sha256=want) == b"payload"
+
+
+def test_store_upload_download_round_trip_verified(tmp_path):
+    store = _store(tmp_path / "local")
+    _save_steps(store, [1, 2])
+    flaky = _FlakyStorage(tmp_path / "bucket", fail_puts=1, corrupt_gets=1)
+    key = store.upload(flaky, "ckpts", backoff=0.001)
+    assert key == "ckpts/checkpoint_2.zip"
+
+    fresh = CheckpointStore(tmp_path / "restored")
+    path = fresh.download(flaky, "ckpts", backoff=0.001)
+    assert path.read_bytes() == b"payload-2"
+    fresh.verify(2)  # manifest traveled and re-verifies locally
+
+
+def test_store_upload_skips_corrupt_newest(tmp_path):
+    store = _store(tmp_path / "local")
+    _save_steps(store, [1, 2])
+    _flip_byte(store.path_for(2))
+    flaky = _FlakyStorage(tmp_path / "bucket")
+    key = store.upload(flaky, "ckpts", backoff=0.001)
+    assert key == "ckpts/checkpoint_1.zip"  # last-good, not last-written
+
+
+def test_store_download_missing_prefix_raises(tmp_path):
+    flaky = _FlakyStorage(tmp_path / "bucket")
+    with pytest.raises(FileNotFoundError):
+        CheckpointStore(tmp_path / "restored").download(flaky, "nothing")
+
+
+# -------------------------------------------------- manifest JSON hygiene
+
+
+def test_manifest_is_valid_json_with_expected_schema(tmp_path):
+    store = _store(tmp_path)
+    store.save_bytes(7, b"x")
+    m = json.loads(manifest_path_for(store.path_for(7)).read_bytes())
+    assert m["format"].startswith("deeplearning4j_tpu/checkpoint-manifest/")
+    assert m["step"] == 7
+    entry = m["files"]["checkpoint_7.zip"]
+    assert set(entry) == {"size", "sha256", "crc32"}
+    assert build_manifest(store.path_for(7))["files"][
+        "checkpoint_7.zip"]["sha256"] == entry["sha256"]
+
+
+# ----------------------------------------- streaming pipeline durability
+
+
+def _stream_net(seed=3):
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_in=8, n_out=2,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _stream_batches(n, seed=0):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    rng = np.random.RandomState(seed)
+    return [DataSet(rng.randn(8, 4).astype(np.float32),
+                    np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)])
+            for _ in range(n)]
+
+
+def _run_stream(pipeline, batches):
+    from deeplearning4j_tpu.streaming.pipeline import QueueSource
+
+    for ds in batches:
+        pipeline.source.put(ds)
+    pipeline.source.close()
+    pipeline.run()
+
+
+def test_streaming_pipeline_checkpoints_and_resumes(tmp_path):
+    from deeplearning4j_tpu.streaming.pipeline import (
+        QueueSource,
+        StreamingTrainPipeline,
+    )
+
+    net = _stream_net()
+    pipe = StreamingTrainPipeline(net, QueueSource(),
+                                  checkpoint_dir=tmp_path,
+                                  checkpoint_every=2)
+    _run_stream(pipe, _stream_batches(5))
+    assert pipe.batches_seen == 5
+    store = pipe.checkpoint_store
+    # cadence saves at batches 2 and 4 plus the final commit at 5
+    assert store.steps()[-1] == 5
+    store.verify(5)
+
+    # a "restarted consumer" resumes from the last durable commit
+    net2 = _stream_net(seed=99)
+    pipe2 = StreamingTrainPipeline(net2, QueueSource(),
+                                   checkpoint_dir=tmp_path)
+    assert pipe2.resumed_from_step == 5
+    assert net2.iteration == 5
+    np.testing.assert_allclose(net2.params(), net.params(), rtol=1e-6)
+    # and keeps training from there
+    _run_stream(pipe2, _stream_batches(2, seed=1))
+    assert net2.iteration == 7
+
+
+def test_streaming_pipeline_resume_skips_corrupt_newest(tmp_path):
+    from deeplearning4j_tpu.streaming.pipeline import (
+        QueueSource,
+        StreamingTrainPipeline,
+    )
+
+    net = _stream_net()
+    pipe = StreamingTrainPipeline(net, QueueSource(),
+                                  checkpoint_dir=tmp_path,
+                                  checkpoint_every=2, keep_last=5)
+    _run_stream(pipe, _stream_batches(5))
+    steps = pipe.checkpoint_store.steps()
+    _flip_byte(pipe.checkpoint_store.path_for(steps[-1]))
+
+    net2 = _stream_net(seed=99)
+    pipe2 = StreamingTrainPipeline(net2, QueueSource(),
+                                   checkpoint_dir=tmp_path)
+    assert pipe2.resumed_from_step == steps[-2]
+    assert net2.iteration == steps[-2]
+
+
+def test_streaming_pipeline_without_checkpointing_unchanged(tmp_path):
+    from deeplearning4j_tpu.streaming.pipeline import (
+        QueueSource,
+        StreamingTrainPipeline,
+    )
+
+    net = _stream_net()
+    pipe = StreamingTrainPipeline(net, QueueSource())
+    _run_stream(pipe, _stream_batches(3))
+    assert pipe.batches_seen == 3
+    assert pipe.checkpoint_store is None
+
+
+# -------------------------------------------- sharded (orbax) durability
+
+
+def test_sharded_checkpoint_manifest_detects_tampering(tmp_path):
+    from deeplearning4j_tpu.util.sharded_checkpoint import (
+        restore_sharded_checkpoint,
+        save_sharded_checkpoint,
+    )
+
+    net = _stream_net()
+    net.fit(_stream_batches(1)[0])
+    ckpt = tmp_path / "ckpt"
+    save_sharded_checkpoint(ckpt, net)
+    assert manifest_path_for(ckpt).exists()
+    # clean restore verifies and round-trips the clock
+    net2 = _stream_net(seed=99)
+    restore_sharded_checkpoint(ckpt, net2)
+    assert net2.iteration == net.iteration
+    np.testing.assert_array_equal(np.asarray(net2.params()),
+                                  np.asarray(net.params()))
+    # flip one byte of the biggest payload file: restore must refuse
+    files = [f for f in ckpt.rglob("*") if f.is_file()]
+    target = max(files, key=lambda f: f.stat().st_size)
+    _flip_byte(target)
+    with pytest.raises(CheckpointCorruptError):
+        restore_sharded_checkpoint(ckpt, _stream_net(seed=7))
+
+
+def test_sharded_checkpoint_manifestless_restores_with_warning(
+        tmp_path, caplog):
+    import logging
+
+    from deeplearning4j_tpu.util.sharded_checkpoint import (
+        restore_sharded_checkpoint,
+        save_sharded_checkpoint,
+    )
+
+    net = _stream_net()
+    ckpt = tmp_path / "ckpt"
+    save_sharded_checkpoint(ckpt, net)
+    manifest_path_for(ckpt).unlink()  # pre-durability-build checkpoint
+    net2 = _stream_net(seed=99)
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        restore_sharded_checkpoint(ckpt, net2)
+    assert any("UNVERIFIED" in r.message for r in caplog.records)
+    np.testing.assert_array_equal(np.asarray(net2.params()),
+                                  np.asarray(net.params()))
+
+
+# --------------------------------------------- review regression coverage
+
+
+def test_gc_orphan_payload_does_not_evict_verified_checkpoints(tmp_path):
+    """An unverifiable orphan (crashed save: payload, no manifest) must
+    not count toward keep_last retention — evicting a restorable
+    checkpoint to keep an unrestorable one would shrink the real
+    fallback window."""
+    store = CheckpointStore(tmp_path, keep_last=2)
+    _save_steps(store, [2, 4])
+    # crashed save at step 6: payload published, manifest never was
+    store.path_for(6).write_bytes(b"orphan")
+    _save_steps(store, [8])  # triggers gc
+    # both verifiable retained entries survive; the orphan didn't evict 4
+    assert store.verify(4) and store.verify(8)
+    result, step = store.load_latest_verified(lambda p: p.read_bytes())
+    assert step == 8
+    _flip_byte(store.path_for(8))
+    result, step = store.load_latest_verified(lambda p: p.read_bytes())
+    assert step == 4  # the second-newest GOOD one was still there
+
+
+def test_crashed_save_does_not_consume_iteration_slot(tmp_path):
+    """CheckpointListener must retry a checkpoint whose save crashed when
+    the rolled-back run re-reaches that iteration (a crashed save marked
+    'already saved' would double the worst-case rollback window)."""
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+        InjectedFault,
+    )
+
+    net = _stream_net()
+    net.fit(_stream_batches(2)[0])
+    inj = CheckpointCrashInjector(phase="mid_write", fail_at_save=1)
+    listener = CheckpointListener(str(tmp_path), every_n_iterations=1,
+                                  save_hooks=[inj])
+    with pytest.raises(InjectedFault):
+        listener.iteration_done(net, 1)
+    assert listener.store.steps() == []
+    # the re-run reaches iteration 1 again: the save must happen now
+    listener.iteration_done(net, 1)
+    assert listener.store.steps() == [1]
+    listener.store.verify(1)
+
+
+def test_saver_overwrite_crash_leaves_no_stale_manifest(tmp_path,
+                                                        monkeypatch):
+    """A best-model overwrite that dies between payload and manifest
+    publish must leave a loadable manifest-less file — never a stale
+    sidecar vouching for the replaced bytes (which would brick an intact
+    checkpoint on verify)."""
+    from deeplearning4j_tpu.earlystopping.saver import LocalFileModelSaver
+    from deeplearning4j_tpu.util import checkpoint_store as cs
+
+    saver = LocalFileModelSaver(tmp_path)
+    net = _stream_net()
+    net.fit(_stream_batches(1)[0])
+    saver.save_best_model(net, 0.5)
+
+    net.fit(_stream_batches(1, seed=5)[0])  # state drifts before re-save
+    monkeypatch.setattr(cs, "write_manifest_for",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("killed before manifest")))
+    with pytest.raises(RuntimeError, match="killed before manifest"):
+        saver.save_best_model(net, 0.4)
+    monkeypatch.undo()
+    # no sidecar: the new payload loads (unverified) instead of tripping
+    # a digest mismatch against the old manifest
+    assert not manifest_path_for(saver.best_path).exists()
+    best = saver.get_best_model()
+    np.testing.assert_allclose(np.asarray(best.params()),
+                               np.asarray(net.params()), rtol=1e-6)
+
+
+def test_retry_does_not_retry_missing_files(tmp_path):
+    """FileNotFoundError subclasses OSError but is not transient: it must
+    raise immediately, not burn the backoff schedule."""
+    from deeplearning4j_tpu.cloud.storage import LocalStorage, RetryingStorage
+
+    calls = []
+
+    def probe():
+        calls.append(1)
+        raise FileNotFoundError("no such key")
+
+    with pytest.raises(FileNotFoundError):
+        retry_with_backoff(probe, backoff=0.001)
+    assert len(calls) == 1
+
+    st = RetryingStorage(LocalStorage(tmp_path / "bucket"), backoff=0.001)
+    with pytest.raises(FileNotFoundError):
+        st.get_bytes("absent-key")
+    assert st.attempts == 1 and st.retries == 0
+
+
+def test_last_checkpoint_probe_has_no_side_effects(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+
+    missing = tmp_path / "never" / "created"
+    assert CheckpointListener.last_checkpoint(str(missing)) is None
+    assert not missing.exists()

@@ -226,3 +226,49 @@ def restore_normalizer_missing(tmp_path):
     p = tmp_path / "model_nonorm.zip"
     write_model(net, p)
     return restore_normalizer(p)
+
+
+# --------------------------------------------- saver durability (ISSUE 2)
+
+
+def test_local_file_saver_truncated_file_raises_typed_error(tmp_path):
+    """A truncated bestModel.bin/latestModel.bin surfaces as the typed
+    CheckpointCorruptError (not a raw BadZipFile/unpickling crash), so
+    resume logic can fall back deliberately."""
+    from deeplearning4j_tpu.util.checkpoint_store import (
+        CheckpointCorruptError,
+    )
+
+    saver = LocalFileModelSaver(tmp_path)
+    net = small_net()
+    net.fit(blobs_iterator())
+    saver.save_best_model(net, 0.5)
+    saver.save_latest_model(net, 0.5)
+    latest = tmp_path / "latestModel.bin"
+    latest.write_bytes(latest.read_bytes()[: latest.stat().st_size // 2])
+    with pytest.raises(CheckpointCorruptError):
+        saver.get_latest_model()
+    # the untouched best model still verifies and loads
+    best = saver.get_best_model()
+    np.testing.assert_allclose(best.params(), net.params(), rtol=1e-6)
+
+
+def test_local_file_saver_writes_manifest_sidecars(tmp_path):
+    saver = LocalFileModelSaver(tmp_path)
+    net = small_net()
+    net.fit(blobs_iterator())
+    saver.save_best_model(net, 0.5)
+    assert (tmp_path / "bestModel.bin.manifest.json").exists()
+    # overwrite commits atomically: sidecar matches the new bytes
+    net.fit(blobs_iterator())
+    saver.save_best_model(net, 0.4)
+    from deeplearning4j_tpu.util.checkpoint_store import verify_manifest
+
+    verify_manifest(tmp_path / "bestModel.bin")
+    assert saver.get_best_model() is not None
+
+
+def test_local_file_saver_missing_files_still_return_none(tmp_path):
+    saver = LocalFileModelSaver(tmp_path)
+    assert saver.get_best_model() is None
+    assert saver.get_latest_model() is None

@@ -503,3 +503,182 @@ def test_early_stopping_distributed_net_mismatch_raises():
     # the handle's own net (or None) is accepted
     EarlyStoppingDistributedTrainer(cfg, net1, it, handle)
     EarlyStoppingDistributedTrainer(cfg, None, it, handle)
+
+
+# -------------------------------------- checkpoint durability chaos (ISSUE 2)
+
+
+def test_save_crash_leaves_prior_checkpoint_loadable_and_resumes(
+        tmp_path, caplog):
+    """THE ISSUE-2 acceptance drill: a crash injected DURING a checkpoint
+    save (mid-write, temp payload truncated like a real preemption) must
+    leave the prior checkpoint verified-loadable, and FaultTolerantTrainer
+    must restore from that last-good entry — with the rolled-back
+    iteration clock — then resume and finish the run."""
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+    )
+
+    resumed_at = []
+
+    class ResumeClock:
+        def iteration_done(self, model, iteration):
+            pass
+
+        def on_restart(self, model, restart_count):
+            resumed_at.append((model.iteration, model.epoch))
+
+    net = _net()
+    net.set_listeners(ResumeClock())
+    # save #1 is the initial snapshot (iteration 0); the cadence save at
+    # iteration 2 is save #2 and dies mid-write
+    inj = CheckpointCrashInjector(phase="mid_write", fail_at_save=2)
+    trainer = FaultTolerantTrainer(net, ListDataSetIterator(_batches(4)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=2, max_restarts=2,
+                                   save_hooks=[inj])
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        trainer.fit(epochs=2)
+
+    assert inj.fired == 1
+    assert trainer.restarts == 1
+    # restored from the initial snapshot: clock rolled back to step 0
+    assert resumed_at == [(0, 0)]
+    # both epochs completed after the restart: 2 epochs x 4 batches
+    assert net.iteration == 8
+    assert net.epoch == 2
+    # the store ends healthy: newest checkpoint verifies and loads
+    step, path = trainer.checkpoint_store.latest_verified()
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    assert restore_model(path).iteration == step
+    assert any("CheckpointCrashInjector: injected crash" in r.message
+               for r in caplog.records)
+    assert any("restored" in r.message for r in caplog.records)
+
+
+def test_save_crash_mid_run_falls_back_to_newest_good(tmp_path):
+    """A save crash AFTER several good cadence saves resumes from the
+    newest good one (not the initial snapshot): lost work is bounded by
+    the checkpoint interval."""
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+    )
+
+    resumed_at = []
+
+    class ResumeClock:
+        def iteration_done(self, model, iteration):
+            pass
+
+        def on_restart(self, model, restart_count):
+            resumed_at.append(model.iteration)
+
+    net = _net()
+    net.set_listeners(ResumeClock())
+    # saves: #1 initial snapshot (it 0), #2 cadence at it 2, #3 cadence at
+    # it 4 — which dies between payload and manifest publish (narrowest
+    # crash window: an unverifiable orphan payload)
+    inj = CheckpointCrashInjector(phase="post_payload", fail_at_save=3)
+    trainer = FaultTolerantTrainer(net, ListDataSetIterator(_batches(6)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=2, max_restarts=2,
+                                   save_hooks=[inj])
+    trainer.fit(epochs=1)
+    assert trainer.restarts == 1
+    assert resumed_at == [2]  # newest VERIFIED good, not iteration 0
+    # the epoch re-runs from its start on top of the restored clock
+    # (at-least-once semantics): 2 + 6 batches
+    assert net.iteration == 8
+
+
+def test_all_checkpoints_corrupt_raises_typed_error(tmp_path):
+    """When a fault hits and NO retained checkpoint survives verification,
+    recovery must fail with the typed CheckpointCorruptError — not restore
+    garbage, not loop forever."""
+    from deeplearning4j_tpu.util.checkpoint_store import (
+        CheckpointCorruptError,
+    )
+
+    net = _net()
+    fault = FaultInjectionListener(fail_at_iteration=3)
+    net.set_listeners(fault)
+    trainer = FaultTolerantTrainer(net, ListDataSetIterator(_batches(4)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=2, max_restarts=2)
+
+    # corrupt every checkpoint the instant it publishes
+    real_save = trainer.checkpoint_store.save
+
+    def save_then_rot(step, writer):
+        path = real_save(step, writer)
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+        return path
+
+    trainer.checkpoint_store.save = save_then_rot
+    with pytest.raises(CheckpointCorruptError, match="no loadable"):
+        trainer.fit(epochs=1)
+
+
+def test_distributed_fit_survives_save_crash(tmp_path, caplog):
+    """Crash-during-save composes with the worker-pool averaging tier:
+    DistributedMultiLayer training restarts from last-good and completes,
+    with the restart counted in TrainingStats."""
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+    )
+
+    net = _net()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=2, collect_training_stats=True)
+    handle = DistributedMultiLayer(net, master)
+    inj = CheckpointCrashInjector(phase="mid_write", fail_at_save=2)
+    trainer = FaultTolerantTrainer(handle, ListDataSetIterator(_batches(8)),
+                                   checkpoint_dir=tmp_path,
+                                   checkpoint_every=2, max_restarts=2,
+                                   save_hooks=[inj])
+    with caplog.at_level(logging.WARNING, logger=LOGGER):
+        trainer.fit(epochs=2)
+    assert inj.fired == 1
+    assert trainer.restarts == 1
+    assert master.get_training_stats().get_count("restarts") == 1
+    assert np.isfinite(net.score_value)
+    trainer.checkpoint_store.latest_verified()  # store ends healthy
+
+
+def test_early_stopping_distributed_survives_save_crash(tmp_path):
+    """EarlyStoppingDistributedTrainer(checkpoint_save_hooks=...) rides
+    the same durability floor: an injected save crash mid-epoch costs one
+    restart, not the run."""
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        InMemoryModelSaver,
+        MaxEpochsTerminationCondition,
+        TerminationReason,
+    )
+    from deeplearning4j_tpu.parallel.early_stopping import (
+        EarlyStoppingDistributedTrainer,
+    )
+    from deeplearning4j_tpu.parallel.fault_tolerance import (
+        CheckpointCrashInjector,
+    )
+
+    net = _net()
+    master = ParameterAveragingTrainingMaster(num_workers=2,
+                                              averaging_frequency=2)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    inj = CheckpointCrashInjector(phase="mid_write", fail_at_save=2)
+    trainer = EarlyStoppingDistributedTrainer(
+        cfg, net, ListDataSetIterator(_batches(8, seed=8)), master,
+        checkpoint_dir=tmp_path, checkpoint_every=2, max_restarts=2,
+        checkpoint_save_hooks=[inj])
+    result = trainer.fit()
+    assert inj.fired == 1
+    assert trainer.fault_tolerant.restarts == 1
+    assert result.termination_reason == \
+        TerminationReason.EPOCH_TERMINATION_CONDITION

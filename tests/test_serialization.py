@@ -113,3 +113,83 @@ def test_computation_graph_round_trip(tmp_path):
     net.fit(DataSet(X, labels), epochs=2)
     net2.fit(DataSet(X, labels), epochs=2)
     np.testing.assert_allclose(net.params(), net2.params(), rtol=1e-5, atol=1e-7)
+
+
+# ----------------------------------------------------- durability (ISSUE 2)
+
+
+def test_write_model_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A save that dies mid-serialization must leave the previous
+    checkpoint byte-identical (temp + os.replace, never truncate-in-place
+    like the reference ModelSerializer) and no scratch files behind."""
+    net, ds = _make_net_and_data()
+    net.fit(ListDataSetIterator([ds]))
+    p = tmp_path / "model.zip"
+    write_model(net, p)
+    good = p.read_bytes()
+
+    net.fit(ListDataSetIterator([ds]))  # state drifts: a rewrite would differ
+    monkeypatch.setattr(type(net.conf), "to_json",
+                        lambda self: (_ for _ in ()).throw(
+                            RuntimeError("killed mid-save")))
+    import pytest
+
+    with pytest.raises(RuntimeError, match="killed mid-save"):
+        write_model(net, p)
+    assert p.read_bytes() == good
+    assert [f.name for f in tmp_path.iterdir()] == ["model.zip"]
+
+
+def test_restore_truncated_checkpoint_raises_typed_error(tmp_path):
+    import pytest
+
+    from deeplearning4j_tpu.util.checkpoint_store import (
+        CheckpointCorruptError,
+    )
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    net, ds = _make_net_and_data()
+    net.fit(ListDataSetIterator([ds]))
+    p = tmp_path / "model.zip"
+    write_model(net, p)
+    p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])
+    with pytest.raises(CheckpointCorruptError, match="corrupt or truncated"):
+        restore_model(p)
+
+
+def test_restore_bitflipped_checkpoint_raises_typed_error(tmp_path):
+    """A flipped byte inside the deflate stream trips the zip CRC — and
+    must surface as the typed error, not a raw BadZipFile."""
+    import pytest
+
+    from deeplearning4j_tpu.util.checkpoint_store import (
+        CheckpointCorruptError,
+    )
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    net, ds = _make_net_and_data()
+    net.fit(ListDataSetIterator([ds]))
+    p = tmp_path / "model.zip"
+    write_model(net, p)
+    data = bytearray(p.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    p.write_bytes(bytes(data))
+    with pytest.raises(CheckpointCorruptError):
+        restore_model(p)
+
+
+def test_restore_zip_missing_members_raises_typed_error(tmp_path):
+    import zipfile
+
+    import pytest
+
+    from deeplearning4j_tpu.util.checkpoint_store import (
+        CheckpointCorruptError,
+    )
+    from deeplearning4j_tpu.util.serialization import restore_model
+
+    p = tmp_path / "hollow.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("unrelated.txt", "not a checkpoint")
+    with pytest.raises(CheckpointCorruptError):
+        restore_model(p)
