@@ -527,6 +527,82 @@ def bench_checkpoint():
             1.0 / total, None, spread)
 
 
+def bench_sentinel():
+    """Per-step overhead of the training health sentinel
+    (`optimize/health.HealthSentinel`): the fused guard adds one global
+    grad-norm reduction + a where-select commit to the compiled step, and
+    the host check forces ONE small (3,)-vector device→host sync per step
+    — which un-pipelines the async dispatch queue, so the sync, not the
+    reduction, is the real tax. Metric: guarded steps/sec (higher
+    better); `sentinel_overhead_pct` records the unguarded-vs-guarded
+    gap so BENCH_*.json tracks the guard's price across rounds."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        DeviceCacheDataSetIterator,
+    )
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.health import HealthSentinel
+
+    def make_net():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(0).learning_rate(0.01).updater(Updater.ADAM)
+                .list()
+                .layer(DenseLayer(n_out=1024, activation=Activation.RELU))
+                .layer(DenseLayer(n_out=512, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(512))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    rng = np.random.default_rng(0)
+    n_steps, B = 32, 128
+    batches = [DataSet(rng.standard_normal((B, 512)).astype(np.float32),
+                       np.eye(10, dtype=np.float32)[
+                           rng.integers(0, 10, B)])
+               for _ in range(n_steps)]
+    it = DeviceCacheDataSetIterator(batches)
+
+    def time_epochs(net):
+        net.fit(it)   # compile
+        net.fit(it)   # resolve buffer handles (remote transport)
+        _sync(net)
+        dts = []
+        for _ in range(_REPEATS):
+            t0 = time.perf_counter()
+            net.fit(it)
+            _sync(net)
+            dts.append(time.perf_counter() - t0)
+        return dts
+
+    base_dt, _ = _median_spread(time_epochs(make_net()))
+
+    guarded = make_net()
+    # escalation disarmed (huge budgets): this measures the guard's cost
+    # on a HEALTHY run, the steady-state every real run pays
+    sentinel = HealthSentinel(skip_budget=10**9, warmup_steps=10**9)
+    guarded.set_health_sentinel(sentinel)
+    guard_dts = time_epochs(guarded)
+    guard_dt, spread = _median_spread(guard_dts)
+    assert sentinel.steps >= (2 + _REPEATS) * n_steps
+    assert sentinel.skips == 0, "healthy bench run must skip nothing"
+    bench_sentinel.sentinel_overhead_pct = round(
+        (guard_dt / base_dt - 1.0) * 100.0, 1)
+    return ("sentinel_guarded_train_steps_per_sec", n_steps / guard_dt,
+            None, spread)
+
+
 def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
     """Synthetic Zipf corpus as pre-tokenized sentences."""
     rng = np.random.default_rng(seed)
@@ -676,7 +752,8 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "word2vec": bench_word2vec,
             "word2vec_50k": bench_word2vec_50k,
             "generate": bench_generate,
-            "checkpoint": bench_checkpoint}
+            "checkpoint": bench_checkpoint,
+            "sentinel": bench_sentinel}
 
 
 def _unit(metric: str) -> str:
@@ -684,6 +761,8 @@ def _unit(metric: str) -> str:
         return "roundtrips/sec"
     if "words" in metric:
         return "words/sec/chip"
+    if "steps" in metric:
+        return "steps/sec/chip"
     return "tokens/sec/chip" if "tokens" in metric else "samples/sec/chip"
 
 
@@ -731,6 +810,9 @@ def main() -> None:
         extra = getattr(_CONFIGS[name], "latency_ms", None)
         if extra is not None:
             entries[name]["latency_ms"] = extra
+        extra = getattr(_CONFIGS[name], "sentinel_overhead_pct", None)
+        if extra is not None:
+            entries[name]["sentinel_overhead_pct"] = extra
     if on_chip:
         baseline_file.write_text(json.dumps(baselines))
 

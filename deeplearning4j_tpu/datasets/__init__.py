@@ -10,4 +10,5 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     ExistingDataSetIterator,
     ListDataSetIterator,
     MultipleEpochsIterator,
+    QuarantiningDataSetIterator,
 )

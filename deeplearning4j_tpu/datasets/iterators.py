@@ -480,3 +480,75 @@ class DeviceCacheDataSetIterator(DataSetIterator):
     @property
     def async_supported(self):
         return False  # already resident: a prefetch thread adds nothing
+
+
+class QuarantiningDataSetIterator(DataSetIterator):
+    """Screens every batch of an underlying iterator for non-finite
+    features/labels/masks (`optimize.health.non_finite_batch_reason`) and
+    diverts poisoned batches to a `optimize.health.BatchQuarantine` —
+    with provenance — instead of letting them reach the fit loop. The
+    data-iterator tier of the training health sentinel: any fit loop
+    (single-node, FaultTolerantTrainer, worker pools) gets poison
+    screening by wrapping its iterator, no network changes needed.
+
+        it = QuarantiningDataSetIterator(base_iterator, "quarantine/")
+        net.fit(it, epochs=3)
+        it.quarantined  # records diverted so far (across epochs)
+
+    Lookahead note: `has_next` must not claim a batch it would then
+    quarantine, so the wrapper pre-pulls until it holds a CLEAN batch or
+    the underlying iterator is exhausted."""
+
+    def __init__(self, underlying, quarantine, max_quarantined: int = 256):
+        from deeplearning4j_tpu.optimize.health import BatchQuarantine
+
+        self._u = underlying
+        self.quarantine = (quarantine if isinstance(quarantine,
+                                                    BatchQuarantine)
+                           else BatchQuarantine(
+                               quarantine, max_records=max_quarantined))
+        self.quarantined = 0
+        self._pos = 0  # position in the CURRENT pass (provenance)
+        self._pending: Optional[DataSet] = None
+
+    def _advance(self) -> None:
+        from deeplearning4j_tpu.optimize.health import (
+            non_finite_batch_reason,
+        )
+
+        while self._pending is None and self._u.has_next():
+            ds = self._u.next()
+            pos = self._pos
+            self._pos += 1
+            reason = non_finite_batch_reason(ds)
+            if reason is None:
+                self._pending = ds
+                return
+            self.quarantine.quarantine(
+                ds, reason, {"stream_position": pos,
+                             "stage": "iterator"})
+            self.quarantined += 1
+
+    def has_next(self) -> bool:
+        self._advance()
+        return self._pending is not None
+
+    def next(self) -> DataSet:
+        self._advance()
+        if self._pending is None:
+            raise StopIteration
+        ds, self._pending = self._pending, None
+        return ds
+
+    def reset(self) -> None:
+        self._pending = None
+        self._pos = 0
+        self._u.reset()
+
+    def batch(self) -> int:
+        return self._u.batch()
+
+    @property
+    def async_supported(self) -> bool:
+        # the screen runs host-side per batch; keep ordering deterministic
+        return False

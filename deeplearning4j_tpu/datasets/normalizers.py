@@ -156,11 +156,33 @@ class NormalizerStandardize(DataNormalization):
         if n == 0:
             raise ValueError("NormalizerStandardize.fit: no data")
         self.mean = (s / n).astype(np.float32)
-        self.std = np.sqrt(np.maximum(ss / n - (s / n) ** 2, 1e-12)).astype(np.float32)
+        self.std = self._guarded_std(ss, s, n, "feature")
         if self.fit_label:
             self.label_mean = (ls / n).astype(np.float32)
-            self.label_std = np.sqrt(np.maximum(lss / n - (ls / n) ** 2, 1e-12)).astype(np.float32)
+            self.label_std = self._guarded_std(lss, ls, n, "label")
         return self
+
+    @staticmethod
+    def _guarded_std(ss, s, n, what: str) -> np.ndarray:
+        """Per-column std with a zero-variance guard: a constant column
+        has std == 0 and dividing by it turns every transformed batch
+        NaN/Inf — clamp those columns to 1.0 (the transform then maps
+        them to exactly 0, matching the reference's epsilon-floor
+        behavior in `DistributionStats`) and warn, since a constant
+        column usually means a broken upstream extractor."""
+        var = np.maximum(ss / n - (s / n) ** 2, 0.0)
+        std = np.sqrt(var).astype(np.float32)
+        zero = var <= 1e-12
+        if zero.any():
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "NormalizerStandardize: %d zero-variance %s column(s) "
+                "(std == 0 would divide to NaN/Inf); clamping std to 1.0 "
+                "for columns %s", int(zero.sum()), what,
+                np.flatnonzero(zero)[:16].tolist())
+            std = np.where(zero, np.float32(1.0), std).astype(np.float32)
+        return std
 
     def transform(self, ds: DataSet) -> DataSet:
         if self.mean is None:

@@ -84,6 +84,7 @@ class MultiLayerNetwork:
         self._jit_rnn_step = None
         self._rnn_pos = 0
         self._normalizer = None
+        self._sentinel = None
         self._input_types = self._resolve_input_types()
 
     # ------------------------------------------------------- normalization
@@ -113,6 +114,27 @@ class MultiLayerNetwork:
 
     def get_normalizer(self):
         return self._normalizer
+
+    # ------------------------------------------------------ health sentinel
+    def set_health_sentinel(self, sentinel) -> None:
+        """Attach a `optimize.health.HealthSentinel`: the compiled train
+        step gains a FUSED finite guard — it computes one global
+        gradient-norm scalar (a single reduction tree over every gradient
+        leaf, no per-array pulls) plus a finiteness flag, and commits the
+        candidate parameters/updater/layer state only when loss and
+        gradient norm are both finite. The host reads one small
+        `(loss, grad_norm, ok)` vector per step (the sentinel's single
+        device→host sync) and drives EWMA spike detection + the
+        skip → LR-backoff → rollback escalation ladder on it. Pass None
+        to detach. Not inherited by `clone()` (sentinel state is
+        host-side and per-fit-loop)."""
+        self._sentinel = sentinel
+        # the guarded step has a different signature/graph: recompile
+        self._jit_train = None
+        self._jit_scan = None
+
+    def get_health_sentinel(self):
+        return self._sentinel
 
     def _prep_features(self, features):
         """Traced input prep: cast compact wire dtypes to the model dtype
@@ -281,9 +303,27 @@ class MultiLayerNetwork:
         so the host loop issues exactly one dispatch per step with no
         host->device transfers besides the batch itself, and steps pipeline
         without any synchronisation."""
-        seed = self.conf.seed
+        core = self._step_core()
 
         def step(params, upd, lstate, iteration, features, labels, fmask, lmask):
+            new_params, new_upd, new_lstate, loss, _ = core(
+                params, upd, lstate, iteration, features, labels, fmask,
+                lmask)
+            return new_params, new_upd, new_lstate, iteration + 1, loss
+
+        return step
+
+    def _step_core(self):
+        """Shared fwd+bwd+update body behind BOTH `train_step_fn` and the
+        sentinel-guarded step (`_guarded_step_fn`) — one definition, so
+        guarded and unguarded runs can never drift apart in math. Also
+        returns the gradients: the unguarded step discards them (they are
+        already consumed by the updates, so XLA adds no extra work) and
+        the guarded step folds them into its fused grad-norm scalar."""
+        seed = self.conf.seed
+
+        def core(params, upd, lstate, iteration, features, labels, fmask,
+                 lmask):
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), iteration)
             (loss, new_lstate), grads = jax.value_and_grad(
                 self._loss_pure, has_aux=True)(params, lstate, features, labels,
@@ -295,14 +335,56 @@ class MultiLayerNetwork:
                                                   grads[i], iteration)
                 new_params.append(p_new)
                 new_upd.append(u_new)
-            return new_params, new_upd, new_lstate, iteration + 1, loss
+            return new_params, new_upd, new_lstate, loss, grads
 
-        return step
+        return core
 
     def _make_train_step(self):
         """Jit the train step with donated param/opt/state buffers — the ONE
-        compiled XLA computation per step (in-place update in HBM)."""
+        compiled XLA computation per step (in-place update in HBM). With a
+        health sentinel attached the guarded variant compiles instead."""
+        if self._sentinel is not None:
+            return jax.jit(self._guarded_step_fn(),
+                           donate_argnums=(0, 1, 2, 3))
         return jax.jit(self.train_step_fn(), donate_argnums=(0, 1, 2, 3))
+
+    def _guarded_step_fn(self):
+        """Sentinel-guarded train step: same fwd+bwd+update as
+        `train_step_fn`, plus (a) a fused single-scalar global
+        gradient-norm reduction, (b) an on-device finite guard that keeps
+        the OLD params/updater/layer state when loss or grad-norm is
+        non-finite (a poisoned batch can never overwrite good parameters
+        or corrupt batch-norm running stats), and (c) a `(3,)` health
+        vector output `[loss, grad_norm, ok]` the host sentinel reads in
+        one sync. The iteration counter still advances on a skipped step
+        (the batch was consumed; host and device clocks stay in
+        lockstep). Computed in f32: a gradient whose squared-norm
+        overflows f32 is treated as non-finite, which is the safe
+        verdict."""
+        core = self._step_core()
+
+        def step(params, upd, lstate, iteration, features, labels, fmask,
+                 lmask):
+            new_params, new_upd, new_lstate, loss, grads = core(
+                params, upd, lstate, iteration, features, labels, fmask,
+                lmask)
+            leaves = jax.tree.leaves(grads)
+            gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                           for g in leaves) if leaves \
+                else jnp.asarray(0.0, jnp.float32)
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm_sq)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+            new_params = keep(new_params, params)
+            new_upd = keep(new_upd, upd)
+            new_lstate = keep(new_lstate, lstate)
+            health = jnp.stack([loss.astype(jnp.float32),
+                                jnp.sqrt(gnorm_sq),
+                                ok.astype(jnp.float32)])
+            return (new_params, new_upd, new_lstate, iteration + 1, loss,
+                    health)
+
+        return step
 
     def _make_scan_train(self):
         """K steps per dispatch: `lax.scan` of the train step over stacked
@@ -394,6 +476,16 @@ class MultiLayerNetwork:
                             != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT)
         tbptt = (self.conf.tbptt_fwd_length > 0)
         scan = scan_steps > 1 and not line_search_algo and not tbptt
+        if scan and self._sentinel is not None:
+            # the sentinel needs per-step health scalars; a scanned chunk
+            # never materializes them (and the per-step host sync the
+            # sentinel forces erases scan's dispatch amortization anyway)
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").info(
+                "scan_steps disabled: health sentinel attached needs "
+                "per-step health checks")
+            scan = False
         if scan and self.listeners:
             # per-iteration listeners observe model state; inside a scanned
             # chunk intermediate states never materialize, so a listener at
@@ -507,15 +599,29 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet):
         self._validate_labels(ds)
         f, l, fm, lm = self._batch_arrays(ds)
+        if self._jit_train is None:  # dropped mid-fit (sentinel LR backoff)
+            self._jit_train = self._make_train_step()
         if getattr(self, "_it_device", None) is None:
             self._it_device = jnp.asarray(self.iteration, jnp.int32)
-        (self._params, self._upd_state, self._layer_state, self._it_device,
-         loss) = self._jit_train(
-            self._params, self._upd_state, self._layer_state, self._it_device,
-            f, l, fm, lm)
+        health = None
+        if self._sentinel is None:
+            (self._params, self._upd_state, self._layer_state,
+             self._it_device, loss) = self._jit_train(
+                self._params, self._upd_state, self._layer_state,
+                self._it_device, f, l, fm, lm)
+        else:
+            (self._params, self._upd_state, self._layer_state,
+             self._it_device, loss, health) = self._jit_train(
+                self._params, self._upd_state, self._layer_state,
+                self._it_device, f, l, fm, lm)
         self._score = loss  # device array; score_value property syncs lazily
         self._last_batch = ds  # host refs only; listeners may recompute grads
         self.iteration += 1
+        if health is not None:
+            # one host sync per step; may raise DivergenceRollback /
+            # TrainingDivergedError (before listeners, so a checkpoint
+            # listener never persists state from an escalating step)
+            self._sentinel.observe(self, health)
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):
                 listener.record_batch(ds.num_examples())
@@ -527,8 +633,14 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.optimize.solvers import Solver
 
         self._validate_labels(ds)
-        Solver(self).optimize(ds)
+        solver = Solver(self)
+        final = solver.optimize(ds)
         self.iteration += 1
+        if self._sentinel is not None:
+            # the solver's host loop already materialized the score; a
+            # rejected commit (non-finite candidate) reports as a skip
+            self._sentinel.observe_host(
+                self, final, committed=not solver.last_commit_rejected)
         for listener in self.listeners:
             if hasattr(listener, "record_batch"):
                 listener.record_batch(ds.num_examples())

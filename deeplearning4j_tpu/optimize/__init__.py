@@ -1,5 +1,13 @@
-"""Training loop support: listeners + gradient checking."""
+"""Training loop support: listeners, health sentinel, gradient checking."""
 
+from deeplearning4j_tpu.optimize.health import (  # noqa: F401
+    BatchQuarantine,
+    DivergenceRollback,
+    HealthSentinel,
+    QuarantineFullError,
+    TrainingDivergedError,
+    non_finite_batch_reason,
+)
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CollectScoresIterationListener,
     IterationListener,

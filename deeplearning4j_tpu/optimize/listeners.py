@@ -22,11 +22,14 @@ logger = logging.getLogger("deeplearning4j_tpu")
 class IterationListener:
     """Base hook interface (reference `IterationListener.java`).
 
-    `on_restart` has no reference analogue: it fires when a fault-tolerant
-    driver (`parallel/fault_tolerance.FaultTolerantTrainer`) restores a
-    checkpoint after a failure, so listeners holding iteration-keyed state
-    (score curves, UI streams) can note the rollback instead of seeing the
-    iteration clock silently jump backwards."""
+    `on_restart`/`on_rollback` have no reference analogue: they fire when
+    a fault-tolerant driver (`parallel/fault_tolerance.FaultTolerantTrainer`)
+    restores a checkpoint — `on_restart` after a crash/transient failure,
+    `on_rollback` after the health sentinel's divergence escalation
+    (`optimize/health.HealthSentinel`) — so listeners holding
+    iteration-keyed state (score curves, UI streams) can note the
+    rollback instead of seeing the iteration clock silently jump
+    backwards."""
 
     def iteration_done(self, model, iteration: int) -> None:
         pass
@@ -38,6 +41,9 @@ class IterationListener:
         pass
 
     def on_restart(self, model, restart_count: int) -> None:
+        pass
+
+    def on_rollback(self, model, rollback_count: int) -> None:
         pass
 
 

@@ -44,6 +44,13 @@ def backtrack_line_search(
     step until f(x + αd) ≤ f(x) + c1·α·gᵀd. Returns (step, new_value);
     step=0.0 if no decrease found."""
     slope = float(grad0 @ direction)
+    if not np.isfinite(slope) or not np.isfinite(value0):
+        # a NaN/Inf gradient or score poisons every Armijo comparison
+        # (NaN compares false, so the loop would silently return the
+        # blown-up value0) — refuse the step instead
+        log.warning("line search: non-finite slope/value (slope=%s, "
+                    "value0=%s); rejecting step", slope, value0)
+        return 0.0, value0
     if slope >= 0:
         log.debug("line search: non-descent direction (slope=%g)", slope)
         return 0.0, value0
@@ -67,6 +74,7 @@ class Solver:
         self.net = net
         self.algo = net.conf.global_conf.optimization_algo
         self.max_ls = net.conf.global_conf.max_num_line_search_iterations
+        self.last_commit_rejected = False
         # ONE jitted (flat, lstate, batch…) → (value, grad) computation per
         # network, cached on the net — batches are traced ARGUMENTS, so
         # training over many minibatches reuses the same executable instead
@@ -104,6 +112,8 @@ class Solver:
                 v, g = vg(x)
                 x = x - lr * g
             final = float(v) if v is not None else float(f(x))
+            self._commit(x, final)
+            return final
         elif self.algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
             final = self._line_gd(vg, f, x, iterations)
             return final  # params set inside
@@ -115,9 +125,6 @@ class Solver:
             return final
         else:
             raise ValueError(f"unknown optimization algorithm {self.algo}")
-        net.set_params(np.asarray(x))
-        net.score_value = final
-        return final
 
     # -- steepest descent + line search ------------------------------------
     def _line_gd(self, vg, f, x, iterations) -> float:
@@ -219,6 +226,21 @@ class Solver:
             q = q + (a - b) * s
         return q
 
-    def _commit(self, x, v):
+    def _commit(self, x, v) -> bool:
+        """Publish candidate parameters + score to the net — UNLESS either
+        is non-finite: an LBFGS/CG blow-up must not silently corrupt the
+        network (the previous params/score stay; the rejection is
+        observable via `last_commit_rejected`, which the attached health
+        sentinel reads as a skipped step)."""
+        finite_score = v is not None and np.isfinite(v)
+        finite_params = bool(jnp.all(jnp.isfinite(x)))
+        if not (finite_score and finite_params):
+            self.last_commit_rejected = True
+            log.warning(
+                "solver: rejecting non-finite candidate (score=%s, "
+                "params finite=%s); keeping previous parameters", v,
+                finite_params)
+            return False
         self.net.set_params(np.asarray(x))
         self.net.score_value = v
+        return True

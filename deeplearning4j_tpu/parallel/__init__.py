@@ -25,7 +25,9 @@ from deeplearning4j_tpu.parallel.fault_tolerance import (  # noqa: F401
     FaultInjectionListener,
     FaultTolerantTrainer,
     InjectedFault,
+    NaNGradientInjector,
     ParameterServerStallInjector,
+    PoisonBatchInjector,
     SlowWorkerInjector,
     WorkerCrashInjector,
 )
@@ -45,6 +47,7 @@ from deeplearning4j_tpu.parallel.training_master import (  # noqa: F401
     DistributedComputationGraph,
     DistributedMultiLayer,
     NoHealthyWorkersError,
+    NonFiniteWorkerResultError,
     ParameterAveragingTrainingMaster,
     ParameterAveragingTrainingWorker,
     TrainingHook,

@@ -24,6 +24,14 @@ table stakes for long TPU runs (preemptible pods), so this build provides:
     phase (mid-write, pre-publish, between payload and manifest),
     exercising the durable store's atomic-commit + last-good-fallback
     guarantees (`util/checkpoint_store.py`).
+  * `NaNGradientInjector` — poisons a minibatch's features with NaN/Inf
+    so loss/gradients go non-finite at a chosen step, TRANSIENTLY (the
+    original data is restored/untouched) — exercises the health
+    sentinel's fused skip guard and escalation ladder
+    (`optimize/health.py`).
+  * `PoisonBatchInjector` — poisons specific records PERSISTENTLY (every
+    replay/re-dispatch sees the same bad record) — exercises quarantine
+    and the exhausted-budget `TrainingDivergedError` path.
 """
 from __future__ import annotations
 
@@ -239,6 +247,217 @@ class CheckpointCrashInjector:
 
 
 # ---------------------------------------------------------------------------
+# data-poisoning injectors (health-sentinel chaos seams)
+
+
+class _PoisonedDataSetIterator:
+    """DataSetIterator-contract wrapper produced by
+    `NaNGradientInjector.wrap` / `PoisonBatchInjector.wrap`: delegates the
+    underlying iterator and runs every yielded batch through the
+    injector. `async_supported` is False so injection order stays
+    deterministic under chaos assertions (no prefetch races)."""
+
+    def __init__(self, underlying, injector):
+        self._u = underlying
+        self._inj = injector
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self):
+        return self._u.has_next()
+
+    def next(self):
+        return self._inj._process(self._u.next())
+
+    def reset(self):
+        self._inj._on_reset()
+        self._u.reset()
+
+    def batch(self):
+        return self._u.batch()
+
+    @property
+    def async_supported(self):
+        return False
+
+
+def _poisoned_copy(ds, value: float):
+    """A features-poisoned COPY of `ds` (labels/masks shared; the
+    original batch is never touched). Features become float32 — poisoning
+    only makes sense for float-featured nets."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    import numpy as np
+
+    bad = np.full(np.shape(ds.features), value, np.float32)
+    return DataSet(bad, ds.labels, ds.features_mask, ds.labels_mask)
+
+
+class NaNGradientInjector(TrainingHook):
+    """TRANSIENT numeric blow-up: the `fail_at_fit`-th minibatch (1-based,
+    counted across epochs/retries/replays, at most `times` times) gets
+    its features replaced with `value` (NaN by default, try ``float('inf')``
+    for the overflow flavor), so the fused train step's loss and gradients
+    go non-finite at a chosen step — the health sentinel's skip guard is
+    what keeps that from corrupting the parameters. Two seams:
+
+    - ``wrap(iterator)`` — single-node fit loops: yields poisoned COPIES;
+      the underlying batches stay clean, so a rollback replay trains on
+      good data (a true transient, unlike `PoisonBatchInjector`).
+    - `TrainingHook` (``worker.add_hook``) — distributed workers:
+      `pre_update` poisons the shard batch in place and `post_update`
+      restores the original features, so a re-dispatched shard trains
+      clean while THIS worker's replica blows up (its non-finite result
+      is then quarantined by the master —
+      `training_master.NonFiniteWorkerResultError`). Restrict to one
+      worker with `worker_id`.
+    """
+
+    def __init__(self, fail_at_fit: int = 1, times: int = 1,
+                 value: float = float("nan"),
+                 worker_id: Optional[int] = None):
+        self.fail_at_fit = fail_at_fit
+        self.remaining = times
+        self.value = value
+        self.worker_id = worker_id
+        self.fired = 0
+        self._fits = 0
+        self._saved = {}
+        self._lock = threading.Lock()
+
+    def _trigger(self) -> bool:
+        with self._lock:
+            self._fits += 1
+            if self._fits < self.fail_at_fit or self.remaining <= 0:
+                return False
+            self.remaining -= 1
+            self.fired += 1
+            fits = self._fits
+        logger.warning("NaNGradientInjector: poisoning minibatch %d with "
+                       "%s features", fits, self.value)
+        return True
+
+    # -- iterator seam ----------------------------------------------------
+    def wrap(self, iterator) -> _PoisonedDataSetIterator:
+        return _PoisonedDataSetIterator(iterator, self)
+
+    def _process(self, ds):
+        return _poisoned_copy(ds, self.value) if self._trigger() else ds
+
+    def _on_reset(self) -> None:
+        pass  # fits count across resets: a replay sees clean data once
+        # `times` is spent — the transient contract
+
+    # -- TrainingHook seam ------------------------------------------------
+    def pre_update(self, ds, net) -> None:
+        if self.worker_id is not None \
+                and current_worker_id() != self.worker_id:
+            return
+        if not self._trigger():
+            return
+        import numpy as np
+
+        with self._lock:
+            self._saved[id(ds)] = ds.features
+        ds.features = np.full(np.shape(ds.features), self.value,
+                              np.float32)
+
+    def post_update(self, ds, net) -> None:
+        with self._lock:
+            orig = self._saved.pop(id(ds), None)
+        if orig is not None:
+            ds.features = orig  # transient: re-dispatch sees clean data
+
+
+class PoisonBatchInjector(TrainingHook):
+    """PERSISTENT data poisoning: the record(s) at stream position
+    `poison_at` (0-based int or collection of ints; position counts from
+    the last `reset()`) have their features replaced with `value` EVERY
+    time they are seen — retries, re-dispatches, and rollback replays
+    included. A genuinely bad record, not a transient blow-up: the path
+    that must end in quarantine (streaming tier) or a typed
+    `TrainingDivergedError` (exhausted sentinel budget), never a hang.
+
+    Seams: ``wrap(iterator)`` (DataSetIterator), ``wrap_source(source)``
+    (plain streaming iterable — also accepts `(features, labels)` tuple
+    records), and `TrainingHook` `pre_update` (poisons the shard batch in
+    place with NO restore — the poison sticks to the shard across
+    re-dispatches, so a data-poisoned shard fails on every worker and
+    surfaces as `WorkerFailureError`)."""
+
+    def __init__(self, poison_at=0, value: float = float("nan"),
+                 worker_id: Optional[int] = None):
+        self.poison_at = ({poison_at} if isinstance(poison_at, int)
+                          else set(poison_at))
+        self.value = value
+        self.worker_id = worker_id
+        self.fired = 0
+        self._pos = 0
+        self._fits = 0
+        self._lock = threading.Lock()
+
+    def _note_fired(self, pos: int) -> None:
+        self.fired += 1
+        logger.warning("PoisonBatchInjector: poisoned record at position "
+                       "%d (%s features)", pos, self.value)
+
+    # -- iterator seam ----------------------------------------------------
+    def wrap(self, iterator) -> _PoisonedDataSetIterator:
+        return _PoisonedDataSetIterator(iterator, self)
+
+    def _process(self, ds):
+        with self._lock:
+            pos = self._pos
+            self._pos += 1
+            hit = pos in self.poison_at
+        if not hit:
+            return ds
+        self._note_fired(pos)
+        return _poisoned_copy(ds, self.value)
+
+    def _on_reset(self) -> None:
+        with self._lock:
+            self._pos = 0  # persistent: the SAME positions re-poison
+            # on every pass/replay
+
+    def wrap_source(self, source):
+        """Poisoning pass-through for a streaming source (plain
+        iterable of DataSets or `(features, labels)` records)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        def gen():
+            for item in source:
+                ds = item if isinstance(item, DataSet) else DataSet(*item)
+                yield self._process(ds)
+
+        return gen()
+
+    # -- TrainingHook seam ------------------------------------------------
+    def pre_update(self, ds, net) -> None:
+        if self.worker_id is not None \
+                and current_worker_id() != self.worker_id:
+            return
+        import numpy as np
+
+        with self._lock:
+            pos = self._fits
+            self._fits += 1
+            hit = pos in self.poison_at
+        if not hit:
+            return
+        self._note_fired(pos)
+        ds.features = np.full(np.shape(ds.features), self.value,
+                              np.float32)  # in place, never restored
+
+
+# ---------------------------------------------------------------------------
 # restart-driving trainer
 
 
@@ -276,11 +495,23 @@ class FaultTolerantTrainer:
     def __init__(self, net, iterator, checkpoint_dir,
                  checkpoint_every: int = 100, max_restarts: int = 3,
                  keep_last: int = 2, propagate: tuple = (),
-                 save_hooks=()):
+                 save_hooks=(), sentinel=None):
         # `propagate`: exception types that are CONTROL FLOW, not failures
         # (e.g. early stopping's iteration-abort) — re-raised immediately
         # instead of triggering a checkpoint restore
         self.propagate = propagate
+        # `sentinel`: a `optimize.health.HealthSentinel` to attach to the
+        # network (bare MultiLayerNetwork only — a distributed handle's
+        # replicas/sharded step never consult it, so attach is refused
+        # loudly there; that tier is guarded by the master's non-finite
+        # result quarantine). The trainer then serves as the sentinel's
+        # rollback driver: a `DivergenceRollback` restores the last
+        # verified-good checkpoint and replays (counted as `rollbacks`,
+        # never against `max_restarts`), and the typed
+        # `TrainingDivergedError` always propagates (an exhausted
+        # divergence budget is not a transient)
+        self.sentinel = sentinel
+        self.rollbacks = 0
         self.net = net
         # the restorable network behind a distributed handle/wrapper
         self.target = net.get_network() if hasattr(net, "get_network") \
@@ -331,6 +562,23 @@ class FaultTolerantTrainer:
         if self._ckpt not in listeners:
             net.set_listeners(*(listeners + [self._ckpt]))
         net._ensure_init()
+        if self.sentinel is not None:
+            if self.net is not net \
+                    or not hasattr(net, "set_health_sentinel"):
+                # fail LOUDLY: a distributed handle drives worker clones /
+                # its own sharded step, neither of which consults the
+                # sentinel — attaching one would be silently inert, the
+                # exact silent-NaN outcome the sentinel exists to prevent
+                raise ValueError(
+                    "sentinel= requires a network whose own fit path runs "
+                    "the guarded step (MultiLayerNetwork); "
+                    f"{type(self.net).__name__} drives replicas/sharded "
+                    "steps that never consult it — the distributed tier "
+                    "is guarded by the master's non-finite result "
+                    "quarantine (NonFiniteWorkerResultError) instead")
+            self.sentinel.rollback_available = True
+            if net.get_health_sentinel() is not self.sentinel:
+                net.set_health_sentinel(self.sentinel)
         from deeplearning4j_tpu.util.checkpoint_store import (
             CheckpointCorruptError,
         )
@@ -358,15 +606,33 @@ class FaultTolerantTrainer:
                 self.net.fit(self.iterator, epochs=1)
                 done += 1
             except Exception as e:
-                if isinstance(e, self.propagate):
+                from deeplearning4j_tpu.optimize.health import (
+                    DivergenceRollback,
+                    TrainingDivergedError,
+                )
+
+                if isinstance(e, self.propagate) \
+                        or isinstance(e, TrainingDivergedError):
+                    # a typed divergence give-up is a verdict, not a
+                    # transient — restoring and retrying would loop
                     raise
-                self.restarts += 1
-                if self.restarts > self.max_restarts:
-                    logger.error("giving up after %d restarts", self.restarts - 1)
-                    raise
-                logger.warning("training failed (%s: %s); restart %d/%d",
-                               type(e).__name__, e, self.restarts,
-                               self.max_restarts)
+                rollback = isinstance(e, DivergenceRollback)
+                if rollback:
+                    # bounded by the SENTINEL's rollback_budget (it
+                    # raises TrainingDivergedError when spent), so never
+                    # charged against max_restarts
+                    self.rollbacks += 1
+                    logger.warning("divergence rollback %d: %s",
+                                   self.rollbacks, e)
+                else:
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        logger.error("giving up after %d restarts",
+                                     self.restarts - 1)
+                        raise
+                    logger.warning("training failed (%s: %s); restart %d/%d",
+                                   type(e).__name__, e, self.restarts,
+                                   self.max_restarts)
                 if not self._restore():  # can't happen after the initial
                     raise RuntimeError(   # save; fail loudly if it does
                         "no checkpoint available to restore")
@@ -381,8 +647,15 @@ class FaultTolerantTrainer:
                     master.reset_worker_health()
                 stats = self._master_stats()
                 if stats is not None:
-                    stats.increment("restarts")
+                    stats.increment("rollbacks" if rollback else "restarts")
+                hook_name = "on_rollback" if rollback else "on_restart"
+                count = self.rollbacks if rollback else self.restarts
                 for listener in getattr(net, "listeners", []):
-                    listener_hook = getattr(listener, "on_restart", None)
+                    listener_hook = getattr(listener, hook_name, None)
                     if listener_hook is not None:
-                        listener_hook(net, self.restarts)
+                        listener_hook(net, count)
+                if rollback:
+                    sentinel = self.sentinel or getattr(
+                        net, "get_health_sentinel", lambda: None)()
+                    if sentinel is not None:
+                        sentinel.on_rolled_back(net)

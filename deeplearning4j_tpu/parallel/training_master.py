@@ -69,6 +69,15 @@ class WorkerFailureError(RuntimeError):
     exhausted); the last worker exception is chained as __cause__."""
 
 
+class NonFiniteWorkerResultError(RuntimeError):
+    """A worker shipped back non-finite parameters or updater state — a
+    replica that diverged (NaN gradient, poisoned shard data). The result
+    is quarantined (it never reaches the average: one NaN replica would
+    poison every parameter of the merged model) and the shard is treated
+    exactly like a failed shard: re-dispatched to a surviving worker
+    under the usual retry/backoff/drop discipline."""
+
+
 class _WindowAbort(Exception):
     """Internal: a worker was dropped mid-window. Nothing has been committed
     to the master net yet, so the window repartitions over the surviving
@@ -292,6 +301,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
       too-tight timeout reads that as a straggler — training still
       completes (degradation is graceful), but with needlessly shed
       capacity.
+    - A worker shipping back NON-FINITE parameters or updater state (a
+      diverged replica: NaN gradient, poisoned shard data) is treated
+      exactly like a crashed worker — the result is quarantined, never
+      averaged in (one NaN replica would poison every merged parameter),
+      and the shard re-dispatches (`NonFiniteWorkerResultError`, counted
+      as `nonfinite_results` in `TrainingStats`).
     - A worker accumulating more than `max_retries` CONSECUTIVE failures is
       dropped from the pool; the in-flight window aborts (nothing was
       committed) and re-runs repartitioned over the survivors, so a
@@ -495,9 +510,34 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     self._heartbeat(wid)
             result = worker.get_final_result(wnet)
             result.num_examples = n
+            self._check_result_finite(result, wid, task.index)
             return result
         finally:
             _worker_ctx.worker_id = None
+
+    @staticmethod
+    def _check_result_finite(result: TrainingResult, worker_id: int,
+                             shard_index: int) -> None:
+        """Quarantine gate on the averaging input: a worker returning
+        non-finite params/updater state is a FAILED shard (raises
+        `NonFiniteWorkerResultError` → retry/backoff/drop machinery),
+        never averaged in. The score is deliberately not checked — a
+        worker that never scored reports NaN score with finite params,
+        and the average ignores it."""
+        bad = None
+        if not np.all(np.isfinite(result.params)):
+            bad = "parameters"
+        elif result.updater_state is not None \
+                and not np.all(np.isfinite(result.updater_state)):
+            bad = "updater state"
+        if bad is not None:
+            logger.warning(
+                "quarantining non-finite result from worker %d (shard "
+                "%d): %s contain NaN/Inf — never averaged in;"
+                " re-dispatching", worker_id, shard_index, bad)
+            raise NonFiniteWorkerResultError(
+                f"worker {worker_id} returned non-finite {bad} for shard "
+                f"{shard_index} — result quarantined, shard re-dispatched")
 
     def _run_window(self, worker: TrainingWorker,
                     shards: List[List[DataSet]],
@@ -595,6 +635,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             self._stats.increment("worker_failures")
             if timed_out:
                 self._stats.increment("worker_timeouts")
+            if isinstance(exc, NonFiniteWorkerResultError):
+                self._stats.increment("nonfinite_results")
         logger.warning(
             "worker %d %s on shard %d (shard attempt %d, consecutive "
             "worker failures %d/%d): %s",

@@ -182,20 +182,42 @@ class StreamingTrainPipeline:
     checkpoint in place (params, updater/layer state, iteration/epoch
     clocks), so a restarted consumer resumes where the last durable
     commit left off — corrupt/partial checkpoints from a mid-save kill
-    are skipped backwards automatically."""
+    are skipped backwards automatically.
+
+    Poison-batch quarantine: a stream is exposed to upstream data bugs a
+    curated dataset never sees, and one NaN record must not kill a
+    long-lived consumer. Pass `quarantine_dir` and every record is
+    screened (`optimize.health.non_finite_batch_reason`) BEFORE it
+    reaches the fit dispatch; poisoned records — and records whose fit
+    raises, or whose step the attached `HealthSentinel` skipped as
+    non-finite — are written to the quarantine directory with a
+    provenance sidecar (reason, stream position, wall-clock) and the
+    pipeline keeps consuming. The quarantine is bounded
+    (`max_quarantined`): a stream that is ALL poison raises
+    `QuarantineFullError` — an outage, not noise. Sentinel escalation
+    signals (`DivergenceRollback`, `TrainingDivergedError`) always
+    propagate: divergence is a run-level event, not a per-record one."""
 
     def __init__(self, net, source: Source, on_batch: Optional[Sink] = None,
                  checkpoint_dir=None, checkpoint_every: int = 0,
-                 keep_last: int = 3, resume: bool = True):
+                 keep_last: int = 3, resume: bool = True,
+                 quarantine_dir=None, max_quarantined: int = 256):
         self.net = net
         self.source = source
         self.on_batch = on_batch
         self.batches_seen = 0
+        self.records_seen = 0
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
         self.checkpoint_every = checkpoint_every
         self.checkpoint_store = None
         self.resumed_from_step: Optional[int] = None
+        self.quarantine = None
+        if quarantine_dir is not None:
+            from deeplearning4j_tpu.optimize.health import BatchQuarantine
+
+            self.quarantine = BatchQuarantine(quarantine_dir,
+                                              max_records=max_quarantined)
         if checkpoint_dir is not None:
             from deeplearning4j_tpu.util.checkpoint_store import (
                 CheckpointStore,
@@ -232,10 +254,55 @@ class StreamingTrainPipeline:
             self.net.iteration,
             lambda tmp: write_model(self.net, tmp, atomic=False))
 
+    def _fit_screened(self, ds) -> bool:
+        """Fit one record behind the quarantine screen; returns True when
+        the record contributed a training step (clean fit — the step may
+        still have been SKIPPED by an attached sentinel, in which case
+        the record is quarantined for triage but counts as consumed)."""
+        from deeplearning4j_tpu.optimize.health import (
+            DivergenceRollback,
+            TrainingDivergedError,
+            non_finite_batch_reason,
+        )
+
+        pos = self.records_seen - 1
+        reason = non_finite_batch_reason(ds)
+        if reason is not None:
+            self.quarantine.quarantine(
+                ds, reason, {"stream_position": pos, "stage": "pre-fit"})
+            return False
+        try:
+            self.net.fit(ds)
+        except (DivergenceRollback, TrainingDivergedError):
+            raise  # run-level divergence escalation, not a record problem
+        except Exception as e:
+            self.quarantine.quarantine(
+                ds, f"fit failed: {type(e).__name__}: {e}",
+                {"stream_position": pos, "stage": "fit"})
+            logger.warning("streaming trainer: quarantined record %d "
+                           "whose fit raised %s; pipeline continues", pos,
+                           type(e).__name__)
+            return False
+        sentinel = getattr(self.net, "get_health_sentinel",
+                           lambda: None)()
+        if sentinel is not None and sentinel.last_step_skipped:
+            # finite features but a non-finite loss/gradient (e.g. an
+            # overflow-scale record): the fused guard dropped the update;
+            # keep the record for triage
+            self.quarantine.quarantine(
+                ds, "non-finite loss/gradient (step skipped by sentinel)",
+                {"stream_position": pos, "stage": "step"})
+        return True
+
     def run(self) -> None:
         for item in self.source:
             ds = item if isinstance(item, DataSet) else DataSet(*item)
-            self.net.fit(ds)
+            self.records_seen += 1
+            if self.quarantine is not None:
+                if not self._fit_screened(ds):
+                    continue  # quarantined; the pipeline keeps running
+            else:
+                self.net.fit(ds)
             self.batches_seen += 1
             if (self.checkpoint_store is not None and self.checkpoint_every
                     and self.batches_seen % self.checkpoint_every == 0):

@@ -272,3 +272,91 @@ def test_local_file_saver_missing_files_still_return_none(tmp_path):
     saver = LocalFileModelSaver(tmp_path)
     assert saver.get_best_model() is None
     assert saver.get_latest_model() is None
+
+
+# ----------------------- termination under non-finite scores (ISSUE 3)
+
+
+def test_invalid_score_condition_catches_every_non_finite_flavor():
+    """NaN, +Inf, -Inf, and float-overflow scores all terminate; ordinary
+    finite scores (including huge-but-finite ones) do not."""
+    c = InvalidScoreIterationTerminationCondition()
+    assert c.terminate(float("nan"))
+    assert c.terminate(float("inf"))
+    assert c.terminate(float("-inf"))
+    assert c.terminate(1e308 * 10)  # overflows to +inf
+    assert c.terminate(-1e308 * 10)
+    assert not c.terminate(0.0)
+    assert not c.terminate(1e308)  # huge but finite: MaxScore's job
+    assert not c.terminate(-1e308)
+
+
+def test_max_score_condition_with_overflow_and_nan():
+    """The score-ceiling condition fires on +Inf/overflow but NOT on NaN
+    (NaN comparisons are false — InvalidScore is the NaN catcher, which
+    is why the two are stacked together)."""
+    c = MaxScoreIterationTerminationCondition(20.0)
+    assert c.terminate(float("inf"))
+    assert c.terminate(1e308 * 10)
+    assert c.terminate(1e308)
+    assert c.terminate(20.0 + 1e-6)
+    assert not c.terminate(20.0)
+    assert not c.terminate(float("-inf"))
+    assert not c.terminate(float("nan"))  # documented: InvalidScore's job
+
+
+def test_nan_loss_triggers_invalid_score_termination():
+    """Iteration-level path end to end: a NaN batch drives the score
+    non-finite and InvalidScore aborts the fit mid-epoch."""
+    net = small_net()
+    batches = list(blobs_iterator())
+    bad = DataSet(np.full_like(batches[0].features, np.nan),
+                  batches[0].labels)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(100))
+           .iteration_termination_conditions(
+               InvalidScoreIterationTerminationCondition())
+           .build())
+    it = ListDataSetIterator(batches[:1] + [bad] + batches[1:])
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.termination_reason == \
+        TerminationReason.ITERATION_TERMINATION_CONDITION
+    assert "InvalidScore" in result.termination_details
+    assert result.total_epochs == 0
+
+
+# --------------------------- zero-variance normalizer guard (ISSUE 3)
+
+
+def test_standardize_zero_variance_column_clamped(caplog):
+    """A constant feature column has std == 0; dividing by it would turn
+    every transformed batch NaN/Inf — the guard clamps that column's std
+    to 1.0 (transform maps it to exactly 0) and warns."""
+    import logging
+
+    caplog.set_level(logging.WARNING, logger="deeplearning4j_tpu")
+    rng = np.random.default_rng(0)
+    X = rng.normal(3.0, 2.0, size=(100, 4)).astype(np.float32)
+    X[:, 2] = 7.5  # constant column
+    norm = NormalizerStandardize().fit(DataSet(X, np.zeros((100, 1))))
+    assert norm.std[2] == 1.0
+    assert "zero-variance" in caplog.text
+    ds = DataSet(X.copy(), np.zeros((100, 1)))
+    norm.transform(ds)
+    assert np.all(np.isfinite(ds.features))
+    np.testing.assert_allclose(ds.features[:, 2], 0.0, atol=1e-6)
+    # the varying columns still standardize normally
+    assert abs(ds.features[:, 0].std() - 1.0) < 5e-2
+    # and the round-trip reverts the constant column to its value
+    np.testing.assert_allclose(norm.revert_features(ds.features)[:, 2],
+                               7.5, rtol=1e-5)
+
+
+def test_standardize_zero_variance_labels_clamped():
+    X = np.random.default_rng(1).normal(size=(50, 3)).astype(np.float32)
+    Y = np.full((50, 2), 4.0, np.float32)  # constant labels
+    norm = NormalizerStandardize(fit_label=True).fit(DataSet(X, Y))
+    assert np.all(norm.label_std == 1.0)
+    ds = DataSet(X.copy(), Y.copy())
+    norm.transform(ds)
+    assert np.all(np.isfinite(ds.labels))

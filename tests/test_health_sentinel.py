@@ -1,0 +1,424 @@
+"""Training health sentinel chaos + unit suite (ISSUE 3).
+
+Proves the escalation contract end to end: an injected NaN gradient at
+step k is SKIPPED on-device (parameters untouched); sustained NaN walks
+the ladder — LR backoff, rollback to the last verified-good checkpoint,
+resume — and training completes; an exhausted rollback budget raises the
+typed `TrainingDivergedError` (never a hang, never silent NaN params); a
+poisoned streaming record lands in the quarantine dir with provenance
+while the pipeline keeps running; and a distributed worker shipping back
+non-finite parameters is quarantined and its shard re-dispatched, never
+averaged in. Injector log lines are asserted via caplog (logger
+`deeplearning4j_tpu`), matching the rest of the chaos suite.
+"""
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ListDataSetIterator,
+    QuarantiningDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.health import (
+    BatchQuarantine,
+    HealthSentinel,
+    QuarantineFullError,
+    TrainingDivergedError,
+    non_finite_batch_reason,
+)
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.parallel.fault_tolerance import (
+    FaultTolerantTrainer,
+    NaNGradientInjector,
+    PoisonBatchInjector,
+)
+from deeplearning4j_tpu.parallel.training_master import (
+    ParameterAveragingTrainingMaster,
+    ParameterAveragingTrainingWorker,
+)
+from deeplearning4j_tpu.streaming.pipeline import StreamingTrainPipeline
+
+LOGGER = "deeplearning4j_tpu"
+
+
+def _net(seed=12345, lr=0.1, activation=Activation.TANH):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(lr)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation=activation))
+            .layer(OutputLayer(n_in=8, n_out=3, activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        f = rng.randn(batch, 4).astype(np.float32)
+        l = np.eye(3, dtype=np.float32)[rng.randint(0, 3, batch)]
+        out.append(DataSet(f, l))
+    return out
+
+
+def _layer_lrs(net):
+    return [layer.updater_cfg.learning_rate for layer in net.layers
+            if layer.updater_cfg is not None]
+
+
+# -------------------------------------------------------- fused skip guard
+
+
+@pytest.mark.chaos
+def test_nan_gradient_batch_skipped_params_untouched(caplog):
+    """Injected NaN gradient at step k: the fused guard drops exactly that
+    update — parameters after the poisoned step are BIT-IDENTICAL to the
+    parameters before it, and training continues on the next batch."""
+    caplog.set_level(logging.WARNING, logger=LOGGER)
+    net = _net()
+    sentinel = HealthSentinel(skip_budget=100)  # escalation disarmed
+    net.set_health_sentinel(sentinel)
+    batches = _batches(3)
+    net.fit(batches[0])  # healthy step (also compiles the guarded step)
+    params_before = net.params()
+    injector = NaNGradientInjector(fail_at_fit=1, times=1)
+    net.fit(injector.wrap(ListDataSetIterator([batches[1]])))
+    assert injector.fired == 1
+    assert sentinel.skips == 1
+    assert sentinel.last_verdict == "non-finite"
+    np.testing.assert_array_equal(net.params(), params_before)
+    assert "batch skipped, parameters untouched" in caplog.text
+    net.fit(batches[2])  # training continues
+    assert sentinel.steps == 3
+    assert not np.array_equal(net.params(), params_before)
+    assert np.all(np.isfinite(net.params()))
+
+
+@pytest.mark.chaos
+def test_inf_overflow_batch_skipped():
+    """The guard catches Inf (overflow) as well as NaN."""
+    net = _net()
+    sentinel = HealthSentinel(skip_budget=100)
+    net.set_health_sentinel(sentinel)
+    injector = NaNGradientInjector(fail_at_fit=2, times=1,
+                                   value=float("inf"))
+    net.fit(injector.wrap(ListDataSetIterator(_batches(3))))
+    assert sentinel.skips == 1
+    assert np.all(np.isfinite(net.params()))
+
+
+# ---------------------------------------------------- escalation end-to-end
+
+
+@pytest.mark.chaos
+def test_sustained_nan_backoff_rollback_resume(caplog, tmp_path):
+    """The acceptance drill: sustained NaN → LR backoff → rollback to the
+    last verified-good checkpoint → training resumes and completes, with
+    rollbacks counted and `on_rollback` listeners notified."""
+    caplog.set_level(logging.WARNING, logger=LOGGER)
+    net = _net()
+    base_lrs = _layer_lrs(net)
+    rollback_calls = []
+
+    class Spy(IterationListener):
+        def on_rollback(self, model, count):
+            rollback_calls.append(count)
+
+    net.set_listeners(Spy())
+    batches = _batches(6, seed=3)
+    injector = NaNGradientInjector(fail_at_fit=2, times=8)
+    sentinel = HealthSentinel(skip_budget=2, backoff_budget=1,
+                              lr_backoff_factor=0.5, rollback_budget=2,
+                              warmup_steps=10**9)
+    trainer = FaultTolerantTrainer(
+        net, injector.wrap(ListDataSetIterator(batches)),
+        checkpoint_dir=tmp_path, checkpoint_every=50, sentinel=sentinel)
+    trainer.fit(epochs=2)  # completes: the transient exhausts mid-run
+
+    assert sentinel.skips == 8
+    assert sentinel.backoffs == 2
+    assert sentinel.rollbacks == 2
+    assert trainer.rollbacks == 2
+    assert rollback_calls == [1, 2]
+    # the backed-off LR persists through the rollbacks (0.5^2)
+    for lr, base in zip(_layer_lrs(net), base_lrs):
+        assert lr == pytest.approx(base * 0.25)
+    assert np.all(np.isfinite(net.params()))
+    assert "backing off learning rate" in caplog.text
+    assert "requesting rollback" in caplog.text
+    assert "restored" in caplog.text  # checkpoint restore happened
+
+
+@pytest.mark.chaos
+def test_exhausted_rollback_budget_raises_typed_error(tmp_path):
+    """Persistent poison (every record bad on every replay): the ladder
+    runs out and raises the typed `TrainingDivergedError` — never a hang,
+    never silent NaN parameters — and FaultTolerantTrainer does NOT
+    swallow it into its restart loop."""
+    net = _net()
+    batches = _batches(4, seed=5)
+    injector = PoisonBatchInjector(poison_at=range(len(batches)))
+    sentinel = HealthSentinel(skip_budget=1, backoff_budget=0,
+                              rollback_budget=1, warmup_steps=10**9)
+    trainer = FaultTolerantTrainer(
+        net, injector.wrap(ListDataSetIterator(batches)),
+        checkpoint_dir=tmp_path, checkpoint_every=50, max_restarts=50,
+        sentinel=sentinel)
+    with pytest.raises(TrainingDivergedError) as exc_info:
+        trainer.fit(epochs=1)
+    assert "rollback" in str(exc_info.value)
+    assert sentinel.rollbacks == 1
+    assert trainer.restarts == 0  # rollbacks never charged as restarts
+    assert np.all(np.isfinite(net.params()))  # guard held throughout
+
+
+def test_standalone_sentinel_diverges_typed_without_rollback_driver():
+    """Without a rollback-capable driver the rollback rung is skipped:
+    the sentinel fails typed after the backoff budget, instead of raising
+    a rollback signal nobody will catch."""
+    net = _net()
+    sentinel = HealthSentinel(skip_budget=1, backoff_budget=1,
+                              warmup_steps=10**9)
+    net.set_health_sentinel(sentinel)
+    injector = PoisonBatchInjector(poison_at=range(8))
+    with pytest.raises(TrainingDivergedError) as exc_info:
+        net.fit(injector.wrap(ListDataSetIterator(_batches(8))))
+    assert "no rollback-capable driver" in str(exc_info.value)
+    assert sentinel.backoffs == 1
+    assert sentinel.rollbacks == 0
+    assert np.all(np.isfinite(net.params()))
+
+
+def test_spike_detection_counts_and_escalates():
+    """EWMA spike detection: a grad-norm/loss far above the healthy
+    baseline counts as unhealthy even though it is finite."""
+    sentinel = HealthSentinel(spike_factor=5.0, warmup_steps=3,
+                              skip_budget=100)
+    net = _net()
+    for _ in range(5):
+        assert sentinel.observe_host(net, 1.0, grad_norm=1.0)
+    assert not sentinel.observe_host(net, 100.0, grad_norm=1.0)  # loss spike
+    assert not sentinel.observe_host(net, 1.0, grad_norm=50.0)  # gnorm spike
+    assert sentinel.spikes == 2
+    # spikes must not drag their own baseline up
+    assert sentinel._loss_ewma == pytest.approx(1.0)
+    assert sentinel.observe_host(net, 1.1, grad_norm=1.2)
+
+
+# ------------------------------------------------------ streaming quarantine
+
+
+@pytest.mark.chaos
+def test_poisoned_streaming_record_quarantined_with_provenance(tmp_path):
+    """A NaN record in the stream lands in the quarantine dir (payload +
+    provenance sidecar) and the pipeline keeps consuming."""
+    net = _net()
+    batches = _batches(5, seed=7)
+    poisoned = PoisonBatchInjector(poison_at=2)
+    pipeline = StreamingTrainPipeline(
+        net, poisoned.wrap_source(batches), quarantine_dir=tmp_path / "q")
+    pipeline.run()
+    assert pipeline.records_seen == 5
+    assert pipeline.batches_seen == 4  # poisoned record never reached fit
+    assert len(pipeline.quarantine) == 1
+    assert np.all(np.isfinite(net.params()))
+    payloads = pipeline.quarantine.record_paths()
+    assert len(payloads) == 1
+    meta = json.loads((tmp_path / "q" / "record_0.json").read_text())
+    assert "non-finite" in meta["reason"]
+    assert meta["provenance"]["stream_position"] == 2
+    assert meta["provenance"]["stage"] == "pre-fit"
+    # the quarantined payload round-trips for triage
+    ds, meta2 = pipeline.quarantine.load(0)
+    assert meta2["seq"] == 0
+    assert not np.isfinite(np.asarray(ds.features)).any()
+
+
+@pytest.mark.chaos
+def test_finite_but_blowup_record_quarantined_by_sentinel(tmp_path):
+    """A record whose features screen clean but whose step overflows is
+    caught by the sentinel's fused guard and quarantined at stage
+    'step' — the pipeline keeps running with finite params."""
+    net = _net(activation=Activation.RELU)  # relu propagates overflow
+    # (tanh would saturate the blow-up away)
+    sentinel = HealthSentinel(skip_budget=100)
+    net.set_health_sentinel(sentinel)
+    batches = _batches(4, seed=11)
+    # finite features at overflow scale: passes the pre-fit screen, but
+    # the squared grad-norm overflows f32 inside the step
+    batches[1] = DataSet(
+        np.full_like(batches[1].features, 1e30), batches[1].labels)
+    assert non_finite_batch_reason(batches[1]) is None
+    pipeline = StreamingTrainPipeline(net, batches,
+                                      quarantine_dir=tmp_path / "q")
+    pipeline.run()
+    assert sentinel.skips == 1
+    assert pipeline.batches_seen == 4  # consumed, but flagged for triage
+    assert len(pipeline.quarantine) == 1
+    meta = json.loads((tmp_path / "q" / "record_0.json").read_text())
+    assert meta["provenance"]["stage"] == "step"
+    assert np.all(np.isfinite(net.params()))
+
+
+def test_streaming_quarantine_full_is_an_outage(tmp_path):
+    """A stream that is all poison raises `QuarantineFullError` instead of
+    silently spinning."""
+    net = _net()
+    bad = [DataSet(np.full((8, 4), np.nan, np.float32),
+                   np.eye(3, dtype=np.float32)[np.zeros(8, int)])
+           for _ in range(4)]
+    pipeline = StreamingTrainPipeline(net, bad,
+                                      quarantine_dir=tmp_path / "q",
+                                      max_quarantined=2)
+    with pytest.raises(QuarantineFullError):
+        pipeline.run()
+
+
+def test_quarantining_iterator_screens_fit_loop(tmp_path):
+    """The data-iterator tier: wrapping any iterator diverts poisoned
+    batches before they reach fit."""
+    batches = _batches(4, seed=13)
+    batches[1].features[0, 0] = np.inf
+    it = QuarantiningDataSetIterator(ListDataSetIterator(batches),
+                                     tmp_path / "q")
+    net = _net()
+    net.fit(it)
+    assert it.quarantined == 1
+    assert net.iteration == 3
+    assert np.all(np.isfinite(net.params()))
+    assert len(it.quarantine.record_paths()) == 1
+
+
+# ------------------------------------------------- distributed worker tier
+
+
+@pytest.mark.chaos
+def test_nonfinite_worker_result_quarantined_and_redispatched(caplog):
+    """A worker whose replica diverges (transient NaN batch) ships back
+    NaN params: the master treats it like a failed shard — quarantined,
+    never averaged in, re-dispatched to a survivor — and training
+    completes with finite parameters."""
+    caplog.set_level(logging.WARNING, logger=LOGGER)
+    net = _net()
+    worker = ParameterAveragingTrainingWorker(net)
+    # transient: worker 2's first minibatch is poisoned in place and then
+    # restored, so the re-dispatched shard trains clean
+    worker.add_hook(NaNGradientInjector(worker_id=2, fail_at_fit=1,
+                                        times=1))
+    master = ParameterAveragingTrainingMaster(
+        num_workers=4, averaging_frequency=2, worker=worker,
+        collect_training_stats=True)
+    master.execute_training(net, ListDataSetIterator(_batches(8, seed=17)))
+    stats = master.get_training_stats()
+    assert stats.get_count("nonfinite_results") == 1
+    assert stats.get_count("worker_retries") == 1
+    assert np.all(np.isfinite(net.params()))
+    assert np.isfinite(net.score_value)
+    assert "quarantining non-finite result from worker 2" in caplog.text
+
+
+# --------------------------------------------------------------- unit tests
+
+
+def test_batch_quarantine_roundtrip_and_restart(tmp_path):
+    q = BatchQuarantine(tmp_path, max_records=3)
+    ds = _batches(1)[0]
+    q.quarantine(ds, "test reason", {"origin": "unit"})
+    restored, meta = q.load(0)
+    np.testing.assert_array_equal(restored.features, ds.features)
+    np.testing.assert_array_equal(restored.labels, ds.labels)
+    assert meta["reason"] == "test reason"
+    assert meta["provenance"]["origin"] == "unit"
+    # a restarted consumer appends instead of overwriting
+    q2 = BatchQuarantine(tmp_path, max_records=3)
+    assert len(q2) == 1
+    q2.quarantine(ds, "second", None)
+    assert {p.name for p in q2.record_paths()} == {"record_0.npz",
+                                                   "record_1.npz"}
+    q2.quarantine(ds, "third", None)
+    with pytest.raises(QuarantineFullError):
+        q2.quarantine(ds, "fourth", None)
+
+
+def test_batch_quarantine_triage_gap_never_overwrites(tmp_path):
+    """A triaged (deleted) record must not cause a restarted consumer to
+    overwrite later evidence: sequence resumes after the HIGHEST index."""
+    q = BatchQuarantine(tmp_path, max_records=10)
+    ds = _batches(1)[0]
+    for _ in range(3):
+        q.quarantine(ds, "r", None)  # record_0..record_2
+    (tmp_path / "record_0.npz").unlink()
+    (tmp_path / "record_0.json").unlink()
+    q2 = BatchQuarantine(tmp_path, max_records=10)
+    q2.quarantine(ds, "after-gap", None)
+    assert (tmp_path / "record_3.npz").exists()
+    assert json.loads(
+        (tmp_path / "record_2.json").read_text())["reason"] == "r"
+
+
+def test_sentinel_with_distributed_handle_refused(tmp_path):
+    """Attaching a sentinel to a distributed handle would be silently
+    inert (replicas never consult it) — the trainer refuses loudly."""
+    from deeplearning4j_tpu.parallel.training_master import (
+        DistributedMultiLayer,
+    )
+
+    net = _net()
+    handle = DistributedMultiLayer(
+        net, ParameterAveragingTrainingMaster(num_workers=2))
+    trainer = FaultTolerantTrainer(
+        handle, ListDataSetIterator(_batches(2)), checkpoint_dir=tmp_path,
+        sentinel=HealthSentinel())
+    with pytest.raises(ValueError, match="guarded step"):
+        trainer.fit(epochs=1)
+
+
+def test_non_finite_batch_reason():
+    clean = _batches(1)[0]
+    assert non_finite_batch_reason(clean) is None
+    bad = _batches(1)[0]
+    bad.features[2, 1] = np.nan
+    assert "features" in non_finite_batch_reason(bad)
+    bad_labels = _batches(1)[0]
+    bad_labels.labels[0, 0] = np.inf
+    assert "labels" in non_finite_batch_reason(bad_labels)
+    # integer features are finite by construction
+    ids = DataSet(np.arange(8, dtype=np.int32).reshape(4, 2),
+                  np.eye(3, dtype=np.float32)[np.zeros(4, int)])
+    assert non_finite_batch_reason(ids) is None
+
+
+def test_sentinel_rejects_bad_config():
+    with pytest.raises(ValueError):
+        HealthSentinel(ewma_beta=1.5)
+    with pytest.raises(ValueError):
+        HealthSentinel(spike_factor=0.5)
+    with pytest.raises(ValueError):
+        HealthSentinel(lr_backoff_factor=1.0)
+    with pytest.raises(ValueError):
+        HealthSentinel(skip_budget=0)
+
+
+def test_sentinel_counters_and_events():
+    events = []
+    sentinel = HealthSentinel(skip_budget=100, warmup_steps=10**9,
+                              on_event=events.append)
+    net = _net()
+    sentinel.observe_host(net, 1.0, grad_norm=2.0)
+    sentinel.observe_host(net, float("nan"), committed=False)
+    c = sentinel.counters()
+    assert c["steps"] == 2 and c["skips"] == 1
+    assert [e["event"] for e in events] == ["non-finite"]

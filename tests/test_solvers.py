@@ -97,3 +97,66 @@ def test_fit_dispatches_to_solver():
     assert net.iteration == 3
     ev = net.evaluate(ListDataSetIterator([ds]))
     assert ev.accuracy() > 0.9
+
+
+# ------------------------------------------------- non-finite commit guard
+
+
+def test_commit_rejects_non_finite_params(caplog):
+    """An LBFGS/CG blow-up must not silently corrupt the net: a candidate
+    with NaN/Inf parameters is rejected and the previous params stay."""
+    import logging
+
+    caplog.set_level(logging.WARNING, logger="deeplearning4j_tpu")
+    net = make_net(OptimizationAlgorithm.LBFGS)
+    before = net.params()
+    solver = Solver(net)
+    bad = jnp.asarray(before).at[3].set(jnp.nan)
+    assert solver._commit(bad, 0.5) is False
+    assert solver.last_commit_rejected
+    np.testing.assert_array_equal(net.params(), before)
+    assert "rejecting non-finite candidate" in caplog.text
+
+
+def test_commit_rejects_non_finite_score():
+    net = make_net(OptimizationAlgorithm.CONJUGATE_GRADIENT)
+    before = net.params()
+    before_score = net.score_value
+    solver = Solver(net)
+    assert solver._commit(jnp.asarray(before), float("nan")) is False
+    assert solver._commit(jnp.asarray(before), float("inf")) is False
+    np.testing.assert_array_equal(net.params(), before)
+    assert net.score_value == before_score
+    # a finite candidate still commits
+    assert solver._commit(jnp.asarray(before), 0.25) is True
+    assert net.score_value == 0.25
+
+
+def test_backtrack_line_search_rejects_non_finite_gradient():
+    """A NaN gradient poisons the Armijo slope; the search must refuse the
+    step instead of silently returning the blown-up value."""
+    f = lambda x: jnp.sum(x ** 2)
+    x = jnp.ones(3)
+    g = jnp.asarray([jnp.nan, 1.0, 1.0])
+    step, v = backtrack_line_search(f, x, -g, float(f(x)), g)
+    assert step == 0.0
+    assert v == float(f(x))
+    # non-finite value0 likewise refuses
+    step, v = backtrack_line_search(f, x, -jnp.ones(3), float("nan"),
+                                    jnp.ones(3))
+    assert step == 0.0
+
+
+def test_sgd_solver_blowup_keeps_previous_params():
+    """The Solver's SGD path also routes through the guarded commit: a
+    diverged iterate never overwrites the net."""
+    net = make_net(OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT,
+                   iterations=3)
+    ds = blobs()
+    before = net.params()
+    bad = DataSet(np.full_like(ds.features, np.nan), ds.labels)
+    solver = Solver(net)
+    solver.optimize(bad, iterations=2)
+    assert solver.last_commit_rejected
+    np.testing.assert_array_equal(net.params(), before)
+    assert np.all(np.isfinite(net.params()))
