@@ -603,6 +603,116 @@ def bench_sentinel():
             None, spread)
 
 
+def bench_serving():
+    """Serving-tier tax (`serving/model_server.ModelServer`): steady-state
+    predict latency and throughput THROUGH the robust path — admission
+    control, deadline stamping, micro-batch assembly, breaker accounting,
+    non-finite output screen — for the same ~1.1 M-param MLP the
+    checkpoint config uses, driven by 4 closed-loop client threads of
+    8-row requests. Metric: rows/sec served (higher better); `latency_ms`
+    records per-request p50/p99 so tail regressions show up even when
+    throughput holds, and `shed_rate_pct` records the typed-shed fraction
+    under a synthetic overload phase (tiny queue + slow-step injector) —
+    the admission-control contract, priced every round."""
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.serving import (
+        ModelServer,
+        ServerOverloadedError,
+        SlowInferenceInjector,
+    )
+    import threading
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01).updater(Updater.ADAM)
+            .list()
+            .layer(DenseLayer(n_out=1024, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=512, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(512))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 512)).astype(np.float32)
+
+    n_threads, reqs_per_thread = 4, 24
+    latencies = []
+    lock = threading.Lock()
+    srv = ModelServer(net, max_queue=256, max_batch_size=64,
+                      batch_window=0.001)
+    try:
+        for _ in range(6):  # warm the jit cache across pad buckets
+            srv.predict(x)
+
+        def client():
+            mine = []
+            for _ in range(reqs_per_thread):
+                t0 = time.perf_counter()
+                srv.predict(x, timeout=60.0)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                latencies.extend(mine)
+
+        dts = []
+        for _ in range(_REPEATS):
+            latencies.clear()
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dts.append(time.perf_counter() - t0)
+        dt, spread = _median_spread(dts)
+        lat = np.asarray(latencies)
+        bench_serving.latency_ms = {
+            "p50": round(1e3 * float(np.percentile(lat, 50)), 2),
+            "p99": round(1e3 * float(np.percentile(lat, 99)), 2)}
+        rows_per_sec = n_threads * reqs_per_thread * x.shape[0] / dt
+        assert srv.stats()["failures"] == 0, \
+            "healthy bench run must not fail inference"
+    finally:
+        srv.shutdown(drain_timeout=10.0)
+
+    # overload phase: tiny queue + slow steps; record the shed fraction
+    slow = SlowInferenceInjector(delay=0.05)
+    overloaded = ModelServer(net, max_queue=4, max_batch_size=8,
+                             batch_window=0.0, infer_hooks=[slow])
+    offered = 48
+    shed = [0]
+
+    def flood():
+        try:
+            overloaded.predict(x, timeout=30.0)
+        except ServerOverloadedError:
+            with lock:
+                shed[0] += 1
+
+    try:
+        overloaded.predict(x)  # compile before the clock matters
+        threads = [threading.Thread(target=flood) for _ in range(offered)]
+        for t in threads:
+            t.start()
+        slow.release()
+        for t in threads:
+            t.join()
+    finally:
+        overloaded.shutdown(drain_timeout=10.0)
+    bench_serving.shed_rate_pct = round(100.0 * shed[0] / offered, 1)
+    return "serving_predict_rows_per_sec", rows_per_sec, None, spread
+
+
 def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
     """Synthetic Zipf corpus as pre-tokenized sentences."""
     rng = np.random.default_rng(seed)
@@ -753,12 +863,15 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "word2vec_50k": bench_word2vec_50k,
             "generate": bench_generate,
             "checkpoint": bench_checkpoint,
-            "sentinel": bench_sentinel}
+            "sentinel": bench_sentinel,
+            "serving": bench_serving}
 
 
 def _unit(metric: str) -> str:
     if "roundtrips" in metric:
         return "roundtrips/sec"
+    if "rows" in metric:
+        return "rows/sec"
     if "words" in metric:
         return "words/sec/chip"
     if "steps" in metric:
@@ -813,6 +926,9 @@ def main() -> None:
         extra = getattr(_CONFIGS[name], "sentinel_overhead_pct", None)
         if extra is not None:
             entries[name]["sentinel_overhead_pct"] = extra
+        extra = getattr(_CONFIGS[name], "shed_rate_pct", None)
+        if extra is not None:
+            entries[name]["shed_rate_pct"] = extra
     if on_chip:
         baseline_file.write_text(json.dumps(baselines))
 

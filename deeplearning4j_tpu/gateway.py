@@ -10,23 +10,68 @@ process holding no TPU) can build configs, fit, predict, evaluate.
 Protocol: one JSON object per line. Request:
   {"id": 1, "method": "fit", "params": {...}}
 Response:
-  {"id": 1, "result": ...} or {"id": 1, "error": "message"}
+  {"id": 1, "result": ...}
+  or {"id": 1, "error": "Type: message", "error_type": "Type",
+      "retry_after": 0.05}           # retry_after only on shed errors
 Arrays travel as {"__ndarray__": "<base64 of np.save bytes>"}.
+
+Robustness (the serving-tier hardening pass):
+
+- **request-size bound** — a line longer than `max_request_bytes` gets a
+  typed `RequestTooLargeError` response and the connection closes (the
+  stream cannot be resynced mid-line). An unterminated request can no
+  longer grow a handler's buffer without bound.
+- **recv timeout** — each connection arms a socket-level `recv_timeout`;
+  a client that goes silent mid-request releases its handler thread
+  instead of pinning it forever.
+- **serving integration** — construct with `serving={...}` (ModelServer
+  kwargs, or `True` for defaults) and every created/loaded model is
+  wrapped in a `serving.ModelServer`: `predict`/`evaluate` ride through
+  admission control, deadlines, and the circuit breaker, and the typed
+  shed errors (`ServerOverloadedError` + `retry_after`, ...) surface in
+  the error payload. `reload_model` hot-swaps a model from a checkpoint
+  path or store directory with canary validation — a corrupt or broken
+  candidate is rejected while the old model keeps serving.
+- **client retries** — `GatewayClient` retries idempotent methods once
+  with backoff after a `ConnectionResetError`/`BrokenPipeError`
+  (server restart, LB connection recycle), and surfaces server-side
+  `error` payloads as the typed `GatewayError` (`.error_type`,
+  `.retry_after`) instead of a bare RuntimeError.
 """
 from __future__ import annotations
 
 import base64
+import contextlib
 import io
 import json
 import logging
 import socket
 import socketserver
 import threading
+import time
+from pathlib import Path
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class GatewayError(RuntimeError):
+    """A server-side error surfaced through the gateway protocol.
+    `error_type` is the server-side exception class name (e.g.
+    `"ServerOverloadedError"`); `retry_after` (seconds) is present on
+    shed/unavailable responses so clients can back off intelligently."""
+
+    def __init__(self, msg: str, error_type: Optional[str] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.error_type = error_type
+        self.retry_after = retry_after
+
+
+class RequestTooLargeError(RuntimeError):
+    """The request line exceeded the server's `max_request_bytes`."""
 
 
 def encode_array(a: np.ndarray) -> Dict[str, str]:
@@ -63,10 +108,23 @@ def encode_value(v):
 class EntryPoint:
     """Methods callable over the gateway (reference
     `DeepLearning4jEntryPoint.java`): one live model per session keyed by a
-    caller-chosen name."""
+    caller-chosen name.
 
-    def __init__(self):
+    `serving` — None serves `predict`/`evaluate` directly off the net
+    (historical behavior); a dict of `serving.ModelServer` kwargs (or
+    `True` for defaults) wraps every created/loaded model in a
+    ModelServer, so those calls gain admission control, deadlines, and
+    circuit breaking, plus `reload_model`/`server_stats` management."""
+
+    # lifecycle methods a remote caller must NOT reach through the RPC
+    # dispatch: one unauthenticated request could drain every ModelServer
+    # (after which predict would silently bypass the serving tier)
+    _RPC_EXCLUDED = frozenset({"shutdown"})
+
+    def __init__(self, serving: Optional[dict] = None):
         self._models: Dict[str, Any] = {}
+        self._servers: Dict[str, Any] = {}
+        self._serving = {} if serving is True else serving
 
     # -- model lifecycle --------------------------------------------------
     def create_model(self, name: str, config: dict) -> str:
@@ -79,13 +137,13 @@ class EntryPoint:
             config if isinstance(config, str) else json.dumps(config))
         net = MultiLayerNetwork(conf)
         net.init()
-        self._models[name] = net
+        self._install(name, net)
         return name
 
     def load_model(self, name: str, path: str) -> str:
         from deeplearning4j_tpu.util.serialization import restore_model
 
-        self._models[name] = restore_model(path)
+        self._install(name, restore_model(path))
         return name
 
     def save_model(self, name: str, path: str) -> str:
@@ -94,10 +152,43 @@ class EntryPoint:
         write_model(self._model(name), path)
         return path
 
+    def _install(self, name: str, net) -> None:
+        self._models[name] = net
+        if self._serving is not None:
+            from deeplearning4j_tpu.serving import ModelServer
+
+            old = self._servers.pop(name, None)
+            if old is not None:
+                old.shutdown(drain_timeout=5.0)
+            self._servers[name] = ModelServer(net, **self._serving)
+
     def _model(self, name: str):
         if name not in self._models:
             raise KeyError(f"no model {name!r}; create_model/load_model first")
         return self._models[name]
+
+    def _live_server(self, name: str):
+        """The model's ModelServer, re-wrapping lazily when serving is
+        enabled but the server is gone (a `GatewayServer.stop()` drains
+        servers; a later `start()` must NOT silently serve unprotected).
+        None when the serving tier is disabled."""
+        if self._serving is None:
+            return None
+        if name in self._models and name not in self._servers:
+            from deeplearning4j_tpu.serving import ModelServer
+
+            self._servers[name] = ModelServer(self._models[name],
+                                              **self._serving)
+        return self._servers.get(name)
+
+    def _server(self, name: str):
+        self._model(name)  # raises the canonical "no model" KeyError
+        srv = self._live_server(name)
+        if srv is None:
+            raise RuntimeError(
+                f"model {name!r} has no ModelServer — construct the "
+                "gateway with serving={...} to enable the serving tier")
+        return srv
 
     # -- train/infer ------------------------------------------------------
     def fit(self, name: str, features, labels, epochs: int = 1) -> float:
@@ -106,31 +197,92 @@ class EntryPoint:
                 np.asarray(labels, np.float32), epochs=epochs)
         return float(net.score_value)
 
-    def predict(self, name: str, features) -> np.ndarray:
-        return self._model(name).output(np.asarray(features, np.float32))
+    def predict(self, name: str, features,
+                timeout: Optional[float] = None) -> np.ndarray:
+        feats = np.asarray(features, np.float32)
+        net = self._model(name)
+        srv = self._live_server(name)
+        if srv is not None:
+            return srv.predict(feats, timeout=timeout)
+        return net.output(feats)
 
-    def evaluate(self, name: str, features, labels) -> dict:
-        from deeplearning4j_tpu.datasets.dataset import DataSet
+    def evaluate(self, name: str, features, labels,
+                 timeout: Optional[float] = None) -> dict:
+        feats = np.asarray(features, np.float32)
+        labs = np.asarray(labels, np.float32)
+        self._model(name)
+        srv = self._live_server(name)
+        if srv is not None:
+            # ride the serving tier so evaluation traffic obeys the same
+            # admission/deadline/breaker discipline as predictions
+            from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = self._model(name).evaluate(
-            DataSet(np.asarray(features, np.float32),
-                    np.asarray(labels, np.float32)))
+            out = srv.predict(feats, timeout=timeout)
+            ev = Evaluation()
+            ev.eval(labs, out)
+        else:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+
+            ev = self._model(name).evaluate(DataSet(feats, labs))
         return {"accuracy": ev.accuracy(), "precision": ev.precision(),
                 "recall": ev.recall(), "f1": ev.f1()}
 
     def score(self, name: str) -> Optional[float]:
         return self._model(name).score_value
 
+    # -- serving management ----------------------------------------------
+    def reload_model(self, name: str, path: str,
+                     step: Optional[int] = None) -> int:
+        """Hot-swap model `name` from a checkpoint file path or a
+        `CheckpointStore` directory (newest verified step when `step` is
+        None), with manifest verification + canary validation — a bad
+        candidate is rejected with the old model still serving. Returns
+        the new model_version."""
+        srv = self._server(name)
+        p = Path(path)
+        if p.is_dir():
+            from deeplearning4j_tpu.util.checkpoint_store import (
+                CheckpointStore,
+            )
+
+            source: Any = CheckpointStore(p)
+        else:
+            source = p
+        version = srv.reload(source, step=step)
+        self._models[name] = srv.net
+        return version
+
+    def server_stats(self, name: str) -> dict:
+        return self._server(name).stats()
+
+    def shutdown(self, drain_timeout: float = 10.0) -> None:
+        """Drain and stop every ModelServer (called by
+        `GatewayServer.stop`)."""
+        for srv in self._servers.values():
+            srv.shutdown(drain_timeout=drain_timeout)
+        self._servers.clear()
+
 
 class GatewayServer:
     """TCP JSON-RPC server (reference `Server.java` GatewayServer role).
 
     `port=0` picks an ephemeral port (see `.port` after `start()`).
-    """
+    `max_request_bytes` bounds one request line (oversize → typed error +
+    close); `recv_timeout` arms a per-connection socket timeout so a
+    silent client cannot pin a handler thread forever; `serving` enables
+    the ModelServer tier on the default EntryPoint (ignored when an
+    `entry_point` instance is passed — configure that one directly)."""
 
     def __init__(self, entry_point: Optional[EntryPoint] = None,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.entry = entry_point or EntryPoint()
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_request_bytes: int = 64 << 20,
+                 recv_timeout: Optional[float] = 600.0,
+                 serving: Optional[dict] = None):
+        if max_request_bytes < 1:
+            raise ValueError("max_request_bytes must be >= 1")
+        self.entry = entry_point or EntryPoint(serving=serving)
+        self.max_request_bytes = max_request_bytes
+        self.recv_timeout = recv_timeout
         self._host, self._requested_port = host, port
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -143,26 +295,69 @@ class GatewayServer:
 
     def start(self) -> "GatewayServer":
         entry = self.entry
+        max_bytes = self.max_request_bytes
+        recv_timeout = self.recv_timeout
 
         class Handler(socketserver.StreamRequestHandler):
+            # StreamRequestHandler.setup() arms this on the connection:
+            # a silent/stalled client raises socket.timeout out of
+            # readline instead of blocking the handler thread forever
+            timeout = recv_timeout
+
+            def _respond(self, resp: dict) -> bool:
+                try:
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+                    return True
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # client vanished mid-response: nothing to salvage
+                    logger.info("gateway: client disconnected mid-response")
+                    return False
+
             def handle(self):
-                for raw in self.rfile:
-                    req_id = None  # this request's id only — never a stale one
+                while True:
+                    try:
+                        raw = self.rfile.readline(max_bytes + 1)
+                    except (socket.timeout, TimeoutError):
+                        logger.warning(
+                            "gateway: closing connection idle past "
+                            "recv_timeout=%.1fs", recv_timeout)
+                        return
+                    except (ConnectionResetError, BrokenPipeError, OSError):
+                        return  # mid-request disconnect
+                    if not raw:
+                        return  # clean EOF
+                    if len(raw) > max_bytes:
+                        # the remainder of this line is unread; the
+                        # stream cannot be resynced — answer typed, close
+                        self._respond({
+                            "id": None,
+                            "error": f"RequestTooLargeError: request line "
+                                     f"exceeds max_request_bytes="
+                                     f"{max_bytes}",
+                            "error_type": "RequestTooLargeError"})
+                        return
+                    req_id = None  # this request's id only — never stale
                     try:
                         req = json.loads(raw)
                         if isinstance(req, dict):
                             req_id = req.get("id")
-                        method = getattr(entry, req["method"])
-                        if req["method"].startswith("_"):
+                        if req["method"].startswith("_") or req["method"] \
+                                in getattr(entry, "_RPC_EXCLUDED", ()):
                             raise AttributeError(req["method"])
+                        method = getattr(entry, req["method"])
                         params = decode_value(req.get("params", {}))
                         resp = {"id": req_id,
                                 "result": encode_value(method(**params))}
                     except Exception as e:  # surfaced to the client
                         resp = {"id": req_id,
-                                "error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write((json.dumps(resp) + "\n").encode())
-                    self.wfile.flush()
+                                "error": f"{type(e).__name__}: {e}",
+                                "error_type": type(e).__name__}
+                        retry_after = getattr(e, "retry_after", None)
+                        if retry_after is not None:
+                            resp["retry_after"] = float(retry_after)
+                    if not self._respond(resp):
+                        return
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -178,24 +373,68 @@ class GatewayServer:
         logger.info("gateway listening on %s:%d", self._host, self.port)
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 10.0) -> None:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        shutdown = getattr(self.entry, "shutdown", None)
+        if shutdown is not None:
+            shutdown(drain_timeout=drain_timeout)
 
 
 class GatewayClient:
     """Line-JSON client for GatewayServer (usable as a reference for
-    non-Python clients)."""
+    non-Python clients).
+
+    Connection-level failures (`ConnectionResetError`/`BrokenPipeError`,
+    or the server closing mid-call) on IDEMPOTENT methods are retried
+    once after `retry_backoff` seconds over a fresh connection — a
+    server restart or LB connection recycle costs one backoff, not a
+    failed call. Non-idempotent methods (`fit`, `create_model`, ...)
+    never auto-retry: the server may have applied the side effect before
+    the connection died. Server-side errors raise the typed
+    `GatewayError`."""
+
+    # safe to re-send after an ambiguous connection failure: read-only or
+    # naturally deduplicated on the server side
+    _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
+                             "server_stats"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
-                 timeout: float = 60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._file = self._sock.makefile("rwb")
+                 timeout: float = 60.0, retry_backoff: float = 0.05):
+        self._host, self._port, self._timeout = host, port, timeout
+        self.retry_backoff = retry_backoff
         self._next_id = 0
+        self._connect()
 
-    def call(self, method: str, **params):
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=self._timeout)
+        self._file = self._sock.makefile("rwb")
+
+    def call(self, method: str, _idempotent: Optional[bool] = None,
+             **params):
+        """Invoke `method` on the server's entry point. `_idempotent`
+        overrides the built-in retry whitelist for custom entry-point
+        methods."""
+        idempotent = (method in self._IDEMPOTENT if _idempotent is None
+                      else _idempotent)
+        try:
+            return self._call_once(method, params)
+        except ConnectionError as e:  # incl. reset/broken-pipe subclasses
+            if not idempotent:
+                raise
+            logger.warning("gateway client: %s during idempotent %r; "
+                           "reconnecting after %.3fs backoff",
+                           type(e).__name__, method, self.retry_backoff)
+            time.sleep(self.retry_backoff)
+            with contextlib.suppress(Exception):
+                self.close()
+            self._connect()
+            return self._call_once(method, params)
+
+    def _call_once(self, method: str, params: dict):
         self._next_id += 1
         req = {"id": self._next_id, "method": method,
                "params": encode_value(params)}
@@ -206,9 +445,15 @@ class GatewayClient:
             raise ConnectionError("gateway closed the connection")
         resp = json.loads(line)
         if "error" in resp:
-            raise RuntimeError(resp["error"])
+            raise GatewayError(resp["error"],
+                               error_type=resp.get("error_type"),
+                               retry_after=resp.get("retry_after"))
         return decode_value(resp["result"])
 
     def close(self):
-        self._file.close()
-        self._sock.close()
+        # best-effort: closing a connection the peer already dropped must
+        # not raise out of cleanup (the buffered writer flushes on close)
+        with contextlib.suppress(OSError):
+            self._file.close()
+        with contextlib.suppress(OSError):
+            self._sock.close()

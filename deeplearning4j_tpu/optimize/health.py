@@ -90,6 +90,21 @@ class QuarantineFullError(RuntimeError):
 # poisoned-batch helpers
 
 
+def non_finite_array_reason(a, name: str = "array") -> Optional[str]:
+    """Why this single array is poisoned, or None when clean: NaN/Inf in a
+    floating array (integer arrays are finite by construction). Shared by
+    the batch screen below and the serving tier's output screen
+    (`serving.model_server` runs it on every inference result before the
+    circuit breaker sees the step as a success)."""
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.floating):
+        return None
+    if not np.isfinite(a).all():
+        bad = np.count_nonzero(~np.isfinite(a))
+        return f"{name} contain {bad} non-finite value(s)"
+    return None
+
+
 def non_finite_batch_reason(ds) -> Optional[str]:
     """Why this batch would poison a training step, or None when clean:
     checks features/labels/masks for NaN/Inf (integer arrays are finite by
@@ -99,12 +114,9 @@ def non_finite_batch_reason(ds) -> Optional[str]:
         a = getattr(ds, name, None)
         if a is None:
             continue
-        a = np.asarray(a)
-        if not np.issubdtype(a.dtype, np.floating):
-            continue
-        if not np.isfinite(a).all():
-            bad = np.count_nonzero(~np.isfinite(a))
-            return f"{name} contain {bad} non-finite value(s)"
+        reason = non_finite_array_reason(a, name)
+        if reason is not None:
+            return reason
     return None
 
 

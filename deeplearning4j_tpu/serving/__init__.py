@@ -1,0 +1,39 @@
+"""Robust serving tier: admission control, per-request deadlines with
+adaptive micro-batching, circuit breaking, and safe hot model reload —
+the inference-path counterpart of the training robustness tier
+(elastic workers / durable checkpoints / health sentinel). See
+`docs/serving.md` for the ladder semantics and tuning knobs.
+"""
+from deeplearning4j_tpu.serving.chaos import (
+    BrokenModelInjector,
+    InjectedServingFault,
+    ReloadCorruptionInjector,
+    SlowInferenceInjector,
+)
+from deeplearning4j_tpu.serving.model_server import (
+    CircuitBreaker,
+    DeadlineExceededError,
+    InferenceFailedError,
+    ModelServer,
+    ModelValidationError,
+    ServerClosedError,
+    ServerOverloadedError,
+    ServiceUnavailableError,
+    ServingError,
+)
+
+__all__ = [
+    "BrokenModelInjector",
+    "CircuitBreaker",
+    "DeadlineExceededError",
+    "InferenceFailedError",
+    "InjectedServingFault",
+    "ModelServer",
+    "ModelValidationError",
+    "ReloadCorruptionInjector",
+    "ServerClosedError",
+    "ServerOverloadedError",
+    "ServiceUnavailableError",
+    "ServingError",
+    "SlowInferenceInjector",
+]

@@ -1,0 +1,138 @@
+"""Serving-tier chaos injectors, completing the fault-injection family
+started in `parallel/fault_tolerance.py` (worker crashes, checkpoint
+save-crashes, NaN gradients). These drive the three serving ladders the
+chaos suite (`tests/test_serving.py`) proves end to end:
+
+- overload → typed shed → recovery (`SlowInferenceInjector`),
+- breaker open → half-open probe → close (`BrokenModelInjector`),
+- reload-of-corrupt-candidate → rejection with the previous model still
+  serving (`ReloadCorruptionInjector`).
+
+`SlowInferenceInjector` and `BrokenModelInjector` plug into
+`ModelServer(infer_hooks=[...])` — called as `hook(phase, info)` at
+`pre_step`/`post_step` around every device dispatch.
+`ReloadCorruptionInjector` damages checkpoint artifacts on disk, the
+same corruption family `tests/test_checkpoint_durability.py` uses."""
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+class InjectedServingFault(RuntimeError):
+    """Raised by `BrokenModelInjector` inside the device step — the
+    server must translate it into a typed `InferenceFailedError` and
+    count it toward the circuit breaker, exactly like a real failure."""
+
+
+class SlowInferenceInjector:
+    """Deterministic serving straggler: every device step sleeps `delay`
+    seconds while `active`. With a delay ≫ the request arrival interval
+    the bounded queue fills and admission control MUST shed — the
+    overload drill. `release()` ends the slowdown (recovery phase);
+    `steps` counts affected dispatches."""
+
+    def __init__(self, delay: float = 0.2):
+        self.delay = delay
+        self.active = True
+        self.steps = 0
+
+    def release(self) -> None:
+        self.active = False
+
+    def __call__(self, phase: str, info: dict) -> None:
+        if phase == "pre_step" and self.active:
+            self.steps += 1
+            time.sleep(self.delay)
+
+
+class BrokenModelInjector:
+    """Model breakage on demand: while `active`, every device step
+    raises `InjectedServingFault` (mode='raise') or flags the step so a
+    test double can poison outputs. Drives the breaker ladder: failures
+    accumulate → breaker opens → `heal()` → the half-open probe succeeds
+    → breaker closes. `failures` counts injected faults."""
+
+    def __init__(self, mode: str = "raise"):
+        if mode not in ("raise",):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self.active = True
+        self.failures = 0
+        self._lock = threading.Lock()
+
+    def heal(self) -> None:
+        self.active = False
+
+    def break_again(self) -> None:
+        self.active = True
+
+    def __call__(self, phase: str, info: dict) -> None:
+        if phase == "pre_step" and self.active:
+            with self._lock:
+                self.failures += 1
+            raise InjectedServingFault(
+                "injected model breakage (serving chaos)")
+
+
+class ReloadCorruptionInjector:
+    """Damage a hot-reload candidate on disk before the server loads it.
+
+    Three corruption families, matching how real candidates go bad:
+
+    - `corrupt_payload(path)` — flip bytes mid-payload WITHOUT touching
+      the manifest: integrity verification must catch the drift
+      (`CheckpointCorruptError`) before any bytes are trusted.
+    - `truncate(path)` — cut the payload short (killed copy/download);
+      same typed outcome.
+    - `poison_params(store, step, net)` — the insidious one: write a
+      VALID, manifest-consistent checkpoint whose parameters are all
+      NaN. It loads cleanly; only the server's canary validation can
+      catch it (`ModelValidationError`).
+
+    `corruptions` counts injected damages."""
+
+    def __init__(self):
+        self.corruptions = 0
+
+    def corrupt_payload(self, path) -> Path:
+        path = Path(path)
+        data = bytearray(path.read_bytes())
+        mid = len(data) // 2
+        for i in range(mid, min(mid + 16, len(data))):
+            data[i] ^= 0xFF
+        path.write_bytes(bytes(data))
+        self.corruptions += 1
+        return path
+
+    def truncate(self, path, keep: int = 100) -> Path:
+        path = Path(path)
+        path.write_bytes(path.read_bytes()[:keep])
+        self.corruptions += 1
+        return path
+
+    def poison_params(self, store, step: int, net) -> Path:
+        """Commit a manifest-consistent checkpoint of `net` with every
+        parameter NaN into `store` at `step` — the candidate that MUST
+        be stopped by canary validation, not by integrity checks."""
+        from deeplearning4j_tpu.util.serialization import (
+            restore_model,
+            write_model,
+        )
+
+        # clone via serialize/restore so the live net is never touched
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            tmp = Path(d) / "clone.zip"
+            write_model(net, tmp)
+            clone = restore_model(tmp)
+        clone.set_params(np.full_like(np.asarray(clone.params()), np.nan))
+        path = store.save(step,
+                          lambda tmp_path: write_model(clone, tmp_path,
+                                                       atomic=False))
+        self.corruptions += 1
+        return path

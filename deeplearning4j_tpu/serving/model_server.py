@@ -1,0 +1,773 @@
+"""Robust model serving: admission control, deadlines, circuit breaking,
+safe hot reload.
+
+The reference stack ships a serve-from-streams path
+(`DL4jServeRouteBuilder.java`, SURVEY §dl4j-streaming) with none of the
+protections a "heavy traffic from millions of users" tier needs: a slow
+model backs requests up without bound, a broken model serves garbage
+forever, and swapping a model under live traffic means a window of broken
+predictions. `ModelServer` wraps a fitted `MultiLayerNetwork` /
+`ComputationGraph` behind four defenses, mirroring what PRs 1–3 did for
+training:
+
+- **admission control** — a bounded request queue plus a concurrency
+  limiter sized to device capacity (`max_concurrent` executor threads,
+  each dispatching one device step at a time). A full queue raises the
+  typed `ServerOverloadedError` carrying a `retry_after` hint (EWMA step
+  latency × backlog) instead of queueing unboundedly — load is shed at
+  the door, never absorbed until the process OOMs.
+- **per-request deadlines** — `predict(x, timeout=...)` stamps a
+  monotonic deadline. Expired requests are shed (typed
+  `DeadlineExceededError`) BEFORE touching the accelerator — at pop time
+  and again at batch-assembly time — and batch assembly never waits past
+  the earliest deadline in the forming batch.
+- **adaptive micro-batching** — concurrent predict calls with compatible
+  shapes coalesce into one device step (rows padded up to the next
+  power-of-two bucket ≤ `max_batch_size`, so the jitted forward compiles
+  O(log max_batch) shapes, not one per arrival pattern). Assembly waits
+  at most `batch_window` seconds for stragglers, bounded by the earliest
+  deadline.
+- **circuit breaking** — `breaker_threshold` CONSECUTIVE inference
+  failures (device-step exceptions or non-finite outputs, screened via
+  the PR-3 `optimize.health.non_finite_array_reason` helper) open the
+  breaker: requests fail fast with the typed `ServiceUnavailableError`
+  (`retry_after` = time until half-open) without touching the device.
+  After `breaker_reset_timeout` the breaker half-opens and admits ONE
+  probe batch; a healthy probe closes it, a failed probe re-opens it.
+- **safe hot reload** — `reload(source)` loads a candidate from a path or
+  a PR-2 `CheckpointStore` (integrity manifest verified before any bytes
+  are trusted), validates it on a canary batch (finite outputs, input
+  accepted, output width matching the live model), then swaps under a
+  read-write lock: in-flight requests finish on the old model, the first
+  request after the swap sees the new one, and a failed candidate is
+  rejected with a typed `ModelValidationError` /
+  `CheckpointCorruptError` while the old model keeps serving — no
+  request ever observes the bad model.
+
+`shutdown(drain_timeout)` stops admission (typed `ServerClosedError`),
+drains queued + in-flight requests for up to `drain_timeout` seconds,
+then fails whatever remains — a shutdown is a bounded event, not a hang.
+
+Chaos seam: `infer_hooks=[hook]` fires `hook(phase, info)` at
+`pre_step` / `post_step` around every device dispatch —
+`serving.chaos.SlowInferenceInjector` and `BrokenModelInjector` use it to
+drive the overload and breaker ladders end to end
+(`tests/test_serving.py`).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+# ---------------------------------------------------------------------------
+# typed give-up errors
+
+
+class ServingError(RuntimeError):
+    """Base class for every typed serving-tier give-up."""
+
+
+class ServerOverloadedError(ServingError):
+    """Admission control shed this request: the bounded queue is full.
+    `retry_after` (seconds) estimates when capacity frees up."""
+
+    def __init__(self, msg: str, retry_after: float = 0.1):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before (or while) it could be
+    served; it was shed without touching the accelerator."""
+
+
+class ServiceUnavailableError(ServingError):
+    """The circuit breaker is open (or the probe slot is taken while
+    half-open): recent inference failed repeatedly, so requests fail
+    fast instead of queueing behind a broken model. `retry_after`
+    (seconds) is the time until the next half-open probe window."""
+
+    def __init__(self, msg: str, retry_after: float = 0.1):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class InferenceFailedError(ServingError):
+    """The device step for this request's batch raised, or produced
+    non-finite outputs. Counted by the circuit breaker."""
+
+
+class ModelValidationError(ServingError):
+    """A hot-reload candidate failed canary validation (raised on the
+    canary batch, produced non-finite outputs, or changed the output
+    width). The previous model is still serving."""
+
+
+class ServerClosedError(ServingError):
+    """The server is shut (or shutting) down; no new requests are
+    admitted and unfinished queued requests fail with this."""
+
+
+# ---------------------------------------------------------------------------
+# read-write lock (hot reload swaps under the write side; every device
+# step holds the read side, so in-flight requests finish on the old model)
+
+
+class _RWLock:
+    """Writer-preferring reader-writer lock: once a writer is waiting,
+    new readers queue behind it, so a reload cannot be starved by a
+    steady request stream."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    @contextlib.contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if not self._readers:
+                    self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+
+class CircuitBreaker:
+    """Classic three-state breaker over consecutive failures.
+
+    closed --(threshold consecutive failures)--> open
+    open --(reset_timeout elapsed)--> half_open (one probe admitted)
+    half_open --(probe ok)--> closed; --(probe fails)--> open
+
+    Thread-safe; all transitions are logged. Successes anywhere reset
+    the consecutive-failure count."""
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout: float = 5.0,
+                 on_event: Optional[Callable[[str], None]] = None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        if reset_timeout <= 0:
+            raise ValueError("reset_timeout must be > 0")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.on_event = on_event
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._pending_events: List[str] = []
+        self.opens = 0  # telemetry: how many times the breaker tripped
+
+    def _transition(self, state: str) -> None:
+        if state != self._state:
+            logger.warning("circuit breaker: %s -> %s", self._state, state)
+            self._state = state
+            if state == "open":
+                self.opens += 1
+                self._opened_at = time.monotonic()
+            if self.on_event is not None:
+                self._pending_events.append(state)
+
+    def _take_events(self) -> List[str]:
+        events, self._pending_events = self._pending_events, []
+        return events
+
+    def _fire(self, events: List[str]) -> None:
+        # OUTSIDE the lock: a callback that reads .state / calls reset()
+        # must not deadlock against the transition that fired it
+        for state in events:
+            self.on_event(state)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            out, events = self._state, self._take_events()
+        self._fire(events)
+        return out
+
+    def _maybe_half_open(self) -> None:
+        if self._state == "open" and \
+                time.monotonic() - self._opened_at >= self.reset_timeout:
+            self._transition("half_open")
+            self._probe_in_flight = False
+
+    def _reject_open_locked(self) -> None:
+        if self._state == "open":
+            remaining = max(
+                0.0, self.reset_timeout
+                - (time.monotonic() - self._opened_at))
+            raise ServiceUnavailableError(
+                f"circuit breaker open after "
+                f"{self._consecutive_failures} consecutive inference "
+                f"failures; retry in {remaining:.3f}s",
+                retry_after=remaining)
+
+    def reject_if_open(self) -> None:
+        """Fail-fast door check: raises `ServiceUnavailableError` while
+        open, NEVER consumes the half-open probe slot (only `acquire`,
+        whose caller always reports success/failure, may take it — a
+        door check that took the slot could never give it back)."""
+        with self._lock:
+            self._maybe_half_open()
+            try:
+                self._reject_open_locked()
+            finally:
+                events = self._take_events()
+        self._fire(events)
+
+    def acquire(self) -> bool:
+        """Gate one unit of work. Raises `ServiceUnavailableError` when
+        open (retry_after = time to half-open) or when half-open with
+        the probe slot already taken. Returns True when the caller IS
+        the half-open probe — it MUST pass that token back to
+        `record_success`/`record_failure` (both release the slot; only
+        the probe's outcome drives half-open transitions, so a stale
+        pre-open step finishing late cannot corrupt the probe state)."""
+        with self._lock:
+            self._maybe_half_open()
+            try:
+                self._reject_open_locked()
+                probe = False
+                if self._state == "half_open":
+                    if self._probe_in_flight:
+                        raise ServiceUnavailableError(
+                            "circuit breaker half-open: probe in flight",
+                            retry_after=self.reset_timeout / 4)
+                    self._probe_in_flight = True
+                    probe = True
+            finally:
+                events = self._take_events()
+        self._fire(events)
+        return probe
+
+    def record_success(self, probe: bool = False) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if probe:
+                self._probe_in_flight = False
+                self._transition("closed")
+            # a stale (non-probe) success during open/half_open only
+            # resets the failure streak — the probe decides the state
+            events = self._take_events()
+        self._fire(events)
+
+    def record_failure(self, probe: bool = False) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if probe:
+                self._probe_in_flight = False
+                self._transition("open")  # failed probe: re-open
+            elif self._state == "closed" and \
+                    self._consecutive_failures >= self.failure_threshold:
+                self._transition("open")
+            events = self._take_events()
+        self._fire(events)
+
+    def reset(self) -> None:
+        """Force-close (used after a successful hot reload: the new
+        model's health is proven by the canary, not inherited)."""
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            self._transition("closed")
+            events = self._take_events()
+        self._fire(events)
+
+
+# ---------------------------------------------------------------------------
+# requests
+
+
+class _Request:
+    __slots__ = ("features", "deadline", "event", "result", "error",
+                 "enqueued_at")
+
+    def __init__(self, features, deadline: Optional[float]):
+        self.features = features
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) >= self.deadline
+
+    def finish(self, result=None, error: Optional[BaseException] = None):
+        self.result = result
+        self.error = error
+        self.event.set()
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    """Next power-of-two ≥ n, capped at max_batch — bounds the number of
+    distinct shapes the jitted forward ever compiles."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+# ---------------------------------------------------------------------------
+# the server
+
+
+class ModelServer:
+    """Admission-controlled, deadline-aware, breaker-protected serving
+    wrapper around a fitted network (see module docstring).
+
+    `predict(x)` is thread-safe and blocking: any number of caller
+    threads (gateway handlers, serve routes) may call it concurrently;
+    compatible concurrent calls coalesce into one device step.
+    """
+
+    def __init__(self, net, *, max_queue: int = 64, max_concurrent: int = 1,
+                 max_batch_size: int = 64, batch_window: float = 0.002,
+                 default_timeout: Optional[float] = None,
+                 breaker_threshold: int = 5,
+                 breaker_reset_timeout: float = 5.0,
+                 canary: Optional[np.ndarray] = None,
+                 auto_canary: bool = True,
+                 infer_hooks: Sequence[Callable] = (),
+                 pad_batches: bool = True):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._net = net
+        self.max_queue = max_queue
+        self.max_batch_size = max_batch_size
+        self.batch_window = batch_window
+        self.default_timeout = default_timeout
+        self.pad_batches = pad_batches
+        self.infer_hooks: List[Callable] = list(infer_hooks)
+        self.breaker = CircuitBreaker(failure_threshold=breaker_threshold,
+                                      reset_timeout=breaker_reset_timeout)
+        self._canary = None if canary is None else np.asarray(canary)
+        # with auto_canary, the first successfully-served request donates
+        # its leading row as the reload-validation batch — a server that
+        # has taken traffic can always validate a candidate
+        self.auto_canary = auto_canary
+        self._rwlock = _RWLock()
+        self._reload_lock = threading.Lock()
+        self.model_version = 0
+        # queue machinery: a deque under one condition (executors need to
+        # peek deadlines and pop several compatible requests per batch,
+        # which queue.Queue cannot express)
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._in_flight = 0
+        self._closed = False
+        self._step_latency_ewma = 0.01  # retry_after hint seed
+        # counters (observable state for tests/telemetry)
+        self.served = 0          # requests completed successfully
+        self.batches = 0         # device steps dispatched
+        self.shed_overload = 0   # rejected at admission (queue full)
+        self.shed_deadline = 0   # expired before the device step
+        self.shed_unavailable = 0  # rejected by the open breaker
+        self.failures = 0        # requests failed by a bad device step
+        self.reloads = 0
+        self.reload_rejections = 0
+        self._threads = [
+            threading.Thread(target=self._serve_loop, daemon=True,
+                             name=f"model-server-exec-{i}")
+            for i in range(max_concurrent)]
+        for t in self._threads:
+            t.start()
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def net(self):
+        """The live model (read-only peek; swapped by `reload`)."""
+        return self._net
+
+    def stats(self) -> dict:
+        with self._cond:
+            queued = len(self._queue)
+        return {"served": self.served, "batches": self.batches,
+                "shed_overload": self.shed_overload,
+                "shed_deadline": self.shed_deadline,
+                "shed_unavailable": self.shed_unavailable,
+                "failures": self.failures, "reloads": self.reloads,
+                "reload_rejections": self.reload_rejections,
+                "breaker_state": self.breaker.state,
+                "breaker_opens": self.breaker.opens,
+                "model_version": self.model_version, "queued": queued}
+
+    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        """Serve one request: features `x` of shape (B, ...). Blocks
+        until the result is ready or a typed give-up fires
+        (`ServerOverloadedError`, `DeadlineExceededError`,
+        `ServiceUnavailableError`, `InferenceFailedError`,
+        `ServerClosedError`). `timeout` (seconds; `default_timeout` when
+        None) stamps the request's deadline."""
+        x = np.asarray(x)
+        if x.ndim < 2:
+            raise ValueError(
+                f"predict expects a batched (B, ...) array, got shape "
+                f"{x.shape} — wrap a single example as x[None]")
+        timeout = self.default_timeout if timeout is None else timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # fail fast at the door while the breaker is open: these requests
+        # must not consume queue capacity that recovered traffic needs
+        # (reject_if_open never takes the half-open probe slot — only the
+        # executor's acquire/record pair may)
+        try:
+            self.breaker.reject_if_open()
+        except ServiceUnavailableError:
+            with self._cond:
+                self.shed_unavailable += 1
+            raise
+        req = _Request(x, deadline)
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("model server is shut down")
+            if len(self._queue) >= self.max_queue:
+                self.shed_overload += 1
+                # backlog ÷ capacity × EWMA step latency: how long until
+                # the queue has likely drained enough to admit us
+                retry = max(0.001, self._step_latency_ewma
+                            * (len(self._queue) / max(1, len(self._threads))
+                               / max(1, self.max_batch_size) + 1))
+                raise ServerOverloadedError(
+                    f"request queue full ({self.max_queue} pending); "
+                    f"retry in {retry:.3f}s", retry_after=retry)
+            self._queue.append(req)
+            self._cond.notify()
+        wait = None if deadline is None \
+            else max(0.0, deadline - time.monotonic()) + 30.0
+        if not req.event.wait(wait):  # executor always finishes requests;
+            raise InferenceFailedError(  # this is a belt-and-braces bound
+                "request was never completed (executor stalled)")
+        if req.error is not None:
+            raise req.error
+        with self._cond:
+            self.served += 1
+        return req.result
+
+    def __call__(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        return self.predict(x, timeout=timeout)
+
+    # -- batch assembly ----------------------------------------------------
+    def _pop_expired(self, req: _Request, now: float) -> bool:
+        if req.expired(now):
+            self.shed_deadline += 1
+            req.finish(error=DeadlineExceededError(
+                f"deadline expired {now - req.deadline:.3f}s ago while "
+                "queued; request shed before the device step"))
+            return True
+        return False
+
+    def _assemble(self) -> Optional[List[_Request]]:
+        """Pop one deadline-respecting micro-batch (None = shut down and
+        queue drained). Waits up to `batch_window` after the first
+        request for compatible stragglers, but never past the earliest
+        deadline in the forming batch."""
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                while self._queue and self._pop_expired(self._queue[0], now):
+                    self._queue.popleft()
+                if self._queue:
+                    break
+                if self._closed:
+                    return None
+                self._cond.wait(0.05)
+            first = self._queue.popleft()
+            batch = [first]
+            rows = first.features.shape[0]
+            shape, dtype = first.features.shape[1:], first.features.dtype
+            # the straggler window closes EARLY enough that the batch can
+            # still make its tightest deadline: deadline minus the EWMA
+            # step latency, never merely the deadline itself
+            margin = self._step_latency_ewma
+
+            def _bound(end, deadline):
+                return end if deadline is None \
+                    else min(end, deadline - margin)
+
+            window_end = _bound(time.monotonic() + self.batch_window,
+                                first.deadline)
+            while rows < self.max_batch_size:
+                now = time.monotonic()
+                if self._queue:
+                    nxt = self._queue[0]
+                    if self._pop_expired(nxt, now):
+                        self._queue.popleft()
+                        continue
+                    if nxt.features.shape[1:] != shape \
+                            or nxt.features.dtype != dtype \
+                            or rows + nxt.features.shape[0] \
+                            > self.max_batch_size:
+                        break  # incompatible/overflow: next batch's problem
+                    self._queue.popleft()
+                    batch.append(nxt)
+                    rows += nxt.features.shape[0]
+                    window_end = _bound(window_end, nxt.deadline)
+                    continue
+                if now >= window_end or self._closed:
+                    break
+                self._cond.wait(window_end - now)
+            self._in_flight += len(batch)
+            return batch
+
+    def _finish(self, batch: List[_Request], *, results=None, error=None):
+        for i, req in enumerate(batch):
+            req.finish(result=None if results is None else results[i],
+                       error=error)
+        with self._cond:
+            self._in_flight -= len(batch)
+            self._cond.notify_all()
+
+    # -- the device step ---------------------------------------------------
+    def _hook(self, phase: str, info: dict) -> None:
+        for hook in self.infer_hooks:
+            hook(phase, info)
+
+    def _serve_loop(self) -> None:
+        while True:
+            batch = self._assemble()
+            if batch is None:
+                return
+            # final pre-accelerator deadline screen: assembly may have
+            # waited on a window; expired members are shed, not computed
+            now = time.monotonic()
+            live = []
+            with self._cond:
+                for req in batch:
+                    if req.expired(now):
+                        self.shed_deadline += 1
+                        self._in_flight -= 1
+                        req.finish(error=DeadlineExceededError(
+                            "deadline expired during batch assembly; "
+                            "request shed before the device step"))
+                    else:
+                        live.append(req)
+                if not live:
+                    self._cond.notify_all()
+            if not live:
+                continue
+            try:
+                probe = self.breaker.acquire()
+            except ServiceUnavailableError as e:
+                with self._cond:
+                    self.shed_unavailable += len(live)
+                self._finish(live, error=e)
+                continue
+            try:
+                results = self._execute(live)
+            except BaseException as e:
+                self.breaker.record_failure(probe)
+                with self._cond:
+                    self.failures += len(live)
+                err = e if isinstance(e, ServingError) else \
+                    InferenceFailedError(
+                        f"device step failed: {type(e).__name__}: {e}")
+                logger.warning("model server: inference failure (%s)", err)
+                self._finish(live, error=err)
+                continue
+            self.breaker.record_success(probe)
+            self._finish(live, results=results)
+
+    def _execute(self, batch: List[_Request]) -> List[np.ndarray]:
+        from deeplearning4j_tpu.optimize.health import non_finite_array_reason
+
+        feats = np.concatenate([r.features for r in batch], axis=0) \
+            if len(batch) > 1 else batch[0].features
+        rows = feats.shape[0]
+        padded = rows
+        if self.pad_batches:
+            padded = _bucket(rows, self.max_batch_size)
+            if padded > rows:
+                pad = np.zeros((padded - rows,) + feats.shape[1:],
+                               feats.dtype)
+                feats = np.concatenate([feats, pad], axis=0)
+        info = {"batch_size": rows, "padded_size": padded,
+                "requests": len(batch), "model_version": self.model_version}
+        t0 = time.monotonic()
+        with self._rwlock.read():
+            self._hook("pre_step", info)
+            out = np.asarray(self._net.output(feats))
+            self._hook("post_step", info)
+        with self._cond:  # concurrent executors must not lose updates
+            self._step_latency_ewma = (0.8 * self._step_latency_ewma
+                                       + 0.2 * (time.monotonic() - t0))
+            self.batches += 1
+        out = out[:rows]
+        reason = non_finite_array_reason(out, "outputs")
+        if reason is not None:
+            raise InferenceFailedError(
+                f"model produced poisoned predictions: {reason}")
+        if self._canary is None and self.auto_canary:
+            self._canary = np.array(batch[0].features[:1])
+        results, lo = [], 0
+        for req in batch:
+            hi = lo + req.features.shape[0]
+            results.append(out[lo:hi])
+            lo = hi
+        return results
+
+    # -- hot reload --------------------------------------------------------
+    def reload(self, source, step: Optional[int] = None,
+               canary: Optional[np.ndarray] = None) -> int:
+        """Safely swap in a new model under live traffic.
+
+        `source` is a checkpoint path or a `util.checkpoint_store
+        .CheckpointStore` (newest verified step when `step` is None).
+        The candidate's integrity manifest is verified before any bytes
+        are trusted, then the candidate must pass canary validation
+        (accept the canary batch, produce finite outputs of the live
+        model's output width) BEFORE the swap: a failed candidate raises
+        `CheckpointCorruptError` / `ModelValidationError` with the old
+        model still serving. The swap itself happens under the write
+        lock — in-flight requests finish on the old model — and resets
+        the circuit breaker. Returns the new `model_version`."""
+        with self._reload_lock:
+            try:
+                candidate = self._load_candidate(source, step)
+                self._validate_candidate(candidate, canary)
+            except Exception:
+                # every pre-swap failure is a rejected deploy: integrity
+                # (CheckpointCorruptError) and canary rejections alike
+                # must show in the telemetry counter
+                with self._cond:
+                    self.reload_rejections += 1
+                raise
+            with self._rwlock.write():
+                self._net = candidate
+                self.model_version += 1
+                version = self.model_version
+            self.breaker.reset()
+            self.reloads += 1
+            logger.warning("model server: hot reload complete "
+                           "(model_version=%d)", version)
+            return version
+
+    def _load_candidate(self, source, step: Optional[int]):
+        from deeplearning4j_tpu.util.checkpoint_store import (
+            CheckpointStore,
+            manifest_path_for,
+            verify_manifest,
+        )
+        from deeplearning4j_tpu.util.serialization import restore_model
+
+        if isinstance(source, CheckpointStore):
+            if step is None:
+                candidate, got = source.load_latest_verified(restore_model)
+                logger.info("reload candidate: checkpoint step %d", got)
+                return candidate
+            source.verify(step)
+            return restore_model(source.path_for(step))
+        path = Path(source)
+        if manifest_path_for(path).exists():
+            verify_manifest(path)  # raises CheckpointCorruptError on drift
+        else:
+            logger.warning("reload candidate %s has no integrity manifest; "
+                           "loading unverified", path)
+        return restore_model(path)
+
+    def _validate_candidate(self, candidate,
+                            canary: Optional[np.ndarray]) -> None:
+        from deeplearning4j_tpu.optimize.health import non_finite_array_reason
+
+        canary = canary if canary is not None else self._canary
+        if canary is None:
+            logger.warning("model server: no canary batch configured — "
+                           "hot-reload candidate swaps in UNVALIDATED "
+                           "(pass canary= to the server or to reload())")
+            return
+        canary = np.asarray(canary)
+        try:
+            out = np.asarray(candidate.output(canary))
+        except Exception as e:
+            raise ModelValidationError(
+                f"reload candidate rejected: canary batch of shape "
+                f"{canary.shape} raised {type(e).__name__}: {e}") from e
+        reason = non_finite_array_reason(out, "canary outputs")
+        if reason is not None:
+            raise ModelValidationError(
+                f"reload candidate rejected: {reason} on the canary batch "
+                "(non-finite parameters or a numerically broken graph)")
+        try:
+            live_out = np.asarray(self._net.output(canary))
+        except Exception:
+            live_out = None  # live model can't serve the canary; skip the
+        if live_out is not None \
+                and live_out.shape[1:] != out.shape[1:]:  # width contract
+            raise ModelValidationError(
+                f"reload candidate rejected: output shape {out.shape[1:]} "
+                f"!= live model's {live_out.shape[1:]} — clients would "
+                "observe a silent contract break")
+
+    # -- shutdown ----------------------------------------------------------
+    def shutdown(self, drain_timeout: float = 10.0) -> bool:
+        """Stop admission, drain queued + in-flight requests for up to
+        `drain_timeout` seconds, fail the rest with `ServerClosedError`,
+        and join the executor threads. Returns True when every admitted
+        request finished (clean drain), False when stragglers were
+        failed at the timeout. Idempotent."""
+        deadline = time.monotonic() + drain_timeout
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        drained = True
+        with self._cond:
+            while self._queue or self._in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    drained = False
+                    while self._queue:
+                        self._queue.popleft().finish(
+                            error=ServerClosedError(
+                                "server shut down before this request "
+                                "could be served"))
+                    break
+                self._cond.wait(min(remaining, 0.05))
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()) + 1.0)
+        if not drained:
+            logger.warning("model server: shutdown drain timed out with "
+                           "requests still pending")
+        return drained

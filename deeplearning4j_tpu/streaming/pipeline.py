@@ -333,18 +333,57 @@ class StreamingTrainPipeline:
 
 class ServeRoute:
     """Model-serving route: feature stream → predictions → sink (reference
-    `DL4jServeRouteBuilder.java`)."""
+    `DL4jServeRouteBuilder.java`).
 
-    def __init__(self, net, source: Source, sink: Sink):
+    `net` may be a bare network (historical behavior: direct jitted
+    `output()` per record) or a `serving.ModelServer` — then every
+    record rides the robust serving tier (admission control, deadlines,
+    circuit breaker, hot reload under live traffic) and a typed shed
+    (`ServingError`) costs a counted drop + optional `on_shed` callback
+    instead of killing the route: a stream consumer must outlive an
+    overload or breaker-open window. `served`/`shed` expose the
+    counts; `request_timeout` stamps each record's deadline."""
+
+    def __init__(self, net, source: Source, sink: Sink,
+                 on_shed: Optional[Callable[[Any, Exception], None]] = None,
+                 request_timeout: Optional[float] = None):
         self.net = net
         self.source = source
         self.sink = sink
+        self.on_shed = on_shed
+        self.request_timeout = request_timeout
+        self.served = 0
+        self.shed = 0
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
 
     def run(self) -> None:
+        from deeplearning4j_tpu.serving.model_server import (
+            ModelServer,
+            ServerClosedError,
+            ServingError,
+        )
+
+        server = self.net if isinstance(self.net, ModelServer) else None
         for feats in self.source:
-            self.sink(self.net.output(np.asarray(feats, np.float32)))
+            feats = np.asarray(feats, np.float32)
+            if server is None:
+                self.sink(self.net.output(feats))
+                self.served += 1
+                continue
+            try:
+                out = server.predict(feats, timeout=self.request_timeout)
+            except ServerClosedError:
+                raise  # route's backend is gone: a route-level event
+            except ServingError as e:
+                self.shed += 1
+                logger.warning("serve route: record shed (%s: %s); "
+                               "route continues", type(e).__name__, e)
+                if self.on_shed is not None:
+                    self.on_shed(feats, e)
+                continue
+            self.sink(out)
+            self.served += 1
 
     def start(self) -> "ServeRoute":
         def _guard():

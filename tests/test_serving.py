@@ -1,0 +1,508 @@
+"""Serving-tier chaos suite: the three ladders the robust serving tier
+must prove end to end (ISSUE 4 acceptance contract):
+
+1. overload → typed shed (`ServerOverloadedError` + retry_after) →
+   recovery, with zero dropped in-flight requests;
+2. circuit breaker open → half-open probe → close (and failed-probe
+   re-open), with non-finite outputs counted as failures;
+3. hot reload of a corrupt / non-finite / contract-breaking candidate →
+   typed rejection with the previous model still serving — no request
+   ever observes the bad model.
+
+Plus the deadline discipline (expired requests shed before the device,
+batch assembly bounded by the earliest deadline), micro-batch
+coalescing, graceful drain, and the streaming serve route riding the
+server."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.serving import (
+    BrokenModelInjector,
+    DeadlineExceededError,
+    InferenceFailedError,
+    ModelServer,
+    ModelValidationError,
+    ReloadCorruptionInjector,
+    ServerClosedError,
+    ServerOverloadedError,
+    ServiceUnavailableError,
+    SlowInferenceInjector,
+)
+from deeplearning4j_tpu.util.checkpoint_store import (
+    CheckpointCorruptError,
+    CheckpointStore,
+)
+from deeplearning4j_tpu.util.serialization import write_model
+
+
+def _conf(n_out=3, seed=7):
+    return (dl4j.NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.3)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=n_out,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, 3, n)
+    x = (rng.normal(size=(n, 4)) + c[:, None]).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[c]
+
+
+@pytest.fixture(scope="module")
+def net():
+    n = dl4j.MultiLayerNetwork(_conf())
+    n.init()
+    return n
+
+
+@pytest.fixture()
+def x():
+    return _data()[0]
+
+
+@pytest.fixture()
+def server_factory(net):
+    servers = []
+
+    def make(the_net=None, **kw):
+        srv = ModelServer(the_net if the_net is not None else net, **kw)
+        servers.append(srv)
+        return srv
+
+    yield make
+    for srv in servers:
+        srv.shutdown(drain_timeout=5.0)
+
+
+# ---------------------------------------------------------------- basics
+def test_predict_matches_direct_output(server_factory, net, x):
+    srv = server_factory()
+    np.testing.assert_allclose(srv.predict(x), net.output(x), atol=1e-6)
+    assert srv.stats()["served"] == 1
+
+
+def test_predict_rejects_unbatched_input(server_factory, x):
+    srv = server_factory()
+    with pytest.raises(ValueError, match="batched"):
+        srv.predict(x[0])
+
+
+def test_concurrent_predicts_coalesce_into_one_step(server_factory, net, x):
+    """While one slow step occupies the device, queued compatible
+    requests must assemble into a single device step."""
+    batch_sizes = []
+
+    def spy(phase, info):
+        if phase == "pre_step":
+            batch_sizes.append(info["requests"])
+
+    slow = SlowInferenceInjector(delay=0.3)
+    srv = server_factory(infer_hooks=[slow, spy], max_batch_size=32,
+                         max_queue=32)
+    results = [None] * 7
+
+    def call(i):
+        results[i] = srv.predict(x[i:i + 1], timeout=30)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(7)]
+    threads[0].start()
+    time.sleep(0.1)  # t0 is on the device; the rest arrive while it runs
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.1)
+    slow.release()
+    for t in threads:
+        t.join()
+    assert all(r is not None and r.shape == (1, 3) for r in results)
+    assert srv.stats()["served"] == 7
+    assert max(batch_sizes) > 1, f"no coalescing observed: {batch_sizes}"
+    for i, r in enumerate(results):
+        np.testing.assert_allclose(r, net.output(x[i:i + 1]), atol=1e-5)
+
+
+# ----------------------------------------------- ladder 1: overload/shed
+@pytest.mark.chaos
+def test_overload_typed_shed_then_recovery(server_factory, x):
+    """Queue full → typed `ServerOverloadedError` with a retry_after
+    hint; every ADMITTED request completes (zero dropped in-flight);
+    after the slowdown ends the server serves normally again."""
+    slow = SlowInferenceInjector(delay=0.25)
+    srv = server_factory(max_queue=3, max_batch_size=4, infer_hooks=[slow])
+    outcomes = []
+    lock = threading.Lock()
+
+    def flood():
+        try:
+            out = srv.predict(x[:2], timeout=30)
+            with lock:
+                outcomes.append(("ok", out.shape))
+        except ServerOverloadedError as e:
+            assert e.retry_after > 0
+            with lock:
+                outcomes.append(("shed", None))
+
+    threads = [threading.Thread(target=flood) for _ in range(12)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    slow.release()
+    for t in threads:
+        t.join()
+    served = sum(1 for kind, _ in outcomes if kind == "ok")
+    shed = sum(1 for kind, _ in outcomes if kind == "shed")
+    stats = srv.stats()
+    assert shed > 0, "overload must shed"
+    assert served + shed == 12, "every request got a typed outcome"
+    # zero dropped in-flight: everything admitted was served, correctly
+    assert stats["served"] == served
+    assert stats["shed_overload"] == shed
+    assert all(shape == (2, 3) for kind, shape in outcomes if kind == "ok")
+    # recovery: the un-slowed server serves immediately
+    assert srv.predict(x, timeout=5).shape == (32, 3)
+    assert srv.stats()["queued"] == 0
+
+
+# -------------------------------------------------- ladder 1b: deadlines
+@pytest.mark.chaos
+def test_expired_request_shed_before_device(server_factory, x):
+    """A request whose deadline expires while the device is busy must be
+    shed with `DeadlineExceededError` and never dispatched."""
+    rows_stepped = []
+
+    def spy(phase, info):
+        if phase == "pre_step":
+            rows_stepped.append(info["batch_size"])
+
+    slow = SlowInferenceInjector(delay=0.4)
+    srv = server_factory(infer_hooks=[slow, spy], max_queue=8)
+    t = threading.Thread(target=lambda: srv.predict(x, timeout=30))
+    t.start()
+    time.sleep(0.1)  # the slow step is on the device
+    with pytest.raises(DeadlineExceededError):
+        srv.predict(x[:5], timeout=0.05)
+    slow.release()
+    t.join()
+    assert srv.stats()["shed_deadline"] == 1
+    assert 5 not in rows_stepped, "expired request reached the device"
+
+
+def test_batch_assembly_bounded_by_earliest_deadline(server_factory, x):
+    """With a pathological batch_window, a deadlined request must still
+    be served promptly — assembly never waits past the deadline."""
+    srv = server_factory(batch_window=10.0)
+    t0 = time.monotonic()
+    out = srv.predict(x, timeout=0.5)
+    elapsed = time.monotonic() - t0
+    assert out.shape == (32, 3)
+    assert elapsed < 5.0, f"assembly waited the full window ({elapsed:.1f}s)"
+
+
+# ------------------------------------------------ ladder 2: the breaker
+@pytest.mark.chaos
+def test_breaker_opens_fails_fast_half_opens_closes(server_factory, x):
+    brk = BrokenModelInjector()
+    srv = server_factory(infer_hooks=[brk], breaker_threshold=3,
+                         breaker_reset_timeout=0.4)
+    for _ in range(3):
+        with pytest.raises(InferenceFailedError, match="injected"):
+            srv.predict(x)
+    assert srv.breaker.state == "open"
+    # open: fail fast, typed, with a retry hint, device untouched
+    t0 = time.monotonic()
+    with pytest.raises(ServiceUnavailableError) as ei:
+        srv.predict(x)
+    assert time.monotonic() - t0 < 0.25
+    assert ei.value.retry_after > 0
+    batches_while_open = srv.stats()["batches"]
+    # half-open probe after the reset timeout; healed model closes it
+    brk.heal()
+    time.sleep(0.5)
+    out = srv.predict(x)
+    assert out.shape == (32, 3)
+    assert srv.breaker.state == "closed"
+    stats = srv.stats()
+    assert stats["breaker_opens"] == 1
+    assert stats["batches"] == batches_while_open + 1
+
+
+@pytest.mark.chaos
+def test_breaker_failed_probe_reopens(server_factory, x):
+    brk = BrokenModelInjector()
+    srv = server_factory(infer_hooks=[brk], breaker_threshold=2,
+                         breaker_reset_timeout=0.3)
+    for _ in range(2):
+        with pytest.raises(InferenceFailedError):
+            srv.predict(x)
+    assert srv.breaker.state == "open"
+    time.sleep(0.35)
+    with pytest.raises(InferenceFailedError):
+        srv.predict(x)  # the probe — still broken
+    assert srv.breaker.state == "open"
+    assert srv.stats()["breaker_opens"] == 2
+    brk.heal()
+    time.sleep(0.35)
+    assert srv.predict(x).shape == (32, 3)
+    assert srv.breaker.state == "closed"
+
+
+@pytest.mark.chaos
+def test_non_finite_outputs_count_as_breaker_failures(server_factory, x):
+    """A model emitting NaN predictions is broken even though the device
+    step 'succeeds' — the PR-3 non-finite screen must feed the
+    breaker."""
+    poisoned = dl4j.MultiLayerNetwork(_conf())
+    poisoned.init()
+    poisoned.set_params(np.full_like(np.asarray(poisoned.params()), np.nan))
+    srv = server_factory(the_net=poisoned, breaker_threshold=2,
+                         breaker_reset_timeout=60.0)
+    for _ in range(2):
+        with pytest.raises(InferenceFailedError, match="non-finite"):
+            srv.predict(x)
+    assert srv.breaker.state == "open"
+    with pytest.raises(ServiceUnavailableError):
+        srv.predict(x)
+
+
+# ----------------------------------------------- ladder 3: hot reload
+def _fitted_clone(seed=1, epochs=5, n_out=3):
+    net = dl4j.MultiLayerNetwork(_conf(n_out=n_out, seed=seed))
+    net.init()
+    x, y = _data(48, seed=seed)
+    if n_out == 3:
+        net.fit(DataSet(x, y), epochs=epochs)
+    return net
+
+
+def test_hot_reload_swaps_atomically(server_factory, net, x, tmp_path):
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    srv = server_factory(canary=x[:2])
+    before = srv.predict(x)
+    assert srv.reload(store) == 1
+    after = srv.predict(x)
+    assert not np.allclose(before, after), "reload did not swap the model"
+    np.testing.assert_allclose(after, candidate.output(x), atol=1e-5)
+    assert srv.stats()["reloads"] == 1
+
+
+@pytest.mark.chaos
+def test_inflight_requests_finish_on_old_model(server_factory, net, x,
+                                               tmp_path):
+    """A request already on the device when reload() lands must be
+    answered by the OLD model; the next request sees the new one."""
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    slow = SlowInferenceInjector(delay=0.4)
+    srv = server_factory(canary=x[:2], infer_hooks=[slow])
+    old_expected = net.output(x)
+    got = {}
+
+    def inflight():
+        got["out"] = srv.predict(x, timeout=30)
+
+    t = threading.Thread(target=inflight)
+    t.start()
+    time.sleep(0.1)  # in flight on the old model
+    srv.reload(store)  # blocks on the write lock until in-flight drains
+    t.join()
+    slow.release()
+    np.testing.assert_allclose(got["out"], old_expected, atol=1e-5)
+    np.testing.assert_allclose(srv.predict(x), candidate.output(x),
+                               atol=1e-5)
+
+
+@pytest.mark.chaos
+def test_reload_corrupt_candidate_rejected_old_model_serves(
+        server_factory, net, x, tmp_path):
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    inj = ReloadCorruptionInjector()
+    path = store.save(1, lambda tmp: write_model(candidate, tmp,
+                                                 atomic=False))
+    inj.corrupt_payload(path)
+    srv = server_factory(canary=x[:2])
+    before = srv.predict(x)
+    with pytest.raises(CheckpointCorruptError):
+        srv.reload(store, step=1)
+    np.testing.assert_allclose(srv.predict(x), before, atol=1e-6)
+    assert srv.stats()["model_version"] == 0
+
+
+@pytest.mark.chaos
+def test_reload_truncated_candidate_rejected(server_factory, x, tmp_path):
+    store = CheckpointStore(tmp_path)
+    inj = ReloadCorruptionInjector()
+    path = store.save(1, lambda tmp: write_model(_fitted_clone(), tmp,
+                                                 atomic=False))
+    inj.truncate(path)
+    srv = server_factory(canary=x[:2])
+    before = srv.predict(x)
+    with pytest.raises(CheckpointCorruptError):
+        srv.reload(store, step=1)
+    np.testing.assert_allclose(srv.predict(x), before, atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_reload_poisoned_candidate_rejected_by_canary(server_factory, net,
+                                                      x, tmp_path):
+    """NaN-parameter candidate: manifest-consistent, loads cleanly —
+    only canary validation can stop it, and must."""
+    store = CheckpointStore(tmp_path)
+    inj = ReloadCorruptionInjector()
+    inj.poison_params(store, 1, net)
+    srv = server_factory(canary=x[:2])
+    before = srv.predict(x)
+    with pytest.raises(ModelValidationError, match="non-finite"):
+        srv.reload(store, step=1)
+    stats = srv.stats()
+    assert stats["reload_rejections"] == 1 and stats["model_version"] == 0
+    np.testing.assert_allclose(srv.predict(x), before, atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_reload_fallback_skips_corrupt_newest(server_factory, x, tmp_path):
+    """Step-less reload walks newest→oldest verified: a corrupt newest
+    checkpoint is skipped, the older good one swaps in."""
+    store = CheckpointStore(tmp_path)
+    good = _fitted_clone()
+    store.save(1, lambda tmp: write_model(good, tmp, atomic=False))
+    bad_path = store.save(2, lambda tmp: write_model(_fitted_clone(seed=3),
+                                                     tmp, atomic=False))
+    ReloadCorruptionInjector().corrupt_payload(bad_path)
+    srv = server_factory(canary=x[:2])
+    assert srv.reload(store) == 1
+    np.testing.assert_allclose(srv.predict(x), good.output(x), atol=1e-5)
+
+
+def test_reload_output_width_change_rejected(server_factory, x, tmp_path):
+    """A candidate that silently changes the output width breaks every
+    client; canary validation must refuse it."""
+    store = CheckpointStore(tmp_path)
+    wide = _fitted_clone(n_out=5)
+    store.save(1, lambda tmp: write_model(wide, tmp, atomic=False))
+    srv = server_factory(canary=x[:2])
+    srv.predict(x)
+    with pytest.raises(ModelValidationError, match="output shape"):
+        srv.reload(store, step=1)
+    assert srv.stats()["model_version"] == 0
+
+
+def test_auto_canary_arms_reload_validation(server_factory, net, x,
+                                            tmp_path):
+    """Without an explicit canary, the first served request donates one:
+    a later poisoned reload is still caught."""
+    store = CheckpointStore(tmp_path)
+    ReloadCorruptionInjector().poison_params(store, 1, net)
+    srv = server_factory()  # no canary=
+    srv.predict(x)  # donates x[:1] as the auto-canary
+    with pytest.raises(ModelValidationError):
+        srv.reload(store, step=1)
+
+
+# -------------------------------------------------------------- shutdown
+def test_shutdown_drains_then_rejects(server_factory, x):
+    slow = SlowInferenceInjector(delay=0.2)
+    srv = server_factory(infer_hooks=[slow], max_queue=8)
+    results = []
+
+    def call():
+        results.append(srv.predict(x[:2], timeout=30).shape)
+
+    threads = [threading.Thread(target=call) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    slow.release()
+    assert srv.shutdown(drain_timeout=10.0) is True
+    for t in threads:
+        t.join()
+    assert len(results) == 3, "admitted requests were dropped by shutdown"
+    with pytest.raises(ServerClosedError):
+        srv.predict(x)
+
+
+# -------------------------------------------------- streaming serve path
+@pytest.mark.chaos
+def test_serve_route_rides_server_and_survives_shedding(net, x):
+    """The streaming serve route through a ModelServer: a breaker-open
+    window sheds records (counted, route alive) and recovery resumes
+    serving — the route never dies to a typed serving error."""
+    from deeplearning4j_tpu.streaming import QueueSink, QueueSource, ServeRoute
+
+    brk = BrokenModelInjector()
+    brk.heal()  # starts healthy
+    srv = ModelServer(net, breaker_threshold=2, breaker_reset_timeout=60.0,
+                      infer_hooks=[brk])
+    try:
+        src = QueueSource()
+        sink = QueueSink()
+        shed_records = []
+        route = ServeRoute(srv, src, sink,
+                           on_shed=lambda f, e: shed_records.append(f))
+        route_thread = threading.Thread(target=route.run)
+
+        src.put(x[:4])
+        src.put(x[4:8])
+        route_thread.start()
+        while route.served < 2:
+            time.sleep(0.01)
+        brk.break_again()  # everything now fails → breaker opens
+        for lo in range(0, 12, 4):
+            src.put(x[lo:lo + 4])
+        while route.served + route.shed < 5:
+            time.sleep(0.01)
+        brk.heal()
+        srv.breaker.reset()  # close the window (recovery)
+        src.put(x[8:12])
+        src.close()
+        route_thread.join(timeout=30)
+        assert not route_thread.is_alive()
+        assert route.error is None
+        assert route.shed == 3 and len(shed_records) == 3
+        assert route.served == 3 and len(sink.items) == 3
+        assert sink.items[-1].shape == (4, 3)
+    finally:
+        srv.shutdown(drain_timeout=5.0)
+
+
+def test_serve_route_direct_net_unchanged(net, x):
+    """Historical behavior: a bare net still serves directly."""
+    from deeplearning4j_tpu.streaming import QueueSink, QueueSource, ServeRoute
+
+    src = QueueSource()
+    sink = QueueSink()
+    route = ServeRoute(net, src, sink).start()
+    src.put(x[:4])
+    src.close()
+    route.join(timeout=60)
+    assert route.served == 1 and sink.items[0].shape == (4, 3)
+
+
+@pytest.mark.chaos
+def test_integrity_rejected_reload_counts_as_rejection(server_factory, x,
+                                                       tmp_path):
+    """Corruption-rejected candidates must show in the same telemetry
+    counter as canary-rejected ones — a deploy pipeline alerts on it."""
+    store = CheckpointStore(tmp_path)
+    path = store.save(1, lambda tmp: write_model(_fitted_clone(), tmp,
+                                                 atomic=False))
+    ReloadCorruptionInjector().corrupt_payload(path)
+    srv = server_factory(canary=x[:2])
+    with pytest.raises(CheckpointCorruptError):
+        srv.reload(store, step=1)
+    assert srv.stats()["reload_rejections"] == 1

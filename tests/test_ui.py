@@ -365,3 +365,52 @@ def test_live_pages_serve_valid_js_and_model_series():
         assert 'http-equiv="refresh"' in hist
     finally:
         server.stop()
+
+
+# ------------------------------------------- remote router robustness
+def test_remote_router_counts_drops_and_warns_rate_limited(caplog):
+    """A full queue or exhausted POST retries must be OBSERVABLE
+    shedding: dropped_count grows and a rate-limited warning lands in
+    the log (one per warn_every window, not one per record)."""
+    import logging
+
+    # no listener on this port: every POST fails after `retries` tries
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9",
+                                        queue_size=2, retries=1,
+                                        timeout=0.2, backoff=0.01,
+                                        warn_every=60.0)
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        for i in range(30):
+            router.put_record(StatsRecord("s", "stats", "w", time.time(),
+                                          {"i": i}))
+        deadline = time.time() + 15
+        while router.dropped_count < 5 and time.time() < deadline:
+            time.sleep(0.05)
+    router.shutdown()
+    assert router.dropped_count >= 5
+    warnings = [r for r in caplog.records
+                if "dropping stats records" in r.message]
+    assert len(warnings) == 1, "drop warning must be rate-limited"
+
+
+def test_remote_router_retries_transient_post_failure():
+    """One transient POST failure costs a backoff retry, not a drop."""
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9",
+                                        retries=3, backoff=0.01)
+    calls = {"n": 0}
+    delivered = []
+
+    def flaky(body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("transient")
+        delivered.append(body)
+
+    router._post_once = flaky
+    router.put_record(StatsRecord("s", "stats", "w", time.time(), {"x": 1}))
+    deadline = time.time() + 10
+    while not delivered and time.time() < deadline:
+        time.sleep(0.02)
+    router.shutdown()
+    assert calls["n"] == 2 and len(delivered) == 1
+    assert router.dropped_count == 0
