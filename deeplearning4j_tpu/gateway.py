@@ -31,7 +31,9 @@ Robustness (the serving-tier hardening pass):
   shed errors (`ServerOverloadedError` + `retry_after`, ...) surface in
   the error payload. `reload_model` hot-swaps a model from a checkpoint
   path or store directory with canary validation — a corrupt or broken
-  candidate is rejected while the old model keeps serving.
+  candidate is rejected while the old model keeps serving. With
+  `serving={"generation": {...}}`, `generate` serves autoregressive
+  decoding through the continuous-batching decode engine.
 - **client retries** — `GatewayClient` retries idempotent methods once
   with backoff after a `ConnectionResetError`/`BrokenPipeError`
   (server restart, LB connection recycle), and surfaces server-side
@@ -230,6 +232,21 @@ class EntryPoint:
     def score(self, name: str) -> Optional[float]:
         return self._model(name).score_value
 
+    def generate(self, name: str, prompt_ids, n_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Autoregressive generation for a `gpt_configuration` model
+        through the serving tier's continuous-batching decode engine —
+        concurrent gateway callers share the slot pool, so no request
+        waits on another's tail. Requires `serving={..., "generation":
+        {...}}` (DecodeEngine kwargs, or True for defaults). Typed shed
+        errors (`ServerOverloadedError` + retry_after, ...) surface in
+        the error payload like `predict`'s."""
+        srv = self._server(name)
+        return srv.generate(np.asarray(prompt_ids), int(n_tokens),
+                            temperature=float(temperature),
+                            seed=int(seed), timeout=timeout)
+
     # -- serving management ----------------------------------------------
     def reload_model(self, name: str, path: str,
                      step: Optional[int] = None) -> int:
@@ -397,9 +414,10 @@ class GatewayClient:
     `GatewayError`."""
 
     # safe to re-send after an ambiguous connection failure: read-only or
-    # naturally deduplicated on the server side
+    # naturally deduplicated on the server side (generate is seeded, so a
+    # re-send recomputes the identical tokens)
     _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
-                             "server_stats"})
+                             "server_stats", "generate"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05):

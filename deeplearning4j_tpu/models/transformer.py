@@ -9,6 +9,13 @@ final LayerNorm → per-timestep softmax head. Scales via:
 - long sequences: `parallel/sequence.py` ring/Ulysses attention;
 - deep stacks: homogeneous blocks fit `parallel/pipeline.py`;
 - wide FFN: `parallel/experts.py` Switch MoE.
+
+Decode machinery: `GPTPlan` + the `_block_heads`/`_block_ffn`/
+`_final_logits`/`_sample_logits` helpers are the SINGLE implementation of
+per-token transformer compute, shared by whole-batch `generate()` below
+and by the continuous-batching `serving.decode_engine.DecodeEngine` —
+the engine's argmax-parity guarantee against `generate` holds by
+construction, not only by test.
 """
 from __future__ import annotations
 
@@ -81,6 +88,160 @@ def gpt_configuration(vocab_size: int,
             .build())
 
 
+# ---------------------------------------------------------------------------
+# shared decode plan + per-block compute (generate() AND the serving
+# decode engine trace through these — one implementation of the numerics)
+
+
+class GPTPlan:
+    """Static decode plan for a `gpt_configuration` network: layer
+    indices, the embedding layer, and the mixed-precision policy
+    (embedding/block math and KV caches in the net's compute dtype — bf16
+    halves cache bandwidth, the decode step's dominant cost — with the
+    logits head and sampling in the param dtype, mirroring the training
+    step's precision boundary)."""
+
+    def __init__(self, net):
+        net._ensure_init()
+        layers = net.layers
+        if not isinstance(layers[0], TokenEmbedding):
+            raise ValueError("generate() expects a gpt_configuration "
+                             "network (TokenEmbedding first)")
+        self.net = net
+        self.layers = layers
+        self.emb_i = 0
+        self.emb = layers[0]
+        self.block_is = [i for i, l in enumerate(layers)
+                        if isinstance(l, TransformerBlock)]
+        self.ln_is = [i for i, l in enumerate(layers)
+                      if isinstance(l, LayerNormalization)]
+        self.out_i = next(i for i, l in enumerate(layers)
+                          if isinstance(l, RnnOutputLayer))
+        self.dtype = net.dtype
+        self.cdt = net.compute_dtype or net.dtype
+
+    def cast_blocks(self, params):
+        """Embedding + block params in the compute dtype; head params
+        stay in the param dtype."""
+        if self.cdt == self.dtype:
+            return params
+        from deeplearning4j_tpu.nn.precision import tree_cast
+
+        return [tree_cast(p, self.cdt)
+                if i in (self.emb_i, *self.block_is) else p
+                for i, p in enumerate(params)]
+
+    def final_logits(self, bp, params, x):
+        """Trailing LN(s) in the compute dtype (`bp`), then the output
+        head in the param dtype — the same precision boundary the
+        training step draws (`MultiLayerNetwork._loss_pure` casts hidden
+        layers, including trailing LNs, and restores the param dtype only
+        for the loss head)."""
+        from deeplearning4j_tpu.nn.conf.layers import layer_norm
+
+        for i in self.ln_is:
+            if i > max(self.block_is, default=-1):
+                x = layer_norm(x, bp[i]["gamma"], bp[i]["beta"],
+                               self.layers[i].eps)
+        x = x.astype(self.dtype)
+        return x @ params[self.out_i]["W"] + params[self.out_i]["b"]
+
+
+def _block_heads(layer, p, x, positions=None):
+    """(..., d) -> q (..., H, hd) and k/v (..., Hkv, hd) for one block —
+    K/V stay at the layer's (possibly grouped) head count, so GQA caches
+    carry only Hkv heads. `positions`: RoPE rotation positions (prefill:
+    arange(T); whole-batch decode: the current scalar pos; slotted
+    decode: a per-slot vector) — keys enter the cache already rotated at
+    their absolute position."""
+    from deeplearning4j_tpu.nn.conf.layers import layer_norm
+
+    d = x.shape[-1]
+    hd = d // layer.n_heads
+    Hkv = layer._kv_heads
+    kvw = Hkv * hd
+    h1 = layer_norm(x, p["ln1_g"], p["ln1_b"], layer.eps)
+    qkv = h1 @ p["Wqkv"] + p["bqkv"]
+    q = qkv[..., :d].reshape(*x.shape[:-1], layer.n_heads, hd)
+    k = qkv[..., d:d + kvw].reshape(*x.shape[:-1], Hkv, hd)
+    v = qkv[..., d + kvw:].reshape(*x.shape[:-1], Hkv, hd)
+    if layer.rope:
+        from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
+
+        cos, sin = rope_angles(positions, hd, layer.rope_base)
+        q = rope_rotate(q, cos, sin)
+        k = rope_rotate(k, cos, sin)
+    return q, k, v
+
+
+def _block_ffn(layer, p, x):
+    """Post-attention half of the block on (B, T, d) or (B, d)."""
+    import jax
+
+    from deeplearning4j_tpu.nn.conf.layers import layer_norm
+
+    h2 = layer_norm(x, p["ln2_g"], p["ln2_b"], layer.eps)
+    if layer.moe_experts > 0:
+        from deeplearning4j_tpu.parallel.experts import switch_ffn
+
+        lead = h2.shape[:-1]
+        ffn = switch_ffn(p, h2.reshape(-1, h2.shape[-1]),
+                         act=jax.nn.gelu,
+                         capacity_factor=layer.moe_capacity_factor,
+                         aux_weight=layer.moe_aux_weight,
+                         train=False,
+                         passthrough="zero").reshape(*lead, -1)
+    elif layer.ffn_activation == "swiglu":
+        ffn = (jax.nn.silu(h2 @ p["W1"])
+               * (h2 @ p["W3"])) @ p["W2"] + p["b2"]
+    else:
+        ffn = jax.nn.gelu(h2 @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+    return x + ffn
+
+
+def _top_k_filter(logits, top_k: int):
+    """Mask everything below the k-th largest logit per row — the ONE
+    implementation of top-k truncation (generate's static-temperature
+    sampler and the decode engine's dynamic-temperature one both call
+    it, so the truncation numerics cannot drift apart)."""
+    import jax
+    import jax.numpy as jnp
+
+    if top_k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _sample_logits(logits, key, temperature: float, top_k: int):
+    """Greedy argmax when temperature <= 0, else temperature/top-k
+    categorical sampling. Static temperature/top_k (compiled in)."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _top_k_filter(logits / jnp.asarray(temperature, logits.dtype),
+                           top_k)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _prefill_block_attention(layer, q, k, v):
+    """Causal prefill attention for one block: GQA keys/values widened to
+    the full head count (training-path semantics; the grouped-decode win
+    only applies to the cached step)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import full_attention
+
+    kf, vf = k, v
+    if layer._kv_heads != layer.n_heads:
+        g = layer.n_heads // layer._kv_heads
+        kf = jnp.repeat(k, g, axis=2)
+        vf = jnp.repeat(v, g, axis=2)
+    return full_attention(q, kf, vf, causal=True)
+
+
 def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
              top_k: int = 0, seed: int = 0, include_prompt: bool = False):
     """Jitted autoregressive sampler for a `gpt_configuration` network:
@@ -95,32 +256,20 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
 
     temperature <= 0 means greedy (argmax); `top_k > 0` restricts sampling
     to the k most probable tokens.
+
+    Every sequence in the batch decodes the same n_tokens in lockstep —
+    mixed output lengths and per-request admission live in
+    `serving.decode_engine.DecodeEngine` (continuous batching), which
+    reproduces this function's greedy decode argmax-exactly.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from deeplearning4j_tpu.nn.conf.layers import (
-        LayerNormalization,
-        RnnOutputLayer,
-        TokenEmbedding,
-        TransformerBlock,
-        layer_norm,
-    )
-
-    net._ensure_init()
-    layers = net.layers
-    if not isinstance(layers[0], TokenEmbedding):
-        raise ValueError("generate() expects a gpt_configuration network "
-                         "(TokenEmbedding first)")
-    emb_i = 0
-    block_is = [i for i, l in enumerate(layers)
-                if isinstance(l, TransformerBlock)]
-    ln_is = [i for i, l in enumerate(layers)
-             if isinstance(l, LayerNormalization)]
-    out_i = next(i for i, l in enumerate(layers)
-                 if isinstance(l, RnnOutputLayer))
-    emb = layers[emb_i]
+    plan = GPTPlan(net)
+    layers = plan.layers
+    emb_i, block_is = plan.emb_i, plan.block_is
+    emb = plan.emb
 
     prompt = np.asarray(prompt_ids)
     if prompt.ndim == 1:
@@ -132,86 +281,7 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         # caches size to L directly
         raise ValueError(f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds "
                          f"max_length {emb.max_length}")
-    params = net._params
-    dtype = net.dtype
-    # mixed-precision decode: embedding/block math and the KV caches run
-    # in the net's compute dtype (bf16 halves cache bandwidth — the
-    # decode step's dominant cost); the logits head and sampling stay in
-    # the param dtype, mirroring the training step's precision policy
-    cdt = net.compute_dtype or dtype
-
-    def cast_blocks(params):
-        if cdt == dtype:
-            return params
-        from deeplearning4j_tpu.nn.precision import tree_cast
-
-        return [tree_cast(p, cdt) if i in (emb_i, *block_is) else p
-                for i, p in enumerate(params)]
-
-    def block_heads(layer, p, x, positions=None):
-        """(B, T, d) -> q (B, T, H, hd) and k/v (B, T, Hkv, hd) for one
-        block — K/V stay at the layer's (possibly grouped) head count, so
-        GQA caches carry only Hkv heads. `positions`: RoPE rotation
-        positions (prefill: arange(T0); decode: the current scalar pos) —
-        keys enter the cache already rotated at their absolute position."""
-        d = x.shape[-1]
-        hd = d // layer.n_heads
-        Hkv = layer._kv_heads
-        kvw = Hkv * hd
-        h1 = layer_norm(x, p["ln1_g"], p["ln1_b"], layer.eps)
-        qkv = h1 @ p["Wqkv"] + p["bqkv"]
-        q = qkv[..., :d].reshape(*x.shape[:-1], layer.n_heads, hd)
-        k = qkv[..., d:d + kvw].reshape(*x.shape[:-1], Hkv, hd)
-        v = qkv[..., d + kvw:].reshape(*x.shape[:-1], Hkv, hd)
-        if layer.rope:
-            from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
-
-            cos, sin = rope_angles(positions, hd, layer.rope_base)
-            q = rope_rotate(q, cos, sin)
-            k = rope_rotate(k, cos, sin)
-        return q, k, v
-
-    def block_ffn(layer, p, x):
-        """Post-attention half of the block on (B, T, d) or (B, d)."""
-        h2 = layer_norm(x, p["ln2_g"], p["ln2_b"], layer.eps)
-        if layer.moe_experts > 0:
-            from deeplearning4j_tpu.parallel.experts import switch_ffn
-
-            lead = h2.shape[:-1]
-            ffn = switch_ffn(p, h2.reshape(-1, h2.shape[-1]),
-                             act=jax.nn.gelu,
-                             capacity_factor=layer.moe_capacity_factor,
-                             aux_weight=layer.moe_aux_weight,
-                             train=False,
-                             passthrough="zero").reshape(*lead, -1)
-        elif layer.ffn_activation == "swiglu":
-            ffn = (jax.nn.silu(h2 @ p["W1"])
-                   * (h2 @ p["W3"])) @ p["W2"] + p["b2"]
-        else:
-            ffn = jax.nn.gelu(h2 @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
-        return x + ffn
-
-    def final_logits(bp, params, x):
-        """Trailing LN(s) in the compute dtype (`bp`), then the output
-        head in the param dtype — the same precision boundary the training
-        step draws (`MultiLayerNetwork._loss_pure` casts hidden layers,
-        including trailing LNs, and restores the param dtype only for the
-        loss head)."""
-        for i in ln_is:
-            if i > max(block_is, default=-1):
-                x = layer_norm(x, bp[i]["gamma"], bp[i]["beta"],
-                               layers[i].eps)
-        x = x.astype(dtype)
-        return x @ params[out_i]["W"] + params[out_i]["b"]
-
-    def sample(logits, key):
-        if temperature <= 0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / jnp.asarray(temperature, logits.dtype)
-        if top_k > 0:
-            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    cdt = plan.cdt
 
     from collections import OrderedDict
 
@@ -225,9 +295,7 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
 
     @jax.jit
     def prefill(params, ids, key):
-        from deeplearning4j_tpu.ops.attention import full_attention
-
-        bp = cast_blocks(params)
+        bp = plan.cast_blocks(params)
         x = bp[emb_i]["W"][ids]
         if emb.positional:
             x = x + bp[emb_i]["P"][:T0]
@@ -236,16 +304,11 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
         for i in block_is:
             p = bp[i]
             layer = layers[i]
-            q, k, v = block_heads(layer, p, x, jnp.arange(T0))
-            kf, vf = k, v
-            if layer._kv_heads != layer.n_heads:  # GQA: widen for prefill
-                g = layer.n_heads // layer._kv_heads
-                kf = jnp.repeat(k, g, axis=2)
-                vf = jnp.repeat(v, g, axis=2)
-            att = full_attention(q, kf, vf, causal=True)
+            q, k, v = _block_heads(layer, p, x, jnp.arange(T0))
+            att = _prefill_block_attention(layer, q, k, v)
             d = x.shape[-1]
             att = att.reshape(B, T0, d) @ p["Wo"] + p["bo"]
-            x = block_ffn(layer, p, x + att)
+            x = _block_ffn(layer, p, x + att)
             # fixed-size caches so the decode scan has one static shape;
             # positions >= T0 are filled during decode. Layouts are the
             # TPU decode-friendly ones: K as (B, Hkv, hd, L) so the score
@@ -264,12 +327,14 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             vc = jnp.concatenate(
                 [vc, jnp.zeros((B, Hkv, L - T0, hd), v.dtype)], axis=2)
             caches.append((kc, vc))
-        logits = final_logits(bp, params, x[:, -1])
-        return sample(logits, key), caches
+        logits = plan.final_logits(bp, params, x[:, -1])
+        return _sample_logits(logits, key, temperature, top_k), caches
 
     @jax.jit
     def decode(params, tok0, caches, key0):
-        bp = cast_blocks(params)
+        from deeplearning4j_tpu.ops.attention import cached_attention_step
+
+        bp = plan.cast_blocks(params)
 
         def body(carry, t):
             tok, caches, key = carry
@@ -283,32 +348,25 @@ def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
             for bi, i in enumerate(block_is):
                 p = bp[i]
                 layer = layers[i]
-                q, k, v = block_heads(layer, p, x[:, None, :], pos)
+                # heads computed on (B, 1, d) — the same operand ranks the
+                # prefill uses, so XLA picks the same matmul accumulation
+                # (bf16 argmax stability depends on it); squeezed to the
+                # (B, H, hd) step shape after
+                q, k, v = _block_heads(layer, p, x[:, None, :], pos)
+                q, k, v = q[:, 0], k[:, 0], v[:, 0]
                 kc, vc = caches[bi]
-                hd = q.shape[-1]
-                # k (B,1,Hkv,hd) -> one (B,Hkv,hd,1) lane column at pos;
+                # k (B,Hkv,hd) -> one (B,Hkv,hd,1) lane column at pos;
                 # v -> one (B,Hkv,1,hd) row at pos
                 kc = jax.lax.dynamic_update_slice(
-                    kc, jnp.transpose(k, (0, 2, 3, 1)), (0, 0, 0, pos))
+                    kc, k[..., None], (0, 0, 0, pos))
                 vc = jax.lax.dynamic_update_slice(
-                    vc, jnp.transpose(v, (0, 2, 1, 3)), (0, 0, pos, 0))
-                # (B, Hkv, G, hd): query heads grouped by the KV head they
-                # share — the einsums batch over Hkv and contract against
-                # the UN-repeated caches (this is GQA's decode win: each
-                # cache byte is read once and serves G query heads)
-                G = layer.n_heads // layer._kv_heads
-                qg = q[:, 0].reshape(B, layer._kv_heads, G, hd)
-                s = jnp.einsum("bkgd,bkdl->bkgl", qg,
-                               kc) / jnp.sqrt(jnp.asarray(hd, q.dtype))
-                s = jnp.where(jnp.arange(L)[None, None, None, :] <= pos, s,
-                              -jnp.inf)
-                w = jax.nn.softmax(s, axis=-1)
-                att = jnp.einsum("bkgl,bkld->bkgd", w, vc)
-                att = att.reshape(B, -1) @ p["Wo"] + p["bo"]
-                x = block_ffn(layer, p, x + att)
+                    vc, v[:, :, None, :], (0, 0, pos, 0))
+                att = cached_attention_step(q, kc, vc, pos)
+                att = att @ p["Wo"] + p["bo"]
+                x = _block_ffn(layer, p, x + att)
                 new_caches.append((kc, vc))
-            logits = final_logits(bp, params, x)
-            nxt = sample(logits, sub)
+            logits = plan.final_logits(bp, params, x)
+            nxt = _sample_logits(logits, sub, temperature, top_k)
             return (nxt, new_caches, key), nxt
         _, toks = jax.lax.scan(
             body, (tok0, caches, key0), jnp.arange(n_tokens - 1))

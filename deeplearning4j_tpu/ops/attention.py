@@ -150,6 +150,42 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return attention_finalize(o, l)
 
 
+def cached_attention_step(q: jnp.ndarray, k_cache: jnp.ndarray,
+                          v_cache: jnp.ndarray, pos) -> jnp.ndarray:
+    """One autoregressive decode step against decode-layout KV caches.
+
+    `q`: (B, H, D) — this step's query heads for every sequence (or slot).
+    `k_cache`: (B, Hkv, D, L) and `v_cache`: (B, Hkv, L, D) — the TPU
+    decode layouts (r4): the score einsum contracts D with L on the minor
+    (lane) axis and the weighted sum contracts L with D minor, so each
+    step streams the cache without a strided transpose. `pos`: position of
+    the token being consumed — a scalar (whole-batch decode: every row at
+    the same position) or a (B,) vector (slotted decode: every slot at its
+    own position); cache entries past a row's `pos` are masked off, which
+    is what makes one compiled step correct for slots holding sequences of
+    different lengths (inactive/garbage tail entries are never attended).
+
+    GQA: `H` may be a multiple of `Hkv`; query heads are grouped by the
+    KV head they share and the einsums batch over Hkv against the
+    UN-repeated caches — each cache byte (the decode bandwidth bound) is
+    read once and serves H/Hkv query heads.
+
+    Returns (B, H*D), ready for the output projection.
+    """
+    B, Hkv, D, L = k_cache.shape
+    H = q.shape[1]
+    G = H // Hkv
+    qg = q.reshape(B, Hkv, G, D)
+    s = jnp.einsum("bkgd,bkdl->bkgl", qg,
+                   k_cache) / jnp.sqrt(jnp.asarray(D, q.dtype))
+    pos = jnp.asarray(pos)
+    limit = pos[:, None, None, None] if pos.ndim else pos
+    s = jnp.where(jnp.arange(L)[None, None, None, :] <= limit, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    att = jnp.einsum("bkgl,bkld->bkgd", w, v_cache)
+    return att.reshape(B, H * D)
+
+
 _SEQ_PARALLEL: list = []  # (mesh, seq_axis, batch_axis) stack
 
 

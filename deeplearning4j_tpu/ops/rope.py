@@ -32,8 +32,8 @@ def rope_angles(positions, head_dim: int, base: float = 10000.0):
 
 
 def rope_rotate(x, cos, sin):
-    """Rotate (..., T, H, hd) by per-position tables (T, hd/2) — or a
-    single position's (hd/2,) tables for one decode step. Computed in
+    """Rotate (..., T, H, hd) by per-position tables (..., T, hd/2) — or
+    a single position's (hd/2,) tables for one decode step. Computed in
     f32 (angles are precision-sensitive at long range) and cast back."""
     half = x.shape[-1] // 2
     dt = x.dtype
@@ -41,7 +41,10 @@ def rope_rotate(x, cos, sin):
     x1, x2 = xf[..., :half], xf[..., half:]
     if cos.ndim == 1:            # single position: broadcast over heads
         c, s = cos, sin
-    else:                        # (T, half) -> (T, 1, half) over heads
-        c, s = cos[:, None, :], sin[:, None, :]
+    else:                        # (..., T, half) -> (..., T, 1, half):
+        # an axis inserted before `half` broadcasts over heads; leading
+        # dims (e.g. the slotted decode's per-slot position batch) align
+        # with x's leading dims
+        c, s = cos[..., None, :], sin[..., None, :]
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
                            axis=-1).astype(dt)

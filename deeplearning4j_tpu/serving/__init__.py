@@ -1,8 +1,10 @@
 """Robust serving tier: admission control, per-request deadlines with
-adaptive micro-batching, circuit breaking, and safe hot model reload —
-the inference-path counterpart of the training robustness tier
-(elastic workers / durable checkpoints / health sentinel). See
-`docs/serving.md` for the ladder semantics and tuning knobs.
+adaptive micro-batching, circuit breaking, safe hot model reload, and a
+continuous-batching generation path (`DecodeEngine`: slotted KV cache +
+iteration-level scheduling) — the inference-path counterpart of the
+training robustness tier (elastic workers / durable checkpoints /
+health sentinel). See `docs/serving.md` for the ladder semantics and
+tuning knobs.
 """
 from deeplearning4j_tpu.serving.chaos import (
     BrokenModelInjector,
@@ -10,6 +12,7 @@ from deeplearning4j_tpu.serving.chaos import (
     ReloadCorruptionInjector,
     SlowInferenceInjector,
 )
+from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
 from deeplearning4j_tpu.serving.model_server import (
     CircuitBreaker,
     DeadlineExceededError,
@@ -26,6 +29,7 @@ __all__ = [
     "BrokenModelInjector",
     "CircuitBreaker",
     "DeadlineExceededError",
+    "DecodeEngine",
     "InferenceFailedError",
     "InjectedServingFault",
     "ModelServer",

@@ -1,0 +1,989 @@
+"""Continuous-batching decode engine: slotted KV cache + in-flight
+admission (iteration-level scheduling).
+
+`models/transformer.generate` is a whole-batch synchronous sampler:
+every request in a batch decodes the same number of tokens in lockstep,
+so at mixed output lengths every request waits for the slowest sequence
+and the chip idles between calls. The r4 decode profile concluded that
+at serving shapes decode is dispatch+cache-bandwidth bound and
+"throughput scales with batch, not with further kernel work" — the
+batch dimension is therefore the scheduling resource. This engine turns
+it into a pool of `n_slots` decode **slots** (Orca's iteration-level
+scheduling, OSDI '22; the slot/block-managed cache family of
+vLLM/PagedAttention, SOSP '23, minus paging — slots are fixed-length
+rows of one contiguous cache):
+
+- **one slotted KV cache** per block, allocated once and advanced
+  in place (donated through the jitted step): K `(S, Hkv, hd, L)`,
+  V `(S, Hkv, L, hd)` — the r4 decode layouts with the batch axis
+  reinterpreted as the slot axis. Per-slot position and active mask
+  make ONE compiled decode step correct for slots holding sequences of
+  different lengths: `ops.attention.cached_attention_step` masks each
+  slot's cache past its own position, inactive slots are carried
+  through unchanged, so there is exactly one compiled decode shape no
+  matter how requests arrive or retire.
+- **a jitted decode step advances ALL active slots every iteration** —
+  a request admitted mid-flight starts decoding on the very next step,
+  and a request that finishes frees its slot immediately. No request
+  ever waits on another request's tail.
+- **a jitted prefill** writes a new prompt's KV into a freed slot at a
+  small set of pow-2-padded prompt buckets (`prompt_buckets`), so the
+  prefill compiles O(#buckets) shapes. Padding is harmless by
+  construction: cache entries past a slot's position are never
+  attended, and decode overwrites them before the position reaches
+  them.
+- **a host scheduler loop** admits queued requests into free slots,
+  retires slots on EOS / max-tokens / expired deadlines, and delivers
+  tokens per-request as they complete.
+
+Robustness rides the PR-4 serving tier: a bounded queue sheds with the
+typed `ServerOverloadedError` (+`retry_after`), a deadline expiring in
+the queue sheds BEFORE prefill, a deadline expiring in flight frees its
+slot for the next request, an optional `CircuitBreaker` gates admission
+and counts device failures, and `drain_and_swap(net)` lets a hot reload
+finish in-flight requests on the old weights, swap, and keep serving.
+
+**Parity guarantee**: the engine traces the SAME per-block helpers as
+`generate` (`models.transformer.GPTPlan`/`_block_heads`/`_block_ffn`/
+`_final_logits`/`cached_attention_step`), so slotted greedy decode
+reproduces whole-batch `generate` argmax-exactly at f32 for the same
+prompts, regardless of admission order (asserted in
+`tests/test_serving_generate.py`).
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.model_server import (
+    DeadlineExceededError,
+    InferenceFailedError,
+    ServerClosedError,
+    ServerOverloadedError,
+    ServiceUnavailableError,
+    ServingError,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class _GenRequest:
+    """One generation request's lifecycle: queued → (shed | prefilled
+    into a slot) → decoding → (completed | expired | failed). `tokens`
+    grows as the engine emits — tokens are delivered per-request as they
+    complete, never held for a batch."""
+
+    __slots__ = ("prompt", "n_tokens", "temperature", "seed", "deadline",
+                 "event", "tokens", "error", "enqueued_at", "probe",
+                 "slot", "completed_at")
+
+    def __init__(self, prompt: np.ndarray, n_tokens: int,
+                 temperature: float, seed: int,
+                 deadline: Optional[float]):
+        self.prompt = prompt
+        self.n_tokens = n_tokens
+        self.temperature = temperature
+        self.seed = seed
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
+        self.completed_at: Optional[float] = None
+        self.probe = False
+        self.slot: Optional[int] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) >= self.deadline
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        self.error = error
+        self.completed_at = time.monotonic()
+        self.event.set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until this request completes; the generated tokens
+        (1-D int32, possibly shorter than n_tokens on EOS) or a typed
+        `ServingError`."""
+        wait = timeout
+        if wait is None and self.deadline is not None:
+            # belt-and-braces bound: the scheduler always finishes
+            # deadline-stamped requests shortly after expiry
+            wait = max(0.0, self.deadline - time.monotonic()) + 30.0
+        if not self.event.wait(wait):
+            raise InferenceFailedError(
+                "generation request was never completed (engine stalled)")
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.tokens, np.int32)
+
+
+def _dispatched(thunk):
+    """Run one compiled dispatch INCLUDING its host materialization,
+    tagging any exception raised so the caller can tell a FAILED
+    DISPATCH (which, under buffer donation, may have invalidated the
+    donated cache buffers) apart from failures raised after the results
+    landed (non-finite screens, hooks) — only the former justifies
+    failing other slots. The device_get must live inside the thunk: on
+    asynchronous backends a device-side error surfaces at
+    materialization, not at the dispatch call."""
+    try:
+        return thunk()
+    except BaseException as e:
+        e._dispatch_failure = True
+        raise
+
+
+class DecodeEngine:
+    """Continuous-batching generation over a fixed pool of decode slots
+    (see module docstring).
+
+    Parameters
+    ----------
+    net : a fitted `gpt_configuration` network (TokenEmbedding first).
+    n_slots : decode slots = max concurrently-decoding requests; also
+        the batch dimension of the one compiled decode step. Size it so
+        slot_occupancy_pct stays high at your arrival rate.
+    max_len : KV cache length L (prompt + generated tokens per request).
+        Defaults to the embedding's max_length (clamped to it for
+        learned-positional models).
+    prompt_buckets : pow-2 prompt pad lengths the prefill compiles for;
+        a longer prompt falls back to the next power of two ≤ max_len.
+    max_queue : bounded admission queue; beyond it `submit` sheds with
+        the typed `ServerOverloadedError`.
+    eos_token : optional token id that retires a slot early.
+    top_k : static top-k for sampled (temperature > 0) requests.
+    breaker : optional `CircuitBreaker` shared with a `ModelServer` —
+        admission is rejected while open, device failures count.
+    step_hooks : chaos/observability seam — called as `hook(phase,
+        info)` at pre/post_prefill and pre/post_decode.
+    decode_chunk : fuse up to this many decode iterations into ONE
+        dispatch (a `lax.scan` over the same step body — identical
+        numerics) whenever no scheduling event can fall inside the
+        chunk: every in-flight request needs ≥chunk more tokens, no
+        deadline can expire within it, and no queued request is waiting
+        on a free slot. Decode is dispatch-bound at serving shapes (r4
+        profile), so this amortizes the per-iteration dispatch + host
+        sync the same way `generate`'s scanned decode does, while
+        keeping admission latency bounded by `decode_chunk` iterations.
+        1 disables fusion.
+    """
+
+    def __init__(self, net, *, n_slots: int = 4,
+                 max_len: Optional[int] = None,
+                 prompt_buckets: Sequence[int] = (32, 64, 128),
+                 max_queue: int = 64,
+                 default_timeout: Optional[float] = None,
+                 eos_token: Optional[int] = None,
+                 top_k: int = 0,
+                 breaker=None,
+                 step_hooks: Sequence[Callable] = (),
+                 decode_chunk: int = 4):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        self.n_slots = n_slots
+        self.max_queue = max_queue
+        self.default_timeout = default_timeout
+        self.eos_token = eos_token
+        self.top_k = top_k
+        self.decode_chunk = decode_chunk
+        self.breaker = breaker
+        self.step_hooks: List[Callable] = list(step_hooks)
+        self._requested_max_len = max_len
+        self._prompt_buckets = tuple(sorted(set(int(b) for b in
+                                                prompt_buckets)))
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[_GenRequest]] = [None] * n_slots
+        self._closed = False
+        self._kill = False
+        self._draining = False
+        self._swap_net = None
+        self._swap_in_progress = False
+        self._swap_error: Optional[BaseException] = None
+        self._swap_done = threading.Event()
+        self._step_ewma = 0.01
+        # counters (observable state for tests/telemetry)
+        self.submitted = 0
+        self.served = 0
+        self.shed_overload = 0
+        self.shed_deadline = 0
+        self.shed_unavailable = 0
+        self.failures = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.active_slot_steps = 0
+        self.tokens_generated = 0
+        self.swaps = 0
+        self._build(net)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-engine-scheduler")
+        self._thread.start()
+
+    # -- compiled machinery ------------------------------------------------
+    def _build(self, net) -> None:
+        """(Re)build the compiled prefill/decode pair and the slotted
+        device state for `net`. Called at construction and after a
+        drained weight swap; jit caches are per-engine closures, so a
+        swap to a differently-shaped net recompiles cleanly."""
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from deeplearning4j_tpu.models.transformer import (
+            GPTPlan,
+            _block_ffn,
+            _block_heads,
+            _prefill_block_attention,
+            _sample_logits,
+        )
+        from deeplearning4j_tpu.ops.attention import cached_attention_step
+
+        plan = GPTPlan(net)
+        L = self._requested_max_len or plan.emb.max_length
+        if plan.emb.positional:
+            L = min(L, plan.emb.max_length)
+        if L < 2:
+            raise ValueError(f"max_len {L} leaves no room to decode")
+        S = self.n_slots
+        emb_i, block_is = plan.emb_i, plan.block_is
+        layers, emb, cdt = plan.layers, plan.emb, plan.cdt
+        top_k = self.top_k
+        buckets = tuple(b for b in self._prompt_buckets if b <= L) or \
+            (min(32, L),)
+        # buffer donation keeps the slotted cache in place in HBM instead
+        # of copying ~S*L*layers of KV every step; CPU (the test backend)
+        # does not support donation and would warn once per dispatch
+        donate = jax.default_backend() != "cpu"
+        self._donate = donate
+
+        from deeplearning4j_tpu.models.transformer import _top_k_filter
+
+        def scale_and_filter(logits, temps):
+            """Dynamic-temperature scale + shared top-k truncation.
+            `temps` broadcasts over the row dim; <= 0 rows are scaled by
+            1 (their categorical draw is discarded for greedy argmax)."""
+            safe_t = jnp.where(temps > 0, temps, 1.0).astype(logits.dtype)
+            return _top_k_filter(logits / safe_t[..., None], top_k)
+
+        def sample_slots(logits, keys, temps):
+            """Per-slot sampling: greedy argmax where temps <= 0 (the
+            parity-pinned path — identical to `_sample_logits` at
+            temperature 0), per-slot-key categorical otherwise."""
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            ks = jax.vmap(jax.random.split)(keys)      # (S, 2, 2)
+            new_keys, subs = ks[:, 0], ks[:, 1]
+            scaled = scale_and_filter(logits, temps)
+            sampled = jax.vmap(
+                lambda k, lg: jax.random.categorical(k, lg))(subs, scaled)
+            return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy), \
+                new_keys
+
+        def logits_ok(logits, active):
+            """Per-slot non-finite screen, the predict path's breaker
+            discipline applied to generation: a slot whose logits go
+            NaN/Inf must FAIL typed (and count toward the breaker), not
+            'succeed' with garbage argmax tokens. Returns (S,) bool;
+            inactive rows pass — freed slots hold stale state by
+            design. Per-slot attribution means one poisoned sequence
+            does not take healthy neighbors down with it."""
+            row_ok = jnp.all(jnp.isfinite(logits.astype(jnp.float32)),
+                             axis=-1)
+            return jnp.where(active, row_ok, True)
+
+        def step_math(bp, params, caches, tok, pos, keys, temps, active):
+            """Advance ALL slots one token: inactive slots are masked
+            (token/position carried through unchanged), so every
+            iteration compiles to this single shape."""
+            x = bp[emb_i]["W"][tok]
+            if emb.positional:
+                x = x + bp[emb_i]["P"][jnp.minimum(pos, emb.max_length - 1)]
+            x = x.astype(cdt)
+            wpos = jnp.minimum(pos, L - 1)
+            rows = jnp.arange(S)
+            new_caches = []
+            for bi, i in enumerate(block_is):
+                p = bp[i]
+                layer = layers[i]
+                # same operand ranks as generate's decode ((S,1,d) heads,
+                # squeezed) so XLA picks the same accumulation order —
+                # argmax parity is a numerics property, not just a logic
+                # one. positions: a per-slot column vector
+                q, k, v = _block_heads(layer, p, x[:, None, :],
+                                       pos[:, None])
+                q, k, v = q[:, 0], k[:, 0], v[:, 0]
+                kc, vc = caches[bi]
+                kc = kc.at[rows, :, :, wpos].set(k)
+                vc = vc.at[rows, :, wpos, :].set(v)
+                att = cached_attention_step(q, kc, vc, pos)
+                att = att @ p["Wo"] + p["bo"]
+                x = _block_ffn(layer, p, x + att)
+                new_caches.append((kc, vc))
+            logits = plan.final_logits(bp, params, x)
+            nxt, new_keys = sample_slots(logits, keys, temps)
+            nxt = jnp.where(active, nxt, tok)
+            new_pos = jnp.where(active, pos + 1, pos)
+            return new_caches, nxt, new_pos, new_keys, \
+                logits_ok(logits, active)
+
+        @partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def decode_step(params, caches, tok, pos, keys, temps, active):
+            bp = plan.cast_blocks(params)
+            return step_math(bp, params, caches, tok, pos, keys, temps,
+                             active)
+
+        @partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def decode_chunked(params, caches, tok, pos, keys, temps, active):
+            """`decode_chunk` iterations of the SAME step body fused into
+            one dispatch via lax.scan — used only when the scheduler
+            proves no admission/retirement/deadline event can land inside
+            the chunk. Returns every intermediate token (chunk, S)."""
+            bp = plan.cast_blocks(params)
+
+            def body(carry, _):
+                caches, tok, pos, keys = carry
+                caches, tok, pos, keys, step_ok = step_math(
+                    bp, params, caches, tok, pos, keys, temps, active)
+                return (caches, tok, pos, keys), (tok, step_ok)
+
+            (caches, tok, pos, keys), (toks, oks) = jax.lax.scan(
+                body, (caches, tok, pos, keys), None,
+                length=self.decode_chunk)
+            # per-STEP flags (chunk, S): the host attributes a poisoned
+            # step to the right iteration, so a request that completed
+            # via EOS before the bad step still succeeds
+            return caches, tok, pos, keys, toks, oks
+
+        @partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def prefill(params, caches, ids, t0, slot, tok, pos, keys, temps,
+                    kp, kd, temp):
+            """Write one prompt's KV into slot `slot` and emit its first
+            token. `ids` is (1, bucket) — pow-2 padded; the pad region's
+            KV entries are masked off by position until decode overwrites
+            them, so padding never changes a real token's numerics."""
+            bp = plan.cast_blocks(params)
+            P = ids.shape[1]
+            x = bp[emb_i]["W"][ids]
+            if emb.positional:
+                x = x + bp[emb_i]["P"][:P]
+            x = x.astype(cdt)
+            new_caches = []
+            for bi, i in enumerate(block_is):
+                p = bp[i]
+                layer = layers[i]
+                q, k, v = _block_heads(layer, p, x, jnp.arange(P))
+                att = _prefill_block_attention(layer, q, k, v)
+                d = x.shape[-1]
+                att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
+                x = _block_ffn(layer, p, x + att)
+                kc, vc = caches[bi]
+                kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, P)
+                vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, P, hd)
+                z = jnp.zeros((), slot.dtype)  # match slot's index dtype
+                kc = jax.lax.dynamic_update_slice(kc, kcol, (slot, z, z, z))
+                vc = jax.lax.dynamic_update_slice(vc, vrow, (slot, z, z, z))
+                new_caches.append((kc, vc))
+            logits = plan.final_logits(bp, params, x[0, t0 - 1][None])
+            # kp samples the prefill token, kd seeds the slot's decode
+            # key — the same split generate() draws from PRNGKey(seed).
+            # Temperature is dynamic per request, so the greedy/sampled
+            # select mirrors sample_slots (same scale_and_filter core)
+            greedy = _sample_logits(logits, kp, 0.0, 0)
+            drawn = jax.random.categorical(
+                kp, scale_and_filter(logits, temp[None]),
+                axis=-1).astype(jnp.int32)
+            tok0 = jnp.where(temp > 0, drawn, greedy)
+            tok = tok.at[slot].set(tok0[0])
+            pos = pos.at[slot].set(t0)
+            keys = keys.at[slot].set(kd)
+            temps = temps.at[slot].set(temp)
+            return new_caches, tok, pos, keys, temps, tok0, \
+                jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
+
+        self._plan = plan
+        self._net = net
+        self.max_len = L
+        self.prompt_buckets = buckets
+        self._decode_step = decode_step
+        self._decode_chunked = decode_chunked
+        self._prefill = prefill
+        self._reset_device_state()
+
+    def _reset_device_state(self) -> None:
+        """Fresh slotted cache + per-slot state (construction, weight
+        swap, or recovery after a failed device step — a raised dispatch
+        may have invalidated donated buffers)."""
+        import jax
+        import jax.numpy as jnp
+
+        plan, S, L = self._plan, self.n_slots, self.max_len
+        caches = []
+        for i in plan.block_is:
+            layer = plan.layers[i]
+            hd = layer.n_out // layer.n_heads
+            Hkv = layer._kv_heads
+            caches.append((jnp.zeros((S, Hkv, hd, L), plan.cdt),
+                           jnp.zeros((S, Hkv, L, hd), plan.cdt)))
+        self._caches = caches
+        self._tok = jnp.zeros((S,), jnp.int32)
+        self._pos = jnp.zeros((S,), jnp.int32)
+        self._keys = jnp.stack([jax.random.PRNGKey(i) for i in range(S)])
+        self._temps = jnp.zeros((S,), jnp.float32)
+        self._active = np.zeros((S,), bool)
+
+    # -- public surface ----------------------------------------------------
+    def submit(self, prompt_ids, n_tokens: int, *,
+               temperature: float = 0.0, seed: int = 0,
+               timeout: Optional[float] = None) -> _GenRequest:
+        """Admit one generation request (non-blocking). Typed give-ups:
+        `ServerOverloadedError` (queue full), `ServiceUnavailableError`
+        (breaker open), `ServerClosedError`. Returns the request handle;
+        `request.result()` blocks for the tokens."""
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError(
+                f"submit expects one 1-D prompt of token ids, got shape "
+                f"{prompt.shape}")
+        if n_tokens < 1:
+            raise ValueError("n_tokens must be >= 1")
+        T0 = prompt.shape[0]
+        if T0 + n_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds the "
+                f"engine's max_len {self.max_len} — raise max_len or "
+                "shorten the request")
+        with self._cond:
+            if self._closed:  # before the breaker door check: a closed
+                # engine must say "closed" (terminal), not "retry later"
+                raise ServerClosedError("decode engine is shut down")
+        if self.breaker is not None:
+            try:
+                self.breaker.reject_if_open()
+            except ServiceUnavailableError:
+                with self._cond:
+                    self.shed_unavailable += 1
+                raise
+        timeout = self.default_timeout if timeout is None else timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        req = _GenRequest(prompt.astype(np.int32), int(n_tokens),
+                          float(temperature), int(seed), deadline)
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("decode engine is shut down")
+            if len(self._queue) >= self.max_queue:
+                self.shed_overload += 1
+                retry = max(0.001, self._step_ewma
+                            * (len(self._queue) / self.n_slots + 1))
+                raise ServerOverloadedError(
+                    f"generation queue full ({self.max_queue} pending); "
+                    f"retry in {retry:.3f}s", retry_after=retry)
+            self.submitted += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt_ids, n_tokens: int, *,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: submit + wait. Returns the generated
+        tokens (1-D int32; shorter than `n_tokens` only on EOS)."""
+        return self.submit(prompt_ids, n_tokens, temperature=temperature,
+                           seed=seed, timeout=timeout).result()
+
+    def stats(self) -> dict:
+        with self._cond:
+            queued = len(self._queue)
+            active = sum(1 for r in self._slots if r is not None)
+        occupancy = (100.0 * self.active_slot_steps
+                     / (self.decode_steps * self.n_slots)
+                     if self.decode_steps else 0.0)
+        return {"submitted": self.submitted, "served": self.served,
+                "shed_overload": self.shed_overload,
+                "shed_deadline": self.shed_deadline,
+                "shed_unavailable": self.shed_unavailable,
+                "failures": self.failures, "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "tokens_generated": self.tokens_generated,
+                "slot_occupancy_pct": round(occupancy, 1),
+                "n_slots": self.n_slots, "active_slots": active,
+                "queued": queued, "swaps": self.swaps,
+                "max_len": self.max_len,
+                "prompt_buckets": list(self.prompt_buckets)}
+
+    def drain_and_swap(self, net, timeout: Optional[float] = None) -> None:
+        """Hot-reload seam: pause admission, let every in-flight request
+        FINISH on the current weights (KV caches were computed with
+        them — mixing would corrupt numerics), swap to `net` (recompiling
+        lazily), then resume admission. Queued requests survive the swap
+        and decode on the new weights. Raises the swap-build error (e.g.
+        `net` is not a gpt network) with the old weights still serving."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("decode engine is shut down")
+            self._swap_net = net
+            self._swap_error = None
+            self._swap_done.clear()
+            self._draining = True
+            self._cond.notify_all()
+        if not self._swap_done.wait(timeout):
+            with self._cond:
+                # race guard: the scheduler may already be PAST the
+                # _swap_net check and mid-build — abandoning then would
+                # report "old weights serving" while the new ones land.
+                # Only abandon a swap the scheduler has not picked up
+                abandon = not self._swap_in_progress \
+                    and not self._swap_done.is_set()
+                if abandon:  # resume serving the old weights
+                    self._swap_net = None
+                    self._draining = False
+                    self._cond.notify_all()
+            if abandon:
+                raise ServingError(
+                    f"decode engine drain did not complete within "
+                    f"{timeout}s (long in-flight generations); old "
+                    "weights still serving")
+            self._swap_done.wait()  # build already running: finish it out
+        err = self._swap_error
+        if err is not None:
+            raise err
+
+    def shutdown(self, drain_timeout: float = 10.0) -> bool:
+        """Stop admission (typed `ServerClosedError` for queued + new
+        requests), let in-flight generations finish for up to
+        `drain_timeout` seconds, then fail the rest. Returns True on a
+        clean drain. Idempotent."""
+        deadline = time.monotonic() + drain_timeout
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        drained = True
+        with self._cond:
+            while any(r is not None for r in self._slots):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    drained = False
+                    self._kill = True
+                    self._cond.notify_all()
+                    break
+                self._cond.wait(min(remaining, 0.05))
+        self._thread.join(max(0.0, deadline - time.monotonic()) + 5.0)
+        if not drained:
+            logger.warning("decode engine: shutdown drain timed out with "
+                           "generations still in flight")
+        return drained
+
+    # -- scheduler ---------------------------------------------------------
+    def _hook(self, phase: str, info: dict) -> None:
+        for hook in self.step_hooks:
+            hook(phase, info)
+
+    def _bucket_for(self, t0: int) -> int:
+        from deeplearning4j_tpu.serving.model_server import _bucket
+
+        for b in self.prompt_buckets:
+            if b >= t0:
+                return b
+        return _bucket(t0, self.max_len)  # pow-2 fallback past the buckets
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and not self._kill \
+                        and not self._work_pending():
+                    self._cond.wait(0.05)
+                if self._kill:
+                    self._fail_all_locked(ServerClosedError(
+                        "engine shut down before this request finished"))
+                    self._abort_pending_swap_locked()
+                    return
+                if self._closed:
+                    while self._queue:
+                        self._queue.popleft().finish(ServerClosedError(
+                            "engine shut down before this request "
+                            "could be served"))
+                    if not any(r is not None for r in self._slots):
+                        self._abort_pending_swap_locked()
+                        self._cond.notify_all()
+                        return
+            try:
+                if not self._draining and not self._closed:
+                    self._admit()
+                self._expire_in_flight()
+                self._step_active()
+                self._maybe_swap()
+            except BaseException:  # scheduler must never die silently
+                logger.exception("decode engine: scheduler iteration "
+                                 "failed; failing in-flight requests")
+                with self._cond:
+                    self._fail_all_locked(InferenceFailedError(
+                        "decode engine scheduler failure"))
+                self._reset_device_state()
+
+    def _abort_pending_swap_locked(self) -> None:
+        """A scheduler exit (shutdown/kill) with a drain pending must
+        release the `drain_and_swap` caller — a reload blocked forever
+        on a dead scheduler would also pin the ModelServer reload lock."""
+        if self._draining or self._swap_net is not None:
+            self._swap_net = None
+            self._draining = False
+            self._swap_error = ServerClosedError(
+                "engine shut down while draining for a weight swap")
+            self._swap_done.set()
+
+    def _work_pending(self) -> bool:
+        if any(r is not None for r in self._slots):
+            return True
+        if self._draining:
+            return True  # reach _maybe_swap even with empty slots
+        return bool(self._queue) and not self._draining
+
+    def _fail_all_locked(self, err: BaseException) -> None:
+        while self._queue:
+            self._queue.popleft().finish(err)  # never acquired the breaker
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[s] = None
+                self._active[s] = False
+                if self.breaker is not None:
+                    # release the request's breaker token — a dropped
+                    # half-open probe would wedge the shared breaker in
+                    # half_open and reject ALL traffic until a reload
+                    self.breaker.record_failure(req.probe)
+                req.finish(err)
+        self._cond.notify_all()
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (prefill each). Expired
+        queued requests are shed BEFORE their prefill ever dispatches."""
+        while True:
+            with self._cond:
+                free = [s for s in range(self.n_slots)
+                        if self._slots[s] is None]
+                if not free or not self._queue:
+                    return
+                req = self._queue.popleft()
+            if req.expired():
+                with self._cond:
+                    self.shed_deadline += 1
+                req.finish(DeadlineExceededError(
+                    "deadline expired while queued; request shed before "
+                    "prefill"))
+                continue
+            probe = False
+            if self.breaker is not None:
+                try:
+                    probe = self.breaker.acquire()
+                except ServiceUnavailableError as e:
+                    with self._cond:
+                        self.shed_unavailable += 1
+                    req.finish(e)
+                    continue
+            req.probe = probe
+            try:
+                self._prefill_into(free[0], req)
+            except BaseException as e:
+                if self.breaker is not None:
+                    self.breaker.record_failure(probe)
+                with self._cond:
+                    self.failures += 1
+                err = e if isinstance(e, ServingError) else \
+                    InferenceFailedError(
+                        f"prefill failed: {type(e).__name__}: {e}")
+                logger.warning("decode engine: prefill failure (%s)", err)
+                req.finish(err)
+                if self._donate and getattr(e, "_dispatch_failure", False):
+                    # the raised DISPATCH may have invalidated the DONATED
+                    # cache buffers — every in-flight slot's KV is gone
+                    # with them, so those requests must fail too (queued
+                    # ones survive: they hold no device state), then the
+                    # state rebuilds. Post-dispatch failures (non-finite
+                    # screen, hooks) and the no-donation CPU path leave
+                    # the caches valid: only this request fails
+                    cache_err = InferenceFailedError(
+                        "slotted cache lost to a failed prefill dispatch "
+                        "(donated buffers)")
+                    with self._cond:
+                        for s, r in enumerate(self._slots):
+                            if r is not None:
+                                self._slots[s] = None
+                                self._active[s] = False
+                                r.finish(cache_err)
+                        self._cond.notify_all()
+                    self._reset_device_state()
+
+    def _prefill_into(self, slot: int, req: _GenRequest) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = req.prompt.shape[0]
+        bucket = self._bucket_for(t0)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t0] = req.prompt
+        key = jax.random.PRNGKey(req.seed)
+        kp, kd = jax.random.split(key)  # generate()'s prefill/decode split
+        info = {"slot": slot, "bucket": bucket, "t0": t0}
+        self._hook("pre_prefill", info)
+
+        def run():
+            (self._caches, self._tok, self._pos, self._keys, self._temps,
+             tok0, ok) = self._prefill(
+                self._net._params, self._caches, jnp.asarray(ids),
+                jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
+                self._tok, self._pos, self._keys, self._temps, kp, kd,
+                jnp.asarray(req.temperature, jnp.float32))
+            return jax.device_get((tok0, ok))
+
+        first, ok = _dispatched(run)
+        first = int(first[0])
+        if not bool(ok):
+            raise InferenceFailedError(
+                "model produced non-finite logits during prefill "
+                "(poisoned parameters or a numerically broken graph)")
+        self._hook("post_prefill", info)
+        with self._cond:
+            self.prefills += 1
+            self.tokens_generated += 1
+        req.tokens.append(first)
+        if req.n_tokens == 1 or first == self.eos_token:
+            self._retire(slot, req, attached=False)
+            return
+        with self._cond:
+            req.slot = slot
+            self._slots[slot] = req
+            self._active[slot] = True
+
+    def _retire(self, slot: int, req: _GenRequest, *,
+                attached: bool = True) -> None:
+        """Successful completion: free the slot, credit the breaker,
+        deliver the tokens."""
+        with self._cond:
+            if attached:
+                self._slots[slot] = None
+                self._active[slot] = False
+            self.served += 1
+            self._cond.notify_all()
+        if self.breaker is not None:
+            self.breaker.record_success(req.probe)
+        req.finish()
+
+    def _expire_in_flight(self) -> None:
+        """An expired in-flight request frees its slot immediately — the
+        next queued request takes it on the following iteration. Expired
+        QUEUED requests are also swept here (not only at admission), so
+        a doomed request behind long-running slots fails promptly."""
+        now = time.monotonic()
+        expired_queued = []
+        with self._cond:
+            keep = collections.deque()
+            while self._queue:
+                req = self._queue.popleft()
+                if req.expired(now):
+                    expired_queued.append(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+            self.shed_deadline += len(expired_queued)
+        for req in expired_queued:
+            req.finish(DeadlineExceededError(
+                "deadline expired while queued; request shed before "
+                "prefill"))
+        for s in range(self.n_slots):
+            req = self._slots[s]
+            if req is not None and req.expired(now):
+                with self._cond:
+                    self._slots[s] = None
+                    self._active[s] = False
+                    self.shed_deadline += 1
+                    self._cond.notify_all()
+                if self.breaker is not None:
+                    # the device work done so far was healthy; expiry is
+                    # a deadline event, not a model failure
+                    self.breaker.record_success(req.probe)
+                req.finish(DeadlineExceededError(
+                    f"deadline expired after {len(req.tokens)} of "
+                    f"{req.n_tokens} tokens; slot freed"))
+
+    def _chunk_eligible(self, live, now: float) -> bool:
+        """A chunked dispatch is allowed only when no scheduling event
+        can land inside it: every live request needs at least a full
+        chunk more tokens, no deadline could expire before the chunk
+        returns, and — when EOS can retire a slot mid-chunk — no queued
+        request is waiting to take a freed slot (without an eos_token,
+        the remaining-tokens bound already proves nothing retires
+        mid-chunk). Admission waits at most one chunk — `_admit` runs
+        before every dispatch."""
+        if self.decode_chunk <= 1:
+            return False
+        if self.eos_token is not None:
+            with self._cond:
+                if self._queue:
+                    return False  # a mid-chunk EOS would strand the slot
+        margin = 2.0 * self.decode_chunk * max(self._step_ewma, 1e-4)
+        for _, r in live:
+            if r.n_tokens - len(r.tokens) < self.decode_chunk:
+                return False
+            if r.deadline is not None and r.deadline - now < margin:
+                return False
+        return True
+
+    def _step_active(self) -> None:
+        import jax.numpy as jnp
+
+        live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
+        if not live:
+            return
+        now = time.monotonic()
+        chunked = self._chunk_eligible(live, now)
+        info = {"active": len(live), "step": self.decode_steps,
+                "chunk": self.decode_chunk if chunked else 1}
+        t0 = time.monotonic()
+        try:
+            import jax
+
+            self._hook("pre_decode", info)
+
+            def run():
+                if chunked:
+                    (self._caches, self._tok, self._pos, self._keys,
+                     toks_d, oks_d) = self._decode_chunked(
+                        self._net._params, self._caches, self._tok,
+                        self._pos, self._keys, self._temps,
+                        jnp.asarray(self._active))
+                    # (chunk, S) tokens + per-step flags, ONE host sync
+                    return jax.device_get((toks_d, oks_d))
+                (self._caches, self._tok, self._pos, self._keys,
+                 ok_d) = self._decode_step(
+                    self._net._params, self._caches, self._tok,
+                    self._pos, self._keys, self._temps,
+                    jnp.asarray(self._active))
+                # THE per-iteration host sync — the price of
+                # iteration-level scheduling; chunking amortizes it
+                t, o = jax.device_get((self._tok, ok_d))
+                return t[None], o[None]
+
+            toks, oks = _dispatched(run)
+            self._hook("post_decode", info)
+        except BaseException as e:
+            err = e if isinstance(e, ServingError) else \
+                InferenceFailedError(
+                    f"decode step failed: {type(e).__name__}: {e}")
+            logger.warning("decode engine: decode failure (%s)", err)
+            with self._cond:
+                self.failures += len(live)
+            for s, req in live:
+                if self.breaker is not None:
+                    self.breaker.record_failure(req.probe)
+                with self._cond:
+                    self._slots[s] = None
+                    self._active[s] = False
+                    self._cond.notify_all()
+                req.finish(err)
+            if getattr(e, "_dispatch_failure", False):
+                # only a failed DISPATCH can have invalidated the
+                # donated cache buffers; hook failures leave them valid
+                self._reset_device_state()
+            return
+        n_steps = toks.shape[0]
+        with self._cond:
+            self._step_ewma = (0.8 * self._step_ewma
+                               + 0.2 * (time.monotonic() - t0) / n_steps)
+            self.decode_steps += n_steps
+            self.active_slot_steps += len(live) * n_steps
+        for s, req in live:
+            done = False
+            poisoned = False
+            for t in range(n_steps):
+                # per-step, per-slot non-finite screen (predict's
+                # breaker discipline): a poisoned step fails THIS
+                # request typed — unless it already completed via EOS
+                # at an earlier step of the chunk — and healthy
+                # neighbors keep decoding (their cache rows are
+                # untouched)
+                if not bool(oks[t, s]):
+                    poisoned = True
+                    break
+                tok = int(toks[t, s])
+                req.tokens.append(tok)
+                with self._cond:
+                    self.tokens_generated += 1
+                if len(req.tokens) >= req.n_tokens \
+                        or tok == self.eos_token:
+                    done = True  # EOS overshoot inside a chunk: tokens
+                    break        # past EOS are dropped with the slot
+            if poisoned:
+                nf_err = InferenceFailedError(
+                    "model produced non-finite logits during decode "
+                    "(poisoned parameters or a numerically broken graph)")
+                logger.warning("decode engine: %s", nf_err)
+                with self._cond:
+                    self.failures += 1
+                    self._slots[s] = None
+                    self._active[s] = False
+                    self._cond.notify_all()
+                if self.breaker is not None:
+                    self.breaker.record_failure(req.probe)
+                req.finish(nf_err)
+            elif done:
+                self._retire(s, req)
+
+    def _maybe_swap(self) -> None:
+        if not self._draining:
+            return
+        with self._cond:
+            if any(r is not None for r in self._slots):
+                return  # still draining: in-flight finish on old weights
+            net = self._swap_net
+            if net is None:  # drain abandoned (timeout in drain_and_swap)
+                self._draining = False
+                return
+            # claimed: from here the swap WILL complete (or fail) and
+            # set _swap_done — a timing-out drain_and_swap caller sees
+            # this flag and waits it out instead of mis-reporting
+            # "old weights still serving"
+            self._swap_in_progress = True
+        try:
+            self._build(net)
+            misfit = []
+            with self._cond:
+                self.swaps += 1
+                # queued requests were validated against the OLD
+                # max_len; the rebuilt engine may be tighter (smaller
+                # emb.max_length). A request that no longer fits would
+                # decode silently-wrong tail tokens past the new cache
+                # length — fail it typed instead
+                keep: collections.deque = collections.deque()
+                while self._queue:
+                    r = self._queue.popleft()
+                    if r.prompt.shape[0] + r.n_tokens > self.max_len:
+                        misfit.append(r)
+                    else:
+                        keep.append(r)
+                self._queue = keep
+            for r in misfit:
+                r.finish(ServingError(
+                    f"request (prompt {r.prompt.shape[0]} + n_tokens "
+                    f"{r.n_tokens}) no longer fits the swapped engine's "
+                    f"max_len {self.max_len}"))
+        except BaseException as e:
+            self._swap_error = e
+            logger.warning("decode engine: weight swap rejected (%s); "
+                           "old weights still serving", e)
+        finally:
+            with self._cond:
+                self._swap_net = None
+                self._draining = False
+                self._swap_in_progress = False
+                self._cond.notify_all()
+            self._swap_done.set()

@@ -48,6 +48,16 @@ training:
 drains queued + in-flight requests for up to `drain_timeout` seconds,
 then fails whatever remains — a shutdown is a bounded event, not a hang.
 
+**Generation serving** — construct with `generation={...}`
+(`serving.decode_engine.DecodeEngine` kwargs, or `True` for defaults)
+and `generate(prompt_ids, n_tokens, ...)` serves autoregressive
+generation through the continuous-batching decode engine: requests ride
+the same admission-control/deadline/breaker discipline as `predict`
+(typed `ServerOverloadedError` + `retry_after` on overload; a deadline
+expiring in the queue sheds before prefill; one expiring in flight
+frees its decode slot), and `reload()` drains the engine's slots so
+in-flight generations finish on the old weights before the swap.
+
 Chaos seam: `infer_hooks=[hook]` fires `hook(phase, info)` at
 `pre_step` / `post_step` around every device dispatch —
 `serving.chaos.SlowInferenceInjector` and `BrokenModelInjector` use it to
@@ -369,7 +379,8 @@ class ModelServer:
                  canary: Optional[np.ndarray] = None,
                  auto_canary: bool = True,
                  infer_hooks: Sequence[Callable] = (),
-                 pad_batches: bool = True):
+                 pad_batches: bool = True,
+                 generation: Optional[dict] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if max_concurrent < 1:
@@ -401,9 +412,16 @@ class ModelServer:
         self._in_flight = 0
         self._closed = False
         self._step_latency_ewma = 0.01  # retry_after hint seed
+        # generation tier: DecodeEngine kwargs (or {} for defaults);
+        # the engine itself is built lazily on the first generate() so a
+        # predict-only server never pays for it
+        self._generation_cfg = {} if generation is True else generation
+        self._engine = None
+        self._engine_lock = threading.Lock()
         # counters (observable state for tests/telemetry)
         self.served = 0          # requests completed successfully
         self.batches = 0         # device steps dispatched
+        self.rows_dispatched = 0  # rows across dispatched micro-batches
         self.shed_overload = 0   # rejected at admission (queue full)
         self.shed_deadline = 0   # expired before the device step
         self.shed_unavailable = 0  # rejected by the open breaker
@@ -426,15 +444,32 @@ class ModelServer:
     def stats(self) -> dict:
         with self._cond:
             queued = len(self._queue)
-        return {"served": self.served, "batches": self.batches,
-                "shed_overload": self.shed_overload,
-                "shed_deadline": self.shed_deadline,
-                "shed_unavailable": self.shed_unavailable,
-                "failures": self.failures, "reloads": self.reloads,
-                "reload_rejections": self.reload_rejections,
-                "breaker_state": self.breaker.state,
-                "breaker_opens": self.breaker.opens,
-                "model_version": self.model_version, "queued": queued}
+            # batch starvation observability: how full are dispatched
+            # micro-batches relative to device capacity (max_batch_size)?
+            # Low batch_fill_pct = the chip runs under-occupied steps —
+            # raise batch_window / offered concurrency, not kernel work
+            fill = (100.0 * self.rows_dispatched
+                    / (self.batches * self.max_batch_size)
+                    if self.batches else 0.0)
+        out = {"served": self.served, "batches": self.batches,
+               "batch_fill_pct": round(fill, 1),
+               "shed_overload": self.shed_overload,
+               "shed_deadline": self.shed_deadline,
+               "shed_unavailable": self.shed_unavailable,
+               "failures": self.failures, "reloads": self.reloads,
+               "reload_rejections": self.reload_rejections,
+               "breaker_state": self.breaker.state,
+               "breaker_opens": self.breaker.opens,
+               "model_version": self.model_version, "queued": queued}
+        engine = self._engine
+        if engine is not None:
+            gen = engine.stats()
+            # the decode-side starvation number, surfaced at top level
+            # next to batch_fill_pct: the two tell an operator whether
+            # they are batch-starved on predict and/or generation
+            out["slot_occupancy_pct"] = gen["slot_occupancy_pct"]
+            out["generation"] = gen
+        return out
 
     def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
         """Serve one request: features `x` of shape (B, ...). Blocks
@@ -489,6 +524,48 @@ class ModelServer:
 
     def __call__(self, x, timeout: Optional[float] = None) -> np.ndarray:
         return self.predict(x, timeout=timeout)
+
+    # -- generation (continuous batching) ----------------------------------
+    def _ensure_engine(self):
+        if self._generation_cfg is None:
+            raise RuntimeError(
+                "generation serving is not enabled — construct the server "
+                "with generation={...} (DecodeEngine kwargs) or "
+                "generation=True")
+        # closed-check and lazy construction share the engine lock, and
+        # shutdown() snapshots the engine under the same lock — a
+        # generate() racing shutdown() either sees _closed here or
+        # finishes building an engine shutdown() will then drain
+        with self._engine_lock:
+            with self._cond:
+                if self._closed:
+                    raise ServerClosedError("model server is shut down")
+            if self._engine is None:
+                from deeplearning4j_tpu.serving.decode_engine import (
+                    DecodeEngine,
+                )
+
+                cfg = dict(self._generation_cfg)
+                cfg.setdefault("max_queue", self.max_queue)
+                cfg.setdefault("breaker", self.breaker)
+                self._engine = DecodeEngine(self._net, **cfg)
+            return self._engine
+
+    def generate(self, prompt_ids, n_tokens: int, *,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Serve one generation request through the continuous-batching
+        decode engine (`serving.decode_engine.DecodeEngine`): admitted
+        into a decode slot as soon as one frees, decoded alongside every
+        other in-flight request, returned the moment ITS tokens are done
+        — never waiting on another request's tail. Shares the server's
+        circuit breaker and admission discipline; typed give-ups match
+        `predict`'s. Returns the generated token ids (1-D int32)."""
+        engine = self._ensure_engine()
+        timeout = self.default_timeout if timeout is None else timeout
+        return engine.generate(prompt_ids, n_tokens,
+                               temperature=temperature, seed=seed,
+                               timeout=timeout)
 
     # -- batch assembly ----------------------------------------------------
     def _pop_expired(self, req: _Request, now: float) -> bool:
@@ -635,6 +712,7 @@ class ModelServer:
             self._step_latency_ewma = (0.8 * self._step_latency_ewma
                                        + 0.2 * (time.monotonic() - t0))
             self.batches += 1
+            self.rows_dispatched += rows
         out = out[:rows]
         reason = non_finite_array_reason(out, "outputs")
         if reason is not None:
@@ -676,9 +754,38 @@ class ModelServer:
                     self.reload_rejections += 1
                 raise
             with self._rwlock.write():
+                old_net = self._net
                 self._net = candidate
                 self.model_version += 1
                 version = self.model_version
+            # generation tier: the decode engine drains its slots (every
+            # in-flight generation FINISHES on the old weights — its KV
+            # cache was computed with them), swaps, and resumes serving
+            # queued + new requests on the candidate. Runs after the
+            # predict-path swap, outside the rwlock: generation steps
+            # must keep dispatching while the engine drains. Snapshot
+            # under _engine_lock so a concurrent FIRST generate() that is
+            # mid-build cannot install an old-weights engine this reload
+            # never sees (the lock blocks until the build lands)
+            with self._engine_lock:
+                engine = self._engine
+            if engine is not None:
+                try:
+                    engine.drain_and_swap(candidate)
+                except BaseException:
+                    # the engine rejected/aborted the swap and still
+                    # serves the old weights — roll the predict path
+                    # back too, or the server would be split-brained
+                    # (predict on v2, generate on v1). The version stays
+                    # MONOTONIC: the rollback is its own version bump,
+                    # so telemetry tagged with the candidate's version
+                    # never aliases a later successful reload
+                    with self._rwlock.write():
+                        self._net = old_net
+                        self.model_version += 1
+                    with self._cond:
+                        self.reload_rejections += 1
+                    raise
             self.breaker.reset()
             self.reloads += 1
             logger.warning("model server: hot reload complete "
@@ -752,7 +859,21 @@ class ModelServer:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        with self._engine_lock:  # see _ensure_engine: closes the race
+            engine = self._engine  # with a concurrent lazy construction
         drained = True
+        engine_result: dict = {}
+        engine_thread = None
+        if engine is not None:
+            # drain the decode engine CONCURRENTLY with the predict
+            # queue: both run against the same drain_timeout budget, so
+            # a long in-flight generation cannot starve queued predicts
+            # of their drain window (nor stretch shutdown to 2x budget)
+            engine_thread = threading.Thread(
+                target=lambda: engine_result.update(
+                    ok=engine.shutdown(drain_timeout=drain_timeout)),
+                daemon=True)
+            engine_thread.start()
         with self._cond:
             while self._queue or self._in_flight:
                 remaining = deadline - time.monotonic()
@@ -767,6 +888,9 @@ class ModelServer:
                 self._cond.wait(min(remaining, 0.05))
         for t in self._threads:
             t.join(max(0.0, deadline - time.monotonic()) + 1.0)
+        if engine_thread is not None:
+            engine_thread.join(max(0.0, deadline - time.monotonic()) + 5.0)
+            drained = drained and engine_result.get("ok", False)
         if not drained:
             logger.warning("model server: shutdown drain timed out with "
                            "requests still pending")
