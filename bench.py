@@ -314,12 +314,19 @@ def bench_lstm_large():
 
 
 def _gpt_train_bench(metric, *, vocab, d_model, n_heads, n_layers, T,
-                     batch_size, warmup, bench, attention_block_size):
+                     batch_size, warmup, bench, attention_block_size,
+                     device_time=False):
     """Shared staging/measurement for the gpt-family training configs:
     build the bf16 net, stage sparse-int-label batches in HBM, time the
     steady-state epoch (median of _REPEATS), count MFU from XLA cost
     analysis. One implementation so a methodology fix cannot miss a
-    config. Returns (metric, tokens/sec, mfu, spread, net, batches)."""
+    config. With `device_time`, also difference a half-length epoch out
+    of the full one — bench_generate's r5 trick, generalized per
+    ROADMAP item 4: the per-epoch fixed cost (tunnel RTT, dispatch
+    bookkeeping, host hiccups) cancels in (dt_full - dt_half), leaving
+    a device-time-per-token median that separates host noise from real
+    step regressions. Returns (metric, tokens/sec, mfu, spread, net,
+    batches, device_ms_per_token-or-None)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -344,7 +351,18 @@ def _gpt_train_bench(metric, *, vocab, d_model, n_heads, n_layers, T,
     value = bench * batch_size * T / dt
     mfu = _mfu(_step_flops(net, batches[0]) / (batch_size * T), value,
                bf16=True)
-    return metric, value, mfu, spread, net, batches
+    dms = None
+    if device_time and bench > 1:
+        half = bench // 2
+        # same compiled step, same staged data, half the steps per
+        # timed epoch; medians of _REPEATS both sides
+        dt_half, _ = _throughput(net, batches, warmup, half)
+        if dt > dt_half:
+            dms = round(1e3 * (dt - dt_half)
+                        / ((bench - half) * batch_size * T), 6)
+        else:  # host noise swamped the differencing: wall-time bound
+            dms = round(1e3 * dt / (bench * batch_size * T), 6)
+    return metric, value, mfu, spread, net, batches, dms
 
 
 def bench_gpt():
@@ -352,10 +370,13 @@ def bench_gpt():
     precision. T=256 rides FULL attention: measured 892k vs 840k tok/s
     for the blockwise path at this length (blockwise/flash win only at
     T >> 1k); batch sweep: 32->892k, 64->1.25M, 128->1.43M, 256+->1.33M."""
-    return _gpt_train_bench(
+    out = _gpt_train_bench(
         "gpt_causal_lm_train_tokens_per_sec_per_chip",
         vocab=256, d_model=256, n_heads=8, n_layers=4, T=256,
-        batch_size=128, warmup=4, bench=16, attention_block_size=1024)[:4]
+        batch_size=128, warmup=4, bench=16, attention_block_size=1024,
+        device_time=True)
+    bench_gpt.device_ms_per_token = out[6]
+    return out[:4]
 
 
 def bench_gpt_med():
@@ -363,11 +384,17 @@ def bench_gpt_med():
     between the toy gpt config (d256/4L, shape-capped ~17% MFU) and
     gpt_long (d1024/T4096, ~42% MFU): realistic short-context training
     shapes where fusion wins are visible (r3 verdict ask #9). Batch sweep
-    on chip: 32->335k, 64->360k, 128->351k tok/s."""
-    return _gpt_train_bench(
+    on chip: 32->335k, 64->360k, 128->351k tok/s. r6: sub-baseline in
+    BENCH_r05 (0.979) with no device-time number to blame host vs chip —
+    `device_ms_per_token` (half-length differencing) now ships every
+    round so the next regression is attributable."""
+    out = _gpt_train_bench(
         "gpt_med_d512_train_tokens_per_sec_per_chip",
         vocab=512, d_model=512, n_heads=8, n_layers=8, T=512,
-        batch_size=64, warmup=3, bench=10, attention_block_size=1024)[:4]
+        batch_size=64, warmup=3, bench=10, attention_block_size=1024,
+        device_time=True)
+    bench_gpt_med.device_ms_per_token = out[6]
+    return out[:4]
 
 
 def bench_gpt_long():
@@ -388,7 +415,7 @@ def bench_gpt_long():
 
     vocab, d_model, heads = 256, 1024, 8
     T, batch_size = 4096, 8
-    metric, value, _, spread, net, batches = _gpt_train_bench(
+    metric, value, _, spread, net, batches, _dms = _gpt_train_bench(
         "gpt_long_t4096_train_tokens_per_sec_per_chip",
         vocab=vocab, d_model=d_model, n_heads=heads, n_layers=8, T=T,
         batch_size=batch_size, warmup=2, bench=6, attention_block_size=512)
@@ -884,20 +911,30 @@ def bench_generate():
 
 # serve_generate workload shape — module-level so the slow CPU smoke
 # test (tests/test_serving_generate.py) can shrink it without forking
-# the measurement logic. Output lengths are drawn from a SMALL mixed set
-# so the whole-batch baseline compiles a bounded number of decode pairs
-# (generate's LRU holds 8) while still exercising mixed-length goodput.
+# the measurement logic. r6: the workload is MIXED-LENGTH prompts
+# (mostly short, a long tail of `long_frac` prompts at the top length)
+# — the traffic shape paging + chunked prefill exist for: short
+# requests must not pay long requests' worst-case KV, and a long
+# prompt's prefill must not stall their decodes.
 _SERVE_GEN_SHAPE = {
     "vocab": 256, "d_model": 256, "n_heads": 8, "n_layers": 4,
-    "T0": 32, "n_requests": 32, "out_lengths": (32, 48, 64, 96, 128),
-    "n_slots": 8, "mean_interarrival": 0.01, "gqa_kv_heads": 2,
+    "prompt_lengths": (128, 4096), "long_frac": 0.25,
+    "n_requests": 32, "out_lengths": (32, 48, 64, 96, 128),
+    "r5_n_slots": 8, "slots_multiplier": 4,
+    "page_size": 128, "prefill_chunk": 256,
+    "mean_interarrival": 0.01, "gqa_kv_heads": 2,
     "repeats": _REPEATS,
 }
 
 
 def _serve_gen_workload(shp, rng):
-    prompts = rng.integers(0, shp["vocab"],
-                           (shp["n_requests"], shp["T0"])).astype(np.int32)
+    """Mixed-length prompts (list of 1-D id arrays), output lengths and
+    Poisson arrival offsets for one serve_generate pass."""
+    short, long_ = min(shp["prompt_lengths"]), max(shp["prompt_lengths"])
+    t0s = np.where(rng.random(shp["n_requests"]) < shp["long_frac"],
+                   long_, short)
+    prompts = [rng.integers(0, shp["vocab"], int(t)).astype(np.int32)
+               for t in t0s]
     outs = rng.choice(np.asarray(shp["out_lengths"]), shp["n_requests"])
     arrivals = np.cumsum(rng.exponential(shp["mean_interarrival"],
                                          shp["n_requests"]))
@@ -940,36 +977,44 @@ def _serve_gen_engine_pass(engine, prompts, outs, arrivals):
 def bench_serve_generate():
     """Continuous-batching generation goodput
     (`serving.decode_engine.DecodeEngine`) under Poisson arrivals with
-    mixed output lengths, against the whole-batch-`generate`-per-request
-    baseline (what a naive server does: one B=1 `generate` call per
-    request, each request waiting for the full previous call).
+    MIXED-LENGTH prompts (mostly 128-token, a `long_frac` tail at 4096)
+    and mixed output lengths — the paged-KV + chunked-prefill
+    acceptance workload.
 
-    The r4 decode profile's conclusion — decode throughput scales with
-    batch, not kernel work — is the mechanism priced here: the engine
-    keeps `n_slots` sequences in one decode dispatch while requests
-    arrive/retire per iteration, so mixed-length traffic fills the batch
-    dimension the whole-batch path wastes on tail-waiting. Metric:
-    goodput tokens/sec (median of `repeats` passes). Satellites:
-    per-request p50/p99 latency (arrival→completion, queueing included),
-    `slot_occupancy_pct` (the batch-starvation signal), the serial
-    baseline's tokens/sec + simulated-queueing latency for the same
-    arrival times, and a GQA engine variant line
-    (`gpt_configuration(n_kv_heads=...)` — r4 measured +54% decode from
-    cache-byte shrink) kept OFF the headline metric so the baseline
-    stays comparable."""
+    Two configurations of the SAME engine run the same traffic on the
+    SAME KV memory budget:
+
+    - **r5**: `r5_n_slots` slots, buckets covering the longest prompt
+      (one-shot prefill, no chunking) and the pool sized to give every
+      slot a full max-length allocation — the dense r5 slotted-cache
+      configuration, reproduced exactly under the paged engine.
+    - **paged**: `slots_multiplier ×` the slot count on the IDENTICAL
+      pool (pages bound by ACTUAL request lengths, so short requests
+      stop paying the 4096-token worst case), short buckets + chunked
+      prefill (`prefill_chunk`) so a 4096-token prompt prefills
+      interleaved with decode instead of head-of-line-blocking it.
+
+    Headline metric: paged-config goodput tokens/sec (median of
+    `repeats` passes) — renamed from r5's `serve_generate_goodput_*`
+    because the workload changed shape (mixed prompts), which resets
+    baseline comparability (the lstm_large precedent). Satellites:
+    paged p50/p99 arrival→completion latency, `slot_occupancy_pct`,
+    `pages_in_use_peak` + `prefill_chunks` (the new paging/chunking
+    accounting), the r5 configuration's goodput + latency on the same
+    traffic, their ratio `paged_vs_r5_goodput`, and a GQA variant line
+    (`gpt_configuration(n_kv_heads=...)`) kept OFF the headline."""
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models.transformer import (
-        generate,
-        gpt_configuration,
-    )
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
 
     shp = _SERVE_GEN_SHAPE
     rng = np.random.default_rng(0)
     prompts, outs, arrivals = _serve_gen_workload(shp, rng)
-    max_len = shp["T0"] + int(max(shp["out_lengths"]))
+    short_t0 = min(shp["prompt_lengths"])
+    long_t0 = max(shp["prompt_lengths"])
+    max_len = long_t0 + int(max(shp["out_lengths"]))
 
     def build_net(n_kv_heads=0):
         net = MultiLayerNetwork(
@@ -983,11 +1028,14 @@ def bench_serve_generate():
         net.init()
         return net
 
-    def engine_goodput(net):
+    def engine_goodput(net, n_slots, **engine_kw):
         engine = DecodeEngine(
-            net, n_slots=shp["n_slots"], max_len=max_len,
-            prompt_buckets=(shp["T0"],),
-            max_queue=max(64, 2 * shp["n_requests"]))
+            net, n_slots=n_slots, max_len=max_len,
+            page_size=shp["page_size"],
+            prefill_chunk=shp["prefill_chunk"],
+            max_queue=max(64, 2 * shp["n_requests"]),
+            max_queued_pages=10 ** 9,  # latency priced, not queue sheds
+            **engine_kw)
         try:
             _serve_gen_engine_pass(engine, prompts, outs, arrivals)  # jit
             _serve_gen_engine_pass(engine, prompts, outs, arrivals)  # settle
@@ -1005,54 +1053,48 @@ def bench_serve_generate():
             occupancy = round(
                 100.0 * (engine.active_slot_steps - base_active)
                 / max(1, d_steps * engine.n_slots), 1)
+            stats = engine.stats()
         finally:
             engine.shutdown(drain_timeout=30.0)
         return (float(np.median(goodputs)),
-                float(max(goodputs) / min(goodputs)), lats, occupancy)
+                float(max(goodputs) / min(goodputs)), lats, occupancy,
+                stats)
+
+    def pct(lats):
+        return {"p50": round(1e3 * float(np.percentile(lats, 50)), 2),
+                "p99": round(1e3 * float(np.percentile(lats, 99)), 2)}
 
     net = build_net()
-    goodput, spread, lats, occupancy = engine_goodput(net)
-    bench_serve_generate.latency_ms = {
-        "p50": round(1e3 * float(np.percentile(lats, 50)), 2),
-        "p99": round(1e3 * float(np.percentile(lats, 99)), 2)}
-    bench_serve_generate.slot_occupancy_pct = occupancy
+    # r5 configuration: one-shot prefill for every prompt (buckets cover
+    # the longest) and a full max-length KV allocation per slot
+    r5_goodput, _, r5_lats, _, r5_stats = engine_goodput(
+        net, shp["r5_n_slots"],
+        prompt_buckets=(short_t0, long_t0))
+    kv_budget_pages = r5_stats["pool_pages"]  # n_slots x pages-per-slot
 
-    # whole-batch-per-request serial baseline: warm every (T0, n_tokens)
-    # pair once (compile), then time the serial sweep; per-request
-    # latency under the SAME arrivals is simulated from the measured
-    # service times (completion_i = max(arrival_i, completion_{i-1}) +
-    # service_i — an M/D/1-style queue walk, no second measurement)
-    for n_tok in sorted(set(int(o) for o in outs)):
-        generate(net, prompts[:1], n_tok, temperature=0.0)
-    services = []
-    t0 = time.perf_counter()
-    total = 0
-    for i in range(len(outs)):
-        s0 = time.perf_counter()
-        out = generate(net, prompts[i:i + 1], int(outs[i]),
-                       temperature=0.0)
-        total += np.asarray(out).size
-        services.append(time.perf_counter() - s0)
-    base_dt = time.perf_counter() - t0
-    base_tokens_per_sec = total / base_dt
-    done = 0.0
-    base_lats = []
-    for i in range(len(outs)):
-        done = max(arrivals[i], done) + services[i]
-        base_lats.append(done - arrivals[i])
-    bench_serve_generate.baseline_tokens_per_sec = round(
-        base_tokens_per_sec, 1)
-    bench_serve_generate.baseline_latency_ms = {
-        "p50": round(1e3 * float(np.percentile(base_lats, 50)), 2),
-        "p99": round(1e3 * float(np.percentile(base_lats, 99)), 2)}
-    bench_serve_generate.goodput_vs_serial = round(
-        goodput / base_tokens_per_sec, 3)
+    # paged configuration: 4x the slots on the SAME pool, short buckets
+    # so the 4096-token prompts ride chunked prefill
+    goodput, spread, lats, occupancy, stats = engine_goodput(
+        net, shp["r5_n_slots"] * shp["slots_multiplier"],
+        pool_pages=kv_budget_pages,
+        prompt_buckets=(short_t0,))
+    bench_serve_generate.latency_ms = pct(lats)
+    bench_serve_generate.slot_occupancy_pct = occupancy
+    bench_serve_generate.pages_in_use_peak = stats["pages_in_use_peak"]
+    bench_serve_generate.pool_pages = stats["pool_pages"]
+    bench_serve_generate.prefill_chunks = stats["prefill_chunks"]
+    bench_serve_generate.r5_goodput_tokens_per_sec = round(r5_goodput, 1)
+    bench_serve_generate.r5_latency_ms = pct(r5_lats)
+    bench_serve_generate.paged_vs_r5_goodput = round(
+        goodput / r5_goodput, 3)
 
     # GQA variant line (not the headline: baseline comparability)
     gqa_net = build_net(n_kv_heads=shp["gqa_kv_heads"])
-    gqa_goodput = engine_goodput(gqa_net)[0]
+    gqa_goodput = engine_goodput(
+        gqa_net, shp["r5_n_slots"] * shp["slots_multiplier"],
+        pool_pages=kv_budget_pages, prompt_buckets=(short_t0,))[0]
     bench_serve_generate.gqa_goodput_tokens_per_sec = round(gqa_goodput, 1)
-    return ("serve_generate_goodput_tokens_per_sec", goodput, None,
+    return ("serve_generate_paged_goodput_tokens_per_sec", goodput, None,
             spread)
 
 
@@ -1126,9 +1168,12 @@ def main() -> None:
                 ("shed_rate_pct", "shed_rate_pct"),
                 ("device_ms_per_token", "device_ms_per_token"),
                 ("slot_occupancy_pct", "slot_occupancy_pct"),
-                ("baseline_tokens_per_sec", "baseline_tokens_per_sec"),
-                ("baseline_latency_ms", "baseline_latency_ms"),
-                ("goodput_vs_serial", "goodput_vs_serial"),
+                ("pages_in_use_peak", "pages_in_use_peak"),
+                ("pool_pages", "pool_pages"),
+                ("prefill_chunks", "prefill_chunks"),
+                ("r5_goodput_tokens_per_sec", "r5_goodput_tokens_per_sec"),
+                ("r5_latency_ms", "r5_latency_ms"),
+                ("paged_vs_r5_goodput", "paged_vs_r5_goodput"),
                 ("gqa_goodput_tokens_per_sec",
                  "gqa_goodput_tokens_per_sec")):
             extra = getattr(_CONFIGS[name], attr, None)

@@ -11,11 +11,13 @@ final LayerNorm → per-timestep softmax head. Scales via:
 - wide FFN: `parallel/experts.py` Switch MoE.
 
 Decode machinery: `GPTPlan` + the `_block_heads`/`_block_ffn`/
-`_final_logits`/`_sample_logits` helpers are the SINGLE implementation of
-per-token transformer compute, shared by whole-batch `generate()` below
-and by the continuous-batching `serving.decode_engine.DecodeEngine` —
-the engine's argmax-parity guarantee against `generate` holds by
-construction, not only by test.
+`_final_logits`/`_sample_logits`/`_prefill_block_attention`/
+`_prefill_chunk_block_attention` helpers are the SINGLE implementation
+of per-token transformer compute, shared by whole-batch `generate()`
+below and by the continuous-batching
+`serving.decode_engine.DecodeEngine` (paged KV cache + chunked
+prefill) — the engine's argmax-parity guarantee against `generate`
+holds by construction, not only by test.
 """
 from __future__ import annotations
 
@@ -240,6 +242,21 @@ def _prefill_block_attention(layer, q, k, v):
         kf = jnp.repeat(k, g, axis=2)
         vf = jnp.repeat(v, g, axis=2)
     return full_attention(q, kf, vf, causal=True)
+
+
+def _prefill_chunk_block_attention(layer, q, k_cache, v_cache, q_pos):
+    """Causal attention for ONE prompt chunk of one block against the
+    slot's (paged-gathered) dense cache — the chunked-prefill
+    counterpart of `_prefill_block_attention`, used by the decode
+    engine when a prompt is longer than its one-shot buckets. `q`:
+    (1, C, H, hd) fresh chunk queries at absolute positions `q_pos`
+    (C,); `k_cache`/`v_cache`: (Hkv, hd, L)/(Hkv, L, hd) already
+    holding the chunk's own K/V, so masking to entries `<= q_pos` is
+    exactly causal over [prior chunks ‖ this chunk]. Returns
+    (1, C, H*hd)."""
+    from deeplearning4j_tpu.ops.attention import cached_attention_chunk
+
+    return cached_attention_chunk(q[0], k_cache, v_cache, q_pos)[None]
 
 
 def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,

@@ -186,6 +186,75 @@ def cached_attention_step(q: jnp.ndarray, k_cache: jnp.ndarray,
     return att.reshape(B, H * D)
 
 
+def paged_gather(k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                 page_table: jnp.ndarray):
+    """Materialize per-slot dense decode-layout caches from a paged pool.
+
+    `k_pool`: (P, Hkv, D, page) and `v_pool`: (P, Hkv, page, D) — the
+    decode layouts of `cached_attention_step` with the length axis cut
+    into fixed-size pages; page 0 is the reserved trash page (never
+    allocated, absorbs masked writes). `page_table`: (S, n_pages) int32
+    mapping each slot's logical page index to a pool page id
+    (unallocated entries point at page 0). Returns (k, v) in the dense
+    layouts (S, Hkv, D, n_pages*page) / (S, Hkv, n_pages*page, D): the
+    gather is ordered by logical page index, so logical position
+    `p` lands at index `p` exactly as in the contiguous cache — downstream
+    attention numerics are the DENSE step's numerics, which is what
+    keeps paged decode argmax-identical to `generate`. Garbage in
+    unwritten/trash regions is masked by position downstream (and is
+    always finite — pages only ever hold zeros or real KV — so masked
+    `0 * garbage` terms stay exact zeros)."""
+    P, Hkv, D, page = k_pool.shape
+    S, n_pages = page_table.shape
+    k = jnp.take(k_pool, page_table, axis=0)     # (S, n_pages, Hkv, D, page)
+    k = jnp.transpose(k, (0, 2, 3, 1, 4)).reshape(S, Hkv, D, n_pages * page)
+    v = jnp.take(v_pool, page_table, axis=0)     # (S, n_pages, Hkv, page, D)
+    v = jnp.transpose(v, (0, 2, 1, 3, 4)).reshape(S, Hkv, n_pages * page, D)
+    return k, v
+
+
+def paged_attention_step(q: jnp.ndarray, k_pool: jnp.ndarray,
+                         v_pool: jnp.ndarray, page_table: jnp.ndarray,
+                         pos) -> jnp.ndarray:
+    """One decode step against a PAGED KV pool: gather each slot's pages
+    into the dense decode layout, then run `cached_attention_step`
+    unchanged — paged storage, dense numerics. The persistent allocation
+    is the pool (pages actually held per request), not
+    slots × max-length; the gathered dense view is a transient of the
+    step. (A fused kernel that walks the page table in-place — vLLM's
+    PagedAttention — is the follow-on optimization; this XLA form is the
+    portable reference semantics.)"""
+    k, v = paged_gather(k_pool, v_pool, page_table)
+    return cached_attention_step(q, k, v, pos)
+
+
+def cached_attention_chunk(q: jnp.ndarray, k_cache: jnp.ndarray,
+                           v_cache: jnp.ndarray, q_pos) -> jnp.ndarray:
+    """Chunked-prefill attention for ONE slot: a block of C queries
+    against that slot's dense-layout cache.
+
+    `q`: (C, H, D) — the prompt chunk's query heads, at absolute
+    positions `q_pos` (C,). `k_cache`: (Hkv, D, L), `v_cache`:
+    (Hkv, L, D) — the slot's cache (typically `paged_gather` output for
+    one slot) which already contains this chunk's own K/V, so masking
+    each query to cache entries `<= q_pos` yields exactly causal
+    attention over [prompt-so-far ‖ this chunk]. GQA contracts against
+    the un-repeated Hkv caches, like `cached_attention_step`.
+
+    Returns (C, H*D), ready for the output projection."""
+    Hkv, D, L = k_cache.shape
+    C, H = q.shape[0], q.shape[1]
+    G = H // Hkv
+    qg = jnp.transpose(q.reshape(C, Hkv, G, D), (1, 2, 0, 3))  # (Hkv,G,C,D)
+    s = jnp.einsum("kgcd,kdl->kgcl", qg,
+                   k_cache) / jnp.sqrt(jnp.asarray(D, q.dtype))
+    limit = jnp.asarray(q_pos)[None, None, :, None]
+    s = jnp.where(jnp.arange(L)[None, None, None, :] <= limit, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    att = jnp.einsum("kgcl,kld->kgcd", w, v_cache)    # (Hkv, G, C, D)
+    return jnp.transpose(att, (2, 0, 1, 3)).reshape(C, H * D)
+
+
 _SEQ_PARALLEL: list = []  # (mesh, seq_axis, batch_axis) stack
 
 

@@ -1,7 +1,8 @@
 """Robust serving tier: admission control, per-request deadlines with
 adaptive micro-batching, circuit breaking, safe hot model reload, and a
-continuous-batching generation path (`DecodeEngine`: slotted KV cache +
-iteration-level scheduling) — the inference-path counterpart of the
+continuous-batching generation path (`DecodeEngine`: paged KV cache,
+chunked prefill + iteration-level scheduling) — the inference-path
+counterpart of the
 training robustness tier (elastic workers / durable checkpoints /
 health sentinel). See `docs/serving.md` for the ladder semantics and
 tuning knobs.
@@ -19,6 +20,7 @@ from deeplearning4j_tpu.serving.model_server import (
     InferenceFailedError,
     ModelServer,
     ModelValidationError,
+    OutOfPagesError,
     ServerClosedError,
     ServerOverloadedError,
     ServiceUnavailableError,
@@ -34,6 +36,7 @@ __all__ = [
     "InjectedServingFault",
     "ModelServer",
     "ModelValidationError",
+    "OutOfPagesError",
     "ReloadCorruptionInjector",
     "ServerClosedError",
     "ServerOverloadedError",

@@ -1,5 +1,5 @@
-"""Continuous-batching decode engine: slotted KV cache + in-flight
-admission (iteration-level scheduling).
+"""Continuous-batching decode engine: PAGED KV cache + chunked prefill
+over slotted iteration-level scheduling.
 
 `models/transformer.generate` is a whole-batch synchronous sampler:
 every request in a batch decodes the same number of tokens in lockstep,
@@ -9,45 +9,71 @@ at serving shapes decode is dispatch+cache-bandwidth bound and
 "throughput scales with batch, not with further kernel work" — the
 batch dimension is therefore the scheduling resource. This engine turns
 it into a pool of `n_slots` decode **slots** (Orca's iteration-level
-scheduling, OSDI '22; the slot/block-managed cache family of
-vLLM/PagedAttention, SOSP '23, minus paging — slots are fixed-length
-rows of one contiguous cache):
+scheduling, OSDI '22), with the KV memory behind the slots managed as
+**pages** (PagedAttention, Kwon et al., SOSP '23) and long prompts
+prefilled in **chunks interleaved with decode** (Sarathi-Serve,
+Agrawal et al., 2024):
 
-- **one slotted KV cache** per block, allocated once and advanced
-  in place (donated through the jitted step): K `(S, Hkv, hd, L)`,
-  V `(S, Hkv, L, hd)` — the r4 decode layouts with the batch axis
-  reinterpreted as the slot axis. Per-slot position and active mask
-  make ONE compiled decode step correct for slots holding sequences of
-  different lengths: `ops.attention.cached_attention_step` masks each
-  slot's cache past its own position, inactive slots are carried
-  through unchanged, so there is exactly one compiled decode shape no
-  matter how requests arrive or retire.
+- **one paged KV pool** per block, allocated once and advanced in
+  place (donated through the jitted step): K `(P, Hkv, hd, page)`,
+  V `(P, Hkv, page, hd)` — the r4 decode layouts with the length axis
+  cut into fixed-size pow-2 pages. Page 0 is a reserved trash page that
+  absorbs masked writes from inactive slots; every other page is
+  allocated to exactly one request at a time. A per-slot **page table**
+  `(S, n_pages_max)` lives on device; `ops.attention.paged_gather`
+  reassembles each slot's logical cache in position order, so the
+  attention numerics are EXACTLY the dense slotted step's numerics
+  (`cached_attention_step` runs unchanged on the gathered view).
+- **memory-side admission control**: a request needs
+  `ceil(span/page)` pages (span = padded prefill width or
+  prompt+output, whichever is larger). Pages are allocated at
+  ADMISSION — queued requests hold no memory — and returned to the
+  free list on retirement/expiry/failure, so slots-per-chip is bound
+  by ACTUAL request lengths, not worst-case `max_len` per slot. When
+  the pool is exhausted the queue head WAITS (FIFO) for a retirement
+  to free pages, and the bounded queue gains a memory axis: beyond
+  `max_queued_pages` of aggregate queued page demand, `submit` sheds
+  with the typed `OutOfPagesError` (a `ServerOverloadedError`
+  subclass, `retry_after` included) — the same at-the-door discipline
+  as the count-bounded queue.
 - **a jitted decode step advances ALL active slots every iteration** —
-  a request admitted mid-flight starts decoding on the very next step,
-  and a request that finishes frees its slot immediately. No request
-  ever waits on another request's tail.
-- **a jitted prefill** writes a new prompt's KV into a freed slot at a
-  small set of pow-2-padded prompt buckets (`prompt_buckets`), so the
-  prefill compiles O(#buckets) shapes. Padding is harmless by
-  construction: cache entries past a slot's position are never
-  attended, and decode overwrites them before the position reaches
-  them.
+  per-slot position + active mask make ONE compiled decode shape
+  correct for any mix of sequence lengths; inactive slots' cache
+  writes are redirected to the trash page so a freed (and reallocated)
+  page can never be corrupted by a stale lane.
+- **prefill**: prompts up to the largest `prompt_buckets` entry
+  prefill in ONE dispatch exactly as before (same
+  `_prefill_block_attention` numerics as `generate`), now writing
+  into the slot's pages. Prompts longer than every bucket AND longer
+  than `prefill_chunk` prefill in fixed-size chunks of
+  `prefill_chunk` tokens, at most `prefill_chunk_budget` chunk
+  dispatches per scheduler iteration, INTERLEAVED with decode steps —
+  admitting a 4096-token prompt no longer head-of-line-blocks every
+  in-flight decode. Each chunk attends causally over
+  [prior chunks ‖ itself] through the paged cache
+  (`models.transformer._prefill_chunk_block_attention`); the final
+  chunk samples the first token with the same kp/kd key discipline as
+  `generate`.
 - **a host scheduler loop** admits queued requests into free slots,
-  retires slots on EOS / max-tokens / expired deadlines, and delivers
-  tokens per-request as they complete.
+  drives pending prefill chunks, retires slots on EOS / max-tokens /
+  expired deadlines, and delivers tokens per-request as they complete.
 
 Robustness rides the PR-4 serving tier: a bounded queue sheds with the
-typed `ServerOverloadedError` (+`retry_after`), a deadline expiring in
-the queue sheds BEFORE prefill, a deadline expiring in flight frees its
-slot for the next request, an optional `CircuitBreaker` gates admission
-and counts device failures, and `drain_and_swap(net)` lets a hot reload
-finish in-flight requests on the old weights, swap, and keep serving.
+typed `ServerOverloadedError` (+`retry_after`), the page ledger sheds
+with `OutOfPagesError`, a deadline expiring in the queue sheds BEFORE
+prefill, a deadline expiring in flight (mid-prefill or mid-decode)
+frees its slot AND its pages, an optional `CircuitBreaker` gates
+admission and counts device failures, and `drain_and_swap(net)` lets a
+hot reload finish in-flight requests on the old weights, swap, and
+keep serving.
 
 **Parity guarantee**: the engine traces the SAME per-block helpers as
 `generate` (`models.transformer.GPTPlan`/`_block_heads`/`_block_ffn`/
-`_final_logits`/`cached_attention_step`), so slotted greedy decode
-reproduces whole-batch `generate` argmax-exactly at f32 for the same
-prompts, regardless of admission order (asserted in
+`_prefill_block_attention`/`_prefill_chunk_block_attention`/
+`cached_attention_step`), and the paged gather reassembles caches in
+logical-position order, so slotted greedy decode reproduces whole-batch
+`generate` argmax-exactly at f32 for the same prompts, regardless of
+admission order, page/slot reuse, or prefill chunking (asserted in
 `tests/test_serving_generate.py`).
 """
 from __future__ import annotations
@@ -63,6 +89,7 @@ import numpy as np
 from deeplearning4j_tpu.serving.model_server import (
     DeadlineExceededError,
     InferenceFailedError,
+    OutOfPagesError,
     ServerClosedError,
     ServerOverloadedError,
     ServiceUnavailableError,
@@ -73,14 +100,19 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class _GenRequest:
-    """One generation request's lifecycle: queued → (shed | prefilled
-    into a slot) → decoding → (completed | expired | failed). `tokens`
-    grows as the engine emits — tokens are delivered per-request as they
-    complete, never held for a batch."""
+    """One generation request's lifecycle: queued → (shed | admitted
+    into a slot, prefilled — one-shot or chunk by chunk) → decoding →
+    (completed | expired | failed). `tokens` grows as the engine
+    emits — tokens are delivered per-request as they complete, never
+    held for a batch. `n_pages` is the page reservation taken at
+    submit; `pages` the pool pages held from admission to
+    retirement; `prefill_pos` the next chunk offset while a long
+    prompt is mid-prefill (None once decoding)."""
 
     __slots__ = ("prompt", "n_tokens", "temperature", "seed", "deadline",
                  "event", "tokens", "error", "enqueued_at", "probe",
-                 "slot", "completed_at")
+                 "slot", "completed_at", "n_pages", "pages",
+                 "prefill_pos")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -97,6 +129,9 @@ class _GenRequest:
         self.completed_at: Optional[float] = None
         self.probe = False
         self.slot: Optional[int] = None
+        self.n_pages = 0
+        self.pages: Optional[List[int]] = None
+        self.prefill_pos: Optional[int] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -128,7 +163,7 @@ def _dispatched(thunk):
     """Run one compiled dispatch INCLUDING its host materialization,
     tagging any exception raised so the caller can tell a FAILED
     DISPATCH (which, under buffer donation, may have invalidated the
-    donated cache buffers) apart from failures raised after the results
+    donated pool buffers) apart from failures raised after the results
     landed (non-finite screens, hooks) — only the former justifies
     failing other slots. The device_get must live inside the thunk: on
     asynchronous backends a device-side error surfaces at
@@ -142,19 +177,50 @@ def _dispatched(thunk):
 
 class DecodeEngine:
     """Continuous-batching generation over a fixed pool of decode slots
-    (see module docstring).
+    backed by a paged KV pool (see module docstring).
 
     Parameters
     ----------
     net : a fitted `gpt_configuration` network (TokenEmbedding first).
     n_slots : decode slots = max concurrently-decoding requests; also
-        the batch dimension of the one compiled decode step. Size it so
-        slot_occupancy_pct stays high at your arrival rate.
-    max_len : KV cache length L (prompt + generated tokens per request).
+        the batch dimension of the one compiled decode step. With
+        paging, KV memory is sized by `pool_pages`, not by
+        `n_slots × max_len` — size `n_slots` for concurrency and the
+        pool for memory.
+    max_len : per-request length cap (prompt + generated tokens).
         Defaults to the embedding's max_length (clamped to it for
-        learned-positional models).
-    prompt_buckets : pow-2 prompt pad lengths the prefill compiles for;
-        a longer prompt falls back to the next power of two ≤ max_len.
+        learned-positional models). Also sizes the per-slot page-table
+        width.
+    page_size : pow-2 KV page length (positions per page). Clamped to
+        the pow-2 ceiling of `max_len`. 128 matches the TPU lane width
+        of the decode layouts; tests use small pages to force
+        multi-page requests.
+    pool_pages : allocatable KV pages shared by all slots (page 0, the
+        trash page, is extra). Default `n_slots × ceil(max_len/page)` —
+        the dense r5 slotted cache's exact memory budget, so the
+        default cannot regress capacity. The real win runs the other
+        way: on a fixed memory budget, raise `n_slots` well past
+        `pool_pages × page / max_len` and let ACTUAL request lengths,
+        not the worst case, decide how many decode concurrently.
+    max_queued_pages : memory axis of the bounded queue: max aggregate
+        page demand allowed to WAIT (queued requests hold no pages;
+        this bounds how deep the page-wait room gets). Beyond it,
+        `submit` sheds with the typed `OutOfPagesError` + retry_after.
+        A lone waiter always queues regardless of the cap — only
+        aggregate demand sheds, so any request that fits the pool is
+        eventually servable. Default `4 × pool_pages` (~four pool
+        turnovers of patience).
+    prompt_buckets : pow-2 prompt pad lengths the one-shot prefill
+        compiles for; a longer prompt falls back to the next power of
+        two ≤ max_len, or to CHUNKED prefill when it is also longer
+        than `prefill_chunk`.
+    prefill_chunk : pow-2 chunk width for chunked prefill of long
+        prompts. Chunking activates for prompts longer than both the
+        largest bucket and this value (and only when it is < max_len).
+    prefill_chunk_budget : max prefill-chunk dispatches per scheduler
+        iteration — the knob trading admission latency of long prompts
+        against decode latency of in-flight requests. 1 interleaves
+        one chunk between consecutive decode steps.
     max_queue : bounded admission queue; beyond it `submit` sheds with
         the typed `ServerOverloadedError`.
     eos_token : optional token id that retires a slot early.
@@ -162,22 +228,24 @@ class DecodeEngine:
     breaker : optional `CircuitBreaker` shared with a `ModelServer` —
         admission is rejected while open, device failures count.
     step_hooks : chaos/observability seam — called as `hook(phase,
-        info)` at pre/post_prefill and pre/post_decode.
+        info)` at pre/post_prefill (info carries `chunk_off`/`final`
+        for chunked prefill) and pre/post_decode.
     decode_chunk : fuse up to this many decode iterations into ONE
         dispatch (a `lax.scan` over the same step body — identical
         numerics) whenever no scheduling event can fall inside the
         chunk: every in-flight request needs ≥chunk more tokens, no
-        deadline can expire within it, and no queued request is waiting
-        on a free slot. Decode is dispatch-bound at serving shapes (r4
-        profile), so this amortizes the per-iteration dispatch + host
-        sync the same way `generate`'s scanned decode does, while
-        keeping admission latency bounded by `decode_chunk` iterations.
-        1 disables fusion.
+        deadline can expire within it, no prompt is mid-prefill, and no
+        queued request is waiting on a free slot. 1 disables fusion.
     """
 
     def __init__(self, net, *, n_slots: int = 4,
                  max_len: Optional[int] = None,
+                 page_size: int = 128,
+                 pool_pages: Optional[int] = None,
+                 max_queued_pages: Optional[int] = None,
                  prompt_buckets: Sequence[int] = (32, 64, 128),
+                 prefill_chunk: int = 256,
+                 prefill_chunk_budget: int = 1,
                  max_queue: int = 64,
                  default_timeout: Optional[float] = None,
                  eos_token: Optional[int] = None,
@@ -191,15 +259,30 @@ class DecodeEngine:
             raise ValueError("max_queue must be >= 1")
         if decode_chunk < 1:
             raise ValueError("decode_chunk must be >= 1")
+        if page_size < 1 or page_size & (page_size - 1):
+            raise ValueError("page_size must be a power of two")
+        if prefill_chunk < 1 or prefill_chunk & (prefill_chunk - 1):
+            raise ValueError("prefill_chunk must be a power of two")
+        if prefill_chunk_budget < 1:
+            raise ValueError("prefill_chunk_budget must be >= 1")
+        if pool_pages is not None and pool_pages < 1:
+            raise ValueError("pool_pages must be >= 1")
+        if max_queued_pages is not None and max_queued_pages < 0:
+            raise ValueError("max_queued_pages must be >= 0")
         self.n_slots = n_slots
         self.max_queue = max_queue
         self.default_timeout = default_timeout
         self.eos_token = eos_token
         self.top_k = top_k
         self.decode_chunk = decode_chunk
+        self.prefill_chunk_budget = prefill_chunk_budget
         self.breaker = breaker
         self.step_hooks: List[Callable] = list(step_hooks)
         self._requested_max_len = max_len
+        self._requested_page_size = page_size
+        self._requested_pool_pages = pool_pages
+        self._requested_max_queued_pages = max_queued_pages
+        self._requested_prefill_chunk = prefill_chunk
         self._prompt_buckets = tuple(sorted(set(int(b) for b in
                                                 prompt_buckets)))
         self._cond = threading.Condition()
@@ -213,17 +296,21 @@ class DecodeEngine:
         self._swap_error: Optional[BaseException] = None
         self._swap_done = threading.Event()
         self._step_ewma = 0.01
+        self._pages_demand_queued = 0
         # counters (observable state for tests/telemetry)
         self.submitted = 0
         self.served = 0
         self.shed_overload = 0
+        self.shed_out_of_pages = 0
         self.shed_deadline = 0
         self.shed_unavailable = 0
         self.failures = 0
         self.prefills = 0
+        self.prefill_chunks = 0
         self.decode_steps = 0
         self.active_slot_steps = 0
         self.tokens_generated = 0
+        self.pages_in_use_peak = 0
         self.swaps = 0
         self._build(net)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -232,7 +319,7 @@ class DecodeEngine:
 
     # -- compiled machinery ------------------------------------------------
     def _build(self, net) -> None:
-        """(Re)build the compiled prefill/decode pair and the slotted
+        """(Re)build the compiled prefill/decode machinery and the paged
         device state for `net`. Called at construction and after a
         drained weight swap; jit caches are per-engine closures, so a
         swap to a differently-shaped net recompiles cleanly."""
@@ -245,9 +332,13 @@ class DecodeEngine:
             _block_ffn,
             _block_heads,
             _prefill_block_attention,
+            _prefill_chunk_block_attention,
             _sample_logits,
         )
-        from deeplearning4j_tpu.ops.attention import cached_attention_step
+        from deeplearning4j_tpu.ops.attention import (
+            cached_attention_step,
+            paged_gather,
+        )
 
         plan = GPTPlan(net)
         L = self._requested_max_len or plan.emb.max_length
@@ -261,9 +352,31 @@ class DecodeEngine:
         top_k = self.top_k
         buckets = tuple(b for b in self._prompt_buckets if b <= L) or \
             (min(32, L),)
-        # buffer donation keeps the slotted cache in place in HBM instead
-        # of copying ~S*L*layers of KV every step; CPU (the test backend)
-        # does not support donation and would warn once per dispatch
+        from deeplearning4j_tpu.serving.model_server import _bucket
+
+        # page geometry: the logical per-slot cache length is max_len
+        # rounded up to a whole number of pages AND (when chunking can
+        # activate) a whole number of prefill chunks, so every padded
+        # prefill width fits the slot's page-table row. A page longer
+        # than max_len is clamped to max_len's pow-2 ceiling (one page
+        # per slot)
+        page = _bucket(L, self._requested_page_size)
+        C = self._requested_prefill_chunk
+        chunk_enabled = C < L
+        M = max(page, C) if chunk_enabled else page
+        L_logical = -(-L // M) * M
+        n_pages_max = L_logical // page
+        pool_pages = self._requested_pool_pages
+        if pool_pages is None:
+            # default: the dense r5 slotted cache's exact KV budget
+            pool_pages = S * n_pages_max
+        max_queued_pages = self._requested_max_queued_pages
+        if max_queued_pages is None:
+            max_queued_pages = 4 * pool_pages
+        # buffer donation keeps the page pools in place in HBM instead
+        # of copying ~pool_pages*page*layers of KV every step; CPU (the
+        # test backend) does not support donation and would warn once
+        # per dispatch
         donate = jax.default_backend() != "cpu"
         self._donate = donate
 
@@ -301,16 +414,50 @@ class DecodeEngine:
                              axis=-1)
             return jnp.where(active, row_ok, True)
 
-        def step_math(bp, params, caches, tok, pos, keys, temps, active):
+        def write_pages(kp_, vp_, kcol, vrow, wpids, woff):
+            """Scatter one contiguous prefill span (1, Hkv, hd, W) /
+            (1, Hkv, W, hd) into the pool pages `wpids`: floor(W/page)
+            aligned full-page writes, then a partial tail (a non-pow-2
+            fallback bucket, or a sub-page chunk) at in-page offset
+            `woff` — which is nonzero only in the W < page chunked
+            case, where chunk-aligned pow-2 offsets guarantee the span
+            never straddles a page boundary."""
+            W = kcol.shape[3]
+            z = jnp.zeros((), jnp.int32)
+            nfull = W // page
+            for j in range(nfull):
+                kp_ = jax.lax.dynamic_update_slice(
+                    kp_, kcol[..., j * page:(j + 1) * page],
+                    (wpids[j], z, z, z))
+                vp_ = jax.lax.dynamic_update_slice(
+                    vp_, vrow[:, :, j * page:(j + 1) * page, :],
+                    (wpids[j], z, z, z))
+            if W % page:
+                kp_ = jax.lax.dynamic_update_slice(
+                    kp_, kcol[..., nfull * page:], (wpids[nfull], z, z,
+                                                    woff))
+                vp_ = jax.lax.dynamic_update_slice(
+                    vp_, vrow[:, :, nfull * page:, :], (wpids[nfull], z,
+                                                        woff, z))
+            return kp_, vp_
+
+        def step_math(bp, params, caches, page_table, tok, pos, keys,
+                      temps, active):
             """Advance ALL slots one token: inactive slots are masked
-            (token/position carried through unchanged), so every
-            iteration compiles to this single shape."""
+            (token/position carried through unchanged, cache writes
+            redirected to the trash page so a reallocated page is never
+            corrupted), so every iteration compiles to this single
+            shape."""
             x = bp[emb_i]["W"][tok]
             if emb.positional:
                 x = x + bp[emb_i]["P"][jnp.minimum(pos, emb.max_length - 1)]
             x = x.astype(cdt)
-            wpos = jnp.minimum(pos, L - 1)
+            wpos = jnp.minimum(pos, L_logical - 1)
+            lpage = wpos // page
+            loff = wpos % page
             rows = jnp.arange(S)
+            # inactive lanes write to the reserved trash page 0
+            pids = jnp.where(active, page_table[rows, lpage], 0)
             new_caches = []
             for bi, i in enumerate(block_is):
                 p = bp[i]
@@ -322,13 +469,14 @@ class DecodeEngine:
                 q, k, v = _block_heads(layer, p, x[:, None, :],
                                        pos[:, None])
                 q, k, v = q[:, 0], k[:, 0], v[:, 0]
-                kc, vc = caches[bi]
-                kc = kc.at[rows, :, :, wpos].set(k)
-                vc = vc.at[rows, :, wpos, :].set(v)
-                att = cached_attention_step(q, kc, vc, pos)
+                kp_, vp_ = caches[bi]
+                kp_ = kp_.at[pids, :, :, loff].set(k)
+                vp_ = vp_.at[pids, :, loff, :].set(v)
+                kd, vd = paged_gather(kp_, vp_, page_table)
+                att = cached_attention_step(q, kd, vd, pos)
                 att = att @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                new_caches.append((kc, vc))
+                new_caches.append((kp_, vp_))
             logits = plan.final_logits(bp, params, x)
             nxt, new_keys = sample_slots(logits, keys, temps)
             nxt = jnp.where(active, nxt, tok)
@@ -337,23 +485,27 @@ class DecodeEngine:
                 logits_ok(logits, active)
 
         @partial(jax.jit, donate_argnums=(1,) if donate else ())
-        def decode_step(params, caches, tok, pos, keys, temps, active):
+        def decode_step(params, caches, page_table, tok, pos, keys, temps,
+                        active):
             bp = plan.cast_blocks(params)
-            return step_math(bp, params, caches, tok, pos, keys, temps,
-                             active)
+            return step_math(bp, params, caches, page_table, tok, pos,
+                             keys, temps, active)
 
         @partial(jax.jit, donate_argnums=(1,) if donate else ())
-        def decode_chunked(params, caches, tok, pos, keys, temps, active):
+        def decode_chunked(params, caches, page_table, tok, pos, keys,
+                           temps, active):
             """`decode_chunk` iterations of the SAME step body fused into
             one dispatch via lax.scan — used only when the scheduler
-            proves no admission/retirement/deadline event can land inside
-            the chunk. Returns every intermediate token (chunk, S)."""
+            proves no admission/retirement/deadline/prefill event can
+            land inside the chunk (page tables are therefore invariant
+            across it). Returns every intermediate token (chunk, S)."""
             bp = plan.cast_blocks(params)
 
             def body(carry, _):
                 caches, tok, pos, keys = carry
                 caches, tok, pos, keys, step_ok = step_math(
-                    bp, params, caches, tok, pos, keys, temps, active)
+                    bp, params, caches, page_table, tok, pos, keys,
+                    temps, active)
                 return (caches, tok, pos, keys), (tok, step_ok)
 
             (caches, tok, pos, keys), (toks, oks) = jax.lax.scan(
@@ -365,12 +517,16 @@ class DecodeEngine:
             return caches, tok, pos, keys, toks, oks
 
         @partial(jax.jit, donate_argnums=(1,) if donate else ())
-        def prefill(params, caches, ids, t0, slot, tok, pos, keys, temps,
-                    kp, kd, temp):
-            """Write one prompt's KV into slot `slot` and emit its first
-            token. `ids` is (1, bucket) — pow-2 padded; the pad region's
-            KV entries are masked off by position until decode overwrites
-            them, so padding never changes a real token's numerics."""
+        def prefill(params, caches, ids, t0, slot, wpids, tok, pos, keys,
+                    temps, kp, kdec, temp):
+            """One-shot prefill: write one prompt's KV into the slot's
+            pages and emit its first token. `ids` is (1, bucket) — pow-2
+            padded; the pad region's KV entries land in the request's
+            own pages and are masked off by position until decode
+            overwrites them, so padding never changes a real token's
+            numerics. The block math is IDENTICAL to `generate`'s
+            prefill (`_prefill_block_attention`) — only the cache
+            write targets pages instead of a slot row."""
             bp = plan.cast_blocks(params)
             P = ids.shape[1]
             x = bp[emb_i]["W"][ids]
@@ -386,15 +542,14 @@ class DecodeEngine:
                 d = x.shape[-1]
                 att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                kc, vc = caches[bi]
+                kp_, vp_ = caches[bi]
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, P)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, P, hd)
-                z = jnp.zeros((), slot.dtype)  # match slot's index dtype
-                kc = jax.lax.dynamic_update_slice(kc, kcol, (slot, z, z, z))
-                vc = jax.lax.dynamic_update_slice(vc, vrow, (slot, z, z, z))
-                new_caches.append((kc, vc))
+                kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids,
+                                       jnp.zeros((), jnp.int32))
+                new_caches.append((kp_, vp_))
             logits = plan.final_logits(bp, params, x[0, t0 - 1][None])
-            # kp samples the prefill token, kd seeds the slot's decode
+            # kp samples the prefill token, kdec seeds the slot's decode
             # key — the same split generate() draws from PRNGKey(seed).
             # Temperature is dynamic per request, so the greedy/sampled
             # select mirrors sample_slots (same scale_and_filter core)
@@ -405,50 +560,160 @@ class DecodeEngine:
             tok0 = jnp.where(temp > 0, drawn, greedy)
             tok = tok.at[slot].set(tok0[0])
             pos = pos.at[slot].set(t0)
-            keys = keys.at[slot].set(kd)
+            keys = keys.at[slot].set(kdec)
             temps = temps.at[slot].set(temp)
             return new_caches, tok, pos, keys, temps, tok0, \
                 jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
 
+        @partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def prefill_chunk_fn(params, caches, page_row, ids, off, woff,
+                             t0, slot, wpids, tok, pos, keys, temps, kp,
+                             kdec, temp):
+            """One prefill CHUNK: embed `ids` (1, prefill_chunk) at
+            absolute positions off..off+C-1, write its KV into pages
+            `wpids`, attend causally over [prior chunks ‖ this chunk]
+            through the slot's gathered page row, and emit logits at
+            prompt position t0-1 (only meaningful — and only consumed
+            by the host — on the FINAL chunk). Slot token/position/key
+            state is set every chunk; the final chunk's values are the
+            ones that stick before decode starts."""
+            bp = plan.cast_blocks(params)
+            Cw = ids.shape[1]
+            qpos = off + jnp.arange(Cw)
+            x = bp[emb_i]["W"][ids]
+            if emb.positional:
+                # gather (not dynamic_slice): a padded final chunk may
+                # run past the positional table, and dynamic_slice's
+                # start-clamping would silently shift REAL positions —
+                # the per-position clamp only garbles the masked pad
+                # tail
+                x = x + bp[emb_i]["P"][jnp.minimum(qpos,
+                                                   emb.max_length - 1)]
+            x = x.astype(cdt)
+            new_caches = []
+            for bi, i in enumerate(block_is):
+                p = bp[i]
+                layer = layers[i]
+                q, k, v = _block_heads(layer, p, x, qpos)
+                kp_, vp_ = caches[bi]
+                kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, C)
+                vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, C, hd)
+                kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids, woff)
+                # gather AFTER the write: the chunk attends to itself
+                # through the cache, which is exactly causal with the
+                # <= qpos mask
+                kd, vd = paged_gather(kp_, vp_, page_row[None])
+                att = _prefill_chunk_block_attention(layer, q, kd[0],
+                                                     vd[0], qpos)
+                d = x.shape[-1]
+                att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
+                x = _block_ffn(layer, p, x + att)
+                new_caches.append((kp_, vp_))
+            r = jnp.clip(t0 - 1 - off, 0, Cw - 1)
+            logits = plan.final_logits(bp, params, x[0, r][None])
+            greedy = _sample_logits(logits, kp, 0.0, 0)
+            drawn = jax.random.categorical(
+                kp, scale_and_filter(logits, temp[None]),
+                axis=-1).astype(jnp.int32)
+            tok0 = jnp.where(temp > 0, drawn, greedy)
+            tok = tok.at[slot].set(tok0[0])
+            pos = pos.at[slot].set(t0)
+            keys = keys.at[slot].set(kdec)
+            temps = temps.at[slot].set(temp)
+            # screen the whole chunk's hidden states, not only the
+            # logits row: a non-finite mid-prompt chunk poisons the
+            # cache it just wrote, and must fail HERE, typed
+            ok = jnp.all(jnp.isfinite(logits.astype(jnp.float32))) \
+                & jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+            return new_caches, tok, pos, keys, temps, tok0, ok
+
         self._plan = plan
         self._net = net
         self.max_len = L
+        self.page_size = page
+        self.pool_pages = pool_pages
+        self.max_queued_pages = max_queued_pages
+        self.prefill_chunk = C
+        self._chunk_enabled = chunk_enabled
+        self._n_pages_max = n_pages_max
+        self._L_logical = L_logical
         self.prompt_buckets = buckets
         self._decode_step = decode_step
         self._decode_chunked = decode_chunked
         self._prefill = prefill
+        self._prefill_chunk_fn = prefill_chunk_fn
         self._reset_device_state()
 
     def _reset_device_state(self) -> None:
-        """Fresh slotted cache + per-slot state (construction, weight
-        swap, or recovery after a failed device step — a raised dispatch
-        may have invalidated donated buffers)."""
+        """Fresh page pools + page table + per-slot state (construction,
+        weight swap, or recovery after a failed device step — a raised
+        dispatch may have invalidated donated buffers). Callers
+        guarantee no slot holds a request when this runs, so the free
+        list rebuilds to the full pool; queued requests keep their
+        reservations (they hold no device state)."""
         import jax
         import jax.numpy as jnp
 
-        plan, S, L = self._plan, self.n_slots, self.max_len
+        plan, S = self._plan, self.n_slots
+        page, P = self.page_size, self.pool_pages
         caches = []
         for i in plan.block_is:
             layer = plan.layers[i]
             hd = layer.n_out // layer.n_heads
             Hkv = layer._kv_heads
-            caches.append((jnp.zeros((S, Hkv, hd, L), plan.cdt),
-                           jnp.zeros((S, Hkv, L, hd), plan.cdt)))
+            # +1: page 0 is the reserved trash page for masked writes
+            caches.append((jnp.zeros((P + 1, Hkv, hd, page), plan.cdt),
+                           jnp.zeros((P + 1, Hkv, page, hd), plan.cdt)))
         self._caches = caches
+        self._page_table = jnp.zeros((S, self._n_pages_max), jnp.int32)
+        self._free_pages = list(range(P, 0, -1))
         self._tok = jnp.zeros((S,), jnp.int32)
         self._pos = jnp.zeros((S,), jnp.int32)
         self._keys = jnp.stack([jax.random.PRNGKey(i) for i in range(S)])
         self._temps = jnp.zeros((S,), jnp.float32)
         self._active = np.zeros((S,), bool)
 
+    # -- paging arithmetic -------------------------------------------------
+    def _bucket_for(self, t0: int) -> int:
+        from deeplearning4j_tpu.serving.model_server import _bucket
+
+        for b in self.prompt_buckets:
+            if b >= t0:
+                return b
+        return _bucket(t0, self.max_len)  # pow-2 fallback past the buckets
+
+    def _is_chunked(self, t0: int) -> bool:
+        return self._chunk_enabled and t0 > self.prompt_buckets[-1] \
+            and t0 > self.prefill_chunk
+
+    def _prefill_width(self, t0: int) -> int:
+        C = self.prefill_chunk
+        return -(-t0 // C) * C if self._is_chunked(t0) \
+            else self._bucket_for(t0)
+
+    def _pages_for(self, t0: int, n_tokens: int) -> int:
+        """Pages a request must hold: its padded prefill width (pad-
+        tail KV lands in owned pages) or prompt+output KV span,
+        whichever is larger. The last generated token is never written
+        back, hence n_tokens - 1."""
+        span = max(self._prefill_width(t0), t0 + n_tokens - 1)
+        return -(-span // self.page_size)
+
+    def _free_request_pages_locked(self, req: _GenRequest) -> None:
+        if req.pages:
+            self._free_pages.extend(req.pages)
+        req.pages = None
+
     # -- public surface ----------------------------------------------------
     def submit(self, prompt_ids, n_tokens: int, *,
                temperature: float = 0.0, seed: int = 0,
                timeout: Optional[float] = None) -> _GenRequest:
         """Admit one generation request (non-blocking). Typed give-ups:
-        `ServerOverloadedError` (queue full), `ServiceUnavailableError`
-        (breaker open), `ServerClosedError`. Returns the request handle;
-        `request.result()` blocks for the tokens."""
+        `ServerOverloadedError` (queue full), `OutOfPagesError` (the
+        paged KV pool cannot reserve this request's pages right now),
+        `ServiceUnavailableError` (breaker open), `ServerClosedError`.
+        Returns the request handle; `request.result()` blocks for the
+        tokens."""
         prompt = np.asarray(prompt_ids)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -464,6 +729,12 @@ class DecodeEngine:
                 f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds the "
                 f"engine's max_len {self.max_len} — raise max_len or "
                 "shorten the request")
+        need = self._pages_for(T0, n_tokens)
+        if need > self.pool_pages:
+            raise ValueError(
+                f"request needs {need} KV pages of {self.page_size} "
+                f"tokens but the pool holds only {self.pool_pages} — "
+                "raise pool_pages or shorten the request")
         with self._cond:
             if self._closed:  # before the breaker door check: a closed
                 # engine must say "closed" (terminal), not "retry later"
@@ -479,6 +750,7 @@ class DecodeEngine:
         deadline = None if timeout is None else time.monotonic() + timeout
         req = _GenRequest(prompt.astype(np.int32), int(n_tokens),
                           float(temperature), int(seed), deadline)
+        req.n_pages = need
         with self._cond:
             if self._closed:
                 raise ServerClosedError("decode engine is shut down")
@@ -489,6 +761,30 @@ class DecodeEngine:
                 raise ServerOverloadedError(
                     f"generation queue full ({self.max_queue} pending); "
                     f"retry in {retry:.3f}s", retry_after=retry)
+            if self._pages_demand_queued \
+                    and self._pages_demand_queued + need \
+                    > self.max_queued_pages:
+                # memory-side admission control: queued requests hold
+                # no pages, but their aggregate DEMAND is bounded —
+                # beyond `max_queued_pages` of page-wait-room, shed at
+                # the door, typed, instead of queueing work the pool
+                # cannot turn over soon. A LONE waiter always queues
+                # (first clause): a request that fits the pool must
+                # never be permanently shed by the aggregate cap, and
+                # its retry_after would otherwise promise a retry that
+                # could never succeed
+                self.shed_out_of_pages += 1
+                held = self.pool_pages - len(self._free_pages)
+                n_live = sum(1 for r in self._slots if r is not None)
+                retry = max(0.001, self._step_ewma
+                            * (len(self._queue) + n_live + 1))
+                raise OutOfPagesError(
+                    f"KV page pool exhausted ({held}/{self.pool_pages} "
+                    f"pages in use, {self._pages_demand_queued} queued "
+                    f"demand of {self.max_queued_pages} allowed; {need} "
+                    f"more needed); retry in {retry:.3f}s",
+                    retry_after=retry)
+            self._pages_demand_queued += need
             self.submitted += 1
             self._queue.append(req)
             self._cond.notify_all()
@@ -506,20 +802,45 @@ class DecodeEngine:
         with self._cond:
             queued = len(self._queue)
             active = sum(1 for r in self._slots if r is not None)
+            held = self.pool_pages - len(self._free_pages)
+            demand = self._pages_demand_queued
+            used_positions = 0
+            for r in self._slots:
+                if r is None:
+                    continue
+                t0 = r.prompt.shape[0]
+                used_positions += min(r.prefill_pos, t0) \
+                    if r.prefill_pos is not None else t0 + len(r.tokens)
         occupancy = (100.0 * self.active_slot_steps
                      / (self.decode_steps * self.n_slots)
                      if self.decode_steps else 0.0)
+        # internal fragmentation of pages actually held by slots: the
+        # tail of each request's last page (and not-yet-filled growth
+        # room) is allocated-but-unused
+        frag = (100.0 * (1.0 - used_positions
+                         / (held * self.page_size))
+                if held else 0.0)
         return {"submitted": self.submitted, "served": self.served,
                 "shed_overload": self.shed_overload,
+                "shed_out_of_pages": self.shed_out_of_pages,
                 "shed_deadline": self.shed_deadline,
                 "shed_unavailable": self.shed_unavailable,
                 "failures": self.failures, "prefills": self.prefills,
+                "prefill_chunks": self.prefill_chunks,
                 "decode_steps": self.decode_steps,
                 "tokens_generated": self.tokens_generated,
                 "slot_occupancy_pct": round(occupancy, 1),
                 "n_slots": self.n_slots, "active_slots": active,
                 "queued": queued, "swaps": self.swaps,
                 "max_len": self.max_len,
+                "page_size": self.page_size,
+                "pool_pages": self.pool_pages,
+                "pages_in_use": held,
+                "pages_in_use_peak": self.pages_in_use_peak,
+                "queued_page_demand": demand,
+                "max_queued_pages": self.max_queued_pages,
+                "page_fragmentation_pct": round(frag, 1),
+                "prefill_chunk": self.prefill_chunk,
                 "prompt_buckets": list(self.prompt_buckets)}
 
     def drain_and_swap(self, net, timeout: Optional[float] = None) -> None:
@@ -589,14 +910,6 @@ class DecodeEngine:
         for hook in self.step_hooks:
             hook(phase, info)
 
-    def _bucket_for(self, t0: int) -> int:
-        from deeplearning4j_tpu.serving.model_server import _bucket
-
-        for b in self.prompt_buckets:
-            if b >= t0:
-                return b
-        return _bucket(t0, self.max_len)  # pow-2 fallback past the buckets
-
     def _loop(self) -> None:
         while True:
             with self._cond:
@@ -610,7 +923,9 @@ class DecodeEngine:
                     return
                 if self._closed:
                     while self._queue:
-                        self._queue.popleft().finish(ServerClosedError(
+                        req = self._queue.popleft()
+                        self._pages_demand_queued -= req.n_pages
+                        req.finish(ServerClosedError(
                             "engine shut down before this request "
                             "could be served"))
                     if not any(r is not None for r in self._slots):
@@ -621,6 +936,7 @@ class DecodeEngine:
                 if not self._draining and not self._closed:
                     self._admit()
                 self._expire_in_flight()
+                self._step_prefills()
                 self._step_active()
                 self._maybe_swap()
             except BaseException:  # scheduler must never die silently
@@ -651,11 +967,14 @@ class DecodeEngine:
 
     def _fail_all_locked(self, err: BaseException) -> None:
         while self._queue:
-            self._queue.popleft().finish(err)  # never acquired the breaker
+            req = self._queue.popleft()
+            self._pages_demand_queued -= req.n_pages
+            req.finish(err)  # never acquired the breaker
         for s, req in enumerate(self._slots):
             if req is not None:
                 self._slots[s] = None
                 self._active[s] = False
+                self._free_request_pages_locked(req)
                 if self.breaker is not None:
                     # release the request's breaker token — a dropped
                     # half-open probe would wedge the shared breaker in
@@ -665,15 +984,26 @@ class DecodeEngine:
         self._cond.notify_all()
 
     def _admit(self) -> None:
-        """Move queued requests into free slots (prefill each). Expired
-        queued requests are shed BEFORE their prefill ever dispatches."""
+        """Move queued requests into free slots. Expired queued requests
+        are shed BEFORE any device work. The queue head waits (FIFO)
+        when the free list cannot cover its pages — a retirement frees
+        them in bounded time; a short prompt prefills one-shot
+        immediately, a long one is parked mid-prefill and
+        chunk-prefilled by `_step_prefills` interleaved with decode."""
+        import jax.numpy as jnp
+
         while True:
             with self._cond:
                 free = [s for s in range(self.n_slots)
                         if self._slots[s] is None]
                 if not free or not self._queue:
                     return
+                head = self._queue[0]
+                if not head.expired() \
+                        and head.n_pages > len(self._free_pages):
+                    return  # page-blocked: wait for a retirement
                 req = self._queue.popleft()
+                self._pages_demand_queued -= req.n_pages
             if req.expired():
                 with self._cond:
                     self.shed_deadline += 1
@@ -691,48 +1021,42 @@ class DecodeEngine:
                     req.finish(e)
                     continue
             req.probe = probe
-            try:
-                self._prefill_into(free[0], req)
-            except BaseException as e:
-                if self.breaker is not None:
-                    self.breaker.record_failure(probe)
+            slot = free[0]
+            with self._cond:
+                req.pages = [self._free_pages.pop()
+                             for _ in range(req.n_pages)]
+                held = self.pool_pages - len(self._free_pages)
+                self.pages_in_use_peak = max(self.pages_in_use_peak, held)
+            row = np.zeros((self._n_pages_max,), np.int32)
+            row[:len(req.pages)] = req.pages
+            self._page_table = self._page_table.at[slot].set(
+                jnp.asarray(row))
+            t0 = req.prompt.shape[0]
+            if self._is_chunked(t0):
                 with self._cond:
-                    self.failures += 1
-                err = e if isinstance(e, ServingError) else \
-                    InferenceFailedError(
-                        f"prefill failed: {type(e).__name__}: {e}")
-                logger.warning("decode engine: prefill failure (%s)", err)
-                req.finish(err)
-                if self._donate and getattr(e, "_dispatch_failure", False):
-                    # the raised DISPATCH may have invalidated the DONATED
-                    # cache buffers — every in-flight slot's KV is gone
-                    # with them, so those requests must fail too (queued
-                    # ones survive: they hold no device state), then the
-                    # state rebuilds. Post-dispatch failures (non-finite
-                    # screen, hooks) and the no-donation CPU path leave
-                    # the caches valid: only this request fails
-                    cache_err = InferenceFailedError(
-                        "slotted cache lost to a failed prefill dispatch "
-                        "(donated buffers)")
-                    with self._cond:
-                        for s, r in enumerate(self._slots):
-                            if r is not None:
-                                self._slots[s] = None
-                                self._active[s] = False
-                                r.finish(cache_err)
-                        self._cond.notify_all()
-                    self._reset_device_state()
+                    req.prefill_pos = 0
+                    req.slot = slot
+                    self._slots[slot] = req
+                    # _active stays False until the final chunk lands
+                continue
+            try:
+                self._prefill_into(slot, req)
+            except BaseException as e:
+                self._prefill_failure(slot, req, e, attached=False)
 
     def _prefill_into(self, slot: int, req: _GenRequest) -> None:
         import jax
         import jax.numpy as jnp
 
+        page = self.page_size
         t0 = req.prompt.shape[0]
         bucket = self._bucket_for(t0)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :t0] = req.prompt
+        n_w = -(-bucket // page)
+        wpids = jnp.asarray(np.asarray(req.pages[:n_w], np.int32))
         key = jax.random.PRNGKey(req.seed)
-        kp, kd = jax.random.split(key)  # generate()'s prefill/decode split
+        kp, kdec = jax.random.split(key)  # generate()'s prefill/decode split
         info = {"slot": slot, "bucket": bucket, "t0": t0}
         self._hook("pre_prefill", info)
 
@@ -741,8 +1065,8 @@ class DecodeEngine:
              tok0, ok) = self._prefill(
                 self._net._params, self._caches, jnp.asarray(ids),
                 jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
-                self._tok, self._pos, self._keys, self._temps, kp, kd,
-                jnp.asarray(req.temperature, jnp.float32))
+                wpids, self._tok, self._pos, self._keys, self._temps,
+                kp, kdec, jnp.asarray(req.temperature, jnp.float32))
             return jax.device_get((tok0, ok))
 
         first, ok = _dispatched(run)
@@ -764,14 +1088,141 @@ class DecodeEngine:
             self._slots[slot] = req
             self._active[slot] = True
 
+    def _step_prefills(self) -> None:
+        """Drive pending chunked prefills, at most
+        `prefill_chunk_budget` chunk dispatches per scheduler
+        iteration — the interleaving that keeps a long prompt from
+        head-of-line-blocking in-flight decodes."""
+        budget = self.prefill_chunk_budget
+        for s in range(self.n_slots):
+            if budget <= 0:
+                return
+            req = self._slots[s]
+            if req is None or req.prefill_pos is None:
+                continue
+            self._prefill_chunk_into(s, req)
+            budget -= 1
+
+    def _prefill_chunk_into(self, slot: int, req: _GenRequest) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        C, page = self.prefill_chunk, self.page_size
+        off = req.prefill_pos
+        t0 = req.prompt.shape[0]
+        final = off + C >= t0
+        ids = np.zeros((1, C), np.int32)
+        take = min(C, t0 - off)
+        ids[0, :take] = req.prompt[off:off + take]
+        if C >= page:
+            pids = req.pages[off // page: off // page + C // page]
+            woff = 0
+        else:
+            pids = [req.pages[off // page]]
+            woff = off % page
+        key = jax.random.PRNGKey(req.seed)
+        kp, kdec = jax.random.split(key)
+        info = {"slot": slot, "t0": t0, "chunk": C, "chunk_off": off,
+                "final": final}
+        self._hook("pre_prefill", info)
+
+        def run():
+            (self._caches, self._tok, self._pos, self._keys, self._temps,
+             tok0, ok) = self._prefill_chunk_fn(
+                self._net._params, self._caches, self._page_table[slot],
+                jnp.asarray(ids), jnp.asarray(off, jnp.int32),
+                jnp.asarray(woff, jnp.int32), jnp.asarray(t0, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(np.asarray(pids, np.int32)),
+                self._tok, self._pos, self._keys, self._temps, kp, kdec,
+                jnp.asarray(req.temperature, jnp.float32))
+            return jax.device_get((tok0, ok))
+
+        try:
+            first, ok = _dispatched(run)
+            if not bool(ok):
+                raise InferenceFailedError(
+                    "model produced non-finite activations during chunked "
+                    "prefill (poisoned parameters or a numerically broken "
+                    "graph)")
+        except BaseException as e:
+            self._prefill_failure(slot, req, e, attached=True)
+            return
+        self._hook("post_prefill", info)
+        with self._cond:
+            self.prefill_chunks += 1
+        if not final:
+            req.prefill_pos = off + C
+            return
+        req.prefill_pos = None
+        with self._cond:
+            self.prefills += 1
+            self.tokens_generated += 1
+        first = int(first[0])
+        req.tokens.append(first)
+        if req.n_tokens == 1 or first == self.eos_token:
+            self._retire(slot, req)
+            return
+        with self._cond:
+            self._active[slot] = True
+
+    def _prefill_failure(self, slot: int, req: _GenRequest,
+                         e: BaseException, *, attached: bool) -> None:
+        """Shared give-up path for one-shot and chunked prefill: free
+        the slot + pages, count the failure, and — on a failed DISPATCH
+        under donation — fail every in-flight slot (the donated pool
+        buffers may be gone with it) and rebuild device state."""
+        if self.breaker is not None:
+            self.breaker.record_failure(req.probe)
+        with self._cond:
+            self.failures += 1
+            if attached:
+                self._slots[slot] = None
+                self._active[slot] = False
+            self._free_request_pages_locked(req)
+            self._cond.notify_all()
+        err = e if isinstance(e, ServingError) else \
+            InferenceFailedError(
+                f"prefill failed: {type(e).__name__}: {e}")
+        logger.warning("decode engine: prefill failure (%s)", err)
+        req.finish(err)
+        if self._donate and getattr(e, "_dispatch_failure", False):
+            # the raised DISPATCH may have invalidated the DONATED page
+            # pools — every in-flight slot's KV is gone with them, so
+            # those requests must fail too (queued ones survive: they
+            # hold no device state), then the state rebuilds.
+            # Post-dispatch failures (non-finite screen, hooks) and the
+            # no-donation CPU path leave the pools valid: only this
+            # request fails
+            self._fail_occupied_slots(InferenceFailedError(
+                "paged KV pool lost to a failed prefill dispatch "
+                "(donated buffers)"))
+            self._reset_device_state()
+
+    def _fail_occupied_slots(self, err: BaseException) -> None:
+        """Fail EVERY slot-holding request (decoding or mid-prefill) —
+        used when a failed dispatch may have invalidated the donated
+        pools, which back all of them."""
+        with self._cond:
+            for s, r in enumerate(self._slots):
+                if r is not None:
+                    self._slots[s] = None
+                    self._active[s] = False
+                    r.pages = None  # pools rebuild wholesale after this
+                    if self.breaker is not None:
+                        self.breaker.record_failure(r.probe)
+                    r.finish(err)
+            self._cond.notify_all()
+
     def _retire(self, slot: int, req: _GenRequest, *,
                 attached: bool = True) -> None:
-        """Successful completion: free the slot, credit the breaker,
-        deliver the tokens."""
+        """Successful completion: free the slot AND its pages, credit
+        the breaker, deliver the tokens."""
         with self._cond:
             if attached:
                 self._slots[slot] = None
                 self._active[slot] = False
+            self._free_request_pages_locked(req)
             self.served += 1
             self._cond.notify_all()
         if self.breaker is not None:
@@ -779,10 +1230,11 @@ class DecodeEngine:
         req.finish()
 
     def _expire_in_flight(self) -> None:
-        """An expired in-flight request frees its slot immediately — the
-        next queued request takes it on the following iteration. Expired
-        QUEUED requests are also swept here (not only at admission), so
-        a doomed request behind long-running slots fails promptly."""
+        """An expired in-flight request (decoding OR mid-prefill) frees
+        its slot and pages immediately — the next queued request takes
+        them on the following iteration. Expired QUEUED requests are
+        also swept here (not only at admission), so a doomed request
+        behind long-running slots fails promptly."""
         now = time.monotonic()
         expired_queued = []
         with self._cond:
@@ -791,6 +1243,7 @@ class DecodeEngine:
                 req = self._queue.popleft()
                 if req.expired(now):
                     expired_queued.append(req)
+                    self._pages_demand_queued -= req.n_pages
                 else:
                     keep.append(req)
             self._queue = keep
@@ -805,6 +1258,7 @@ class DecodeEngine:
                 with self._cond:
                     self._slots[s] = None
                     self._active[s] = False
+                    self._free_request_pages_locked(req)
                     self.shed_deadline += 1
                     self._cond.notify_all()
                 if self.breaker is not None:
@@ -816,20 +1270,24 @@ class DecodeEngine:
                     f"{req.n_tokens} tokens; slot freed"))
 
     def _chunk_eligible(self, live, now: float) -> bool:
-        """A chunked dispatch is allowed only when no scheduling event
-        can land inside it: every live request needs at least a full
-        chunk more tokens, no deadline could expire before the chunk
-        returns, and — when EOS can retire a slot mid-chunk — no queued
-        request is waiting to take a freed slot (without an eos_token,
-        the remaining-tokens bound already proves nothing retires
+        """A chunked decode dispatch is allowed only when no scheduling
+        event can land inside it: every live request needs at least a
+        full chunk more tokens, no deadline could expire before the
+        chunk returns, no prompt is mid-prefill (its chunks must
+        interleave with decode, not wait behind a fused run), and —
+        when EOS can retire a slot mid-chunk — no queued request is
+        waiting to take a freed slot (without an eos_token, the
+        remaining-tokens bound already proves nothing retires
         mid-chunk). Admission waits at most one chunk — `_admit` runs
         before every dispatch."""
         if self.decode_chunk <= 1:
             return False
-        if self.eos_token is not None:
-            with self._cond:
-                if self._queue:
-                    return False  # a mid-chunk EOS would strand the slot
+        with self._cond:
+            if any(r is not None and r.prefill_pos is not None
+                   for r in self._slots):
+                return False
+            if self.eos_token is not None and self._queue:
+                return False  # a mid-chunk EOS would strand the slot
         margin = 2.0 * self.decode_chunk * max(self._step_ewma, 1e-4)
         for _, r in live:
             if r.n_tokens - len(r.tokens) < self.decode_chunk:
@@ -841,7 +1299,8 @@ class DecodeEngine:
     def _step_active(self) -> None:
         import jax.numpy as jnp
 
-        live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
+        live = [(s, r) for s, r in enumerate(self._slots)
+                if r is not None and r.prefill_pos is None]
         if not live:
             return
         now = time.monotonic()
@@ -858,15 +1317,15 @@ class DecodeEngine:
                 if chunked:
                     (self._caches, self._tok, self._pos, self._keys,
                      toks_d, oks_d) = self._decode_chunked(
-                        self._net._params, self._caches, self._tok,
-                        self._pos, self._keys, self._temps,
+                        self._net._params, self._caches, self._page_table,
+                        self._tok, self._pos, self._keys, self._temps,
                         jnp.asarray(self._active))
                     # (chunk, S) tokens + per-step flags, ONE host sync
                     return jax.device_get((toks_d, oks_d))
                 (self._caches, self._tok, self._pos, self._keys,
                  ok_d) = self._decode_step(
-                    self._net._params, self._caches, self._tok,
-                    self._pos, self._keys, self._temps,
+                    self._net._params, self._caches, self._page_table,
+                    self._tok, self._pos, self._keys, self._temps,
                     jnp.asarray(self._active))
                 # THE per-iteration host sync — the price of
                 # iteration-level scheduling; chunking amortizes it
@@ -888,11 +1347,17 @@ class DecodeEngine:
                 with self._cond:
                     self._slots[s] = None
                     self._active[s] = False
+                    self._free_request_pages_locked(req)
                     self._cond.notify_all()
                 req.finish(err)
             if getattr(e, "_dispatch_failure", False):
                 # only a failed DISPATCH can have invalidated the
-                # donated cache buffers; hook failures leave them valid
+                # donated pool buffers; hook failures leave them valid.
+                # Mid-prefill slots are backed by the same pools — they
+                # go down with them before the rebuild
+                self._fail_occupied_slots(InferenceFailedError(
+                    "paged KV pool lost to a failed decode dispatch "
+                    "(donated buffers)"))
                 self._reset_device_state()
             return
         n_steps = toks.shape[0]
@@ -909,8 +1374,7 @@ class DecodeEngine:
                 # breaker discipline): a poisoned step fails THIS
                 # request typed — unless it already completed via EOS
                 # at an earlier step of the chunk — and healthy
-                # neighbors keep decoding (their cache rows are
-                # untouched)
+                # neighbors keep decoding (their pages are untouched)
                 if not bool(oks[t, s]):
                     poisoned = True
                     break
@@ -931,6 +1395,7 @@ class DecodeEngine:
                     self.failures += 1
                     self._slots[s] = None
                     self._active[s] = False
+                    self._free_request_pages_locked(req)
                     self._cond.notify_all()
                 if self.breaker is not None:
                     self.breaker.record_failure(req.probe)
@@ -959,23 +1424,35 @@ class DecodeEngine:
             with self._cond:
                 self.swaps += 1
                 # queued requests were validated against the OLD
-                # max_len; the rebuilt engine may be tighter (smaller
-                # emb.max_length). A request that no longer fits would
-                # decode silently-wrong tail tokens past the new cache
-                # length — fail it typed instead
+                # max_len/page geometry; the rebuilt engine may be
+                # tighter. A request that no longer fits would decode
+                # silently-wrong tail tokens past the new cache length —
+                # fail it typed instead. Survivors' page demand is
+                # recomputed against the NEW geometry, re-applying both
+                # admission bounds (per-request pool fit + wait-room cap)
                 keep: collections.deque = collections.deque()
+                reserved = 0
                 while self._queue:
                     r = self._queue.popleft()
                     if r.prompt.shape[0] + r.n_tokens > self.max_len:
                         misfit.append(r)
-                    else:
-                        keep.append(r)
+                        continue
+                    r.n_pages = self._pages_for(r.prompt.shape[0],
+                                                r.n_tokens)
+                    if r.n_pages > self.pool_pages or \
+                            reserved + r.n_pages > self.max_queued_pages:
+                        misfit.append(r)  # incl. pool shrunk below the
+                        continue          # surviving queue's demand
+                    reserved += r.n_pages
+                    keep.append(r)
                 self._queue = keep
+                self._pages_demand_queued = reserved
             for r in misfit:
                 r.finish(ServingError(
                     f"request (prompt {r.prompt.shape[0]} + n_tokens "
                     f"{r.n_tokens}) no longer fits the swapped engine's "
-                    f"max_len {self.max_len}"))
+                    f"max_len {self.max_len} / {self.pool_pages}-page "
+                    "pool"))
         except BaseException as e:
             self._swap_error = e
             logger.warning("decode engine: weight swap rejected (%s); "

@@ -51,12 +51,16 @@ then fails whatever remains — a shutdown is a bounded event, not a hang.
 **Generation serving** — construct with `generation={...}`
 (`serving.decode_engine.DecodeEngine` kwargs, or `True` for defaults)
 and `generate(prompt_ids, n_tokens, ...)` serves autoregressive
-generation through the continuous-batching decode engine: requests ride
-the same admission-control/deadline/breaker discipline as `predict`
-(typed `ServerOverloadedError` + `retry_after` on overload; a deadline
-expiring in the queue sheds before prefill; one expiring in flight
-frees its decode slot), and `reload()` drains the engine's slots so
-in-flight generations finish on the old weights before the swap.
+generation through the continuous-batching decode engine (paged KV
+cache + chunked prefill): requests ride the same
+admission-control/deadline/breaker discipline as `predict` (typed
+`ServerOverloadedError` + `retry_after` on overload, typed
+`OutOfPagesError` when the KV page pool's wait room is full; a
+deadline expiring in the queue sheds before prefill; one expiring in
+flight frees its decode slot AND its pages), and `reload()` drains the
+engine's slots so in-flight generations finish on the old weights
+before the swap. `stats()` surfaces `pages_in_use`,
+`page_fragmentation_pct`, and `prefill_chunks` top-level.
 
 Chaos seam: `infer_hooks=[hook]` fires `hook(phase, info)` at
 `pre_step` / `post_step` around every device dispatch —
@@ -94,6 +98,15 @@ class ServerOverloadedError(ServingError):
     def __init__(self, msg: str, retry_after: float = 0.1):
         super().__init__(msg)
         self.retry_after = retry_after
+
+
+class OutOfPagesError(ServerOverloadedError):
+    """The decode engine's paged KV pool cannot reserve enough pages
+    for this request right now: memory-side admission control shed it
+    at the door. Subclasses `ServerOverloadedError` so every existing
+    overload handler (gateway retry_after payloads, serve-route shed
+    counting) composes unchanged; `retry_after` estimates when enough
+    pages free up."""
 
 
 class DeadlineExceededError(ServingError):
@@ -468,6 +481,13 @@ class ModelServer:
             # next to batch_fill_pct: the two tell an operator whether
             # they are batch-starved on predict and/or generation
             out["slot_occupancy_pct"] = gen["slot_occupancy_pct"]
+            # paged-KV health, also top-level: pages_in_use vs the pool
+            # is the memory-side occupancy, page_fragmentation_pct the
+            # allocated-but-unused tail, prefill_chunks how much prompt
+            # work is riding the interleaved chunked path
+            out["pages_in_use"] = gen["pages_in_use"]
+            out["page_fragmentation_pct"] = gen["page_fragmentation_pct"]
+            out["prefill_chunks"] = gen["prefill_chunks"]
             out["generation"] = gen
         return out
 

@@ -1,14 +1,16 @@
 """Continuous-batching generation serving
 (`serving/decode_engine.DecodeEngine` + `ModelServer.generate`).
 
-The load-bearing contract is PARITY: slotted decode must reproduce
-whole-batch `models.transformer.generate` argmax-exactly at f32 for the
-same prompts, REGARDLESS of admission order — slot reuse, mixed prompt
-lengths, mixed output lengths, chunked decode, and GQA/RoPE variants
-all included. On top of that, the serving ladders: overload sheds
-typed, a deadline expiring in the queue sheds before prefill, one
-expiring in flight frees its slot, and `reload()` during active decode
-finishes in-flight requests on the OLD weights before swapping.
+The load-bearing contract is PARITY: paged slotted decode must
+reproduce whole-batch `models.transformer.generate` argmax-exactly at
+f32 for the same prompts, REGARDLESS of admission order — slot AND
+page reuse, mixed prompt lengths, mixed output lengths, fused decode
+chunks, CHUNKED PREFILL of long prompts, and GQA/RoPE variants all
+included. On top of that, the serving ladders: overload and
+page-pool exhaustion shed typed, a deadline expiring in the queue
+sheds before prefill, one expiring in flight frees its slot and
+pages, and `reload()` during active decode finishes in-flight
+requests on the OLD weights before swapping.
 
 Everything here runs on CPU in the quick tier except the bench smoke
 (`slow`): the fast tests keep shapes tiny so the jitted prefill/decode
@@ -30,6 +32,7 @@ from deeplearning4j_tpu.serving import (
     DeadlineExceededError,
     DecodeEngine,
     ModelServer,
+    OutOfPagesError,
     ServerClosedError,
     ServerOverloadedError,
 )
@@ -197,6 +200,166 @@ def test_eos_token_retires_slot_early(net):
         np.testing.assert_array_equal(got2, exp2)
     finally:
         eng.shutdown()
+
+
+def test_long_prompt_chunked_prefill_parity(net):
+    """A prompt longer than every bucket AND the prefill chunk rides
+    the CHUNKED prefill path (several chunk dispatches through the
+    paged cache) and must still match whole-batch generate
+    argmax-exactly — the chunked-prefill acceptance pin."""
+    rng = np.random.default_rng(29)
+    long_prompt = rng.integers(0, VOCAB, 20).astype(np.int32)
+    eng = _engine(net, max_len=48, prompt_buckets=(4,), prefill_chunk=8,
+                  page_size=8)
+    try:
+        exp = generate(net, long_prompt[None], 6, temperature=0.0)[0]
+        np.testing.assert_array_equal(eng.generate(long_prompt, 6), exp)
+        st = eng.stats()
+        assert st["prefills"] == 1
+        assert st["prefill_chunks"] >= 3, \
+            "a 20-token prompt over 8-token chunks must take >= 3 chunks"
+    finally:
+        eng.shutdown()
+
+
+def test_chunked_prefill_gqa_rope_parity():
+    """Chunked prefill through the paged cache with the modern-decoder
+    stack (GQA grouped cache pages + per-position rotary embeddings +
+    SwiGLU) — the acceptance criteria's GQA-under-chunking pin."""
+    net = _gpt_net(n_heads=4, n_kv_heads=2, rope=True,
+                   ffn_activation="swiglu")
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, VOCAB, 19).astype(np.int32)
+    eng = _engine(net, max_len=48, prompt_buckets=(4,), prefill_chunk=8,
+                  page_size=8)
+    try:
+        exp = generate(net, prompt[None], 5, temperature=0.0)[0]
+        np.testing.assert_array_equal(eng.generate(prompt, 5), exp)
+        assert eng.stats()["prefill_chunks"] >= 3
+    finally:
+        eng.shutdown()
+
+
+def test_chunked_prefill_interleaves_with_decode(net):
+    """THE head-of-line pin: while a long prompt chunk-prefills, an
+    in-flight decode keeps stepping — decode dispatches land BETWEEN
+    that prompt's chunk dispatches, and both requests stay
+    argmax-exact."""
+    events = []
+    lock = threading.Lock()
+
+    def recorder(phase, info):
+        with lock:
+            events.append((phase, dict(info)))
+
+    rng = np.random.default_rng(37)
+    short = rng.integers(0, VOCAB, 5).astype(np.int32)
+    long_p = rng.integers(0, VOCAB, 24).astype(np.int32)
+    eng = _engine(net, n_slots=2, max_len=64, prompt_buckets=(8,),
+                  prefill_chunk=8, page_size=8, decode_chunk=1,
+                  step_hooks=[recorder])
+    try:
+        short_req = eng.submit(short, 24)
+        while not short_req.tokens:      # decoding, not queued
+            assert short_req.error is None, short_req.error
+            time.sleep(0.005)
+        long_req = eng.submit(long_p, 4)
+        exp_short = generate(net, short[None], 24, temperature=0.0)[0]
+        exp_long = generate(net, long_p[None], 4, temperature=0.0)[0]
+        np.testing.assert_array_equal(short_req.result(timeout=120.0),
+                                      exp_short)
+        np.testing.assert_array_equal(long_req.result(timeout=120.0),
+                                      exp_long)
+        with lock:
+            chunk_idx = [i for i, (ph, info) in enumerate(events)
+                         if ph == "pre_prefill" and "chunk_off" in info]
+            decode_idx = [i for i, (ph, _) in enumerate(events)
+                          if ph == "pre_decode"]
+        assert len(chunk_idx) >= 3
+        assert any(chunk_idx[0] < d < chunk_idx[-1] for d in decode_idx), \
+            "no decode step landed between the long prompt's prefill " \
+            "chunks — chunked prefill is not interleaving"
+    finally:
+        eng.shutdown()
+
+
+def test_page_reuse_after_retirement_keeps_parity(net):
+    """Pages freed by retired requests are REUSED by the next wave —
+    the pool is sized so wave 2 cannot avoid wave 1's pages — and the
+    new occupants' decode must be argmax-exact: no stale KV from the
+    pages' previous owner may leak into a new request's attention."""
+    prompts = _prompts(4, 9, seed=41)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    # 9-token prompt -> 16-wide bucket = 2 pages of 8; span 9+6-1=14 -> 2
+    # pages. pool_pages=4 == exactly wave 1's demand, so wave 2's pages
+    # are all reallocations
+    eng = _engine(net, n_slots=2, max_len=32, prompt_buckets=(16,),
+                  page_size=8, pool_pages=4)
+    try:
+        first = [eng.submit(prompts[i], 6) for i in range(2)]
+        for i, r in enumerate(first):
+            np.testing.assert_array_equal(r.result(timeout=120.0),
+                                          expected[i])
+        assert eng.stats()["pages_in_use"] == 0
+        assert eng.stats()["pages_in_use_peak"] == 4
+        second = [eng.submit(prompts[i], 6) for i in range(2, 4)]
+        for i, r in enumerate(second, start=2):
+            np.testing.assert_array_equal(r.result(timeout=120.0),
+                                          expected[i])
+    finally:
+        eng.shutdown()
+
+
+def test_pool_exhaustion_sheds_typed_with_retry_after(net):
+    """Memory-side admission control: when the queued page demand
+    exceeds `max_queued_pages`, submit sheds with the typed
+    `OutOfPagesError` (a ServerOverloadedError subclass, so every
+    existing overload handler composes) carrying retry_after — and
+    page-blocked waiters admit and complete once a retirement frees
+    the pool."""
+    gate = threading.Event()
+
+    def slow_hook(phase, info):
+        if phase == "pre_decode":
+            gate.wait(0.05)
+
+    # 4-page pool; each request (t0=5 -> bucket 8, span 5+24-1=28) needs
+    # 4 pages: one in flight fills the pool
+    eng = _engine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                  page_size=8, pool_pages=4, max_queued_pages=4,
+                  step_hooks=[slow_hook])
+    try:
+        prompts = _prompts(3, 5, seed=43)
+        expected = generate(net, prompts, 24, temperature=0.0)
+        holder = eng.submit(prompts[0], 24)    # takes all 4 pages
+        while not holder.tokens:
+            assert holder.error is None, holder.error
+            time.sleep(0.005)
+        assert eng.stats()["pages_in_use"] == 4
+        waiter = eng.submit(prompts[1], 24)    # queued page demand: 4
+        with pytest.raises(OutOfPagesError) as ei:
+            eng.submit(prompts[2], 24)         # demand 8 > 4 allowed
+        assert ei.value.retry_after > 0
+        assert isinstance(ei.value, ServerOverloadedError)
+        st = eng.stats()
+        assert st["shed_out_of_pages"] == 1
+        assert st["queued_page_demand"] == 4
+        gate.set()
+        # the pool turns over: holder retires, waiter takes its pages
+        np.testing.assert_array_equal(holder.result(timeout=120.0),
+                                      expected[0])
+        np.testing.assert_array_equal(waiter.result(timeout=120.0),
+                                      expected[1])
+    finally:
+        eng.shutdown()
+    # a request that can NEVER fit the pool is a config error, not a shed
+    eng2 = _engine(net, n_slots=1, max_len=32, prompt_buckets=(8,),
+                   page_size=8, pool_pages=2)
+    try:
+        with pytest.raises(ValueError, match="pool"):
+            eng2.submit(_prompts(1, 5)[0], 24)  # needs 4 > 2 pages
+    finally:
+        eng2.shutdown()
 
 
 # -------------------------------------------- admission / deadlines
@@ -394,23 +557,31 @@ def test_gateway_generate_round_trip(net):
 
 @pytest.mark.slow
 def test_bench_serve_generate_smoke(monkeypatch):
-    """The goodput bench runs green end to end at a shrunken shape and
-    records every satellite number the acceptance criteria name."""
+    """The goodput bench runs green end to end at a shrunken
+    mixed-length shape and records every satellite number the
+    acceptance criteria name (paged-vs-r5 comparison, pages_in_use +
+    prefill-chunk accounting)."""
     import bench
 
     monkeypatch.setitem(bench.__dict__, "_SERVE_GEN_SHAPE", {
         "vocab": 64, "d_model": 32, "n_heads": 2, "n_layers": 2,
-        "T0": 8, "n_requests": 8, "out_lengths": (8, 12, 16),
-        "n_slots": 4, "mean_interarrival": 0.002, "gqa_kv_heads": 1,
+        "prompt_lengths": (8, 48), "long_frac": 0.25,
+        "n_requests": 8, "out_lengths": (8, 12, 16),
+        "r5_n_slots": 2, "slots_multiplier": 2,
+        "page_size": 8, "prefill_chunk": 16,
+        "mean_interarrival": 0.002, "gqa_kv_heads": 1,
         "repeats": 2,
     })
     metric, value, mfu, spread = bench.bench_serve_generate()
-    assert metric == "serve_generate_goodput_tokens_per_sec"
+    assert metric == "serve_generate_paged_goodput_tokens_per_sec"
     assert value > 0 and spread >= 1.0
     fn = bench.bench_serve_generate
     assert set(fn.latency_ms) == {"p50", "p99"}
-    assert set(fn.baseline_latency_ms) == {"p50", "p99"}
+    assert set(fn.r5_latency_ms) == {"p50", "p99"}
     assert 0 < fn.slot_occupancy_pct <= 100.0
-    assert fn.baseline_tokens_per_sec > 0
-    assert fn.goodput_vs_serial > 0
+    assert fn.r5_goodput_tokens_per_sec > 0
+    assert fn.paged_vs_r5_goodput > 0
+    assert 0 < fn.pages_in_use_peak <= fn.pool_pages
+    assert fn.prefill_chunks > 0, \
+        "the 48-token prompts must ride chunked prefill"
     assert fn.gqa_goodput_tokens_per_sec > 0
