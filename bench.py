@@ -5,8 +5,8 @@ BASELINE.md metrics (the reference publishes no numbers —
 first recorded run of this framework, stored in `.bench_baseline.json`).
 
 Usage: `python bench.py [lenet|resnet50|lstm|gpt|word2vec|generate|
-serve_generate|...]` (default: ALL configs; see `_CONFIGS` for the full
-set). Prints ONE JSON line:
+serve_pool|serve_generate|...]` (default: ALL configs; see `_CONFIGS`
+for the full set). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
    "configs": {name: {metric, value, unit, vs_baseline, mfu}, ...}}
 with a computed MFU estimate (XLA-counted step FLOPs / v5e peak) per
@@ -741,6 +741,153 @@ def bench_serving():
     return "serving_predict_rows_per_sec", rows_per_sec, None, spread
 
 
+def bench_serve_pool():
+    """Replicated-pool serving tax (`serving/replica_pool.ReplicaPool`):
+    steady-state p50/p99 predict latency and rows/sec for a 3-REPLICA
+    pool (least-loaded routing, health probes, shared admission budget)
+    vs a single `ModelServer` under the SAME offered closed-loop load —
+    the price/benefit of the dispatch tier, measured every round. Plus
+    the chaos line the tier exists for: one replica KILLED mid-bench
+    (`ReplicaCrashInjector`), reporting `availability_pct` (fraction of
+    offered requests answered) and the failover count — the number that
+    should read 100.0 / >0 when failover works and <100 when it
+    doesn't."""
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.serving import (
+        ModelServer,
+        ReplicaCrashInjector,
+        ReplicaPool,
+    )
+    import threading
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01).updater(Updater.ADAM)
+            .list()
+            .layer(DenseLayer(n_out=1024, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=512, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(512))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 512)).astype(np.float32)
+    n_threads, reqs_per_thread = 6, 16
+    lock = threading.Lock()
+
+    def drive(predict, latencies=None):
+        """One closed-loop pass: n_threads clients, each sending
+        reqs_per_thread back-to-back requests. Returns wall time."""
+        def client():
+            mine = []
+            for _ in range(reqs_per_thread):
+                t0 = time.perf_counter()
+                predict(x, timeout=60.0)
+                mine.append(time.perf_counter() - t0)
+            if latencies is not None:
+                with lock:
+                    latencies.extend(mine)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    total_rows = n_threads * reqs_per_thread * x.shape[0]
+    server_kw = dict(max_queue=256, max_batch_size=64, batch_window=0.001)
+
+    # single-server reference under the identical load
+    single = ModelServer(net, **server_kw)
+    try:
+        for _ in range(6):
+            single.predict(x)
+        single_dts = [drive(single.predict) for _ in range(_REPEATS)]
+    finally:
+        single.shutdown(drain_timeout=10.0)
+    single_dt, _ = _median_spread(single_dts)
+
+    # the 3-replica pool, same offered load
+    pool = ReplicaPool.from_net(net, 3, server_kwargs=server_kw,
+                                probe_batch=x, probe_interval=1.0,
+                                watchdog_timeout=10.0)
+    latencies = []
+    try:
+        for rep in pool._replicas:  # compile every replica's buckets
+            for _ in range(6):
+                rep.server.predict(x)
+        # latencies accumulate across ALL repeats (the rows/sec headline
+        # is the median over the same passes — one sampling story, and
+        # p99 over _REPEATS x 96 samples instead of one pass's 96)
+        dts = [drive(pool.predict, latencies) for _ in range(_REPEATS)]
+        dt, spread = _median_spread(dts)
+        lat = np.asarray(latencies)
+        bench_serve_pool.latency_ms = {
+            "p50": round(1e3 * float(np.percentile(lat, 50)), 2),
+            "p99": round(1e3 * float(np.percentile(lat, 99)), 2)}
+        assert pool.stats()["failovers"] == 0, \
+            "healthy pool bench must not fail over"
+    finally:
+        pool.shutdown(drain_timeout=10.0)
+    rows_per_sec = total_rows / dt
+    bench_serve_pool.single_rows_per_sec = round(total_rows / single_dt, 1)
+    bench_serve_pool.pool_vs_single = round(single_dt / dt, 3)
+
+    # chaos line: one replica killed mid-bench; failover must keep
+    # availability at 100
+    crash = ReplicaCrashInjector()
+    chaos_kw = dict(server_kw, breaker_threshold=3,
+                    breaker_reset_timeout=0.5)
+    servers = [ModelServer(net.clone() if i else net,
+                           **(dict(chaos_kw, infer_hooks=[crash])
+                              if i == 1 else chaos_kw))
+               for i in range(3)]
+    chaos_pool = ReplicaPool(servers, probe_batch=x, probe_interval=0.25,
+                             watchdog_timeout=5.0, evict_threshold=2)
+    ok = [0]
+    offered = n_threads * reqs_per_thread
+
+    def chaos_client():
+        for i in range(reqs_per_thread):
+            try:
+                chaos_pool.predict(x, timeout=60.0)
+                with lock:
+                    ok[0] += 1
+            except Exception:  # noqa: BLE001 — availability accounting
+                pass
+            if i == 2:
+                crash.crash()  # dies while requests are in flight
+
+    try:
+        for rep in chaos_pool._replicas:
+            rep.server.predict(x)
+        threads = [threading.Thread(target=chaos_client)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        bench_serve_pool.availability_pct = round(100.0 * ok[0] / offered,
+                                                  2)
+        bench_serve_pool.failovers = chaos_pool.stats()["failovers"]
+    finally:
+        chaos_pool.shutdown(drain_timeout=10.0)
+    return ("serve_pool_predict_rows_per_sec", rows_per_sec, None, spread)
+
+
 def _zipf_corpus(vocab_size, n_sentences, sent_len, seed=0):
     """Synthetic Zipf corpus as pre-tokenized sentences."""
     rng = np.random.default_rng(seed)
@@ -770,6 +917,27 @@ def _time_w2v(w2v, sentences):
     return _median_spread(dts)
 
 
+def _w2v_device_ms_per_word(w2v, sentences, dt_full):
+    """Half-corpus differencing (ROADMAP item 4, the
+    `device_ms_per_token` discipline generalized to the word2vec
+    configs): time a half-length corpus pass at the same compiled shapes
+    and take the incremental cost of the extra words. The per-pass fixed
+    cost — vocab-side host bookkeeping, tunnel RTT, dispatch setup —
+    cancels in (dt_full − dt_half), so the number attributes to the
+    chip-side scatter path, not to host/tunnel noise. Falls back to the
+    wall bound when noise swamps the differencing. Both passes share
+    `_time_w2v`'s timing discipline so the two sides of the difference
+    cannot drift."""
+    half = sentences[:len(sentences) // 2]
+    dt_half, _ = _time_w2v(w2v, half)
+    words_full = sum(len(s) for s in sentences)
+    words_half = sum(len(s) for s in half)
+    if dt_full > dt_half and words_full > words_half:
+        return round(1e3 * (dt_full - dt_half)
+                     / (words_full - words_half), 6)
+    return round(1e3 * dt_full / words_full, 6)  # noise swamped: wall
+
+
 def bench_word2vec():
     """Skip-gram with negative sampling (BASELINE config 4: the reference's
     `SkipGram.iterateSample` / `AggregateSkipGram` native-op path, here a
@@ -783,6 +951,8 @@ def bench_word2vec():
                    min_word_frequency=1, epochs=1, seed=1)
     w2v.build_vocab(sentences)
     dt, spread = _time_w2v(w2v, sentences)
+    bench_word2vec.device_ms_per_word = _w2v_device_ms_per_word(
+        w2v, sentences, dt)
     total_words = n_sentences * sent_len
     # scatter/bandwidth-bound by design: MFU is not a meaningful figure
     return ("word2vec_skipgram_train_words_per_sec_per_chip",
@@ -808,6 +978,8 @@ def bench_word2vec_50k():
                    batch_size=16384, scan_flushes=32)
     w2v.build_vocab(sentences)
     dt, spread = _time_w2v(w2v, sentences)
+    bench_word2vec_50k.device_ms_per_word = _w2v_device_ms_per_word(
+        w2v, sentences, dt)
     total_words = n_sentences * sent_len
     return ("word2vec_skipgram_50kvocab_train_words_per_sec_per_chip",
             total_words / dt, None, spread)
@@ -1028,7 +1200,8 @@ def bench_serve_generate():
         net.init()
         return net
 
-    def engine_goodput(net, n_slots, **engine_kw):
+    def engine_goodput(net, n_slots, outs_override=None, **engine_kw):
+        run_outs = outs if outs_override is None else outs_override
         engine = DecodeEngine(
             net, n_slots=n_slots, max_len=max_len,
             page_size=shp["page_size"],
@@ -1037,14 +1210,14 @@ def bench_serve_generate():
             max_queued_pages=10 ** 9,  # latency priced, not queue sheds
             **engine_kw)
         try:
-            _serve_gen_engine_pass(engine, prompts, outs, arrivals)  # jit
-            _serve_gen_engine_pass(engine, prompts, outs, arrivals)  # settle
+            _serve_gen_engine_pass(engine, prompts, run_outs, arrivals)
+            _serve_gen_engine_pass(engine, prompts, run_outs, arrivals)
             # occupancy over the TIMED passes only: the compile pass
             # saturates the slots while XLA works and would bias the
             # lifetime ratio upward
             base_steps = engine.decode_steps
             base_active = engine.active_slot_steps
-            passes = [_serve_gen_engine_pass(engine, prompts, outs,
+            passes = [_serve_gen_engine_pass(engine, prompts, run_outs,
                                              arrivals)
                       for _ in range(shp["repeats"])]
             goodputs = [p[0] for p in passes]
@@ -1088,6 +1261,26 @@ def bench_serve_generate():
     bench_serve_generate.paged_vs_r5_goodput = round(
         goodput / r5_goodput, 3)
 
+    # device_ms_per_token for the serving path (ROADMAP item 4: the
+    # generate-adjacent config still lacked a device-time number): run
+    # the SAME paged configuration and arrivals with HALVED output
+    # lengths and difference out the per-pass fixed cost (prefills,
+    # arrival idle, tunnel dispatch floor) — the incremental cost of the
+    # extra tokens is the decode path's device-side price per token
+    half_outs = np.maximum(1, outs // 2)
+    half_goodput = engine_goodput(
+        net, shp["r5_n_slots"] * shp["slots_multiplier"],
+        outs_override=half_outs,
+        pool_pages=kv_budget_pages, prompt_buckets=(short_t0,))[0]
+    toks_full, toks_half = int(outs.sum()), int(half_outs.sum())
+    dt_full, dt_half = toks_full / goodput, toks_half / half_goodput
+    if dt_full > dt_half and toks_full > toks_half:
+        bench_serve_generate.device_ms_per_token = round(
+            1e3 * (dt_full - dt_half) / (toks_full - toks_half), 4)
+    else:  # noise swamped the differencing: report the wall bound
+        bench_serve_generate.device_ms_per_token = round(
+            1e3 * dt_full / toks_full, 4)
+
     # GQA variant line (not the headline: baseline comparability)
     gqa_net = build_net(n_kv_heads=shp["gqa_kv_heads"])
     gqa_goodput = engine_goodput(
@@ -1108,6 +1301,7 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "checkpoint": bench_checkpoint,
             "sentinel": bench_sentinel,
             "serving": bench_serving,
+            "serve_pool": bench_serve_pool,
             "serve_generate": bench_serve_generate}
 
 
@@ -1167,6 +1361,11 @@ def main() -> None:
                 ("sentinel_overhead_pct", "sentinel_overhead_pct"),
                 ("shed_rate_pct", "shed_rate_pct"),
                 ("device_ms_per_token", "device_ms_per_token"),
+                ("device_ms_per_word", "device_ms_per_word"),
+                ("single_rows_per_sec", "single_rows_per_sec"),
+                ("pool_vs_single", "pool_vs_single"),
+                ("availability_pct", "availability_pct"),
+                ("failovers", "failovers"),
                 ("slot_occupancy_pct", "slot_occupancy_pct"),
                 ("pages_in_use_peak", "pages_in_use_peak"),
                 ("pool_pages", "pool_pages"),

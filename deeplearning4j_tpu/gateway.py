@@ -66,10 +66,14 @@ class GatewayError(RuntimeError):
     shed/unavailable responses so clients can back off intelligently."""
 
     def __init__(self, msg: str, error_type: Optional[str] = None,
-                 retry_after: Optional[float] = None):
+                 retry_after: Optional[float] = None,
+                 replica_id: Optional[int] = None):
         super().__init__(msg)
         self.error_type = error_type
         self.retry_after = retry_after
+        # present when a replicated pool produced the error: which
+        # replica it originated on
+        self.replica_id = replica_id
 
 
 class RequestTooLargeError(RuntimeError):
@@ -116,7 +120,15 @@ class EntryPoint:
     (historical behavior); a dict of `serving.ModelServer` kwargs (or
     `True` for defaults) wraps every created/loaded model in a
     ModelServer, so those calls gain admission control, deadlines, and
-    circuit breaking, plus `reload_model`/`server_stats` management."""
+    circuit breaking, plus `reload_model`/`server_stats` management.
+    With `serving={"replicas": N, "pool": {...}, ...}` (N > 1; "pool"
+    holds optional `serving.ReplicaPool` kwargs, the rest ModelServer
+    kwargs) every model is cloned across N replicas behind a
+    `ReplicaPool`: least-loaded routing, health-probed eviction +
+    failover, optional hedging, and `rolling_reload`/`pool_stats`
+    management — a replica failure costs a failover, not the service.
+    Errors that originated on a specific replica carry `replica_id` in
+    the error payload."""
 
     # lifecycle methods a remote caller must NOT reach through the RPC
     # dispatch: one unauthenticated request could drain every ModelServer
@@ -157,12 +169,39 @@ class EntryPoint:
     def _install(self, name: str, net) -> None:
         self._models[name] = net
         if self._serving is not None:
-            from deeplearning4j_tpu.serving import ModelServer
-
             old = self._servers.pop(name, None)
             if old is not None:
                 old.shutdown(drain_timeout=5.0)
-            self._servers[name] = ModelServer(net, **self._serving)
+            self._servers[name] = self._make_server(net)
+
+    def _make_server(self, net):
+        """One ModelServer — or, with `"replicas": N` in the serving
+        config, a ReplicaPool cloning the net across N servers
+        (`"pool"` sub-dict carries ReplicaPool kwargs; everything else
+        is ModelServer kwargs)."""
+        cfg = dict(self._serving)
+        raw_replicas = cfg.pop("replicas", 1)
+        n_replicas = 1 if raw_replicas is None else int(raw_replicas)
+        if n_replicas < 1:
+            raise ValueError(
+                "serving config 'replicas' must be >= 1, got "
+                f"{raw_replicas!r}")
+        pool_cfg = cfg.pop("pool", {}) or {}
+        if pool_cfg and n_replicas == 1:
+            # fail at construction, not silently un-replicated: pool
+            # kwargs without replicas almost certainly means a typo'd
+            # or forgotten "replicas": N
+            raise ValueError(
+                "serving config has 'pool' kwargs but 'replicas' is "
+                f"{raw_replicas!r} — a ReplicaPool needs 'replicas' > 1")
+        if n_replicas > 1:
+            from deeplearning4j_tpu.serving import ReplicaPool
+
+            return ReplicaPool.from_net(net, n_replicas,
+                                        server_kwargs=cfg, **pool_cfg)
+        from deeplearning4j_tpu.serving import ModelServer
+
+        return ModelServer(net, **cfg)
 
     def _model(self, name: str):
         if name not in self._models:
@@ -177,10 +216,7 @@ class EntryPoint:
         if self._serving is None:
             return None
         if name in self._models and name not in self._servers:
-            from deeplearning4j_tpu.serving import ModelServer
-
-            self._servers[name] = ModelServer(self._models[name],
-                                              **self._serving)
+            self._servers[name] = self._make_server(self._models[name])
         return self._servers.get(name)
 
     def _server(self, name: str):
@@ -197,6 +233,12 @@ class EntryPoint:
         net = self._model(name)
         net.fit(np.asarray(features, np.float32),
                 np.asarray(labels, np.float32), epochs=epochs)
+        srv = self._servers.get(name)
+        if srv is not None and hasattr(srv, "sync_net"):
+            # in-place training updated replica 0's aliased net; push
+            # the new weights to the cloned replicas too, or routing
+            # would answer with pre-fit parameters on N-1 of N picks
+            srv.sync_net(net)
         return float(net.score_value)
 
     def predict(self, name: str, features,
@@ -248,29 +290,70 @@ class EntryPoint:
                             seed=int(seed), timeout=timeout)
 
     # -- serving management ----------------------------------------------
-    def reload_model(self, name: str, path: str,
-                     step: Optional[int] = None) -> int:
-        """Hot-swap model `name` from a checkpoint file path or a
-        `CheckpointStore` directory (newest verified step when `step` is
-        None), with manifest verification + canary validation — a bad
-        candidate is rejected with the old model still serving. Returns
-        the new model_version."""
-        srv = self._server(name)
+    @staticmethod
+    def _reload_source(path: str) -> Any:
         p = Path(path)
         if p.is_dir():
             from deeplearning4j_tpu.util.checkpoint_store import (
                 CheckpointStore,
             )
 
-            source: Any = CheckpointStore(p)
+            return CheckpointStore(p)
+        return p
+
+    def reload_model(self, name: str, path: str,
+                     step: Optional[int] = None) -> int:
+        """Hot-swap model `name` from a checkpoint file path or a
+        `CheckpointStore` directory (newest verified step when `step` is
+        None), with manifest verification + canary validation — a bad
+        candidate is rejected with the old model still serving. On a
+        replicated pool this delegates to `rolling_reload`, so a deploy
+        through the historical RPC is zero-downtime too. Returns the
+        new model_version."""
+        srv = self._server(name)
+        source = self._reload_source(path)
+        if hasattr(srv, "rolling_reload"):
+            # versions cover HEALTHY replicas; a fully-degraded pool
+            # (best-effort reloads only) returns [] — still a deploy,
+            # not an internal error
+            version = max(srv.rolling_reload(source, step=step),
+                          default=0)
         else:
-            source = p
-        version = srv.reload(source, step=step)
+            version = srv.reload(source, step=step)
         self._models[name] = srv.net
         return version
 
+    def rolling_reload(self, name: str, path: str,
+                       step: Optional[int] = None) -> list:
+        """Replica-at-a-time canary-gated reload of model `name`'s
+        `ReplicaPool` (requires `serving={"replicas": N, ...}`): drain →
+        reload → probe per replica, pool-wide rollback to the old
+        weights if any replica's canary or probe fails. Returns the
+        per-replica model versions."""
+        srv = self._server(name)
+        if not hasattr(srv, "rolling_reload"):
+            raise RuntimeError(
+                f"model {name!r} is served by a single ModelServer — "
+                "rolling_reload needs serving={'replicas': N} (N > 1); "
+                "use reload_model instead")
+        versions = srv.rolling_reload(self._reload_source(path), step=step)
+        self._models[name] = srv.net
+        return versions
+
     def server_stats(self, name: str) -> dict:
         return self._server(name).stats()
+
+    def pool_stats(self, name: str) -> dict:
+        """Aggregated `ReplicaPool.stats()` — per-replica server stats
+        plus the pool counters (failovers, hedges, evictions,
+        rolling_reloads, ...)."""
+        srv = self._server(name)
+        if not hasattr(srv, "rolling_reload"):
+            raise RuntimeError(
+                f"model {name!r} is served by a single ModelServer — "
+                "pool_stats needs serving={'replicas': N} (N > 1); use "
+                "server_stats instead")
+        return srv.stats()
 
     def shutdown(self, drain_timeout: float = 10.0) -> None:
         """Drain and stop every ModelServer (called by
@@ -373,6 +456,12 @@ class GatewayServer:
                         retry_after = getattr(e, "retry_after", None)
                         if retry_after is not None:
                             resp["retry_after"] = float(retry_after)
+                        # pool-routed errors name the replica that
+                        # produced them — ops can map a failing
+                        # error stream to one sick replica
+                        replica_id = getattr(e, "replica_id", None)
+                        if replica_id is not None:
+                            resp["replica_id"] = int(replica_id)
                     if not self._respond(resp):
                         return
 
@@ -417,7 +506,7 @@ class GatewayClient:
     # naturally deduplicated on the server side (generate is seeded, so a
     # re-send recomputes the identical tokens)
     _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
-                             "server_stats", "generate"})
+                             "server_stats", "pool_stats", "generate"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05):
@@ -465,7 +554,8 @@ class GatewayClient:
         if "error" in resp:
             raise GatewayError(resp["error"],
                                error_type=resp.get("error_type"),
-                               retry_after=resp.get("retry_after"))
+                               retry_after=resp.get("retry_after"),
+                               replica_id=resp.get("replica_id"))
         return decode_value(resp["result"])
 
     def close(self):

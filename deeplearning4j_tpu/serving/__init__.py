@@ -1,16 +1,19 @@
 """Robust serving tier: admission control, per-request deadlines with
-adaptive micro-batching, circuit breaking, safe hot model reload, and a
+adaptive micro-batching, circuit breaking, safe hot model reload, a
 continuous-batching generation path (`DecodeEngine`: paged KV cache,
-chunked prefill + iteration-level scheduling) — the inference-path
-counterpart of the
-training robustness tier (elastic workers / durable checkpoints /
-health sentinel). See `docs/serving.md` for the ladder semantics and
-tuning knobs.
+chunked prefill + iteration-level scheduling), and a replicated serving
+pool (`ReplicaPool`: health-probed replicas, least-loaded routing with
+failover, hedged predicts, zero-downtime rolling reload) — the
+inference-path counterpart of the training robustness tier (elastic
+workers / durable checkpoints / health sentinel). See
+`docs/serving.md` for the ladder semantics and tuning knobs.
 """
 from deeplearning4j_tpu.serving.chaos import (
     BrokenModelInjector,
     InjectedServingFault,
     ReloadCorruptionInjector,
+    ReplicaCrashInjector,
+    ReplicaHangInjector,
     SlowInferenceInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
@@ -26,6 +29,10 @@ from deeplearning4j_tpu.serving.model_server import (
     ServiceUnavailableError,
     ServingError,
 )
+from deeplearning4j_tpu.serving.replica_pool import (
+    ReplicaEvictedError,
+    ReplicaPool,
+)
 
 __all__ = [
     "BrokenModelInjector",
@@ -38,6 +45,10 @@ __all__ = [
     "ModelValidationError",
     "OutOfPagesError",
     "ReloadCorruptionInjector",
+    "ReplicaCrashInjector",
+    "ReplicaEvictedError",
+    "ReplicaHangInjector",
+    "ReplicaPool",
     "ServerClosedError",
     "ServerOverloadedError",
     "ServiceUnavailableError",

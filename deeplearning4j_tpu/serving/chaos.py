@@ -6,7 +6,17 @@ chaos suite (`tests/test_serving.py`) proves end to end:
 - overload → typed shed → recovery (`SlowInferenceInjector`),
 - breaker open → half-open probe → close (`BrokenModelInjector`),
 - reload-of-corrupt-candidate → rejection with the previous model still
-  serving (`ReloadCorruptionInjector`).
+  serving (`ReloadCorruptionInjector`),
+
+plus the REPLICA-level ladders the replicated pool
+(`serving/replica_pool.py`, `tests/test_replica_pool.py`) proves:
+
+- replica crash mid-flight → failover serves the request, probe loop
+  evicts, revival re-admits (`ReplicaCrashInjector`),
+- replica wedged inside a device step → watchdog eviction, hedged
+  requests won by the healthy replica (`ReplicaHangInjector`),
+- corrupted rolling-reload candidate → pool-wide rollback
+  (`ReloadCorruptionInjector`, reused per replica).
 
 `SlowInferenceInjector` and `BrokenModelInjector` plug into
 `ModelServer(infer_hooks=[...])` — called as `hook(phase, info)` at
@@ -76,6 +86,61 @@ class BrokenModelInjector:
                 self.failures += 1
             raise InjectedServingFault(
                 "injected model breakage (serving chaos)")
+
+
+class ReplicaCrashInjector:
+    """Simulated replica process death. Plug into ONE replica's
+    `infer_hooks`; after `crash()` every device step on that replica
+    raises `InjectedServingFault` — the shape of a replica whose
+    process died with requests in flight (in-flight work errors, the
+    pool fails the request over, the probe loop evicts). `revive()`
+    brings the 'process' back so re-admission can be drilled.
+    `steps_killed` counts dispatches the crash ate."""
+
+    def __init__(self, crashed: bool = False):
+        self.crashed = crashed
+        self.steps_killed = 0
+        self._lock = threading.Lock()
+
+    def crash(self) -> None:
+        self.crashed = True
+
+    def revive(self) -> None:
+        self.crashed = False
+
+    def __call__(self, phase: str, info: dict) -> None:
+        if phase == "pre_step" and self.crashed:
+            with self._lock:
+                self.steps_killed += 1
+            raise InjectedServingFault(
+                "injected replica crash (replica-pool chaos)")
+
+
+class ReplicaHangInjector:
+    """Wedged replica: while `active`, every device step on the wired
+    replica BLOCKS (no error, no progress — the failure deadlines
+    cannot reach, because the hang is inside the accelerator dispatch).
+    Drives the pool's watchdog-eviction and hedging ladders: the probe
+    loop's watchdog reads the silence as a hang, and a hedged request
+    is won by the healthy replica while this one sits. `release()`
+    unblocks every waiter (test teardown MUST call it, or the replica's
+    executor thread sleeps forever); `hangs` counts trapped steps."""
+
+    def __init__(self):
+        self.active = True
+        self.hangs = 0
+        self._lock = threading.Lock()
+        self._released = threading.Event()
+
+    def release(self) -> None:
+        self.active = False
+        self._released.set()
+
+    def __call__(self, phase: str, info: dict) -> None:
+        if phase == "pre_step" and self.active:
+            with self._lock:
+                self.hangs += 1
+            self._released.wait()
 
 
 class ReloadCorruptionInjector:

@@ -798,6 +798,14 @@ class DecodeEngine:
         return self.submit(prompt_ids, n_tokens, temperature=temperature,
                            seed=seed, timeout=timeout).result()
 
+    def pending(self) -> int:
+        """Queued + in-slot generation requests — the engine's share of
+        the load number least-loaded routing compares (folded into
+        `ModelServer.pending()`)."""
+        with self._cond:
+            return len(self._queue) \
+                + sum(1 for r in self._slots if r is not None)
+
     def stats(self) -> dict:
         with self._cond:
             queued = len(self._queue)

@@ -457,6 +457,8 @@ class ModelServer:
     def stats(self) -> dict:
         with self._cond:
             queued = len(self._queue)
+            in_flight = self._in_flight
+            ewma_ms = 1e3 * self._step_latency_ewma
             # batch starvation observability: how full are dispatched
             # micro-batches relative to device capacity (max_batch_size)?
             # Low batch_fill_pct = the chip runs under-occupied steps —
@@ -473,7 +475,15 @@ class ModelServer:
                "reload_rejections": self.reload_rejections,
                "breaker_state": self.breaker.state,
                "breaker_opens": self.breaker.opens,
-               "model_version": self.model_version, "queued": queued}
+               "model_version": self.model_version, "queued": queued,
+               # the routing contract (serving/replica_pool.py leans on
+               # these top-level): how loaded is this replica right now,
+               # and how long does one device step take it.
+               # "queue_depth" deliberately aliases the pre-existing
+               # "queued" — the routing contract name vs the historical
+               # one; both are pinned by tests
+               "in_flight": in_flight, "queue_depth": queued,
+               "ewma_latency_ms": round(ewma_ms, 3)}
         engine = self._engine
         if engine is not None:
             gen = engine.stats()
@@ -544,6 +554,75 @@ class ModelServer:
 
     def __call__(self, x, timeout: Optional[float] = None) -> np.ndarray:
         return self.predict(x, timeout=timeout)
+
+    def pending(self) -> int:
+        """Queued + in-flight request count, across BOTH serving paths
+        (predict queue AND the decode engine's queued/in-slot
+        generations) — the load number a least-loaded router compares,
+        and the drain condition a replica-at-a-time rolling reload
+        waits on. A replica saturated with multi-second generates must
+        not read as idle to the router."""
+        with self._cond:
+            n = len(self._queue) + self._in_flight
+        engine = self._engine
+        if engine is not None:
+            n += engine.pending()
+        return n
+
+    def probe(self, x=None,
+              timeout: Optional[float] = None) -> Optional[bool]:
+        """Active health probe: serve one canary-sized batch through the
+        FULL predict path (admission, batching, breaker, non-finite
+        screen). Three-valued so a router can tell sickness from load:
+
+        - **True** — the canary was served end to end.
+        - **False** — sickness: the step failed, outputs were
+          non-finite, or the breaker is open. (A probe arriving while
+          the breaker is half-open IS the half-open probe, so repeated
+          probing drives a broken-then-healed replica back to closed.)
+        - **None** — inconclusive: the probe was shed on LOAD
+          (queue-full `ServerOverloadedError`) or TIME
+          (`DeadlineExceededError` while queued behind real traffic).
+          A busy replica proves nothing either way — treating this as
+          failure would let a saturating burst evict healthy replicas
+          and cascade a pool into degraded mode.
+
+        With no batch available (none passed, no canary armed yet) the
+        probe degrades to a breaker-state check — `None` unless the
+        breaker is open (it cannot prove health, only flag known
+        sickness)."""
+        batch = x if x is not None else self._canary
+        if batch is None:
+            return False if self.breaker.state == "open" else None
+        try:
+            out = self.predict(np.asarray(batch), timeout=timeout)
+        except (ServerOverloadedError, DeadlineExceededError):
+            return None  # load/time shed: not evidence of sickness
+        except ServingError:
+            return False
+        assert out is not None
+        return True
+
+    def restore_model(self, net) -> int:
+        """Swap `net` in WITHOUT canary validation — the rollback seam a
+        replica pool uses to put known-good old weights back after a
+        failed rolling reload (their health was proven by having
+        served). Same swap discipline as `reload`: write lock (in-flight
+        finishes on the outgoing model), engine drain, breaker reset,
+        monotonic version bump. Returns the new model_version."""
+        with self._reload_lock:
+            with self._rwlock.write():
+                self._net = net
+                self.model_version += 1
+                version = self.model_version
+            with self._engine_lock:
+                engine = self._engine
+            if engine is not None:
+                engine.drain_and_swap(net)
+            self.breaker.reset()
+            logger.warning("model server: restored previous model "
+                           "(model_version=%d)", version)
+            return version
 
     # -- generation (continuous batching) ----------------------------------
     def _ensure_engine(self):

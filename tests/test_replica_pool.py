@@ -1,0 +1,945 @@
+"""Replica-pool chaos suite: the ladders the replicated serving tier
+(`serving/replica_pool.ReplicaPool`) must prove end to end (ISSUE 7
+acceptance contract):
+
+1. replica crash mid-flight under concurrent load → failover serves
+   every accepted request (zero lost), the probe loop evicts the dead
+   replica, revival re-admits it;
+2. ALL replicas down → typed `ServiceUnavailableError` + `retry_after`
+   (degraded mode, no deadlock) → automatic recovery on re-admission;
+3. rolling reload under live Poisson traffic → zero failed requests,
+   every replica on the candidate;
+4. corrupted/poisoned candidate (at replica 0 AND at replica k > 0) →
+   typed rejection + POOL-WIDE rollback, old model still answering;
+5. hedged predict where the primary replica hangs forever → the healthy
+   replica's result wins inside the deadline;
+
+plus watchdog eviction of a wedged replica, least-loaded routing, the
+shared admission budget, and the stats-schema contracts the gateway's
+`server_stats`/`pool_stats` RPCs expose.
+
+Tier-1 safety: every pool here runs with TIGHT probe/watchdog intervals
+and bounded drains, and an autouse SIGALRM wedge guard aborts any test
+that exceeds its budget — a hung replica experiment can never wedge the
+suite past the 870 s tier-1 budget.
+"""
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.serving import (
+    InferenceFailedError,
+    ModelServer,
+    ModelValidationError,
+    ReloadCorruptionInjector,
+    ReplicaCrashInjector,
+    ReplicaEvictedError,
+    ReplicaHangInjector,
+    ReplicaPool,
+    ServerClosedError,
+    ServerOverloadedError,
+    ServiceUnavailableError,
+    SlowInferenceInjector,
+)
+from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+from deeplearning4j_tpu.util.serialization import write_model
+
+WEDGE_GUARD_S = 120  # hard per-test bound, far inside the tier-1 budget
+
+
+@pytest.fixture(autouse=True)
+def _wedge_guard():
+    """Tier-1 safety net: a replica-pool test that wedges (hung replica
+    + a bug in the watchdog/drain path) is killed by SIGALRM instead of
+    eating the suite's 870 s budget."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"replica-pool test exceeded the {WEDGE_GUARD_S} s wedge "
+            "guard — a drain/watchdog path is stuck")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WEDGE_GUARD_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _conf(n_out=3, seed=7):
+    return (dl4j.NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.3)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=n_out,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, 3, n)
+    x = (rng.normal(size=(n, 4)) + c[:, None]).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[c]
+
+
+def _fitted_clone(seed=1, epochs=3):
+    net = dl4j.MultiLayerNetwork(_conf(seed=seed))
+    net.init()
+    x, y = _data(48, seed=seed)
+    net.fit(DataSet(x, y), epochs=epochs)
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    n = dl4j.MultiLayerNetwork(_conf())
+    n.init()
+    return n
+
+
+@pytest.fixture()
+def x():
+    return _data()[0]
+
+
+@pytest.fixture()
+def pool_factory(net):
+    """Build pools with TIGHT intervals (tier-1-safe: no default 1 s /
+    10 s probe cadence in tests) and guarantee bounded shutdown."""
+    pools = []
+    injectors = []
+
+    def make(n_replicas=3, per_replica_hooks=None, server_kwargs=None,
+             the_net=None, **pool_kwargs):
+        base = the_net if the_net is not None else net
+        kw = dict(server_kwargs or {})
+        hooks = per_replica_hooks or {}
+        servers = []
+        for i in range(n_replicas):
+            skw = dict(kw)
+            if i in hooks:
+                skw["infer_hooks"] = list(skw.get("infer_hooks", ())) \
+                    + [hooks[i]]
+                injectors.append(hooks[i])
+            servers.append(ModelServer(base if i == 0 else base.clone(),
+                                       **skw))
+        pool_kwargs.setdefault("probe_batch", _data()[0][:2])
+        pool_kwargs.setdefault("probe_interval", 0.1)
+        pool_kwargs.setdefault("probe_timeout", 5.0)
+        pool_kwargs.setdefault("watchdog_timeout", 2.0)
+        p = ReplicaPool(servers, **pool_kwargs)
+        pools.append(p)
+        return p
+
+    yield make
+    for inj in injectors:  # unhang wedged executors BEFORE draining
+        release = getattr(inj, "release", None)
+        if release is not None:
+            release()
+    for p in pools:
+        p.shutdown(drain_timeout=3.0)
+
+
+# ---------------------------------------------------------------- basics
+def test_pool_predict_matches_direct_output(pool_factory, net, x):
+    pool = pool_factory(n_replicas=2)
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                               net.output(x), atol=1e-6)
+    assert pool.stats()["served"] == 1
+
+
+def test_least_loaded_routing_prefers_idle_replica(pool_factory, x):
+    """With replica 0 wedged on a slow step + queued work, new requests
+    must land on idle replica 1."""
+    slow = SlowInferenceInjector(delay=0.6)
+    pool = pool_factory(n_replicas=2, per_replica_hooks={0: slow},
+                        probe_interval=30.0)  # probes off: routing only
+    t = threading.Thread(
+        target=lambda: pool.predict(x, timeout=30.0))
+    t.start()
+    time.sleep(0.15)  # replica 0 is on the device now
+    out = pool.predict(x[:4], timeout=30.0)
+    assert out.shape == (4, 3)
+    stats = pool.stats()
+    assert stats["replicas"]["1"]["served"] >= 1, \
+        "idle replica 1 did not take the second request"
+    slow.release()
+    t.join()
+
+
+def test_stats_schema_contract(pool_factory, x):
+    """The gateway `server_stats`/`pool_stats` contracts: the routing
+    fields on ModelServer.stats() and the pool counters must exist with
+    the right types — a silent rename breaks the dispatch tier."""
+    pool = pool_factory(n_replicas=2, probe_interval=30.0)
+    pool.predict(x, timeout=30.0)
+    rep_stats = pool._replicas[0].server.stats()
+    for key, typ in [("in_flight", int), ("queue_depth", int),
+                     ("queued", int), ("breaker_state", str),
+                     ("ewma_latency_ms", float), ("served", int),
+                     ("model_version", int)]:
+        assert isinstance(rep_stats[key], typ), (key, rep_stats.get(key))
+    s = pool.stats()
+    for key in ("n_replicas", "healthy_replicas", "pool_in_flight",
+                "admission_budget", "served", "failovers",
+                "hedges_fired", "hedge_wins", "evictions",
+                "readmissions", "rolling_reloads", "rollbacks",
+                "shed_overload", "shed_unavailable", "ewma_latency_ms",
+                "replicas"):
+        assert key in s, f"pool stats missing {key!r}"
+    assert set(s["replicas"]) == {"0", "1"}  # str: JSON-stable keys
+    for rs in s["replicas"].values():
+        assert rs["state"] in ("healthy", "evicted", "draining")
+        assert "consecutive_failures" in rs and "in_flight" in rs
+        assert "stale" in rs
+
+
+def test_shared_admission_budget_sheds_pool_wide(pool_factory, x):
+    """Total in-flight across the pool is bounded by ONE shared budget:
+    with slow replicas and a tiny budget, excess offered load sheds
+    typed at the POOL door — N replicas cannot hoard N full queues."""
+    slows = [SlowInferenceInjector(delay=0.3) for _ in range(2)]
+    pool = pool_factory(n_replicas=2,
+                        per_replica_hooks={0: slows[0], 1: slows[1]},
+                        probe_interval=30.0, admission_budget=3,
+                        max_failovers=0)
+    ok, shed = [], []
+    lock = threading.Lock()
+
+    def flood():
+        try:
+            out = pool.predict(x[:2], timeout=30.0)
+            with lock:
+                ok.append(out.shape)
+        except ServerOverloadedError as e:
+            assert e.retry_after > 0
+            with lock:
+                shed.append(e)
+
+    threads = [threading.Thread(target=flood) for _ in range(10)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    for s in slows:
+        s.release()
+    for t in threads:
+        t.join()
+    assert shed, "pool admission budget never shed"
+    assert len(ok) + len(shed) == 10
+    assert pool.stats()["shed_overload"] == len(shed)
+    assert all(shape == (2, 3) for shape in ok)
+
+
+@pytest.mark.chaos
+def test_replica_queue_full_is_load_not_sickness(pool_factory, x):
+    """Replica-level queue-full sheds must NOT count toward eviction —
+    on the PASSIVE path (request failures) or the PROBE path (probes
+    run every 50 ms here, DURING the saturation, and get shed on load
+    too): a saturating burst against a healthy-but-busy replica sheds
+    typed `ServerOverloadedError` (retry_after intact) and the replica
+    stays healthy — overload cannot cascade the pool into degraded
+    mode."""
+    slow = SlowInferenceInjector(delay=0.3)
+    pool = pool_factory(n_replicas=1, per_replica_hooks={0: slow},
+                        probe_interval=0.05, probe_timeout=0.2,
+                        admission_budget=100,
+                        evict_threshold=1, max_failovers=2,
+                        server_kwargs=dict(max_queue=2,
+                                           max_batch_size=2,
+                                           batch_window=0.0))
+    shed = []
+    lock = threading.Lock()
+
+    def flood():
+        try:
+            pool.predict(x[:2], timeout=30.0)
+        except ServerOverloadedError as e:
+            assert e.retry_after > 0  # the hint survives failover
+            with lock:
+                shed.append(e)
+
+    threads = [threading.Thread(target=flood) for _ in range(12)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    slow.release()
+    for t in threads:
+        t.join()
+    assert shed, "the tiny replica queue never shed"
+    s = pool.stats()
+    assert s["evictions"] == 0, \
+        "queue-full sheds evicted a healthy-but-busy replica"
+    assert s["replicas"]["0"]["state"] == "healthy"
+    assert pool.predict(x, timeout=10.0).shape == (32, 3)
+
+
+# ------------------------------------- ladder 1: crash-mid-flight failover
+@pytest.mark.chaos
+def test_replica_crash_midflight_failover_serves_all(pool_factory, net, x):
+    """ISSUE 7 acceptance: 3 replicas under concurrent load, one
+    crashes mid-flight — ZERO accepted requests are lost (failover
+    serves them), asserted on typed outcomes and pool counters."""
+    crash = ReplicaCrashInjector()
+    pool = pool_factory(n_replicas=3, per_replica_hooks={1: crash},
+                        evict_threshold=2, readmit_successes=2,
+                        server_kwargs=dict(breaker_threshold=3,
+                                           breaker_reset_timeout=0.2))
+    results, failures = [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(9)
+
+    def call(i):
+        barrier.wait()
+        if i == 0:
+            crash.crash()  # dies while the others are in flight
+        try:
+            out = pool.predict(x[:2], timeout=30.0)
+            with lock:
+                results.append(out)
+        except Exception as e:  # noqa: BLE001 — any loss must surface
+            with lock:
+                failures.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(9)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, f"accepted requests were lost: {failures}"
+    assert len(results) == 9
+    expected = net.output(x[:2])
+    for out in results:
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+    stats = pool.stats()
+    assert stats["served"] == 9
+    # the crash cost failovers, not answers (the crashed replica may or
+    # may not have been routed to before its first failure — but once
+    # it failed, the re-route is mandatory)
+    if crash.steps_killed:
+        assert stats["failovers"] >= 1
+    # the probe loop notices the corpse
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["evictions"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["evictions"] >= 1
+    assert pool.stats()["replicas"]["1"]["state"] == "evicted"
+    # revival → consecutive probe successes → re-admission
+    crash.revive()
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["healthy_replicas"] < 3 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    s = pool.stats()
+    assert s["healthy_replicas"] == 3 and s["readmissions"] >= 1
+
+
+# -------------------------------------- ladder 2: all down, degraded mode
+@pytest.mark.chaos
+def test_all_replicas_down_typed_unavailable_then_auto_recovery(
+        pool_factory, x):
+    """Every replica evicted → the pool serves typed
+    `ServiceUnavailableError` with retry_after (NOT a deadlock or a
+    bare crash) and keeps probing; revival re-admits and the pool
+    recovers with no operator action."""
+    crashes = {i: ReplicaCrashInjector(crashed=True) for i in range(2)}
+    pool = pool_factory(n_replicas=2, per_replica_hooks=crashes,
+                        evict_threshold=1, readmit_successes=2,
+                        max_failovers=2,
+                        server_kwargs=dict(breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    # drive both replicas to eviction through real traffic
+    for _ in range(4):
+        with pytest.raises((InferenceFailedError, ServiceUnavailableError,
+                            ReplicaEvictedError)):
+            pool.predict(x, timeout=10.0)
+        if pool.stats()["healthy_replicas"] == 0:
+            break
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["healthy_replicas"] > 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["healthy_replicas"] == 0
+    with pytest.raises(ServiceUnavailableError) as ei:
+        pool.predict(x, timeout=5.0)
+    assert ei.value.retry_after > 0
+    assert pool.stats()["shed_unavailable"] >= 1
+    # recovery is automatic: revive → probes pass → re-admission
+    for c in crashes.values():
+        c.revive()
+    deadline = time.monotonic() + 15.0
+    while pool.stats()["healthy_replicas"] < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["healthy_replicas"] == 2
+    assert pool.predict(x, timeout=10.0).shape == (32, 3)
+
+
+# ------------------------------- ladder 3: rolling reload, live traffic
+@pytest.mark.chaos
+def test_rolling_reload_under_live_traffic_zero_failures(
+        pool_factory, net, x, tmp_path):
+    """ISSUE 7 acceptance: rolling reload with live Poisson traffic —
+    zero failed requests, every replica ends on the candidate."""
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    pool = pool_factory(n_replicas=3, probe_interval=0.2,
+                        server_kwargs=dict(canary=x[:2]))
+    old_out, new_out = net.output(x[:4]), candidate.output(x[:4])
+    stop = threading.Event()
+    failures, answers = [], []
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            try:
+                out = pool.predict(x[:4], timeout=30.0)
+                with lock:
+                    answers.append(out)
+            except Exception as e:  # noqa: BLE001 — zero-failure contract
+                with lock:
+                    failures.append(e)
+            time.sleep(float(rng.exponential(0.004)))
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)  # traffic flowing before the deploy starts
+    versions = pool.rolling_reload(store, drain_timeout=10.0)
+    time.sleep(0.1)  # traffic flowing after it completes
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not failures, \
+        f"rolling reload failed {len(failures)} live requests: " \
+        f"{failures[:3]}"
+    assert versions == [1, 1, 1]
+    stats = pool.stats()
+    assert stats["rolling_reloads"] == 1 and stats["rollbacks"] == 0
+    assert all(rs["model_version"] == 1
+               for rs in stats["replicas"].values())
+    # every answer is one of the two model versions — never garbage from
+    # a half-swapped replica
+    for out in answers:
+        assert np.allclose(out, old_out, atol=1e-5) \
+            or np.allclose(out, new_out, atol=1e-5), \
+            "a live request observed a half-reloaded model"
+    np.testing.assert_allclose(pool.predict(x[:4], timeout=30.0),
+                               new_out, atol=1e-5)
+
+
+# -------------------------- ladder 4: bad candidate, pool-wide rollback
+@pytest.mark.chaos
+def test_rolling_reload_poisoned_candidate_pool_rollback(
+        pool_factory, net, x, tmp_path):
+    """ISSUE 7 acceptance: a corrupted (NaN-parameter,
+    manifest-consistent) candidate is rejected by the first replica's
+    canary ladder and the WHOLE pool rolls back — old model still
+    answering, typed error + counters prove it."""
+    store = CheckpointStore(tmp_path)
+    ReloadCorruptionInjector().poison_params(store, 1, net)
+    pool = pool_factory(n_replicas=3, server_kwargs=dict(canary=x[:2]))
+    before = pool.predict(x, timeout=30.0)
+    with pytest.raises(ModelValidationError, match="non-finite") as ei:
+        pool.rolling_reload(store, step=1, drain_timeout=10.0)
+    assert getattr(ei.value, "replica_id", None) == 0
+    stats = pool.stats()
+    assert stats["rollbacks"] == 1 and stats["rolling_reloads"] == 0
+    assert stats["healthy_replicas"] == 3
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0), before,
+                               atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_rolling_reload_probe_failure_at_replica_k_rolls_back_all(
+        pool_factory, net, x, tmp_path):
+    """The candidate passes every canary but replica 1 cannot SERVE it
+    (its post-reload probe fails): replica 0 — already reloaded and
+    re-admitted — must be rolled back too, so the pool is never split
+    between weight versions."""
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+
+    def break_candidate_serving(phase, info):
+        # fails only while replica 1 serves the CANDIDATE
+        # (model_version 1); the rollback's restore bumps to 2 and the
+        # replica is healthy again on old weights
+        if phase == "pre_step" and info["model_version"] == 1:
+            raise RuntimeError("injected: candidate cannot serve here")
+
+    pool = pool_factory(n_replicas=3,
+                        per_replica_hooks={1: break_candidate_serving},
+                        probe_timeout=3.0,
+                        server_kwargs=dict(canary=x[:2],
+                                           breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    before = pool.predict(x, timeout=30.0)
+    with pytest.raises(InferenceFailedError, match="post-reload probe"):
+        pool.rolling_reload(store, step=1, drain_timeout=10.0)
+    stats = pool.stats()
+    assert stats["rollbacks"] == 1 and stats["rolling_reloads"] == 0
+    # pool-wide: replica 0 (which accepted the candidate) is back on the
+    # OLD weights — versions moved monotonically but outputs are the old
+    # model's
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0), before,
+                               atol=1e-6)
+    for rs in stats["replicas"].values():
+        assert rs["state"] == "healthy"
+    assert not np.allclose(before, candidate.output(x), atol=1e-3), \
+        "test is vacuous: candidate and old model agree"
+
+
+@pytest.mark.chaos
+def test_rolling_reload_not_blocked_by_evicted_replica(
+        pool_factory, net, x, tmp_path):
+    """A dead replica is not a deploy gate: the pool serves without it,
+    so a GOOD checkpoint must deploy to the healthy replicas, while the
+    evicted one gets a best-effort reload so its eventual re-admission
+    cannot split the pool between weight versions."""
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    crash = ReplicaCrashInjector(crashed=True)
+    pool = pool_factory(n_replicas=3, per_replica_hooks={2: crash},
+                        evict_threshold=1, readmit_successes=1,
+                        server_kwargs=dict(canary=x[:2],
+                                           breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    # drive replica 2 to eviction
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["replicas"]["2"]["state"] != "evicted" \
+            and time.monotonic() < deadline:
+        try:
+            pool.predict(x[:2], timeout=5.0)
+        except Exception:  # noqa: BLE001 — driving eviction only
+            pass
+        time.sleep(0.02)
+    assert pool.stats()["replicas"]["2"]["state"] == "evicted"
+    versions = pool.rolling_reload(store, drain_timeout=10.0)
+    assert versions == [1, 1]  # the two healthy replicas gate + deploy
+    s = pool.stats()
+    assert s["rolling_reloads"] == 1 and s["rollbacks"] == 0
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                               candidate.output(x), atol=1e-5)
+    # the evicted replica got the candidate best-effort (canary
+    # validation bypasses infer_hooks), so revival re-admits it on the
+    # POOL's weights — never a version split
+    assert s["replicas"]["2"]["model_version"] == 1
+    assert s["replicas"]["2"]["stale"] is False
+    crash.revive()
+    deadline = time.monotonic() + 15.0
+    while pool.stats()["healthy_replicas"] < 3 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["healthy_replicas"] == 3
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                               candidate.output(x), atol=1e-5)
+
+
+def _wait_for_eviction(pool, rid: str, timeout: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout
+    while pool.stats()["replicas"][rid]["state"] != "evicted" \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert pool.stats()["replicas"][rid]["state"] == "evicted"
+
+
+@pytest.mark.chaos
+def test_rollback_clears_stale_bar_set_in_same_deploy(
+        pool_factory, net, x, tmp_path):
+    """An evicted replica whose best-effort reload fails goes `stale` —
+    but if the SAME deploy then rolls the whole pool back, the pool
+    returns to the very weights that replica still holds: the bar must
+    be lifted, or the replica would be barred from re-admission forever
+    despite being version-consistent with the pool."""
+    store = CheckpointStore(tmp_path)
+    candidate = _fitted_clone()
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    crash = ReplicaCrashInjector(crashed=True)
+
+    def break_candidate_serving(phase, info):
+        if phase == "pre_step" and info["model_version"] == 1:
+            raise RuntimeError("injected: candidate cannot serve here")
+
+    pool = pool_factory(n_replicas=3,
+                        per_replica_hooks={1: crash,
+                                           2: break_candidate_serving},
+                        evict_threshold=1, readmit_successes=1,
+                        probe_timeout=3.0,
+                        server_kwargs=dict(canary=x[:2],
+                                           breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    before = pool.predict(x, timeout=30.0)
+    _wait_for_eviction(pool, "1")  # the probe loop sees the crash
+
+    def broken_reload(*a, **k):
+        raise RuntimeError("injected: best-effort reload failed")
+
+    pool._replicas[1].server.reload = broken_reload
+    # replica 0 deploys fine, replica 1 goes stale best-effort, replica
+    # 2 cannot SERVE the candidate -> pool-wide rollback
+    with pytest.raises(InferenceFailedError, match="post-reload probe"):
+        pool.rolling_reload(store, step=1, drain_timeout=10.0)
+    s = pool.stats()
+    assert s["rollbacks"] == 1 and s["rolling_reloads"] == 0
+    assert s["replicas"]["1"]["stale"] is False
+    # lifted bar means revival re-admits it — on the pool's weights
+    crash.revive()
+    deadline = time.monotonic() + 15.0
+    while pool.stats()["healthy_replicas"] < 3 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["healthy_replicas"] == 3
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0), before,
+                               atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_rollback_keeps_preexisting_stale_bar(pool_factory, net, x,
+                                              tmp_path):
+    """A replica stale from an EARLIER deploy holds weights behind the
+    pool's. When a LATER deploy rolls back, the rollback restores that
+    replica's pre-deploy weights — still behind the pool's — so the
+    stale bar must survive the rollback, or re-admission would split
+    the pool between versions."""
+    store = CheckpointStore(tmp_path)
+    cand_a, cand_b = _fitted_clone(seed=2), _fitted_clone(seed=3)
+    store.save(1, lambda tmp: write_model(cand_a, tmp, atomic=False))
+    store.save(2, lambda tmp: write_model(cand_b, tmp, atomic=False))
+    crash = ReplicaCrashInjector(crashed=True)
+
+    def break_v2_serving(phase, info):
+        if phase == "pre_step" and info["model_version"] == 2:
+            raise RuntimeError("injected: deploy-2 candidate is broken")
+
+    pool = pool_factory(n_replicas=3,
+                        per_replica_hooks={1: crash, 2: break_v2_serving},
+                        evict_threshold=1, readmit_successes=1,
+                        probe_timeout=3.0,
+                        server_kwargs=dict(canary=x[:2],
+                                           breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    _wait_for_eviction(pool, "1")
+    real_reload = pool._replicas[1].server.reload
+    calls = []
+
+    def flaky_reload(*a, **k):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("injected: best-effort reload failed")
+        return real_reload(*a, **k)
+
+    pool._replicas[1].server.reload = flaky_reload
+    # deploy 1 lands on the healthy replicas; replica 1 goes stale
+    assert pool.rolling_reload(store, step=1,
+                               drain_timeout=10.0) == [1, 1]
+    assert pool.stats()["replicas"]["1"]["stale"] is True
+    # deploy 2: replica 1's best-effort reload now works, but replica 2
+    # cannot serve cand_b -> pool-wide rollback to cand_a — which
+    # replica 1 never held
+    with pytest.raises(InferenceFailedError, match="post-reload probe"):
+        pool.rolling_reload(store, step=2, drain_timeout=10.0)
+    s = pool.stats()
+    assert s["rollbacks"] == 1 and s["rolling_reloads"] == 1
+    assert s["replicas"]["1"]["stale"] is True
+    np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                               cand_a.output(x), atol=1e-5)
+    # revival must NOT re-admit it onto mismatched weights
+    crash.revive()
+    time.sleep(0.5)
+    assert pool.stats()["replicas"]["1"]["state"] == "evicted"
+
+
+# -------------------------------------------- ladder 5: hedged predicts
+@pytest.mark.chaos
+def test_hedged_predict_beats_replica_hung_forever(pool_factory, x):
+    """Primary replica hangs INSIDE the device step (no deadline can
+    reach it); the hedge fires on the healthy replica and its result
+    wins promptly. The hung replica is later evicted by the watchdog."""
+    hang = ReplicaHangInjector()
+    pool = pool_factory(n_replicas=2, per_replica_hooks={0: hang},
+                        probe_interval=0.1, watchdog_timeout=0.5,
+                        hedge=True, hedge_delay=0.1)
+    # route deterministically to the hung replica first: replica 0 idle
+    t0 = time.monotonic()
+    out = pool.predict(x, timeout=30.0)
+    elapsed = time.monotonic() - t0
+    assert out.shape == (32, 3)
+    assert elapsed < 10.0, f"hedge did not rescue the request ({elapsed:.1f}s)"
+    stats = pool.stats()
+    if hang.hangs:  # the request did land on the wedged replica
+        assert stats["hedges_fired"] >= 1 and stats["hedge_wins"] >= 1
+    # watchdog eviction: the probe of the hung replica never returns
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["replicas"]["0"]["state"] != "evicted" \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.stats()["replicas"]["0"]["state"] == "evicted"
+    # traffic keeps flowing on the survivor, un-hedged or hedged alike
+    assert pool.predict(x, timeout=10.0).shape == (32, 3)
+    hang.release()
+
+
+@pytest.mark.chaos
+def test_primary_failure_after_hedge_fired_fails_over(pool_factory, x):
+    """Primary fails AFTER the hedge fired onto a wedged replica: the
+    waiter must not sit on the hung hedge until the deadline — a fresh
+    healthy replica exists, so the primary's retryable error fails
+    over to it and the request is served promptly."""
+    hang = ReplicaHangInjector()
+
+    def slow_then_fail(phase, info):
+        if phase == "pre_step":
+            time.sleep(1.0)  # long past the hedge delay
+            raise RuntimeError("injected: primary dies after hedging")
+
+    pool = pool_factory(n_replicas=3,
+                        per_replica_hooks={0: slow_then_fail, 2: hang},
+                        probe_interval=30.0, watchdog_timeout=5.0,
+                        hedge=True, hedge_delay=0.05)
+    # fresh pool, all idle: the round-robin tiebreak picks replica 0 as
+    # primary and replica 2 — the wedged one — as the hedge, leaving
+    # replica 1 as the fresh healthy alternative
+    t0 = time.monotonic()
+    out = pool.predict(x, timeout=60.0)
+    elapsed = time.monotonic() - t0
+    assert out.shape == (32, 3)
+    assert elapsed < 30.0, \
+        f"request blocked on the hung hedge ({elapsed:.1f}s)"
+    s = pool.stats()
+    assert s["hedges_fired"] >= 1
+    assert s["failovers"] >= 1
+    hang.release()
+
+
+@pytest.mark.chaos
+def test_watchdog_evicts_wedged_replica_without_wedging_pool(
+        pool_factory, x):
+    """A replica wedged mid-step is a silence, not an error — only the
+    probe watchdog can see it. The probe LOOP itself must survive (the
+    probe runs on a helper thread) and traffic must keep flowing."""
+    hang = ReplicaHangInjector()
+    pool = pool_factory(n_replicas=3, per_replica_hooks={2: hang},
+                        probe_interval=0.1, watchdog_timeout=0.4)
+    # wedge replica 2 with a sacrificial request (daemon thread: it
+    # blocks until release at teardown)
+    threading.Thread(
+        target=lambda: pool._replicas[2].server.probe(x[:2], timeout=30.0),
+        daemon=True).start()
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["replicas"]["2"]["state"] != "evicted" \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    s = pool.stats()
+    assert s["replicas"]["2"]["state"] == "evicted"
+    assert s["evictions"] >= 1
+    for _ in range(4):  # pool is alive and routing around the corpse
+        assert pool.predict(x, timeout=10.0).shape == (32, 3)
+    hang.release()
+
+
+@pytest.mark.chaos
+def test_recovery_without_explicit_probe_batch(pool_factory, x):
+    """A pool built with NO probe_batch (the gateway's default) must
+    still self-recover: the first served predict auto-arms the pool
+    probe batch (and replica canaries are borrowed meanwhile), so a
+    replica evicted before it ever served — no canary of its own —
+    can still prove recovery and re-admit."""
+    crash = ReplicaCrashInjector(crashed=True)  # dead from the start
+    pool = pool_factory(n_replicas=2, per_replica_hooks={1: crash},
+                        probe_batch=None, probe_interval=0.1,
+                        evict_threshold=1, readmit_successes=2,
+                        server_kwargs=dict(breaker_threshold=2,
+                                           breaker_reset_timeout=0.2))
+    # healthy replica serves → pool probe batch auto-arms
+    assert pool.predict(x, timeout=10.0).shape == (32, 3)
+    # drive the dead replica (never served, no canary) to eviction
+    deadline = time.monotonic() + 10.0
+    while pool.stats()["replicas"]["1"]["state"] != "evicted" \
+            and time.monotonic() < deadline:
+        try:
+            pool.predict(x[:2], timeout=5.0)
+        except Exception:  # noqa: BLE001 — driving eviction only
+            pass
+        time.sleep(0.02)
+    assert pool.stats()["replicas"]["1"]["state"] == "evicted"
+    crash.revive()
+    deadline = time.monotonic() + 15.0
+    while pool.stats()["healthy_replicas"] < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    s = pool.stats()
+    assert s["healthy_replicas"] == 2 and s["readmissions"] >= 1, \
+        "replica with no canary of its own never re-admitted"
+
+
+# ------------------------------------------------------------ generation
+def test_pool_generate_routes_and_matches_whole_batch(pool_factory):
+    """Generation rides the pool: least-loaded routed into a replica's
+    lazily-built DecodeEngine, tokens identical to whole-batch
+    `generate` (seeded greedy decode is failover-safe by construction)."""
+    from deeplearning4j_tpu.models.transformer import (
+        generate,
+        gpt_configuration,
+    )
+
+    gnet = dl4j.MultiLayerNetwork(gpt_configuration(
+        vocab_size=32, d_model=32, n_heads=2, n_layers=1, max_length=24,
+        seed=3))
+    gnet.init()
+    pool = pool_factory(n_replicas=2, the_net=gnet, probe_interval=30.0,
+                        server_kwargs=dict(generation={
+                            "n_slots": 2, "max_len": 24,
+                            "prompt_buckets": (8,), "page_size": 8}))
+    prompt = np.arange(8, dtype=np.int32) % 32
+    out = pool.generate(prompt, 6, timeout=120.0)
+    expected = np.asarray(generate(gnet, prompt[None], 6, temperature=0.0))
+    np.testing.assert_array_equal(out, expected[0])
+    assert pool.stats()["served"] == 1
+    # the router's load number covers the generation path too: engines
+    # drained back to zero, and a submitted-but-unfinished generate
+    # must read as load (ModelServer.pending folds engine.pending in)
+    assert all(r.server.pending() == 0 for r in pool._replicas)
+    engine = next(r.server._engine for r in pool._replicas
+                  if r.server._engine is not None)
+    handle = engine.submit(prompt, 4)
+    assert engine.pending() == 1
+    assert handle.result(timeout=120.0) is not None
+    assert engine.pending() == 0
+
+
+# -------------------------------------------------------------- lifecycle
+def test_pool_shutdown_rejects_new_work(pool_factory, x):
+    pool = pool_factory(n_replicas=2, probe_interval=30.0)
+    assert pool.predict(x, timeout=10.0).shape == (32, 3)
+    assert pool.shutdown(drain_timeout=5.0) is True
+    with pytest.raises(ServerClosedError):
+        pool.predict(x, timeout=1.0)
+
+
+# ---------------------------------------------------------------- gateway
+@pytest.mark.chaos
+def test_gateway_pool_rpcs_and_replica_id_in_error_payload(x, tmp_path):
+    """The gateway fronts a ReplicaPool: `pool_stats` and
+    `rolling_reload` RPCs work end to end, `reload_model` delegates to
+    the rolling path, and a replica-originated error carries
+    `replica_id` in the payload (surfaced on `GatewayError`)."""
+    from deeplearning4j_tpu.gateway import (
+        EntryPoint,
+        GatewayClient,
+        GatewayError,
+        GatewayServer,
+    )
+
+    crash = ReplicaCrashInjector()
+    entry = EntryPoint(serving={
+        "replicas": 2, "canary": x[:2], "infer_hooks": [crash],
+        "breaker_threshold": 3, "breaker_reset_timeout": 0.2,
+        "pool": {"probe_interval": 30.0, "max_failovers": 0,
+                 "evict_threshold": 100, "probe_batch": x[:2]}})
+    gw = GatewayServer(entry_point=entry).start()
+    client = GatewayClient(port=gw.port)
+    try:
+        net = dl4j.MultiLayerNetwork(_conf())
+        net.init()
+        entry._install("m", net)
+        out = client.call("predict", name="m", features=x)
+        assert out.shape == (32, 3)
+        stats = client.call("pool_stats", name="m")
+        assert stats["n_replicas"] == 2 and stats["served"] >= 1
+        assert "failovers" in stats and "replicas" in stats
+        # rolling reload over the wire
+        candidate = _fitted_clone()
+        store = CheckpointStore(tmp_path)
+        store.save(1, lambda tmp: write_model(candidate, tmp,
+                                              atomic=False))
+        versions = client.call("rolling_reload", _idempotent=False,
+                               name="m", path=str(tmp_path))
+        assert versions == [1, 1]
+        np.testing.assert_allclose(
+            client.call("predict", name="m", features=x),
+            candidate.output(x), atol=1e-5)
+        # replica-originated failure names its replica in the payload
+        crash.crash()
+        with pytest.raises(GatewayError) as ei:
+            client.call("predict", name="m", features=x,
+                        _idempotent=False)
+        assert ei.value.error_type == "InferenceFailedError"
+        assert ei.value.replica_id in (0, 1)
+    finally:
+        client.close()
+        gw.stop(drain_timeout=3.0)
+
+
+def test_gateway_single_server_rejects_pool_rpcs(x):
+    """`pool_stats`/`rolling_reload` on a single-server model fail with
+    a pointed message instead of an AttributeError."""
+    from deeplearning4j_tpu.gateway import EntryPoint
+
+    entry = EntryPoint(serving={"canary": x[:2]})
+    net = dl4j.MultiLayerNetwork(_conf())
+    net.init()
+    entry._install("m", net)
+    try:
+        with pytest.raises(RuntimeError, match="replicas"):
+            entry.pool_stats("m")
+        with pytest.raises(RuntimeError, match="replicas"):
+            entry.rolling_reload("m", "/nonexistent")
+    finally:
+        entry.shutdown(drain_timeout=3.0)
+
+
+def test_gateway_pool_config_without_replicas_raises(x):
+    """`"pool"` kwargs with `"replicas"` absent (or 1) is almost
+    certainly a typo'd config — fail at install, not silently run
+    un-replicated with no probes/failover/hedging."""
+    from deeplearning4j_tpu.gateway import EntryPoint
+
+    net = dl4j.MultiLayerNetwork(_conf())
+    net.init()
+    for serving in ({"pool": {"probe_interval": 1.0}},
+                    {"replicas": 1, "pool": {"hedge": True}},
+                    {"replicas": 0}):  # not silently coerced to 1
+        entry = EntryPoint(serving=serving)
+        with pytest.raises(ValueError, match="replicas"):
+            entry._install("m", net)
+
+
+def test_gateway_fit_on_pool_served_model_syncs_replicas(x):
+    """The fit RPC trains the installed net in place — replica 0
+    aliases it, but the cloned replicas must be synced too, or routing
+    would answer with pre-fit weights on N-1 of N picks (a silent
+    version split)."""
+    from deeplearning4j_tpu.gateway import EntryPoint
+
+    entry = EntryPoint(serving={
+        "replicas": 2, "pool": {"probe_interval": 30.0,
+                                "probe_batch": x[:2]}})
+    net = dl4j.MultiLayerNetwork(_conf())
+    net.init()
+    entry._install("m", net)
+    try:
+        _, y = _data()
+        entry.fit("m", x, y, epochs=2)
+        expect = net.output(x)
+        for _ in range(6):  # round-robin tiebreak hits both replicas
+            np.testing.assert_allclose(
+                entry.predict("m", x, timeout=30.0), expect, atol=1e-5)
+    finally:
+        entry.shutdown(drain_timeout=3.0)

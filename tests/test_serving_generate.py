@@ -584,4 +584,5 @@ def test_bench_serve_generate_smoke(monkeypatch):
     assert 0 < fn.pages_in_use_peak <= fn.pool_pages
     assert fn.prefill_chunks > 0, \
         "the 48-token prompts must ride chunked prefill"
+    assert fn.device_ms_per_token > 0  # half-output-length differencing
     assert fn.gqa_goodput_tokens_per_sec > 0
