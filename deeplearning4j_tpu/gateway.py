@@ -33,7 +33,13 @@ Robustness (the serving-tier hardening pass):
   path or store directory with canary validation — a corrupt or broken
   candidate is rejected while the old model keeps serving. With
   `serving={"generation": {...}}`, `generate` serves autoregressive
-  decoding through the continuous-batching decode engine.
+  decoding through the continuous-batching decode engine; the latency
+  tier rides the same dict — `"generation": {"prefix_cache": true,
+  "speculative": {"draft": "self" | <config json>, "k": 4}}` is fully
+  JSON-expressible, so a wire client can enable shared-prefix KV reuse
+  and speculative decoding without shipping a net object
+  (`server_stats` then carries `prefix_hit_tokens_pct` /
+  `spec_accept_rate` / `spec_tokens_per_step` top-level).
 - **client retries** — `GatewayClient` retries idempotent methods once
   with backoff after a `ConnectionResetError`/`BrokenPipeError`
   (server restart, LB connection recycle), and surfaces server-side

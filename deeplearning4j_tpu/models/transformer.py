@@ -259,6 +259,25 @@ def _prefill_chunk_block_attention(layer, q, k_cache, v_cache, q_pos):
     return cached_attention_chunk(q[0], k_cache, v_cache, q_pos)[None]
 
 
+def _verify_block_attention(layer, q, k_cache, v_cache, q_pos):
+    """Batched-over-slots chunk attention for the speculative VERIFY
+    step of one block: every slot scores a (k+1)-token candidate block
+    against its own paged-gathered cache in one dispatch — the
+    slot-batched counterpart of `_prefill_chunk_block_attention`, built
+    on the same `cached_attention_chunk` numerics (which is what keeps
+    greedy speculative decode argmax-exact against `generate`). `q`:
+    (S, C, H, hd) candidate-block queries at absolute positions `q_pos`
+    (S, C); `k_cache`/`v_cache`: (S, Hkv, hd, L)/(S, Hkv, L, hd) —
+    `paged_gather` output, already holding the block's own K/V, so the
+    `<= q_pos` mask is exactly causal over [context ‖ candidates].
+    Returns (S, C, H*hd)."""
+    import jax
+
+    from deeplearning4j_tpu.ops.attention import cached_attention_chunk
+
+    return jax.vmap(cached_attention_chunk)(q, k_cache, v_cache, q_pos)
+
+
 def generate(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
              top_k: int = 0, seed: int = 0, include_prompt: bool = False):
     """Jitted autoregressive sampler for a `gpt_configuration` network:

@@ -810,11 +810,12 @@ class SelfAttention(FeedForwardLayer):
         if self.project_input:
             Hkv = self._kv_heads
             q = self._heads(x @ params["Wq"] + params["bq"])
+            # GQA K/V stay at Hkv heads: the full-attention path
+            # contracts them as a broadcast/grouped einsum (no
+            # materialized repeat); kernel paths widen inside the
+            # dispatch (multi_head_attention)
             k = self._heads(x @ params["Wk"] + params["bk"], Hkv)
             v = self._heads(x @ params["Wv"] + params["bv"], Hkv)
-            if Hkv != self.n_heads:
-                k = jnp.repeat(k, self.n_heads // Hkv, axis=2)
-                v = jnp.repeat(v, self.n_heads // Hkv, axis=2)
         else:
             q = k = v = self._heads(x)
         out = multi_head_attention(q, k, v, causal=self.causal, key_mask=mask,
@@ -1394,11 +1395,12 @@ class TransformerBlock(FeedForwardLayer):
             cos, sin = rope_angles(jnp.arange(T), hd, self.rope_base)
             q = rope_rotate(q, cos, sin)
             k = rope_rotate(k, cos, sin)
-        if Hkv != H:
-            # query head j attends through KV head j // (H // Hkv); the
-            # kernels (flash/blockwise/ring) see equal head counts
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
+        # GQA: query head j attends through KV head j // (H // Hkv).
+        # K/V go to the dispatch UN-repeated (Hkv heads): the
+        # full-attention path groups them as a broadcast einsum —
+        # bit-identical per-head dots without copying each KV element
+        # H/Hkv× through HBM — and the kernel paths (flash/blockwise/
+        # ring) widen inside multi_head_attention
         att = multi_head_attention(q, k, v, causal=self.causal,
                                    key_mask=mask,
                                    block_size=self.block_size)

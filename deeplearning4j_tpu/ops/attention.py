@@ -55,6 +55,43 @@ def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def full_attention_grouped(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           bias: Optional[jnp.ndarray] = None,
+                           causal: bool = False) -> jnp.ndarray:
+    """`full_attention` for grouped-query attention WITHOUT materializing
+    the repeated K/V: q (B, T, H, D) against k/v carrying only Hkv
+    grouped heads (H a multiple of Hkv; query head j reads KV head
+    j // (H/Hkv)). The queries fold into (B, T, Hkv, G, D) and the
+    score/weighted-sum einsums batch over Hkv with G as a free query
+    axis — each K/V element is touched once and BROADCAST across its
+    G query heads, instead of being copied G× through HBM by
+    `jnp.repeat` (the training path's old cost). Per-head numerics are
+    the exact dots `full_attention` computes on the repeated operands,
+    so the two paths agree bitwise (pinned in tests/test_ops.py).
+    `bias` broadcastable to (B, H, Tq, Tk) — a full H-headed bias is
+    regrouped, a broadcasting (B, 1, 1, Tk) mask bias passes through."""
+    B, Tq, H, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, Tq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                   k) / jnp.sqrt(jnp.asarray(D, q.dtype))
+    if bias is not None:
+        if bias.ndim == 4 and bias.shape[1] == H:
+            bias = bias.reshape(B, Hkv, G, *bias.shape[2:])
+        else:  # broadcasting head axis (e.g. mask_bias): keep it 1-wide
+            bias = bias[:, :, None]
+        s = s + bias
+    if causal:
+        iq = jnp.arange(Tq)[:, None]
+        ik = jnp.arange(Tk)[None, :]
+        s = jnp.where(ik <= iq + (Tk - Tq), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    att = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+    return att.reshape(B, Tq, H, D)
+
+
 def attention_block_accum(carry: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
                           q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           bias: Optional[jnp.ndarray]):
@@ -278,23 +315,43 @@ def multi_head_attention(q, k, v, *, causal=False, key_mask=None,
     """Dispatch (the cuDNN-helper pattern: same contract, fastest available
     path picked): ring attention when a sequence-parallel scope is active,
     pallas flash kernel for long unmasked sequences, XLA blockwise beyond
-    `block_size`, full attention otherwise."""
+    `block_size`, full attention otherwise.
+
+    GQA: `k`/`v` may carry fewer heads than `q` (Hkv dividing H). The
+    full-attention path computes the grouping as a broadcast einsum
+    (`full_attention_grouped` — no materialized repeat); the kernel
+    paths (ring/flash/blockwise) require equal head counts and widen
+    via `jnp.repeat`, exactly the layers' historical behavior."""
+    H, Hkv = q.shape[2], k.shape[2]
+
+    def widened():
+        if Hkv == H:
+            return k, v
+        g = H // Hkv
+        return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
     if _SEQ_PARALLEL:
         from deeplearning4j_tpu.parallel.sequence import ring_attention
 
+        kf, vf = widened()
         mesh, axis_name, batch_axis = _SEQ_PARALLEL[-1]
-        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+        return ring_attention(q, kf, vf, mesh, axis_name=axis_name,
                               causal=causal, key_mask=key_mask,
                               batch_axis=batch_axis)
     long_seq = block_size is not None and k.shape[1] > block_size
     if long_seq and key_mask is None:
         from deeplearning4j_tpu.ops.pallas_attention import flash_attention_or_none
 
-        out = flash_attention_or_none(q, k, v, causal=causal)
+        kf, vf = widened()
+        out = flash_attention_or_none(q, kf, vf, causal=causal)
         if out is not None:
             return out
     if long_seq:
-        return blockwise_attention(q, k, v, causal=causal, key_mask=key_mask,
+        kf, vf = widened()
+        return blockwise_attention(q, kf, vf, causal=causal,
+                                   key_mask=key_mask,
                                    block_size=block_size)
     bias = None if key_mask is None else mask_bias(key_mask)
+    if Hkv != H:
+        return full_attention_grouped(q, k, v, bias=bias, causal=causal)
     return full_attention(q, k, v, bias=bias, causal=causal)

@@ -76,10 +76,68 @@ def probe_verdict(cache: dict, key, probe_fn, args, what: str) -> bool:
     return bool(ok)
 
 
-# Mosaic's default scoped-VMEM stack limit is 16 MiB; v5e cores carry
-# 128 MiB. Kernels whose double-buffered slabs exceed the default (the
+# Mosaic's default scoped-VMEM stack limit is 16 MiB; modern cores carry
+# far more. Kernels whose double-buffered slabs exceed the default (the
 # fused LSTM at H=1024 needs 100.1 MiB; 2048-wide attention tiles carry
-# 16 MiB f32 score slabs) pass this shared ceiling via
-# CompilerParams(vmem_limit_bytes=...). One constant so a new TPU
-# generation retunes every kernel family at once.
-VMEM_LIMIT_BYTES = 112 * 1024 * 1024
+# 16 MiB f32 score slabs) pass a shared ceiling via
+# CompilerParams(vmem_limit_bytes=...). The ceiling is DERIVED from the
+# detected device generation (one table so a new TPU generation retunes
+# every kernel family at once): 7/8 of the core's physical VMEM, the
+# same headroom fraction the old hardcoded 112-of-128 MiB constant
+# carried — the reserve absorbs Mosaic's own scratch and avoids
+# spilling at exactly-full occupancy. Unknown kinds (CPU interpret
+# mode, future generations) keep the v4/v5-class default rather than
+# the 16 MiB floor: an over-ask fails loudly at compile (and the probe
+# machinery falls back to XLA), while a silent 16 MiB cap would
+# permanently disable the big-slab kernels.
+_MIB = 1024 * 1024
+_VMEM_PER_CORE_BYTES = {
+    # device_kind prefix -> physical scoped VMEM per core
+    "TPU v2": 16 * _MIB,
+    "TPU v3": 16 * _MIB,
+    "TPU v4 lite": 128 * _MIB,   # v4i inference cores
+    "TPU v4": 128 * _MIB,
+    "TPU v5 lite": 128 * _MIB,   # v5e (device_kind "TPU v5 lite"/"TPU v5e")
+    "TPU v5e": 128 * _MIB,
+    "TPU v5p": 128 * _MIB,
+    "TPU v5": 128 * _MIB,
+    "TPU v6 lite": 128 * _MIB,   # v6e / Trillium
+    "TPU v6e": 128 * _MIB,
+}
+_DEFAULT_VMEM_PER_CORE = 128 * _MIB
+
+# Back-compat alias: the pre-table constant (112 MiB = 7/8 of the
+# 128 MiB v4/v5-class core this build was tuned on). Prefer
+# `vmem_limit_bytes()`.
+VMEM_LIMIT_BYTES = _DEFAULT_VMEM_PER_CORE * 7 // 8
+
+_vmem_limit_cache: dict = {}
+
+
+def vmem_limit_for_kind(device_kind: str) -> int:
+    """Scoped-VMEM ceiling for one `device_kind` string: 7/8 of the
+    generation's physical per-core VMEM (longest-prefix match over the
+    table, so "TPU v5 lite" resolves before "TPU v5"); unknown kinds
+    get the v4/v5-class default."""
+    best = None
+    for prefix, size in _VMEM_PER_CORE_BYTES.items():
+        if device_kind.startswith(prefix) and \
+                (best is None or len(prefix) > len(best[0])):
+            best = (prefix, size)
+    physical = best[1] if best is not None else _DEFAULT_VMEM_PER_CORE
+    return physical * 7 // 8
+
+
+def vmem_limit_bytes() -> int:
+    """The Pallas `vmem_limit_bytes` ceiling for THIS process's default
+    device, detected once and cached. Every kernel family
+    (`pallas_attention`, `pallas_lstm`) reads the same number, so a new
+    TPU generation retunes all of them in one table row."""
+    key = "default"
+    if key not in _vmem_limit_cache:
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:  # no devices (early import, odd backends)
+            kind = ""
+        _vmem_limit_cache[key] = vmem_limit_for_kind(kind)
+    return _vmem_limit_cache[key]

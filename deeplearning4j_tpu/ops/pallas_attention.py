@@ -54,7 +54,7 @@ NEG_INF = -1e30
 # shared kernel-dispatch policy helpers (kept under the historical private
 # names — this module's kernels use them pervasively)
 from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
-    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
+    vmem_limit_bytes as _vmem_limit,
     dot as _dot,
     mxu_dtype as _mxu_dtype,
     probe_verdict as _probe_verdict,
@@ -269,7 +269,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(qf, kf, vf)
     if with_lse:
@@ -327,7 +327,7 @@ def _flash_mha_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         scratch_shapes=[pltpu.VMEM((block_q, D), sdt)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, dsum)
 
@@ -359,7 +359,7 @@ def _flash_mha_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, dsum)
 
@@ -422,7 +422,7 @@ def _eager_probe(dtype, block: int, head_dim: int) -> bool:
     return bool(jnp.all(jnp.isfinite(g[0].astype(jnp.float32))))
 
 
-# _VMEM_LIMIT (shared ceiling, kernel_dispatch): the default 16 MiB
+# _vmem_limit() (generation-derived ceiling, kernel_dispatch): the default 16 MiB
 # scoped-stack limit rejects 2048-wide tiles whose f32 score slabs
 # alone are 16 MiB
 

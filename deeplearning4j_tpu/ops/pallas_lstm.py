@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.kernel_dispatch import (
-    VMEM_LIMIT_BYTES as _VMEM_LIMIT,
+    vmem_limit_bytes as _vmem_limit,
     dot as _dot,
     mxu_dtype as _mxu_dtype,
     probe_verdict as _probe_verdict,
@@ -282,7 +282,7 @@ def _lstm_bwd_kernel_masked(gates_ref, cprev_ref, dh_out_ref,
         dhc0_ref[1] = dc_prev.astype(dhc0_ref.dtype)
 
 
-# _VMEM_LIMIT (shared ceiling, kernel_dispatch): the default 16 MiB
+# _vmem_limit() (generation-derived ceiling, kernel_dispatch): the default 16 MiB
 # scoped-stack limit caps the batch block at 512 for H=256 (bb=1024
 # needs 18.4 MiB of double-buffered xw/gates slabs) and rejects H=1024
 # outright (100.1 MiB at bb=1024); the raised ceiling lets the probe
@@ -340,7 +340,7 @@ def _fwd_call(xw, rw, peep, h0, c0, *, bb: int, with_stash: bool,
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(xw, rw, peep, h0, c0)
     return h_out, cT, c_stash, gates
@@ -380,7 +380,7 @@ def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *, bb: int,
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(gates, c_stash, c_stash, dh_out, dcT, rw, peep, c0)
     return dz, dhc0
@@ -471,7 +471,7 @@ def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, bb: int,
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(xw, rw, peep, h0, c0, mask)
     return outs
@@ -512,7 +512,7 @@ def _bwd_call_masked(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0,
                         pltpu.VMEM((bb, H), sdt)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=_VMEM_LIMIT),
+            vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
     )(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0)
     return dz, dhc0

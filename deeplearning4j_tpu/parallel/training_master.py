@@ -720,6 +720,12 @@ class DistributedMultiLayer:
     def __init__(self, net, training_master: TrainingMaster):
         self.net = net
         self.training_master = training_master
+        # distributed-evaluate replica cache: clones (and, through them,
+        # their jitted eval executables) persist across _shard_map
+        # calls; invalidated by pointing the replicas at the net's
+        # CURRENT params when they changed (see _replicas_for)
+        self._replica_cache: list = []
+        self._replica_params_ref = None
 
     def _num_workers(self) -> int:
         return getattr(self.training_master, "num_workers", 4)
@@ -750,21 +756,43 @@ class DistributedMultiLayer:
             # (score(ds) and per-epoch calculator loops stay cheap)
             return [(idx, per_batch_fn(self.net, ds))
                     for idx, ds in enumerate(batches)]
+        replicas = self._replicas_for(n_workers)
         shards = [[] for _ in range(n_workers)]
         for idx, ds in enumerate(batches):
             shards[idx % n_workers].append((idx, ds))
 
-        def run_shard(shard):
-            if not shard:
-                return []
-            replica = self.net.clone()
-            return [(idx, per_batch_fn(replica, ds)) for idx, ds in shard]
+        def run_shard(wi):
+            return [(idx, per_batch_fn(replicas[wi], ds))
+                    for idx, ds in shards[wi]]
 
         with ThreadPoolExecutor(max_workers=n_workers) as pool:
             out = []
-            for part in pool.map(run_shard, shards):
+            for part in pool.map(run_shard, range(n_workers)):
                 out.extend(part)
         return out
+
+    def _replicas_for(self, n_workers: int) -> list:
+        """Cached per-worker replica clones. A fresh `net.clone()` per
+        `_shard_map` call paid init + param copy + a full re-trace of
+        the replica's jitted eval EVERY epoch of an early-stopping loop;
+        cached replicas keep their compiled executables, and a param
+        sync (the net trained since last call — detected by params
+        identity, every fit commits fresh arrays) just repoints each
+        replica at the net's current params/state. Aliasing is safe:
+        replicas only ever EVALUATE (no donation on the eval path), and
+        the identity stamp re-syncs them before any use after the
+        master's next training step."""
+        if len(self._replica_cache) < n_workers:
+            self._replica_cache.extend(
+                self.net.clone()
+                for _ in range(n_workers - len(self._replica_cache)))
+            self._replica_params_ref = None  # new clones: force a sync
+        if self._replica_params_ref is not self.net._params:
+            for replica in self._replica_cache:
+                replica._params = self.net._params
+                replica._layer_state = self.net._layer_state
+            self._replica_params_ref = self.net._params
+        return self._replica_cache[:n_workers]
 
     def evaluate(self, data, labels: Optional[List[str]] = None,
                  top_n: int = 1):

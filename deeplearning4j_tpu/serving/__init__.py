@@ -1,12 +1,14 @@
 """Robust serving tier: admission control, per-request deadlines with
 adaptive micro-batching, circuit breaking, safe hot model reload, a
 continuous-batching generation path (`DecodeEngine`: paged KV cache,
-chunked prefill + iteration-level scheduling), and a replicated serving
-pool (`ReplicaPool`: health-probed replicas, least-loaded routing with
-failover, hedged predicts, zero-downtime rolling reload) — the
-inference-path counterpart of the training robustness tier (elastic
-workers / durable checkpoints / health sentinel). See
-`docs/serving.md` for the ladder semantics and tuning knobs.
+chunked prefill + iteration-level scheduling, with an opt-in latency
+tier — `PrefixCache` shared-prefix KV reuse and `SpeculativeDecoder`
+draft-verify decoding), and a replicated serving pool (`ReplicaPool`:
+health-probed replicas, least-loaded routing with failover, hedged
+predicts, zero-downtime rolling reload) — the inference-path
+counterpart of the training robustness tier (elastic workers / durable
+checkpoints / health sentinel). See `docs/serving.md` for the ladder
+semantics and tuning knobs.
 """
 from deeplearning4j_tpu.serving.chaos import (
     BrokenModelInjector,
@@ -17,6 +19,8 @@ from deeplearning4j_tpu.serving.chaos import (
     SlowInferenceInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
 from deeplearning4j_tpu.serving.model_server import (
     CircuitBreaker,
     DeadlineExceededError,
@@ -44,6 +48,8 @@ __all__ = [
     "ModelServer",
     "ModelValidationError",
     "OutOfPagesError",
+    "PrefixCache",
+    "SpeculativeDecoder",
     "ReloadCorruptionInjector",
     "ReplicaCrashInjector",
     "ReplicaEvictedError",

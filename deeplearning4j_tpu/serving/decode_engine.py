@@ -75,6 +75,23 @@ logical-position order, so slotted greedy decode reproduces whole-batch
 `generate` argmax-exactly at f32 for the same prompts, regardless of
 admission order, page/slot reuse, or prefill chunking (asserted in
 `tests/test_serving_generate.py`).
+
+**Latency tier (PR 8)** — two opt-in mechanisms compose on top:
+
+- `prefix_cache={...}` (`serving.prefix_cache.PrefixCache`): prompts
+  sharing a page-aligned prefix bind the SAME resident pool pages
+  (refcounted, read-only; the first divergent page starts fresh — page-
+  granular copy-on-write), skipping the shared prefill entirely. Under
+  pool pressure, unreferenced cached pages are reclaimed LRU-first, so
+  caching can never shrink effective capacity; every pool rebuild
+  (weight swap, failure recovery) invalidates the cache wholesale.
+- `speculative={"draft": ..., "k": ...}`
+  (`serving.speculative.SpeculativeDecoder`): a draft model proposes k
+  tokens per slot per iteration, verified in ONE batched target chunk
+  through the paged cache; greedy emission stays argmax-exact and
+  sampled emission distribution-exact for any draft (see that module's
+  docstring). The draft keeps its own paged pools behind the same page
+  table, so prefix hits skip the draft prefill too.
 """
 from __future__ import annotations
 
@@ -112,7 +129,7 @@ class _GenRequest:
     __slots__ = ("prompt", "n_tokens", "temperature", "seed", "deadline",
                  "event", "tokens", "error", "enqueued_at", "probe",
                  "slot", "completed_at", "n_pages", "pages",
-                 "prefill_pos")
+                 "prefill_pos", "hit_len", "n_shared", "nodes", "digests")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -132,6 +149,13 @@ class _GenRequest:
         self.n_pages = 0
         self.pages: Optional[List[int]] = None
         self.prefill_pos: Optional[int] = None
+        # prefix-cache binding: hit_len prompt positions ride shared
+        # pages (the first n_shared entries of `pages`, refcounted via
+        # `nodes`); only pages[n_shared:] are this request's to free
+        self.hit_len = 0
+        self.n_shared = 0
+        self.nodes: Optional[list] = None
+        self.digests: list = []  # memoized per-chunk prompt digests
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -157,6 +181,34 @@ class _GenRequest:
         if self.error is not None:
             raise self.error
         return np.asarray(self.tokens, np.int32)
+
+
+def _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page):
+    """Scatter one contiguous prefill span (1, Hkv, hd, W) /
+    (1, Hkv, W, hd) into the pool pages `wpids`: floor(W/page) aligned
+    full-page writes, then a partial tail (a non-pow-2 fallback bucket,
+    or a sub-page chunk) at in-page offset `woff` — which is nonzero
+    only in the W < page chunked case, where chunk-aligned pow-2
+    offsets guarantee the span never straddles a page boundary. Module
+    level (not an engine closure) so the speculative draft's prefill
+    mirrors the exact same write discipline into its own pools."""
+    import jax
+    import jax.numpy as jnp
+
+    W = kcol.shape[3]
+    z = jnp.zeros((), jnp.int32)
+    nfull = W // page
+    for j in range(nfull):
+        kp_ = jax.lax.dynamic_update_slice(
+            kp_, kcol[..., j * page:(j + 1) * page], (wpids[j], z, z, z))
+        vp_ = jax.lax.dynamic_update_slice(
+            vp_, vrow[:, :, j * page:(j + 1) * page, :], (wpids[j], z, z, z))
+    if W % page:
+        kp_ = jax.lax.dynamic_update_slice(
+            kp_, kcol[..., nfull * page:], (wpids[nfull], z, z, woff))
+        vp_ = jax.lax.dynamic_update_slice(
+            vp_, vrow[:, :, nfull * page:, :], (wpids[nfull], z, woff, z))
+    return kp_, vp_
 
 
 def _dispatched(thunk):
@@ -236,6 +288,21 @@ class DecodeEngine:
         chunk: every in-flight request needs ≥chunk more tokens, no
         deadline can expire within it, no prompt is mid-prefill, and no
         queued request is waiting on a free slot. 1 disables fusion.
+        Ignored while `speculative` is active (the verify step is the
+        fused dispatch then).
+    prefix_cache : None (off), True, or a dict of
+        `serving.prefix_cache.PrefixCache` kwargs (`max_pages`): share
+        page-aligned prompt-prefix KV pages across requests —
+        admission binds the longest cached prefix into the slot's page
+        table (refcounts bumped, prefill skipped for those positions),
+        retirement frees only refcount-zero pages, and cached pages are
+        reclaimed LRU-first under pool pressure. Invalidated on every
+        weight swap / pool rebuild.
+    speculative : None (off) or a dict: `{"draft": <gpt net | "self" |
+        config json dict>, "k": 4}` — draft-verify speculative decoding
+        (`serving.speculative.SpeculativeDecoder`): up to k+1 tokens
+        per scheduler iteration in two dispatches, greedy argmax-exact
+        and sampled distribution-exact for any draft.
     """
 
     def __init__(self, net, *, n_slots: int = 4,
@@ -252,7 +319,9 @@ class DecodeEngine:
                  top_k: int = 0,
                  breaker=None,
                  step_hooks: Sequence[Callable] = (),
-                 decode_chunk: int = 4):
+                 decode_chunk: int = 4,
+                 prefix_cache=None,
+                 speculative: Optional[dict] = None):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -283,6 +352,9 @@ class DecodeEngine:
         self._requested_pool_pages = pool_pages
         self._requested_max_queued_pages = max_queued_pages
         self._requested_prefill_chunk = prefill_chunk
+        self._prefix_cache_cfg = prefix_cache
+        self._speculative_cfg = dict(speculative) if speculative else None
+        self._draft_net = None  # resolved once; "self" re-resolves per swap
         self._prompt_buckets = tuple(sorted(set(int(b) for b in
                                                 prompt_buckets)))
         self._cond = threading.Condition()
@@ -312,6 +384,15 @@ class DecodeEngine:
         self.tokens_generated = 0
         self.pages_in_use_peak = 0
         self.swaps = 0
+        # latency-tier counters (prefix cache + speculative decoding)
+        self.prompt_tokens = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         self._build(net)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-engine-scheduler")
@@ -415,31 +496,7 @@ class DecodeEngine:
             return jnp.where(active, row_ok, True)
 
         def write_pages(kp_, vp_, kcol, vrow, wpids, woff):
-            """Scatter one contiguous prefill span (1, Hkv, hd, W) /
-            (1, Hkv, W, hd) into the pool pages `wpids`: floor(W/page)
-            aligned full-page writes, then a partial tail (a non-pow-2
-            fallback bucket, or a sub-page chunk) at in-page offset
-            `woff` — which is nonzero only in the W < page chunked
-            case, where chunk-aligned pow-2 offsets guarantee the span
-            never straddles a page boundary."""
-            W = kcol.shape[3]
-            z = jnp.zeros((), jnp.int32)
-            nfull = W // page
-            for j in range(nfull):
-                kp_ = jax.lax.dynamic_update_slice(
-                    kp_, kcol[..., j * page:(j + 1) * page],
-                    (wpids[j], z, z, z))
-                vp_ = jax.lax.dynamic_update_slice(
-                    vp_, vrow[:, :, j * page:(j + 1) * page, :],
-                    (wpids[j], z, z, z))
-            if W % page:
-                kp_ = jax.lax.dynamic_update_slice(
-                    kp_, kcol[..., nfull * page:], (wpids[nfull], z, z,
-                                                    woff))
-                vp_ = jax.lax.dynamic_update_slice(
-                    vp_, vrow[:, :, nfull * page:, :], (wpids[nfull], z,
-                                                        woff, z))
-            return kp_, vp_
+            return _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page)
 
         def step_math(bp, params, caches, page_table, tok, pos, keys,
                       temps, active):
@@ -642,6 +699,40 @@ class DecodeEngine:
         self._decode_chunked = decode_chunked
         self._prefill = prefill
         self._prefill_chunk_fn = prefill_chunk_fn
+        # latency tier: prefix cache + speculative decoder are rebuilt
+        # with the geometry on every (re)build, so a weight swap always
+        # starts them cold — stale pages can never serve new weights
+        self._prefix_cache = None
+        if self._prefix_cache_cfg is not None \
+                and self._prefix_cache_cfg is not False:
+            from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+
+            pc_kw = {} if self._prefix_cache_cfg is True \
+                else dict(self._prefix_cache_cfg)
+            self._prefix_cache = PrefixCache(page, **pc_kw)
+        self._spec = None
+        if self._speculative_cfg is not None:
+            from deeplearning4j_tpu.serving.speculative import (
+                SpeculativeDecoder,
+                resolve_draft_net,
+            )
+
+            cfg = dict(self._speculative_cfg)
+            draft = cfg.pop("draft", None)
+            if draft is None:
+                draft = cfg.pop("net", None)  # alias; both given ->
+                # "net" survives into the unknown-option check below
+            k = int(cfg.pop("k", 4))
+            if cfg:
+                raise ValueError(
+                    f"unknown speculative options {sorted(cfg)}")
+            if draft == "self" or self._draft_net is None:
+                self._draft_net = resolve_draft_net(draft, net)
+            self._spec = SpeculativeDecoder(
+                target_plan=plan, target_net=net,
+                draft_net=self._draft_net, k=k, n_slots=S, page=page,
+                L_logical=L_logical, pool_pages=pool_pages,
+                top_k=self.top_k, donate=donate)
         self._reset_device_state()
 
     def _reset_device_state(self) -> None:
@@ -672,6 +763,11 @@ class DecodeEngine:
         self._keys = jnp.stack([jax.random.PRNGKey(i) for i in range(S)])
         self._temps = jnp.zeros((S,), jnp.float32)
         self._active = np.zeros((S,), bool)
+        if self._prefix_cache is not None:
+            # the pools just rebuilt: every cached page id is stale
+            self._prefix_cache.clear()
+        if self._spec is not None:
+            self._spec.reset_state()
 
     # -- paging arithmetic -------------------------------------------------
     def _bucket_for(self, t0: int) -> int:
@@ -695,14 +791,44 @@ class DecodeEngine:
         """Pages a request must hold: its padded prefill width (pad-
         tail KV lands in owned pages) or prompt+output KV span,
         whichever is larger. The last generated token is never written
-        back, hence n_tokens - 1."""
+        back, hence n_tokens - 1. This is the COLD cost — reservations
+        and queue demand always use it, so a cache hit can only shrink
+        the allocation at admission, never under-reserve."""
         span = max(self._prefill_width(t0), t0 + n_tokens - 1)
         return -(-span // self.page_size)
 
+    def _pages_for_hit(self, t0: int, n_tokens: int) -> int:
+        """Total LOGICAL pages of a prefix-hit request (shared + owned):
+        the hit path suffix-prefills in chunks whose padded tail never
+        runs past page·ceil(t0/page), so the span is just the KV the
+        request actually writes — always <= the cold `_pages_for`."""
+        return -(-(t0 + n_tokens - 1) // self.page_size)
+
     def _free_request_pages_locked(self, req: _GenRequest) -> None:
+        """Drop the request's page references: owned pages return to the
+        free list; shared (cached) pages only lose this request's
+        refcount — the cache keeps them resident until LRU reclaim, and
+        a prefix another slot still shares is never freed here."""
+        if req.nodes:
+            self._prefix_cache.release(req.nodes)
+            req.nodes = None
         if req.pages:
-            self._free_pages.extend(req.pages)
+            self._free_pages.extend(req.pages[req.n_shared:])
         req.pages = None
+
+    def _promote_prefix_locked(self, req: _GenRequest) -> None:
+        """After a successful prefill, publish the prompt's fully-
+        covered pages into the prefix cache so the NEXT same-prefix
+        request shares them (the request itself keeps decoding on them;
+        page ownership moves to the cache, refcounted)."""
+        if self._prefix_cache is None or req.pages is None:
+            return
+        req.nodes, freed = self._prefix_cache.insert(req.prompt, req.pages,
+                                                     req.nodes or [])
+        req.n_shared = len(req.nodes)
+        # pages evicted to respect the cache's max_pages cap go straight
+        # back to the pool — a cap-driven eviction must never leak
+        self._free_pages.extend(freed)
 
     # -- public surface ----------------------------------------------------
     def submit(self, prompt_ids, n_tokens: int, *,
@@ -828,28 +954,49 @@ class DecodeEngine:
         frag = (100.0 * (1.0 - used_positions
                          / (held * self.page_size))
                 if held else 0.0)
-        return {"submitted": self.submitted, "served": self.served,
-                "shed_overload": self.shed_overload,
-                "shed_out_of_pages": self.shed_out_of_pages,
-                "shed_deadline": self.shed_deadline,
-                "shed_unavailable": self.shed_unavailable,
-                "failures": self.failures, "prefills": self.prefills,
-                "prefill_chunks": self.prefill_chunks,
-                "decode_steps": self.decode_steps,
-                "tokens_generated": self.tokens_generated,
-                "slot_occupancy_pct": round(occupancy, 1),
-                "n_slots": self.n_slots, "active_slots": active,
-                "queued": queued, "swaps": self.swaps,
-                "max_len": self.max_len,
-                "page_size": self.page_size,
-                "pool_pages": self.pool_pages,
-                "pages_in_use": held,
-                "pages_in_use_peak": self.pages_in_use_peak,
-                "queued_page_demand": demand,
-                "max_queued_pages": self.max_queued_pages,
-                "page_fragmentation_pct": round(frag, 1),
-                "prefill_chunk": self.prefill_chunk,
-                "prompt_buckets": list(self.prompt_buckets)}
+        out = {"submitted": self.submitted, "served": self.served,
+               "shed_overload": self.shed_overload,
+               "shed_out_of_pages": self.shed_out_of_pages,
+               "shed_deadline": self.shed_deadline,
+               "shed_unavailable": self.shed_unavailable,
+               "failures": self.failures, "prefills": self.prefills,
+               "prefill_chunks": self.prefill_chunks,
+               "decode_steps": self.decode_steps,
+               "tokens_generated": self.tokens_generated,
+               "slot_occupancy_pct": round(occupancy, 1),
+               "n_slots": self.n_slots, "active_slots": active,
+               "queued": queued, "swaps": self.swaps,
+               "max_len": self.max_len,
+               "page_size": self.page_size,
+               "pool_pages": self.pool_pages,
+               "pages_in_use": held,
+               "pages_in_use_peak": self.pages_in_use_peak,
+               "queued_page_demand": demand,
+               "max_queued_pages": self.max_queued_pages,
+               "page_fragmentation_pct": round(frag, 1),
+               "prefill_chunk": self.prefill_chunk,
+               "prompt_buckets": list(self.prompt_buckets)}
+        if self._prefix_cache is not None:
+            hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
+                       if self.prompt_tokens else 0.0)
+            out["prefix_hit_tokens_pct"] = round(hit_pct, 1)
+            out["prefix_cache"] = dict(
+                self._prefix_cache.stats(),
+                hits=self.prefix_hits, misses=self.prefix_misses,
+                hit_tokens=self.prefix_hit_tokens,
+                prompt_tokens=self.prompt_tokens)
+        if self._spec is not None:
+            rate = (100.0 * self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+            per_step = (self.spec_emitted / self.spec_steps
+                        if self.spec_steps else 0.0)
+            out["spec_accept_rate"] = round(rate, 1)
+            out["spec_tokens_per_step"] = round(per_step, 3)
+            out["speculative"] = dict(
+                self._spec.stats(), verify_steps=self.spec_steps,
+                proposed=self.spec_proposed, accepted=self.spec_accepted,
+                emitted=self.spec_emitted)
+        return out
 
     def drain_and_swap(self, net, timeout: Optional[float] = None) -> None:
         """Hot-reload seam: pause admission, let every in-flight request
@@ -995,9 +1142,15 @@ class DecodeEngine:
         """Move queued requests into free slots. Expired queued requests
         are shed BEFORE any device work. The queue head waits (FIFO)
         when the free list cannot cover its pages — a retirement frees
-        them in bounded time; a short prompt prefills one-shot
-        immediately, a long one is parked mid-prefill and
-        chunk-prefilled by `_step_prefills` interleaved with decode."""
+        them in bounded time, and unreferenced prefix-cache pages are
+        reclaimed LRU-first before waiting (caching never shrinks
+        effective capacity). With a prefix hit, the longest cached
+        chain binds into the slot's page table (refcounts bumped), only
+        the uncached tail allocates fresh pages, and prefill starts at
+        the first uncached page boundary. A short cold prompt prefills
+        one-shot immediately; a long or prefix-hit one is parked
+        mid-prefill and chunk-prefilled by `_step_prefills` interleaved
+        with decode."""
         import jax.numpy as jnp
 
         while True:
@@ -1007,9 +1160,34 @@ class DecodeEngine:
                 if not free or not self._queue:
                     return
                 head = self._queue[0]
-                if not head.expired() \
-                        and head.n_pages > len(self._free_pages):
-                    return  # page-blocked: wait for a retirement
+                nodes: list = []
+                need = head.n_pages
+                if not head.expired():
+                    if self._prefix_cache is not None:
+                        # only the scheduler thread mutates the cache,
+                        # so this lookup stays valid through the bind;
+                        # a page-blocked head retries every iteration —
+                        # its chunk digests are memoized on the request
+                        nodes = self._prefix_cache.lookup(head.prompt,
+                                                          head.digests)
+                        if nodes:
+                            need = self._pages_for_hit(
+                                head.prompt.shape[0],
+                                head.n_tokens) - len(nodes)
+                    if need > len(self._free_pages) \
+                            and self._prefix_cache is not None:
+                        # pool pressure: release idle cached pages
+                        # (LRU, leaf-first) — the head's own hit chain
+                        # is pinned so reclaim cannot eat it
+                        self._prefix_cache.acquire(nodes)
+                        try:
+                            self._free_pages.extend(
+                                self._prefix_cache.reclaim(
+                                    need - len(self._free_pages)))
+                        finally:
+                            self._prefix_cache.release(nodes)
+                    if need > len(self._free_pages):
+                        return  # page-blocked: wait for a retirement
                 req = self._queue.popleft()
                 self._pages_demand_queued -= req.n_pages
             if req.expired():
@@ -1031,8 +1209,18 @@ class DecodeEngine:
             req.probe = probe
             slot = free[0]
             with self._cond:
-                req.pages = [self._free_pages.pop()
-                             for _ in range(req.n_pages)]
+                if nodes:
+                    self._prefix_cache.acquire(nodes)
+                    req.nodes = nodes
+                    req.n_shared = len(nodes)
+                    req.hit_len = len(nodes) * self.page_size
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += req.hit_len
+                elif self._prefix_cache is not None:
+                    self.prefix_misses += 1
+                self.prompt_tokens += int(req.prompt.shape[0])
+                req.pages = [n.page_id for n in nodes] + \
+                    [self._free_pages.pop() for _ in range(need)]
                 held = self.pool_pages - len(self._free_pages)
                 self.pages_in_use_peak = max(self.pages_in_use_peak, held)
             row = np.zeros((self._n_pages_max,), np.int32)
@@ -1040,9 +1228,13 @@ class DecodeEngine:
             self._page_table = self._page_table.at[slot].set(
                 jnp.asarray(row))
             t0 = req.prompt.shape[0]
-            if self._is_chunked(t0):
+            if req.hit_len or self._is_chunked(t0):
                 with self._cond:
-                    req.prefill_pos = 0
+                    # hit requests always ride the chunk path: suffix
+                    # prefill starts at the first uncached page
+                    # boundary and attends over the shared pages
+                    # through the slot's page row
+                    req.prefill_pos = req.hit_len
                     req.slot = slot
                     self._slots[slot] = req
                     # _active stays False until the final chunk lands
@@ -1083,10 +1275,17 @@ class DecodeEngine:
             raise InferenceFailedError(
                 "model produced non-finite logits during prefill "
                 "(poisoned parameters or a numerically broken graph)")
+        if self._spec is not None:
+            # mirror the prompt into the draft's pools (same pages, same
+            # padded ids) so proposing can start from a complete context
+            _dispatched(lambda: self._spec.prefill_one_shot(ids, wpids))
         self._hook("post_prefill", info)
         with self._cond:
             self.prefills += 1
             self.tokens_generated += 1
+            self._promote_prefix_locked(req)
+        if self._spec is not None:
+            self._spec.seed_slot(slot, req.seed)
         req.tokens.append(first)
         if req.n_tokens == 1 or first == self.eos_token:
             self._retire(slot, req, attached=False)
@@ -1118,19 +1317,29 @@ class DecodeEngine:
         C, page = self.prefill_chunk, self.page_size
         off = req.prefill_pos
         t0 = req.prompt.shape[0]
-        final = off + C >= t0
-        ids = np.zeros((1, C), np.int32)
-        take = min(C, t0 - off)
+        rem = t0 - off
+        final = rem <= C
+        if not final:
+            W = C
+        elif C < page:
+            W = C  # C divides page: the padded tail never straddles
+        else:
+            # final chunk padded only to the next PAGE multiple (<= C):
+            # a prefix-hit suffix must never write past
+            # page*ceil(t0/page), which its reservation covers
+            W = -(-rem // page) * page
+        ids = np.zeros((1, W), np.int32)
+        take = min(W, rem)
         ids[0, :take] = req.prompt[off:off + take]
-        if C >= page:
-            pids = req.pages[off // page: off // page + C // page]
+        if W >= page:
+            pids = req.pages[off // page: off // page + W // page]
             woff = 0
         else:
             pids = [req.pages[off // page]]
             woff = off % page
         key = jax.random.PRNGKey(req.seed)
         kp, kdec = jax.random.split(key)
-        info = {"slot": slot, "t0": t0, "chunk": C, "chunk_off": off,
+        info = {"slot": slot, "t0": t0, "chunk": W, "chunk_off": off,
                 "final": final}
         self._hook("pre_prefill", info)
 
@@ -1153,6 +1362,9 @@ class DecodeEngine:
                     "model produced non-finite activations during chunked "
                     "prefill (poisoned parameters or a numerically broken "
                     "graph)")
+            if self._spec is not None:
+                _dispatched(lambda: self._spec.prefill_chunk(
+                    self._page_table[slot], ids, off, woff, pids))
         except BaseException as e:
             self._prefill_failure(slot, req, e, attached=True)
             return
@@ -1166,6 +1378,9 @@ class DecodeEngine:
         with self._cond:
             self.prefills += 1
             self.tokens_generated += 1
+            self._promote_prefix_locked(req)
+        if self._spec is not None:
+            self._spec.seed_slot(slot, req.seed)
         first = int(first[0])
         req.tokens.append(first)
         if req.n_tokens == 1 or first == self.eos_token:
@@ -1217,6 +1432,7 @@ class DecodeEngine:
                     self._slots[s] = None
                     self._active[s] = False
                     r.pages = None  # pools rebuild wholesale after this
+                    r.nodes = None  # ... and the prefix cache clears
                     if self.breaker is not None:
                         self.breaker.record_failure(r.probe)
                     r.finish(err)
@@ -1304,6 +1520,150 @@ class DecodeEngine:
                 return False
         return True
 
+    def _decode_failure(self, live, e: BaseException) -> None:
+        """Shared decode-step give-up: fail every live request typed,
+        free slots + pages, and — on a failed DISPATCH under donation —
+        fail mid-prefill slots too and rebuild the device state (the
+        donated pools back all of them)."""
+        err = e if isinstance(e, ServingError) else \
+            InferenceFailedError(
+                f"decode step failed: {type(e).__name__}: {e}")
+        logger.warning("decode engine: decode failure (%s)", err)
+        with self._cond:
+            self.failures += len(live)
+        for s, req in live:
+            if self.breaker is not None:
+                self.breaker.record_failure(req.probe)
+            with self._cond:
+                self._slots[s] = None
+                self._active[s] = False
+                self._free_request_pages_locked(req)
+                self._cond.notify_all()
+            req.finish(err)
+        if getattr(e, "_dispatch_failure", False):
+            # only a failed DISPATCH can have invalidated the donated
+            # pool buffers; hook failures leave them valid. Mid-prefill
+            # slots are backed by the same pools — they go down with
+            # them before the rebuild
+            self._fail_occupied_slots(InferenceFailedError(
+                "paged KV pool lost to a failed decode dispatch "
+                "(donated buffers)"))
+            self._reset_device_state()
+
+    def _retire_or_poison(self, s: int, req: _GenRequest, toks, oks,
+                          n_steps: int) -> None:
+        """Consume one slot's emitted tokens from a decode/verify
+        dispatch: append until done (count or EOS — overshoot dropped
+        with the slot) or until a poisoned step fails the request typed
+        while healthy neighbors keep decoding."""
+        done = False
+        poisoned = False
+        for t in range(n_steps):
+            if not bool(oks[t]):
+                poisoned = True
+                break
+            tok = int(toks[t])
+            req.tokens.append(tok)
+            with self._cond:
+                self.tokens_generated += 1
+            if len(req.tokens) >= req.n_tokens \
+                    or tok == self.eos_token:
+                done = True
+                break
+        if poisoned:
+            nf_err = InferenceFailedError(
+                "model produced non-finite logits during decode "
+                "(poisoned parameters or a numerically broken graph)")
+            logger.warning("decode engine: %s", nf_err)
+            with self._cond:
+                self.failures += 1
+                self._slots[s] = None
+                self._active[s] = False
+                self._free_request_pages_locked(req)
+                self._cond.notify_all()
+            if self.breaker is not None:
+                self.breaker.record_failure(req.probe)
+            req.finish(nf_err)
+        elif done:
+            self._retire(s, req)
+
+    def _step_active_spec(self, live) -> bool:
+        """One speculative iteration: draft proposes k tokens per slot,
+        the target verifies them in one batched chunk — up to k+1
+        tokens per slot in two dispatches. Returns False (caller falls
+        back to the vanilla step) when no live slot has the write
+        budget to speculate."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = self._spec
+        k = spec.k
+        # a slot can commit m speculative tokens only while its writes
+        # stay within the reserved span: pos + m <= t0 + n_tokens - 2
+        # (the last token is never written back). With pos = t0 + len - 1
+        # that cap is rem - 1 (rem = tokens still to emit), so a slot
+        # with rem >= 2 can still accept; when EVERY slot is down to its
+        # final token the plain step is strictly cheaper
+        if all(r.n_tokens - len(r.tokens) < 2 for _, r in live):
+            return False
+        wl = np.zeros((self.n_slots,), np.int32)
+        for s, r in live:
+            wl[s] = r.prompt.shape[0] + r.n_tokens - 2
+        info = {"active": len(live), "step": self.decode_steps,
+                "spec": True, "k": k}
+        t0c = time.monotonic()
+        try:
+            self._hook("pre_decode", info)
+
+            def run():
+                wlimit = jnp.asarray(wl)
+                active = jnp.asarray(self._active)
+                (spec._caches, spec._keys, props, qd) = spec._propose(
+                    spec._draft_params(), spec._caches, self._page_table,
+                    self._tok, self._pos, spec._keys, self._temps,
+                    active, wlimit)
+                (self._caches, self._tok, self._pos, self._keys, out,
+                 n_emit, oks) = spec._verify(
+                    self._net._params, self._caches, self._page_table,
+                    self._tok, self._pos, self._keys, self._temps,
+                    active, wlimit, props, qd)
+                return jax.device_get((out, n_emit, oks))
+
+            out, n_emit, oks = _dispatched(run)
+            self._hook("post_decode", info)
+        except BaseException as e:
+            self._decode_failure(live, e)
+            return True
+        emitted = int(sum(max(1, int(n_emit[s])) for s, _ in live))
+        with self._cond:
+            self._step_ewma = (0.8 * self._step_ewma
+                               + 0.2 * (time.monotonic() - t0c)
+                               * len(live) / max(1, emitted))
+            self.decode_steps += 1
+            self.active_slot_steps += len(live)
+            self.spec_steps += 1
+            for s, r in live:
+                # proposals that could actually be consumed: the device
+                # cap is m_cap = wlimit - pos = rem - 1, so accepted
+                # (= n_emit - 1 <= m_cap) never exceeds this count and
+                # the accept RATE stays a true <=100% ratio
+                self.spec_proposed += min(
+                    k, max(0, r.n_tokens - len(r.tokens) - 1))
+                self.spec_accepted += max(0, int(n_emit[s]) - 1)
+        delivered = 0
+        for s, req in live:
+            n = max(1, int(n_emit[s]))
+            before = len(req.tokens)
+            self._retire_or_poison(s, req, out[s, :n],
+                                   np.repeat(oks[s], n), n)
+            delivered += len(req.tokens) - before
+        with self._cond:
+            # spec_tokens_per_step is a DELIVERED-throughput number:
+            # tokens appended to requests, not device emissions — a
+            # mid-verify EOS's dropped overshoot must not inflate it
+            self.spec_emitted += delivered
+        return True
+
     def _step_active(self) -> None:
         import jax.numpy as jnp
 
@@ -1311,8 +1671,10 @@ class DecodeEngine:
                 if r is not None and r.prefill_pos is None]
         if not live:
             return
+        if self._spec is not None and self._step_active_spec(live):
+            return
         now = time.monotonic()
-        chunked = self._chunk_eligible(live, now)
+        chunked = self._spec is None and self._chunk_eligible(live, now)
         info = {"active": len(live), "step": self.decode_steps,
                 "chunk": self.decode_chunk if chunked else 1}
         t0 = time.monotonic()
@@ -1343,30 +1705,7 @@ class DecodeEngine:
             toks, oks = _dispatched(run)
             self._hook("post_decode", info)
         except BaseException as e:
-            err = e if isinstance(e, ServingError) else \
-                InferenceFailedError(
-                    f"decode step failed: {type(e).__name__}: {e}")
-            logger.warning("decode engine: decode failure (%s)", err)
-            with self._cond:
-                self.failures += len(live)
-            for s, req in live:
-                if self.breaker is not None:
-                    self.breaker.record_failure(req.probe)
-                with self._cond:
-                    self._slots[s] = None
-                    self._active[s] = False
-                    self._free_request_pages_locked(req)
-                    self._cond.notify_all()
-                req.finish(err)
-            if getattr(e, "_dispatch_failure", False):
-                # only a failed DISPATCH can have invalidated the
-                # donated pool buffers; hook failures leave them valid.
-                # Mid-prefill slots are backed by the same pools — they
-                # go down with them before the rebuild
-                self._fail_occupied_slots(InferenceFailedError(
-                    "paged KV pool lost to a failed decode dispatch "
-                    "(donated buffers)"))
-                self._reset_device_state()
+            self._decode_failure(live, e)
             return
         n_steps = toks.shape[0]
         with self._cond:
@@ -1375,41 +1714,12 @@ class DecodeEngine:
             self.decode_steps += n_steps
             self.active_slot_steps += len(live) * n_steps
         for s, req in live:
-            done = False
-            poisoned = False
-            for t in range(n_steps):
-                # per-step, per-slot non-finite screen (predict's
-                # breaker discipline): a poisoned step fails THIS
-                # request typed — unless it already completed via EOS
-                # at an earlier step of the chunk — and healthy
-                # neighbors keep decoding (their pages are untouched)
-                if not bool(oks[t, s]):
-                    poisoned = True
-                    break
-                tok = int(toks[t, s])
-                req.tokens.append(tok)
-                with self._cond:
-                    self.tokens_generated += 1
-                if len(req.tokens) >= req.n_tokens \
-                        or tok == self.eos_token:
-                    done = True  # EOS overshoot inside a chunk: tokens
-                    break        # past EOS are dropped with the slot
-            if poisoned:
-                nf_err = InferenceFailedError(
-                    "model produced non-finite logits during decode "
-                    "(poisoned parameters or a numerically broken graph)")
-                logger.warning("decode engine: %s", nf_err)
-                with self._cond:
-                    self.failures += 1
-                    self._slots[s] = None
-                    self._active[s] = False
-                    self._free_request_pages_locked(req)
-                    self._cond.notify_all()
-                if self.breaker is not None:
-                    self.breaker.record_failure(req.probe)
-                req.finish(nf_err)
-            elif done:
-                self._retire(s, req)
+            # per-step, per-slot non-finite screen (predict's breaker
+            # discipline): a poisoned step fails THIS request typed —
+            # unless it already completed via EOS at an earlier step of
+            # the chunk — and healthy neighbors keep decoding (their
+            # pages are untouched)
+            self._retire_or_poison(s, req, toks[:, s], oks[:, s], n_steps)
 
     def _maybe_swap(self) -> None:
         if not self._draining:

@@ -498,6 +498,12 @@ class ModelServer:
             out["pages_in_use"] = gen["pages_in_use"]
             out["page_fragmentation_pct"] = gen["page_fragmentation_pct"]
             out["prefill_chunks"] = gen["prefill_chunks"]
+            # latency tier (prefix cache / speculative decoding), when
+            # enabled: the two headline ratios an operator tunes by
+            for key in ("prefix_hit_tokens_pct", "spec_accept_rate",
+                        "spec_tokens_per_step"):
+                if key in gen:
+                    out[key] = gen[key]
             out["generation"] = gen
         return out
 

@@ -1,0 +1,259 @@
+"""Refcounted shared-prefix KV cache over the decode engine's paged pool.
+
+Production chat traffic is dominated by requests sharing a long system
+prompt, yet the paged decode engine (PR 6) re-prefills that prefix per
+request — the largest remaining lever on the generate path. The page
+tables make sharing a refcount away: resident KV pages are already
+position-indexed and write-masked, so two slots whose prompts share a
+page-aligned prefix can point their page-table rows at the SAME pool
+pages. This module is the bookkeeping for that sharing (PagedAttention-
+style prefix caching, Kwon et al., SOSP '23):
+
+- **keying**: a prompt is cut into page-size token chunks and hashed as
+  a ROLLING CHAIN — each node's key is (parent node, chunk digest), so
+  a chunk's identity includes everything before it and two prompts
+  share a node only when their entire prefix up to that page matches.
+  Digests are collision-guarded by an exact token comparison on lookup.
+- **refcounting**: a node's page is held by `requests` (slots currently
+  bound to it) plus the cache itself while the node is resident. The
+  engine frees a page ONLY when it is neither bound nor cached —
+  retiring a request whose prefix another slot still shares can never
+  free the shared pages.
+- **read-only sharing / copy-on-write at page granularity**: only pages
+  FULLY covered by the prompt are ever cached, and a binding request
+  recomputes its prompt from the first uncached page boundary into
+  freshly allocated pages — shared pages are never written (decode
+  writes land at positions >= t0, past every cached page), so the
+  "copy" of copy-on-write is free: divergence starts in a new page.
+- **LRU eviction under pressure**: when the engine's free list cannot
+  cover an admission, `reclaim` releases unreferenced cached pages
+  leaf-first in LRU order — caching borrows idle pages, it never
+  reduces the pool's effective capacity (`OutOfPagesError` semantics
+  are unchanged).
+- **invalidation**: `clear()` drops every node; the engine calls it
+  whenever the paged pools rebuild (weight swap via `drain_and_swap`,
+  post-failure recovery) so stale pages can never serve new weights.
+
+Thread-safety: externally synchronized — every method is called by the
+`DecodeEngine` under its scheduler condition lock.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+import numpy as np
+
+
+class _PrefixNode:
+    """One cached page of prompt KV: `page_id` in the engine's pool,
+    `tokens` the page's exact token content (collision guard),
+    `requests` the number of slots currently bound to it, `children`
+    how many cached nodes extend this chain (a node with children can
+    not be evicted — its descendants would become unreachable pages)."""
+
+    __slots__ = ("seq", "parent", "page_id", "tokens", "requests",
+                 "children", "last_used", "key")
+
+    def __init__(self, seq: int, parent: Optional["_PrefixNode"],
+                 page_id: int, tokens: np.ndarray, key):
+        self.seq = seq
+        self.parent = parent
+        self.page_id = page_id
+        self.tokens = tokens
+        self.requests = 0
+        self.children = 0
+        self.last_used = 0
+        self.key = key
+
+
+def _digest(tokens: np.ndarray) -> bytes:
+    return hashlib.blake2b(np.ascontiguousarray(tokens, np.int32).tobytes(),
+                           digest_size=16).digest()
+
+
+class PrefixCache:
+    """Refcounted chain cache mapping page-aligned prompt prefixes to
+    resident pool pages (see module docstring).
+
+    Parameters
+    ----------
+    page_size : the engine's KV page length (positions per page) — the
+        sharing granularity.
+    max_pages : optional cap on resident cached pages. On insert past
+        the cap the LRU unpinned tail is evicted first; if everything
+        is pinned the new chunk is simply not cached. None = bounded
+        only by pool pressure (`reclaim`).
+    """
+
+    def __init__(self, page_size: int, max_pages: Optional[int] = None):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if max_pages is not None and max_pages < 0:
+            raise ValueError("max_pages must be >= 0 (or None)")
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self._nodes: dict = {}   # (parent_seq, digest) -> _PrefixNode
+        self._seq = 0
+        self._clock = 0
+        # structural counters (hit/miss/token accounting lives on the
+        # engine, which counts once per BINDING — a page-blocked queue
+        # head re-runs lookup every scheduler iteration)
+        self.insertions = 0
+        self.evictions = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes)
+
+    def stats(self) -> dict:
+        return {"cached_pages": len(self._nodes),
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "page_size": self.page_size,
+                "max_pages": self.max_pages}
+
+    # -- lookup / binding --------------------------------------------------
+    def _max_hit_pages(self, t0: int) -> int:
+        """A hit never covers the whole prompt: position t0-1 must be
+        recomputed so the first-token logits (and the decode state they
+        seed) come from a live prefill — cap the match at the last page
+        boundary strictly before t0-1's page end."""
+        return max(0, (t0 - 1) // self.page_size)
+
+    def lookup(self, prompt: np.ndarray,
+               digest_cache: Optional[list] = None) -> List[_PrefixNode]:
+        """Longest cached chain matching `prompt`'s page-aligned prefix
+        (possibly empty). Touches the matched nodes' LRU clocks; does
+        NOT take references — pair with `acquire` under the same lock
+        before any other cache call can run. `digest_cache`: a caller-
+        owned list memoizing the prompt's per-chunk digests — a page-
+        blocked queue head re-runs lookup every scheduler iteration,
+        and the prompt is immutable, so hashing it once is enough."""
+        page = self.page_size
+        t0 = int(prompt.shape[0])
+        out: List[_PrefixNode] = []
+        parent_seq = 0
+        for i in range(self._max_hit_pages(t0)):
+            chunk = np.ascontiguousarray(prompt[i * page:(i + 1) * page],
+                                         np.int32)
+            if digest_cache is not None and i < len(digest_cache):
+                dig = digest_cache[i]
+            else:
+                dig = _digest(chunk)
+                if digest_cache is not None:
+                    digest_cache.append(dig)
+            node = self._nodes.get((parent_seq, dig))
+            if node is None or not np.array_equal(node.tokens, chunk):
+                break
+            out.append(node)
+            parent_seq = node.seq
+        self._clock += 1
+        for node in out:
+            node.last_used = self._clock
+        return out
+
+    def acquire(self, nodes: List[_PrefixNode]) -> None:
+        for node in nodes:
+            node.requests += 1
+
+    def release(self, nodes: List[_PrefixNode]) -> None:
+        for node in nodes:
+            node.requests -= 1
+            assert node.requests >= 0, "prefix-cache refcount underflow"
+
+    # -- insertion ---------------------------------------------------------
+    def insert(self, prompt: np.ndarray, pages: List[int],
+               held: List[_PrefixNode]):
+        """Promote the prompt's fully-covered pages into the cache after
+        a successful prefill. `pages` is the request's LOGICAL page list
+        (shared prefix pages first, then owned pages); `held` the nodes
+        the request already references (its admission-time hit). New
+        nodes are created only ON TOP of the held chain and only from
+        the request's OWN pages: if another request already cached a
+        deeper chunk with a different page, promotion stops there — a
+        chain's pages always share one numeric lineage, never a mix of
+        two requests' prefills. Returns `(nodes, freed)`: the full node
+        list the request now holds one reference on (callers replace
+        their held list with it; ownership of the promoted pages
+        transfers to the cache), and the page ids of any nodes evicted
+        to respect `max_pages` — the CALLER must return those to its
+        free list, or each cap-driven eviction would leak a pool page."""
+        page = self.page_size
+        t0 = int(prompt.shape[0])
+        cacheable = t0 // page  # pages fully covered by the prompt
+        nodes = list(held)
+        freed: List[int] = []
+        parent = held[-1] if held else None
+        self._clock += 1
+        for i in range(len(held), cacheable):
+            parent_seq = parent.seq if parent is not None else 0
+            chunk = np.ascontiguousarray(prompt[i * page:(i + 1) * page],
+                                         np.int32)
+            key = (parent_seq, _digest(chunk))
+            if key in self._nodes:
+                # raced by another request's promotion of the same
+                # prefix: its page is canonical for future lookups, ours
+                # stays privately owned — do not extend past it with a
+                # mixed-lineage chain
+                break
+            if self.max_pages is not None \
+                    and len(self._nodes) >= self.max_pages:
+                evicted = self._evict_one(protect=nodes)
+                if evicted is None:
+                    break  # cap reached, everything pinned: skip caching
+                freed.append(evicted)
+            self._seq += 1
+            node = _PrefixNode(self._seq, parent, int(pages[i]), chunk, key)
+            node.requests = 1  # the promoting request's reference
+            node.last_used = self._clock
+            if parent is not None:
+                parent.children += 1
+            self._nodes[key] = node
+            nodes.append(node)
+            parent = node
+            self.insertions += 1
+        return nodes, freed
+
+    # -- eviction ----------------------------------------------------------
+    def _evict_one(self, protect: List[_PrefixNode] = ()) -> Optional[int]:
+        """Evict the least-recently-used unpinned LEAF node (no bound
+        requests, no cached children, not in `protect`); returns its
+        page id or None when nothing is evictable. Leaf-first keeps
+        every resident chain reachable from its root."""
+        best = None
+        protected = {id(n) for n in protect}
+        for node in self._nodes.values():
+            if node.requests or node.children or id(node) in protected:
+                continue
+            if best is None or node.last_used < best.last_used:
+                best = node
+        if best is None:
+            return None
+        del self._nodes[best.key]
+        if best.parent is not None:
+            best.parent.children -= 1
+        self.evictions += 1
+        return best.page_id
+
+    def reclaim(self, n_pages: int) -> List[int]:
+        """Release up to `n_pages` cached pages (LRU leaf-first) back to
+        the caller's free list — the admission-pressure valve that keeps
+        caching from ever shrinking effective pool capacity. Pinned
+        pages (bound requests or interior chain nodes) are never
+        touched."""
+        freed: List[int] = []
+        while len(freed) < n_pages:
+            pid = self._evict_one()
+            if pid is None:
+                break
+            freed.append(pid)
+        return freed
+
+    def clear(self) -> None:
+        """Drop every node WITHOUT returning pages (the engine rebuilds
+        its free list wholesale after a pool rebuild — weight swap or
+        post-failure recovery — which is the only time this runs). A
+        stale page can never serve new weights."""
+        self._nodes.clear()

@@ -65,3 +65,150 @@ def test_mcxent_extreme_logits_stable():
     labels = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
     v = float(loss_score(LossFunction.MCXENT, Activation.SOFTMAX, labels, pre))
     assert np.isfinite(v) and v < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# GQA grouped-einsum attention (r6: the training path's K/V grouping is a
+# broadcast einsum, not a materialized jnp.repeat)
+
+
+def test_full_attention_grouped_bit_parity_with_repeat():
+    """`full_attention_grouped` must reproduce repeat-then-full_attention
+    BITWISE: per-head dots are the same contractions on the same
+    operands, only the HBM copies are gone."""
+    from deeplearning4j_tpu.ops.attention import (
+        full_attention,
+        full_attention_grouped,
+        mask_bias,
+    )
+
+    rng = np.random.default_rng(0)
+    B, T, H, Hkv, D = 2, 7, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    kr = jnp.repeat(k, H // Hkv, axis=2)
+    vr = jnp.repeat(v, H // Hkv, axis=2)
+    for causal in (False, True):
+        for mask in (None,
+                     jnp.asarray(rng.integers(0, 2, (B, T)), jnp.float32)):
+            bias = None if mask is None else mask_bias(mask)
+            ref = np.asarray(full_attention(q, kr, vr, bias=bias,
+                                            causal=causal))
+            got = np.asarray(full_attention_grouped(q, k, v, bias=bias,
+                                                    causal=causal))
+            np.testing.assert_array_equal(got, ref)
+
+
+def test_multi_head_attention_accepts_grouped_kv():
+    """The dispatch takes un-repeated Hkv-headed K/V and agrees with the
+    widened reference on both the full and blockwise paths."""
+    from deeplearning4j_tpu.ops.attention import (
+        full_attention,
+        multi_head_attention,
+    )
+
+    rng = np.random.default_rng(1)
+    B, T, H, Hkv, D = 2, 6, 4, 1, 8
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    kr = jnp.repeat(k, H // Hkv, axis=2)
+    vr = jnp.repeat(v, H // Hkv, axis=2)
+    ref = np.asarray(full_attention(q, kr, vr, causal=True))
+    got = np.asarray(multi_head_attention(q, k, v, causal=True))
+    np.testing.assert_array_equal(got, ref)
+    # long-seq path (blockwise widens internally): same numerics as the
+    # widened blockwise call
+    from deeplearning4j_tpu.ops.attention import blockwise_attention
+
+    ref_b = np.asarray(blockwise_attention(q, kr, vr, causal=True,
+                                           block_size=4))
+    got_b = np.asarray(multi_head_attention(q, k, v, causal=True,
+                                            block_size=4))
+    np.testing.assert_array_equal(got_b, ref_b)
+
+
+def test_gqa_transformer_block_forward_bit_parity():
+    """gpt-config bit-parity pin for the satellite: a GQA block's
+    forward through the grouped-einsum dispatch equals the historical
+    materialized-repeat computation exactly."""
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops import attention as att_mod
+
+    net = MultiLayerNetwork(gpt_configuration(
+        vocab_size=32, d_model=32, n_heads=4, n_kv_heads=2,
+        max_length=16, n_layers=2))
+    net.init()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 32, (3, 10))
+    got = np.asarray(net.output(ids))
+
+    # reference: force the historical repeat path by widening K/V before
+    # the dispatch ever sees them
+    orig = att_mod.multi_head_attention
+
+    def widened_dispatch(q, k, v, **kw):
+        if k.shape[2] != q.shape[2]:
+            g = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
+        return orig(q, k, v, **kw)
+
+    att_mod.multi_head_attention = widened_dispatch
+    try:
+        net2 = MultiLayerNetwork(gpt_configuration(
+            vocab_size=32, d_model=32, n_heads=4, n_kv_heads=2,
+            max_length=16, n_layers=2))
+        net2.init()
+        ref = np.asarray(net2.output(ids))
+    finally:
+        att_mod.multi_head_attention = orig
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# VMEM ceiling derived from the device generation (r6 advisor item)
+
+
+def test_vmem_limit_per_generation_table():
+    from deeplearning4j_tpu.ops.kernel_dispatch import (
+        _VMEM_PER_CORE_BYTES,
+        vmem_limit_for_kind,
+    )
+
+    for kind, physical in _VMEM_PER_CORE_BYTES.items():
+        assert vmem_limit_for_kind(kind) == physical * 7 // 8, kind
+    # v2/v3 cores carry 16 MiB: the ceiling must drop below the old
+    # constant there, not overflow physical VMEM
+    assert vmem_limit_for_kind("TPU v3") == 14 * 1024 * 1024
+    assert vmem_limit_for_kind("TPU v5 lite") == 112 * 1024 * 1024
+
+
+def test_vmem_limit_prefix_matching_and_default():
+    from deeplearning4j_tpu.ops.kernel_dispatch import (
+        _DEFAULT_VMEM_PER_CORE,
+        VMEM_LIMIT_BYTES,
+        vmem_limit_for_kind,
+    )
+
+    # longest prefix wins: "TPU v5 lite" must not resolve through
+    # "TPU v5"'s row
+    assert vmem_limit_for_kind("TPU v5 lite chip") == \
+        vmem_limit_for_kind("TPU v5 lite")
+    # unknown kinds (future generations, CPU interpret mode) keep the
+    # v4/v5-class default so big-slab kernels stay enabled
+    assert vmem_limit_for_kind("TPU v9 hypothetical") == \
+        _DEFAULT_VMEM_PER_CORE * 7 // 8
+    assert vmem_limit_for_kind("") == VMEM_LIMIT_BYTES
+
+
+def test_vmem_limit_bytes_cached_and_positive():
+    from deeplearning4j_tpu.ops import kernel_dispatch as kd
+
+    kd._vmem_limit_cache.clear()
+    v1 = kd.vmem_limit_bytes()
+    assert v1 > 0
+    assert kd.vmem_limit_bytes() is v1 or kd.vmem_limit_bytes() == v1
+    assert kd._vmem_limit_cache  # verdict cached after first detection

@@ -571,6 +571,9 @@ def test_bench_serve_generate_smoke(monkeypatch):
         "page_size": 8, "prefill_chunk": 16,
         "mean_interarrival": 0.002, "gqa_kv_heads": 1,
         "repeats": 2,
+        "shared_prefix_len": 16, "shared_tail_len": 4,
+        "sp_n_requests": 6, "sp_out_lengths": (6, 10),
+        "sp_mean_interarrival": 0.002, "spec_k": 3,
     })
     metric, value, mfu, spread = bench.bench_serve_generate()
     assert metric == "serve_generate_paged_goodput_tokens_per_sec"
@@ -586,3 +589,14 @@ def test_bench_serve_generate_smoke(monkeypatch):
         "the 48-token prompts must ride chunked prefill"
     assert fn.device_ms_per_token > 0  # half-output-length differencing
     assert fn.gqa_goodput_tokens_per_sec > 0
+    # latency tier (ISSUE 8 acceptance): the shared-prefix workload must
+    # actually hit the cache and actually accept speculated tokens
+    assert set(fn.shared_prefix_latency_ms) == {"p50", "p99"}
+    assert set(fn.shared_prefix_base_latency_ms) == {"p50", "p99"}
+    assert fn.shared_prefix_goodput_tokens_per_sec > 0
+    assert fn.prefix_hit_tokens_pct > 0, \
+        "shared-prefix traffic must produce prefix-cache hits"
+    assert fn.spec_accept_rate > 0, \
+        "self-draft speculation must accept proposals"
+    assert fn.spec_tokens_per_step > 1, \
+        "speculative decode must emit more than one token per step"

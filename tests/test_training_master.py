@@ -551,3 +551,50 @@ def test_early_stopping_through_master_multiworker():
         TerminationReason.EPOCH_TERMINATION_CONDITION
     assert result.total_epochs == 3
     assert np.isfinite(result.best_model_score)
+
+
+def test_distributed_evaluate_caches_replica_clones(monkeypatch):
+    """r6 satellite: distributed-evaluate replica clones (and through
+    them their jitted evals) are CACHED across `_shard_map` calls —
+    one clone per worker for the whole loop, not per epoch — and a
+    param sync (net trained in between) refreshes the cached replicas
+    instead of re-cloning. Results stay exact against the
+    single-device evaluation either way."""
+    net = _net()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=1)
+    dm = DistributedMultiLayer(net, master)
+    batches = _batches(6)
+
+    clones = [0]
+    orig_clone = MultiLayerNetwork.clone
+
+    def counting_clone(self):
+        clones[0] += 1
+        return orig_clone(self)
+
+    monkeypatch.setattr(MultiLayerNetwork, "clone", counting_clone)
+
+    def single_device_score(data):
+        total = sum(net.score(ds) * ds.num_examples() for ds in data)
+        n = sum(ds.num_examples() for ds in data)
+        return total / n
+
+    # two "epochs" of evaluate + score with a fit in between — the
+    # early-stopping loop's shape
+    s1 = dm.calculate_score(ListDataSetIterator(batches))
+    assert clones[0] == 2, "first call builds one replica per worker"
+    np.testing.assert_allclose(s1, single_device_score(batches), rtol=1e-6)
+    dm.calculate_score(ListDataSetIterator(batches))
+    assert clones[0] == 2, "second call must reuse the cached replicas"
+
+    dm.fit(ListDataSetIterator(batches))  # params change -> replicas sync
+    fit_clones = clones[0]  # training workers clone too; not our concern
+    s3 = dm.calculate_score(ListDataSetIterator(batches))
+    assert clones[0] == fit_clones, \
+        "a param sync must refresh cached replicas, never re-clone"
+    np.testing.assert_allclose(s3, single_device_score(batches), rtol=1e-6)
+
+    # replicas really did pick up the trained weights: the distributed
+    # score equals the (post-fit) single-device score, not the pre-fit
+    assert abs(s3 - s1) > 1e-9, "fit should have moved the score"
