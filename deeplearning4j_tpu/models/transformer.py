@@ -247,8 +247,10 @@ def _prefill_block_attention(layer, q, k, v):
 def _prefill_chunk_block_attention(layer, q, k_cache, v_cache, q_pos):
     """Causal attention for ONE prompt chunk of one block against the
     slot's (paged-gathered) dense cache — the chunked-prefill
-    counterpart of `_prefill_block_attention`, used by the decode
-    engine when a prompt is longer than its one-shot buckets. `q`:
+    counterpart of `_prefill_block_attention`. Since r6 the engine
+    dispatches `ops.attention.paged_attention_chunk_auto` instead (the
+    Pallas page-walk kernel on TPU); this helper IS that path's
+    fallback numerics and stays as the documented reference. `q`:
     (1, C, H, hd) fresh chunk queries at absolute positions `q_pos`
     (C,); `k_cache`/`v_cache`: (Hkv, hd, L)/(Hkv, L, hd) already
     holding the chunk's own K/V, so masking to entries `<= q_pos` is
@@ -263,7 +265,10 @@ def _verify_block_attention(layer, q, k_cache, v_cache, q_pos):
     """Batched-over-slots chunk attention for the speculative VERIFY
     step of one block: every slot scores a (k+1)-token candidate block
     against its own paged-gathered cache in one dispatch — the
-    slot-batched counterpart of `_prefill_chunk_block_attention`, built
+    slot-batched counterpart of `_prefill_chunk_block_attention`
+    (since r6 the verify dispatches
+    `ops.attention.paged_attention_chunk_auto`, whose fallback is
+    exactly this helper's numerics), built
     on the same `cached_attention_chunk` numerics (which is what keeps
     greedy speculative decode argmax-exact against `generate`). `q`:
     (S, C, H, hd) candidate-block queries at absolute positions `q_pos`

@@ -182,12 +182,22 @@ class Layer:
         `BaseLayer.preOutput:354` via `Dropout.applyDropout`). DL4J keeps
         E[x] by inverted dropout: scale by 1/keep at train time.
 
-        The mask is drawn from per-ROW keys (`fold_in(rng, global_row)`,
-        see `ops/rng_rows`) so the realization is invariant to how the
-        batch is partitioned — a GPipe microbatch inside a manual
-        shard_map reproduces exactly the rows a single-device step would
-        draw, which is what makes pipeline training with dropout hold
-        same-seed parity."""
+        Inside a `row_offset_scope` (pipeline microbatches, any manual
+        shard_map slicing the batch) the mask is drawn from per-ROW keys
+        (`fold_in(rng, global_row)`, see `ops/rng_rows`) so the
+        realization is invariant to how the batch is partitioned — a
+        GPipe microbatch reproduces exactly the rows the global batch
+        would draw, which is what makes pipeline training with dropout
+        hold same-seed parity. OUTSIDE any scope (single device, dp
+        shards under the one global-view jit — where a single bulk draw
+        is already partition-invariant because there is only one trace
+        of the whole batch) the mask is ONE bulk bernoulli: the per-row
+        fold_in+vmap stream costs B extra threefry key derivations plus
+        a vmapped draw per dropout site, pure overhead on the
+        single-device path (priced every round by bench gpt_med's
+        `dropout_rng_overhead_pct`). To reproduce pipeline masks on one
+        device, trace under `row_offset_scope(0)` — how the parity
+        tests pin same-seed equality."""
         p = self.dropout or 0.0
         if not train or p <= 0.0 or rng is None:
             return x
@@ -195,9 +205,11 @@ class Layer:
 
         keep = 1.0 - p
         off = current_row_offset()
-        rows = jnp.arange(x.shape[0], dtype=jnp.int32)
-        if off is not None:
-            rows = rows + jnp.asarray(off, jnp.int32)
+        if off is None:  # single-device/global-view: one bulk draw
+            m = jax.random.bernoulli(rng, keep, x.shape)
+            return jnp.where(m, x / keep, 0.0)
+        rows = jnp.arange(x.shape[0], dtype=jnp.int32) \
+            + jnp.asarray(off, jnp.int32)
         keys = jax.vmap(lambda r: jax.random.fold_in(rng, r))(rows)
         m = jax.vmap(
             lambda kk: jax.random.bernoulli(kk, keep, x.shape[1:]))(keys)

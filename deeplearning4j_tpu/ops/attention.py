@@ -258,11 +258,70 @@ def paged_attention_step(q: jnp.ndarray, k_pool: jnp.ndarray,
     unchanged — paged storage, dense numerics. The persistent allocation
     is the pool (pages actually held per request), not
     slots × max-length; the gathered dense view is a transient of the
-    step. (A fused kernel that walks the page table in-place — vLLM's
-    PagedAttention — is the follow-on optimization; this XLA form is the
-    portable reference semantics.)"""
+    step. This XLA form is the portable reference semantics AND the
+    dispatch fallback: `paged_attention_step_auto` runs the fused Pallas
+    kernel that walks the page table in-place (vLLM's PagedAttention,
+    `ops/pallas_paged_attention.py`) when the platform supports it."""
     k, v = paged_gather(k_pool, v_pool, page_table)
     return cached_attention_step(q, k, v, pos)
+
+
+def paged_attention_step_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
+                              v_pool: jnp.ndarray,
+                              page_table: jnp.ndarray, pos,
+                              active=None) -> jnp.ndarray:
+    """`paged_attention_step` behind the kernel-dispatch contract: on
+    TPU the Pallas paged-attention kernel walks the page table in place
+    (`ops/pallas_paged_attention.py` — no dense transient, each cache
+    byte read once); everywhere else (CPU tier-1, kill switch, failed
+    probe) the `paged_gather` + `cached_attention_step` reference path
+    runs unchanged. `q`: (S, H, D); `pos`: (S,) per-slot positions.
+    Inactive lanes (optional `active` (S,) bool) are a compute skip on
+    the kernel path (exact-zero rows) and plain masked-downstream
+    garbage on the gather path — both discarded by the engine.
+    Returns (S, H*D)."""
+    from deeplearning4j_tpu.ops.pallas_paged_attention import (
+        paged_attention_or_none,
+    )
+
+    S, H, D = q.shape
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (S,))
+    out = paged_attention_or_none(q[:, None], k_pool, v_pool, page_table,
+                                  pos, active)
+    if out is not None:
+        return out.reshape(S, H * D)
+    return paged_attention_step(q, k_pool, v_pool, page_table, pos)
+
+
+def paged_attention_chunk_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
+                               v_pool: jnp.ndarray,
+                               page_table: jnp.ndarray, pos0,
+                               active=None) -> jnp.ndarray:
+    """Chunk-width paged attention behind the same dispatch contract —
+    the speculative (k+1)-verify and chunked-prefill-suffix shapes.
+    `q`: (S, C, H, D) — C CONTIGUOUS query tokens per slot starting at
+    absolute position `pos0[s]` (row c attends to cache entries
+    `<= pos0[s] + c`, the `cached_attention_chunk` mask). Kernel path:
+    one fused page-walk dispatch; fallback: `paged_gather` + slot-vmapped
+    `cached_attention_chunk` (exactly `_verify_block_attention`, and for
+    S=1 exactly `_prefill_chunk_block_attention`). Returns (S, C, H*D)."""
+    from deeplearning4j_tpu.ops.pallas_paged_attention import (
+        paged_attention_or_none,
+    )
+
+    S, C, H, D = q.shape
+    pos0 = jnp.asarray(pos0)
+    if pos0.ndim == 0:
+        pos0 = jnp.broadcast_to(pos0, (S,))
+    out = paged_attention_or_none(q, k_pool, v_pool, page_table, pos0,
+                                  active)
+    if out is not None:
+        return out.reshape(S, C, H * D)
+    kd, vd = paged_gather(k_pool, v_pool, page_table)
+    qpos = pos0[:, None] + jnp.arange(C)[None, :]
+    return jax.vmap(cached_attention_chunk)(q, kd, vd, qpos)
 
 
 def cached_attention_chunk(q: jnp.ndarray, k_cache: jnp.ndarray,

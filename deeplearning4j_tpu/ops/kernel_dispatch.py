@@ -7,6 +7,27 @@ here, fall through silently to the XLA path otherwise. This module holds
 the pieces that contract needs so each new kernel doesn't re-implement
 them: MXU dtype policy, accumulation dtype, a precision-pinned
 dot_general, out-of-trace probe execution, and the cached-verdict helper.
+
+Dispatch contract (every kernel family — `pallas_attention`,
+`pallas_lstm`, `pallas_paged_attention` — holds all five):
+
+1. **Same signature, same semantics** as the XLA path it replaces; the
+   XLA path stays in-tree as the portable reference numerics.
+2. **Probe before first dispatch**, out of trace (`probe_verdict`):
+   compile AND run the kernel once at the exact shape class on tiny
+   concrete inputs. A kernel whose probe also CHECKS its output against
+   the XLA reference (the paged-attention family does) turns a
+   miscompiling Mosaic toolchain into a silent fallback instead of a
+   wrong-numerics serving path.
+3. **Silent fallback**: any probe raise is logged once and cached as
+   False; CPU/interpret platforms never dispatch (tier-1 tests run the
+   XLA paths bit-for-bit unchanged).
+4. **Kill switch**: a `DL4J_TPU_NO_<KERNEL>` env var forces the XLA
+   path — how the benches price kernel-vs-XLA A/B lines on identical
+   configs.
+5. **VMEM ceiling**: kernels size their resident slabs against
+   `vmem_limit_bytes()` (generation-derived, below) and decline shapes
+   that cannot fit rather than letting Mosaic fail mid-training.
 """
 from __future__ import annotations
 
@@ -44,6 +65,21 @@ def dot(a, b, dims, dt):
     return jax.lax.dot_general(a, b, dimension_numbers=(dims, ((), ())),
                                preferred_element_type=stat_dtype(dt),
                                precision=dot_precision(dt))
+
+
+def tpu_compiler_params(**kw):
+    """Construct the Pallas TPU compiler-params struct across JAX
+    versions: the class was renamed `TPUCompilerParams` →
+    `CompilerParams` upstream, and a hard reference to either name makes
+    every kernel family unimportable-at-dispatch on the other toolchain
+    (probe failure → permanent XLA fallback on a platform the kernel
+    compiles fine on). One shim so a rename retunes all kernels at
+    once."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
 
 
 def run_probe_out_of_trace(fn, *args) -> bool:

@@ -60,6 +60,7 @@ from deeplearning4j_tpu.ops.kernel_dispatch import (  # noqa: E402
     probe_verdict as _probe_verdict,
     run_probe_out_of_trace as _run_probe_out_of_trace,
     stat_dtype as _stat_dtype,
+    tpu_compiler_params as _compiler_params,
 )
 
 
@@ -267,7 +268,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), sdt),  # running denom l
             pltpu.VMEM((block_q, D), sdt),    # unnormalised output
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
@@ -325,7 +326,7 @@ def _flash_mha_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), sdt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
@@ -357,7 +358,7 @@ def _flash_mha_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
             pltpu.VMEM((block_k, D), sdt),
             pltpu.VMEM((block_k, D), sdt),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,

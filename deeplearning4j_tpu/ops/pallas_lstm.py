@@ -58,6 +58,7 @@ from deeplearning4j_tpu.ops.kernel_dispatch import (
     mxu_dtype as _mxu_dtype,
     probe_verdict as _probe_verdict,
     stat_dtype as _stat_dtype,
+    tpu_compiler_params as _compiler_params,
 )
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -338,7 +339,7 @@ def _fwd_call(xw, rw, peep, h0, c0, *, bb: int, with_stash: bool,
                                  xw.dtype)],
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
@@ -378,7 +379,7 @@ def _bwd_call(gates, c_stash, dh_out, dcT, rw, peep, c0, *, bb: int,
                    jax.ShapeDtypeStruct((2, B, H), sdt)],
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
@@ -469,7 +470,7 @@ def _fwd_call_masked(xw, rw, peep, h0, c0, mask, *, bb: int,
                                  xw.dtype)],                   # gates
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
@@ -510,7 +511,7 @@ def _bwd_call_masked(gates, c_sel, dh_out, dhT, dcT, mask, rw, peep, c0,
                    jax.ShapeDtypeStruct((2, B, H), sdt)],
         scratch_shapes=[pltpu.VMEM((bb, H), sdt),
                         pltpu.VMEM((bb, H), sdt)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,

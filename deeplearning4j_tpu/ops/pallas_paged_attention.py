@@ -1,0 +1,311 @@
+"""Pallas TPU paged-attention decode kernel: walk the page table in
+place, no dense gather.
+
+The paged decode engine (`serving/decode_engine.py`) stores each block's
+KV cache as a pool of fixed-size pages in the r4 decode layouts — K
+`(P+1, Hkv, hd, page)`, V `(P+1, Hkv, page, hd)`, page 0 the reserved
+trash page — with an int32 per-slot page table mapping logical page
+index to pool page id. The portable XLA path
+(`ops.attention.paged_gather` + `cached_attention_step` /
+`cached_attention_chunk`) first REASSEMBLES each slot's pages into a
+dense transient, then attends: every cache byte moves through HBM twice
+(pool → transient write, transient → compute read) on a path that is
+cache-bandwidth-bound by construction. This kernel is the PagedAttention
+move (Kwon et al., SOSP 2023): the page ids ride the grid as
+scalar-prefetch operands (`pltpu.PrefetchScalarGridSpec`), the BlockSpec
+index map dereferences `page_table[slot, j]` directly, and the pipeline
+DMAs each referenced page from the pool into VMEM exactly once — the
+flash-style online-softmax accumulator (the `ops/pallas_attention.py`
+recurrence) runs over pages in logical order with no intermediate
+materialization.
+
+One kernel serves every paged shape of the serving hot path via the
+chunk width `C` of the query block `(S, C, H, hd)`:
+
+- `C == 1`: the decode step (`cached_attention_step` semantics — each
+  slot's single query at position `pos[s]` attends to cache entries
+  `<= pos[s]`);
+- `C == k+1`: the speculative verify chunk
+  (`_verify_block_attention` semantics);
+- `C == prefill_chunk`: the chunked-prefill suffix
+  (`cached_attention_chunk` semantics, S=1 per dispatch).
+
+All three mask identically because the serving paths only ever issue
+CONTIGUOUS query positions: row `c` of slot `s` attends to entries
+`<= positions[s] + c`. GQA contracts the un-repeated `Hkv` pool heads
+against query groups of `G = H // Hkv` heads folded into the matmul's
+sublane axis. The trash-page convention holds for free: unallocated
+page-table entries point at page 0, whose logical positions are always
+past the slot's limit and therefore masked; inactive lanes (optional
+`active` mask) skip the page loop entirely and emit zeros via the
+`l == 0` finalization, the same discipline the flash kernel uses for
+fully-masked rows.
+
+Dispatch rides the `ops/kernel_dispatch.py` contract: the probe
+compiles AND runs the kernel at the exact shape class and CHECKS the
+output against the gather+dense reference (a miscompiling Mosaic
+toolchain degrades to the XLA path, never to wrong tokens); VMEM
+residency (double-buffered K/V page tiles + accumulators) is sized
+against the generation-derived `vmem_limit_bytes()` ceiling and
+oversized shapes decline; `DL4J_TPU_NO_PALLAS_PAGED_ATTENTION` forces
+the gather path (the bench's A/B kill switch); CPU backends never
+dispatch, so tier-1 runs the XLA numerics bit-for-bit unchanged.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.kernel_dispatch import (
+    dot as _dot,
+    mxu_dtype as _mxu_dtype,
+    probe_verdict as _probe_verdict,
+    stat_dtype as _stat_dtype,
+    tpu_compiler_params as _compiler_params,
+    vmem_limit_bytes as _vmem_limit,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+NEG_INF = -1e30  # matches ops/attention.py: exp()/where() stay NaN-free
+
+
+def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_scr, m_scr, l_scr, *, page: int, C: int, G: int,
+                  Hkv: int, hd: int, sm_scale: float):
+    """Grid (S, n_pages), pages sequential: one (C·G, page) score tile
+    per KV head per page, accumulated with the online-softmax
+    recurrence in VMEM scratch. Scalar-prefetch refs: the page table
+    (drives the K/V BlockSpec index maps — the in-place walk), the
+    per-slot start positions, and the active gate."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    CG = C * G
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    p0 = p0_ref[s]
+    # skip pages whose every position is past the last query's limit
+    # (p0 + C - 1) and skip inactive lanes outright: their l stays 0 and
+    # the finalize emits exact zeros (the flash kernel's fully-masked-row
+    # discipline). The DMA for skipped steps still lands (plain indexing
+    # + compute skip measured faster than index-map clamping for the
+    # flash kernel; the same trade holds here) — correctness never
+    # depends on it because masking is positional.
+    @pl.when((j * page <= p0 + C - 1) & (gate_ref[s] != 0))
+    def _step():
+        dt = _mxu_dtype(q_ref.dtype)
+        q = q_ref[0]                                       # (C, H, hd)
+        kpos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (CG, page), 1)
+        rowc = jax.lax.broadcasted_iota(jnp.int32, (CG, page), 0) // G
+        mask = kpos <= p0 + rowc
+        for h in range(Hkv):
+            # query heads h*G..(h+1)*G-1 share KV head h; fold (C, G)
+            # into the sublane axis so one matmul serves the group
+            qh = q[:, h * G:(h + 1) * G, :].reshape(CG, hd).astype(dt)
+            kh = k_ref[0, h].astype(dt)                    # (hd, page)
+            sc = _dot(qh, kh, ((1,), (0,)), dt) * sm_scale
+            sc = jnp.where(mask, sc, NEG_INF)
+            m_prev = m_scr[h][:, :1]
+            l_prev = l_scr[h][:, :1]
+            m_blk = jnp.max(sc, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_blk)
+            p = jnp.exp(sc - m_new)
+            # fully-masked-so-far rows sit at m ~ NEG_INF: zero their
+            # weights so l stays 0 and finalize maps them to output 0
+            p = jnp.where(sc <= NEG_INF / 2, 0.0, p)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+            vh = v_ref[0, h].astype(dt)                    # (page, hd)
+            acc_scr[h] = acc_scr[h] * corr + _dot(p.astype(dt), vh,
+                                                  ((1,), (0,)), dt)
+            m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
+            l_scr[h] = jnp.broadcast_to(l_new, l_scr[h].shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        for h in range(Hkv):
+            l = l_scr[h][:, :1]
+            o = jnp.where(l > 0, acc_scr[h] / jnp.where(l > 0, l, 1.0),
+                          0.0)
+            o_ref[0, :, h * G:(h + 1) * G, :] = \
+                o.reshape(C, G, hd).astype(o_ref.dtype)
+
+
+def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                    v_pool: jnp.ndarray, page_table: jnp.ndarray,
+                    positions: jnp.ndarray, *,
+                    active: Optional[jnp.ndarray] = None,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Paged decode/verify/chunk attention, streamed from the pool.
+
+    `q`: (S, C, H, hd) — C contiguous query tokens per slot (C=1 for
+    the decode step). `k_pool`/`v_pool`: (P+1, Hkv, hd, page) /
+    (P+1, Hkv, page, hd) — the resident pool layouts, page 0 = trash.
+    `page_table`: (S, n_pages) int32 pool page ids in logical order
+    (unallocated entries 0). `positions`: (S,) int32 — row c of slot s
+    attends to cache entries `<= positions[s] + c`, exactly
+    `cached_attention_step` (C=1, positions=pos) and
+    `cached_attention_chunk` (positions=first query position) over the
+    gathered view. `active`: optional (S,) bool — False lanes skip all
+    compute and emit zeros (their output is discarded downstream by the
+    engine's masking; the gather path computes garbage-but-finite
+    values for them instead, equally discarded).
+
+    Returns (S, C, H, hd) in q.dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, C, H, hd = q.shape
+    _, Hkv, _, page = k_pool.shape
+    n_pages = page_table.shape[1]
+    G = H // Hkv
+    sdt = _stat_dtype(q.dtype)
+    gate = jnp.ones((S,), jnp.int32) if active is None \
+        else jnp.asarray(active).astype(jnp.int32)
+    kernel = functools.partial(
+        _paged_kernel, page=page, C=C, G=G, Hkv=Hkv, hd=hd,
+        sm_scale=1.0 / float(hd) ** 0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, C, H, hd),
+                         lambda s, j, pt, p0, g: (s, 0, 0, 0)),
+            # THE page-table walk: the block index map dereferences the
+            # prefetched table, so the pipeline DMAs pool page
+            # `page_table[s, j]` straight into VMEM — no dense transient
+            pl.BlockSpec((1, Hkv, hd, page),
+                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, page, hd),
+                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C, H, hd),
+                               lambda s, j, pt, p0, g: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, C * G, hd), sdt),   # unnormalised output
+            pltpu.VMEM((Hkv, C * G, 128), sdt),  # running max m
+            pltpu.VMEM((Hkv, C * G, 128), sdt),  # running denom l
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, C, H, hd), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=_vmem_limit()),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), positions.astype(jnp.int32), gate,
+      q, k_pool, v_pool)
+
+
+def vmem_bytes_estimate(C: int, H: int, Hkv: int, hd: int, page: int,
+                        itemsize: int) -> int:
+    """Resident VMEM of one grid step: double-buffered q/K/V/out tiles
+    plus the f32 accumulator scratch. Used to decline shapes that
+    cannot fit under the generation-derived ceiling before Mosaic
+    discovers it mid-serving."""
+    CG = C * (H // Hkv)
+    tiles = 2 * itemsize * (2 * C * H * hd            # q + out
+                            + 2 * Hkv * hd * page)    # K + V page tiles
+    scratch = 4 * (Hkv * CG * hd + 2 * Hkv * CG * 128)
+    return tiles + scratch
+
+
+_probe_cache: dict = {}  # (dtype, C, H, Hkv, hd, page) -> verdict
+
+
+def _platform_supported() -> bool:
+    import os
+
+    if os.environ.get("DL4J_TPU_NO_PALLAS_PAGED_ATTENTION"):
+        return False  # forced gather fallback (A/B benches, tests)
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _eager_probe(dtype, C: int, H: int, Hkv: int, hd: int,
+                 page: int) -> bool:
+    """Compile + run the kernel once at this exact shape class on tiny
+    concrete pools, out of trace, and CHECK the output against the
+    gather+dense reference — the dispatch contract's parity-probed
+    variant: a toolchain that compiles-but-miscompiles falls back to
+    XLA instead of serving wrong tokens."""
+    import numpy as np
+
+    from deeplearning4j_tpu.ops.attention import (
+        cached_attention_chunk,
+        paged_gather,
+    )
+
+    S, n_pages = 2, 2
+    P = S * n_pages
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, C, H, hd)), dtype)
+    k_pool = jnp.asarray(
+        rng.standard_normal((P + 1, Hkv, hd, page)), dtype)
+    v_pool = jnp.asarray(
+        rng.standard_normal((P + 1, Hkv, page, hd)), dtype)
+    pt = jnp.asarray(1 + np.arange(P).reshape(S, n_pages), jnp.int32)
+    p0 = jnp.asarray([page - 1, 2 * page - 1], jnp.int32)
+    out = np.asarray(paged_attention(q, k_pool, v_pool, pt, p0))
+    kd, vd = paged_gather(k_pool, v_pool, pt)
+    qpos = p0[:, None] + jnp.arange(C)[None, :]
+    ref = np.asarray(jax.vmap(cached_attention_chunk)(q, kd, vd, qpos))
+    ref = ref.reshape(S, C, H, hd)
+    if not np.all(np.isfinite(out.astype(np.float32))):
+        return False
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-4
+    return bool(np.allclose(out.astype(np.float32),
+                            ref.astype(np.float32), atol=tol, rtol=tol))
+
+
+def paged_attention_or_none(q, k_pool, v_pool, page_table, positions,
+                            active=None) -> Optional[jnp.ndarray]:
+    """Dispatch probe (the reflective cuDNN-helper load): returns None
+    when the kernel can't serve this call — CPU backend, kill switch,
+    unsupported dtype, VMEM overflow at this shape — or when the shape
+    class failed its compile+parity probe. Callers fall back to
+    `paged_gather` + the dense step/chunk."""
+    S, C, H, hd = q.shape
+    _, Hkv, _, page = k_pool.shape
+    if not _platform_supported() \
+            or q.dtype not in (jnp.float32, jnp.bfloat16) \
+            or H % Hkv:
+        return None
+    est = vmem_bytes_estimate(C, H, Hkv, hd, page, q.dtype.itemsize)
+    if est > _vmem_limit():
+        logger.warning(
+            "pallas paged-attention declined: shape (C=%d, H=%d, Hkv=%d, "
+            "hd=%d, page=%d) needs ~%d MiB VMEM > %d MiB ceiling; using "
+            "the gather path", C, H, Hkv, hd, page, est >> 20,
+            _vmem_limit() >> 20)
+        return None
+    key = (jnp.dtype(q.dtype).name, C, H, Hkv, hd, page)
+    if not _probe_verdict(_probe_cache, key, _eager_probe,
+                          (q.dtype, C, H, Hkv, hd, page),
+                          "pallas paged-attention"):
+        return None
+    try:
+        return paged_attention(q, k_pool, v_pool, page_table, positions,
+                               active=active)
+    except Exception as e:  # per-shape staging failure: fall back
+        logger.warning("pallas paged-attention declined for shape %s "
+                       "(%s)", q.shape, e)
+        return None

@@ -14,8 +14,16 @@ each worker holds the full net and draws locally).
 
 The scope communicates the first global row index of the slice currently
 being processed; it is trace-time state (set while tracing the pipeline
-step), never runtime state. Outside any scope the offset is 0 — the
-single-device/global-view case.
+step), never runtime state. Outside any scope the offset is None and
+dropout specializes to ONE bulk draw (r6): the single-device and
+global-view-jit cases need no per-row stream — a single trace of the
+whole batch is partition-invariant by construction — and the per-row
+fold_in+vmap costs B extra threefry derivations per dropout site
+(measured each round as bench gpt_med's `dropout_rng_overhead_pct`).
+Enter `row_offset_scope(0)` around a single-device trace to opt into
+the partition-invariant per-row stream — how the pipeline parity tests
+(`tests/test_pipeline_wrapper.py`) and the dryrun 3-D tier pin
+same-seed mask equality between one device and a pipelined mesh.
 """
 from __future__ import annotations
 

@@ -20,10 +20,14 @@ Agrawal et al., 2024):
   cut into fixed-size pow-2 pages. Page 0 is a reserved trash page that
   absorbs masked writes from inactive slots; every other page is
   allocated to exactly one request at a time. A per-slot **page table**
-  `(S, n_pages_max)` lives on device; `ops.attention.paged_gather`
-  reassembles each slot's logical cache in position order, so the
-  attention numerics are EXACTLY the dense slotted step's numerics
-  (`cached_attention_step` runs unchanged on the gathered view).
+  `(S, n_pages_max)` lives on device; attention dispatches through
+  `ops.attention.paged_attention_step_auto` — on TPU the Pallas
+  paged-attention kernel (`ops/pallas_paged_attention.py`) walks the
+  page table IN PLACE, streaming pages from the pool with no dense
+  transient; on CPU (and under the probe/kill-switch fallback)
+  `ops.attention.paged_gather` reassembles each slot's logical cache
+  in position order and the attention numerics are EXACTLY the dense
+  slotted step's (`cached_attention_step` on the gathered view).
 - **memory-side admission control**: a request needs
   `ceil(span/page)` pages (span = padded prefill width or
   prompt+output, whichever is larger). Pages are allocated at
@@ -69,12 +73,15 @@ keep serving.
 
 **Parity guarantee**: the engine traces the SAME per-block helpers as
 `generate` (`models.transformer.GPTPlan`/`_block_heads`/`_block_ffn`/
-`_prefill_block_attention`/`_prefill_chunk_block_attention`/
-`cached_attention_step`), and the paged gather reassembles caches in
-logical-position order, so slotted greedy decode reproduces whole-batch
-`generate` argmax-exactly at f32 for the same prompts, regardless of
-admission order, page/slot reuse, or prefill chunking (asserted in
-`tests/test_serving_generate.py`).
+`_prefill_block_attention`/`cached_attention_step`-semantics via the
+paged dispatch), and the paged storage is reassembled (fallback) or
+walked (kernel) in logical-position order, so slotted greedy decode
+reproduces whole-batch `generate` argmax-exactly at f32 for the same
+prompts, regardless of admission order, page/slot reuse, or prefill
+chunking (asserted in `tests/test_serving_generate.py`; the kernel-vs-
+gather parity is pinned in `tests/test_pallas_paged_attention.py` and
+by the dispatch probe itself, which checks numerics before trusting
+the kernel).
 
 **Latency tier (PR 8)** — two opt-in mechanisms compose on top:
 
@@ -413,12 +420,11 @@ class DecodeEngine:
             _block_ffn,
             _block_heads,
             _prefill_block_attention,
-            _prefill_chunk_block_attention,
             _sample_logits,
         )
         from deeplearning4j_tpu.ops.attention import (
-            cached_attention_step,
-            paged_gather,
+            paged_attention_chunk_auto,
+            paged_attention_step_auto,
         )
 
         plan = GPTPlan(net)
@@ -529,8 +535,13 @@ class DecodeEngine:
                 kp_, vp_ = caches[bi]
                 kp_ = kp_.at[pids, :, :, loff].set(k)
                 vp_ = vp_.at[pids, :, loff, :].set(v)
-                kd, vd = paged_gather(kp_, vp_, page_table)
-                att = cached_attention_step(q, kd, vd, pos)
+                # kernel-dispatched paged attention: on TPU the Pallas
+                # kernel streams pages straight from the pool (no dense
+                # gather transient — the decode path's dominant cache-
+                # byte cost halves); on CPU/fallback the gather + dense
+                # step reference numerics run unchanged
+                att = paged_attention_step_auto(q, kp_, vp_, page_table,
+                                                pos, active)
                 att = att @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
                 new_caches.append((kp_, vp_))
@@ -656,12 +667,14 @@ class DecodeEngine:
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, C)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, C, hd)
                 kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids, woff)
-                # gather AFTER the write: the chunk attends to itself
+                # attend AFTER the write: the chunk attends to itself
                 # through the cache, which is exactly causal with the
-                # <= qpos mask
-                kd, vd = paged_gather(kp_, vp_, page_row[None])
-                att = _prefill_chunk_block_attention(layer, q, kd[0],
-                                                     vd[0], qpos)
+                # <= qpos mask; the auto path walks the slot's page row
+                # in place on TPU and falls back to gather + chunk
+                # (`_prefill_chunk_block_attention` numerics) elsewhere
+                att = paged_attention_chunk_auto(q, kp_, vp_,
+                                                 page_row[None],
+                                                 off[None])
                 d = x.shape[-1]
                 att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)

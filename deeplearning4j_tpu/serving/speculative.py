@@ -98,13 +98,11 @@ class SpeculativeDecoder:
             _block_ffn,
             _block_heads,
             _prefill_block_attention,
-            _prefill_chunk_block_attention,
             _top_k_filter,
-            _verify_block_attention,
         )
         from deeplearning4j_tpu.ops.attention import (
-            cached_attention_step,
-            paged_gather,
+            paged_attention_chunk_auto,
+            paged_attention_step_auto,
         )
         from deeplearning4j_tpu.serving.decode_engine import _write_pages
 
@@ -192,9 +190,8 @@ class SpeculativeDecoder:
                 vrow = jnp.transpose(vh, (0, 2, 1, 3))
                 kp_, vp_ = _write_pages(kp_, vp_, kcol, vrow, wpids, woff,
                                         page)
-                kd, vd = paged_gather(kp_, vp_, page_row[None])
-                att = _prefill_chunk_block_attention(layer, q, kd[0], vd[0],
-                                                     qpos)
+                att = paged_attention_chunk_auto(q, kp_, vp_,
+                                                 page_row[None], off[None])
                 d = x.shape[-1]
                 att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
@@ -236,8 +233,9 @@ class SpeculativeDecoder:
                     kp_, vp_ = caches[bi]
                     kp_ = kp_.at[pids, :, :, loff].set(kh)
                     vp_ = vp_.at[pids, :, loff, :].set(vh)
-                    kd, vd = paged_gather(kp_, vp_, page_table)
-                    att = cached_attention_step(q, kd, vd, p_j)
+                    att = paged_attention_step_auto(q, kp_, vp_,
+                                                    page_table, p_j,
+                                                    active)
                     att = att @ p["Wo"] + p["bo"]
                     x = _block_ffn(layer, p, x + att)
                     new_caches.append((kp_, vp_))
@@ -288,8 +286,11 @@ class SpeculativeDecoder:
                     loff = wpos % page
                     kp_ = kp_.at[pids, :, :, loff].set(kh[:, j])
                     vp_ = vp_.at[pids, :, loff, :].set(vh[:, j])
-                kd, vd = paged_gather(kp_, vp_, page_table)
-                att = _verify_block_attention(layer, q, kd, vd, qpos)
+                # one (k+1)-wide paged chunk per slot: the kernel walks
+                # the page table in place; the fallback is exactly
+                # `_verify_block_attention` (gather + vmapped chunk)
+                att = paged_attention_chunk_auto(q, kp_, vp_, page_table,
+                                                 pos, active)
                 att = att @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
                 new_caches.append((kp_, vp_))
