@@ -229,7 +229,8 @@ class EntryPoint:
         self._model(name)  # raises the canonical "no model" KeyError
         srv = self._live_server(name)
         if srv is None:
-            raise RuntimeError(
+            from deeplearning4j_tpu.serving import ServingError
+            raise ServingError(
                 f"model {name!r} has no ModelServer — construct the "
                 "gateway with serving={...} to enable the serving tier")
         return srv
@@ -338,7 +339,8 @@ class EntryPoint:
         per-replica model versions."""
         srv = self._server(name)
         if not hasattr(srv, "rolling_reload"):
-            raise RuntimeError(
+            from deeplearning4j_tpu.serving import ServingError
+            raise ServingError(
                 f"model {name!r} is served by a single ModelServer — "
                 "rolling_reload needs serving={'replicas': N} (N > 1); "
                 "use reload_model instead")
@@ -355,7 +357,8 @@ class EntryPoint:
         rolling_reloads, ...)."""
         srv = self._server(name)
         if not hasattr(srv, "rolling_reload"):
-            raise RuntimeError(
+            from deeplearning4j_tpu.serving import ServingError
+            raise ServingError(
                 f"model {name!r} is served by a single ModelServer — "
                 "pool_stats needs serving={'replicas': N} (N > 1); use "
                 "server_stats instead")
@@ -396,7 +399,7 @@ class GatewayServer:
     @property
     def port(self) -> int:
         if self._server is None:
-            raise RuntimeError("server not started")
+            raise GatewayError("server not started")
         return self._server.server_address[1]
 
     def start(self) -> "GatewayServer":
@@ -455,6 +458,10 @@ class GatewayServer:
                         params = decode_value(req.get("params", {}))
                         resp = {"id": req_id,
                                 "result": encode_value(method(**params))}
+                    # graftlint: disable=typed-error  RPC boundary: any
+                    # server-side failure, typed or not, must be serialized
+                    # to the client as a wire error (error_type/retry_after
+                    # travel alongside), never crash the connection thread
                     except Exception as e:  # surfaced to the client
                         resp = {"id": req_id,
                                 "error": f"{type(e).__name__}: {e}",

@@ -859,6 +859,9 @@ class ComputationGraph:
                                                   iteration)
                 return p_new, u_new, loss
 
+            # graftlint: disable=recompile  compiled once per pretraining
+            # LAYER (the closure binds the layer), then reused across the
+            # whole epoch loop below — not a per-iteration retrace
             jstep = jax.jit(step)
             # rng stream mirrors MultiLayerNetwork.pretrain exactly
             # (PRNGKey(seed + layer_position) folded by iteration) so a

@@ -1082,6 +1082,9 @@ class MultiLayerNetwork:
                 p_new, u_new = apply_layer_update(layer, u_i, p_i, g, iteration)
                 return p_new, u_new, loss
 
+            # graftlint: disable=recompile  compiled once per pretraining
+            # LAYER (the closure binds the layer), then reused across the
+            # whole epoch loop below — not a per-iteration retrace
             jstep = jax.jit(step)
             it_count = 0
             for _ in range(epochs):

@@ -591,9 +591,9 @@ def _eager_probe(dtype, bb, H, masked: bool = False) -> bool:
     training step itself near HBM capacity). `masked` probes the masked
     kernel pair instead."""
     T = 2
-    k = jax.random.PRNGKey(0)
-    xw = jax.random.normal(k, (T, bb, 4 * H), dtype)
-    rw = jax.random.normal(k, (H, 4 * H), dtype) * 0.05
+    kx, kr = jax.random.split(jax.random.PRNGKey(0))
+    xw = jax.random.normal(kx, (T, bb, 4 * H), dtype)
+    rw = jax.random.normal(kr, (H, 4 * H), dtype) * 0.05
     peep = jnp.zeros((3, H), dtype)
     z = jnp.zeros((bb, H), dtype)
 

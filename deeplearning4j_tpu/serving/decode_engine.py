@@ -119,6 +119,7 @@ from deeplearning4j_tpu.serving.model_server import (
     ServiceUnavailableError,
     ServingError,
 )
+from deeplearning4j_tpu.util.concurrency import assert_owned
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -365,41 +366,41 @@ class DecodeEngine:
         self._prompt_buckets = tuple(sorted(set(int(b) for b in
                                                 prompt_buckets)))
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()
-        self._slots: List[Optional[_GenRequest]] = [None] * n_slots
-        self._closed = False
-        self._kill = False
-        self._draining = False
-        self._swap_net = None
-        self._swap_in_progress = False
-        self._swap_error: Optional[BaseException] = None
+        self._queue: collections.deque = collections.deque()  # guarded by: _cond
+        self._slots: List[Optional[_GenRequest]] = [None] * n_slots  # guarded by: _cond
+        self._closed = False  # guarded by: _cond
+        self._kill = False  # guarded by: _cond
+        self._draining = False  # guarded by: _cond
+        self._swap_net = None  # guarded by: _cond
+        self._swap_in_progress = False  # guarded by: _cond
+        self._swap_error: Optional[BaseException] = None  # guarded by: _cond
         self._swap_done = threading.Event()
-        self._step_ewma = 0.01
-        self._pages_demand_queued = 0
+        self._step_ewma = 0.01  # guarded by: _cond
+        self._pages_demand_queued = 0  # guarded by: _cond
         # counters (observable state for tests/telemetry)
-        self.submitted = 0
-        self.served = 0
-        self.shed_overload = 0
-        self.shed_out_of_pages = 0
-        self.shed_deadline = 0
-        self.shed_unavailable = 0
-        self.failures = 0
-        self.prefills = 0
-        self.prefill_chunks = 0
-        self.decode_steps = 0
-        self.active_slot_steps = 0
-        self.tokens_generated = 0
-        self.pages_in_use_peak = 0
-        self.swaps = 0
+        self.submitted = 0  # guarded by: _cond
+        self.served = 0  # guarded by: _cond
+        self.shed_overload = 0  # guarded by: _cond
+        self.shed_out_of_pages = 0  # guarded by: _cond
+        self.shed_deadline = 0  # guarded by: _cond
+        self.shed_unavailable = 0  # guarded by: _cond
+        self.failures = 0  # guarded by: _cond
+        self.prefills = 0  # guarded by: _cond
+        self.prefill_chunks = 0  # guarded by: _cond
+        self.decode_steps = 0  # guarded by: _cond
+        self.active_slot_steps = 0  # guarded by: _cond
+        self.tokens_generated = 0  # guarded by: _cond
+        self.pages_in_use_peak = 0  # guarded by: _cond
+        self.swaps = 0  # guarded by: _cond
         # latency-tier counters (prefix cache + speculative decoding)
-        self.prompt_tokens = 0
-        self.prefix_hits = 0
-        self.prefix_misses = 0
-        self.prefix_hit_tokens = 0
-        self.spec_steps = 0
-        self.spec_proposed = 0
-        self.spec_accepted = 0
-        self.spec_emitted = 0
+        self.prompt_tokens = 0  # guarded by: _cond
+        self.prefix_hits = 0  # guarded by: _cond
+        self.prefix_misses = 0  # guarded by: _cond
+        self.prefix_hit_tokens = 0  # guarded by: _cond
+        self.spec_steps = 0  # guarded by: _cond
+        self.spec_proposed = 0  # guarded by: _cond
+        self.spec_accepted = 0  # guarded by: _cond
+        self.spec_emitted = 0  # guarded by: _cond
         self._build(net)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-engine-scheduler")
@@ -722,7 +723,8 @@ class DecodeEngine:
 
             pc_kw = {} if self._prefix_cache_cfg is True \
                 else dict(self._prefix_cache_cfg)
-            self._prefix_cache = PrefixCache(page, **pc_kw)
+            self._prefix_cache = PrefixCache(page, **pc_kw) \
+                .bind_guard(self._cond)
         self._spec = None
         if self._speculative_cfg is not None:
             from deeplearning4j_tpu.serving.speculative import (
@@ -770,15 +772,19 @@ class DecodeEngine:
                            jnp.zeros((P + 1, Hkv, page, hd), plan.cdt)))
         self._caches = caches
         self._page_table = jnp.zeros((S, self._n_pages_max), jnp.int32)
-        self._free_pages = list(range(P, 0, -1))
         self._tok = jnp.zeros((S,), jnp.int32)
         self._pos = jnp.zeros((S,), jnp.int32)
         self._keys = jnp.stack([jax.random.PRNGKey(i) for i in range(S)])
         self._temps = jnp.zeros((S,), jnp.float32)
-        self._active = np.zeros((S,), bool)
-        if self._prefix_cache is not None:
-            # the pools just rebuilt: every cached page id is stale
-            self._prefix_cache.clear()
+        # the free list and the active mask are read by submit()/stats()
+        # on caller threads — publish the rebuilt state under the lock
+        # (the device arrays above are scheduler-thread-owned)
+        with self._cond:
+            self._free_pages = list(range(P, 0, -1))  # guarded by: _cond
+            self._active = np.zeros((S,), bool)  # guarded by: _cond
+            if self._prefix_cache is not None:
+                # the pools just rebuilt: every cached page id is stale
+                self._prefix_cache.clear()
         if self._spec is not None:
             self._spec.reset_state()
 
@@ -822,6 +828,7 @@ class DecodeEngine:
         free list; shared (cached) pages only lose this request's
         refcount — the cache keeps them resident until LRU reclaim, and
         a prefix another slot still shares is never freed here."""
+        assert_owned(self._cond, "DecodeEngine._free_request_pages_locked")
         if req.nodes:
             self._prefix_cache.release(req.nodes)
             req.nodes = None
@@ -834,6 +841,7 @@ class DecodeEngine:
         covered pages into the prefix cache so the NEXT same-prefix
         request shares them (the request itself keeps decoding on them;
         page ownership moves to the cache, refcounted)."""
+        assert_owned(self._cond, "DecodeEngine._promote_prefix_locked")
         if self._prefix_cache is None or req.pages is None:
             return
         req.nodes, freed = self._prefix_cache.insert(req.prompt, req.pages,
@@ -1107,6 +1115,9 @@ class DecodeEngine:
                 self._step_prefills()
                 self._step_active()
                 self._maybe_swap()
+            # graftlint: disable=typed-error  scheduler firewall: the
+            # iteration's failure is converted to InferenceFailedError and
+            # fails all in-flight requests; the loop itself must survive
             except BaseException:  # scheduler must never die silently
                 logger.exception("decode engine: scheduler iteration "
                                  "failed; failing in-flight requests")
@@ -1119,6 +1130,7 @@ class DecodeEngine:
         """A scheduler exit (shutdown/kill) with a drain pending must
         release the `drain_and_swap` caller — a reload blocked forever
         on a dead scheduler would also pin the ModelServer reload lock."""
+        assert_owned(self._cond, "DecodeEngine._abort_pending_swap_locked")
         if self._draining or self._swap_net is not None:
             self._swap_net = None
             self._draining = False
@@ -1134,6 +1146,7 @@ class DecodeEngine:
         return bool(self._queue) and not self._draining
 
     def _fail_all_locked(self, err: BaseException) -> None:
+        assert_owned(self._cond, "DecodeEngine._fail_all_locked")
         while self._queue:
             req = self._queue.popleft()
             self._pages_demand_queued -= req.n_pages
@@ -1151,6 +1164,7 @@ class DecodeEngine:
                 req.finish(err)
         self._cond.notify_all()
 
+    # graftlint: hot-loop
     def _admit(self) -> None:
         """Move queued requests into free slots. Expired queued requests
         are shed BEFORE any device work. The queue head waits (FIFO)
@@ -1254,9 +1268,13 @@ class DecodeEngine:
                 continue
             try:
                 self._prefill_into(slot, req)
+            # graftlint: disable=typed-error  converts to a typed failure:
+            # _prefill_failure wraps non-ServingError causes in
+            # InferenceFailedError and fails only the one request
             except BaseException as e:
                 self._prefill_failure(slot, req, e, attached=False)
 
+    # graftlint: hot-loop
     def _prefill_into(self, slot: int, req: _GenRequest) -> None:
         import jax
         import jax.numpy as jnp
@@ -1308,6 +1326,7 @@ class DecodeEngine:
             self._slots[slot] = req
             self._active[slot] = True
 
+    # graftlint: hot-loop
     def _step_prefills(self) -> None:
         """Drive pending chunked prefills, at most
         `prefill_chunk_budget` chunk dispatches per scheduler
@@ -1323,6 +1342,7 @@ class DecodeEngine:
             self._prefill_chunk_into(s, req)
             budget -= 1
 
+    # graftlint: hot-loop
     def _prefill_chunk_into(self, slot: int, req: _GenRequest) -> None:
         import jax
         import jax.numpy as jnp
@@ -1378,6 +1398,9 @@ class DecodeEngine:
             if self._spec is not None:
                 _dispatched(lambda: self._spec.prefill_chunk(
                     self._page_table[slot], ids, off, woff, pids))
+        # graftlint: disable=typed-error  converts to a typed failure:
+        # _prefill_failure wraps non-ServingError causes in
+        # InferenceFailedError and fails only the one request
         except BaseException as e:
             self._prefill_failure(slot, req, e, attached=True)
             return
@@ -1466,6 +1489,7 @@ class DecodeEngine:
             self.breaker.record_success(req.probe)
         req.finish()
 
+    # graftlint: hot-loop
     def _expire_in_flight(self) -> None:
         """An expired in-flight request (decoding OR mid-prefill) frees
         its slot and pages immediately — the next queued request takes
@@ -1563,6 +1587,7 @@ class DecodeEngine:
                 "(donated buffers)"))
             self._reset_device_state()
 
+    # graftlint: hot-loop
     def _retire_or_poison(self, s: int, req: _GenRequest, toks, oks,
                           n_steps: int) -> None:
         """Consume one slot's emitted tokens from a decode/verify
@@ -1600,6 +1625,7 @@ class DecodeEngine:
         elif done:
             self._retire(s, req)
 
+    # graftlint: hot-loop
     def _step_active_spec(self, live) -> bool:
         """One speculative iteration: draft proposes k tokens per slot,
         the target verifies them in one batched chunk — up to k+1
@@ -1644,6 +1670,9 @@ class DecodeEngine:
 
             out, n_emit, oks = _dispatched(run)
             self._hook("post_decode", info)
+        # graftlint: disable=typed-error  converts to a typed failure:
+        # _decode_failure wraps the cause in InferenceFailedError for the
+        # affected slots and recovers the pool
         except BaseException as e:
             self._decode_failure(live, e)
             return True
@@ -1677,6 +1706,7 @@ class DecodeEngine:
             self.spec_emitted += delivered
         return True
 
+    # graftlint: hot-loop
     def _step_active(self) -> None:
         import jax.numpy as jnp
 
@@ -1717,6 +1747,9 @@ class DecodeEngine:
 
             toks, oks = _dispatched(run)
             self._hook("post_decode", info)
+        # graftlint: disable=typed-error  converts to a typed failure:
+        # _decode_failure wraps the cause in InferenceFailedError for the
+        # affected slots and recovers the pool
         except BaseException as e:
             self._decode_failure(live, e)
             return
@@ -1734,6 +1767,7 @@ class DecodeEngine:
             # pages are untouched)
             self._retire_or_poison(s, req, toks[:, s], oks[:, s], n_steps)
 
+    # graftlint: hot-loop
     def _maybe_swap(self) -> None:
         if not self._draining:
             return
@@ -1784,8 +1818,12 @@ class DecodeEngine:
                     f"{r.n_tokens}) no longer fits the swapped engine's "
                     f"max_len {self.max_len} / {self.pool_pages}-page "
                     "pool"))
+        # graftlint: disable=typed-error  deliberate absorb: a rejected
+        # swap keeps the OLD weights serving; the error is stored for
+        # drain_and_swap's caller to re-raise
         except BaseException as e:
-            self._swap_error = e
+            with self._cond:
+                self._swap_error = e
             logger.warning("decode engine: weight swap rejected (%s); "
                            "old weights still serving", e)
         finally:

@@ -80,6 +80,8 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.util.concurrency import assert_owned
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -254,6 +256,7 @@ class CircuitBreaker:
             self._probe_in_flight = False
 
     def _reject_open_locked(self) -> None:
+        assert_owned(self._lock, "CircuitBreaker._reject_open_locked")
         if self._state == "open":
             remaining = max(
                 0.0, self.reset_timeout
@@ -400,7 +403,7 @@ class ModelServer:
             raise ValueError("max_concurrent must be >= 1")
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
-        self._net = net
+        self._net = net  # guarded by: _rwlock.write()
         self.max_queue = max_queue
         self.max_batch_size = max_batch_size
         self.batch_window = batch_window
@@ -409,38 +412,38 @@ class ModelServer:
         self.infer_hooks: List[Callable] = list(infer_hooks)
         self.breaker = CircuitBreaker(failure_threshold=breaker_threshold,
                                       reset_timeout=breaker_reset_timeout)
-        self._canary = None if canary is None else np.asarray(canary)
+        self._canary = None if canary is None else np.asarray(canary)  # guarded by: _cond
         # with auto_canary, the first successfully-served request donates
         # its leading row as the reload-validation batch — a server that
         # has taken traffic can always validate a candidate
         self.auto_canary = auto_canary
         self._rwlock = _RWLock()
         self._reload_lock = threading.Lock()
-        self.model_version = 0
+        self.model_version = 0  # guarded by: _rwlock.write()
         # queue machinery: a deque under one condition (executors need to
         # peek deadlines and pop several compatible requests per batch,
         # which queue.Queue cannot express)
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()
-        self._in_flight = 0
-        self._closed = False
-        self._step_latency_ewma = 0.01  # retry_after hint seed
+        self._queue: collections.deque = collections.deque()  # guarded by: _cond
+        self._in_flight = 0  # guarded by: _cond
+        self._closed = False  # guarded by: _cond
+        self._step_latency_ewma = 0.01  # guarded by: _cond (retry_after hint seed)
         # generation tier: DecodeEngine kwargs (or {} for defaults);
         # the engine itself is built lazily on the first generate() so a
         # predict-only server never pays for it
         self._generation_cfg = {} if generation is True else generation
-        self._engine = None
+        self._engine = None  # guarded by: _engine_lock
         self._engine_lock = threading.Lock()
         # counters (observable state for tests/telemetry)
-        self.served = 0          # requests completed successfully
-        self.batches = 0         # device steps dispatched
-        self.rows_dispatched = 0  # rows across dispatched micro-batches
-        self.shed_overload = 0   # rejected at admission (queue full)
-        self.shed_deadline = 0   # expired before the device step
-        self.shed_unavailable = 0  # rejected by the open breaker
-        self.failures = 0        # requests failed by a bad device step
-        self.reloads = 0
-        self.reload_rejections = 0
+        self.served = 0          # guarded by: _cond — requests completed
+        self.batches = 0         # guarded by: _cond — device steps dispatched
+        self.rows_dispatched = 0  # guarded by: _cond — rows across micro-batches
+        self.shed_overload = 0   # guarded by: _cond — rejected at admission
+        self.shed_deadline = 0   # guarded by: _cond — expired pre device step
+        self.shed_unavailable = 0  # guarded by: _cond — open-breaker rejects
+        self.failures = 0        # guarded by: _cond — bad device steps
+        self.reloads = 0  # guarded by: _reload_lock
+        self.reload_rejections = 0  # guarded by: _cond
         self._threads = [
             threading.Thread(target=self._serve_loop, daemon=True,
                              name=f"model-server-exec-{i}")
@@ -633,7 +636,7 @@ class ModelServer:
     # -- generation (continuous batching) ----------------------------------
     def _ensure_engine(self):
         if self._generation_cfg is None:
-            raise RuntimeError(
+            raise ServingError(
                 "generation serving is not enabled — construct the server "
                 "with generation={...} (DecodeEngine kwargs) or "
                 "generation=True")
@@ -673,7 +676,7 @@ class ModelServer:
                                timeout=timeout)
 
     # -- batch assembly ----------------------------------------------------
-    def _pop_expired(self, req: _Request, now: float) -> bool:
+    def _pop_expired(self, req: _Request, now: float) -> bool:  # graftlint: holds _cond
         if req.expired(now):
             self.shed_deadline += 1
             req.finish(error=DeadlineExceededError(
@@ -780,6 +783,9 @@ class ModelServer:
                 continue
             try:
                 results = self._execute(live)
+            # graftlint: disable=typed-error  serve-loop firewall: the
+            # failure is converted to InferenceFailedError and delivered to
+            # every waiter below — re-raising would kill the serving thread
             except BaseException as e:
                 self.breaker.record_failure(probe)
                 with self._cond:
@@ -793,6 +799,7 @@ class ModelServer:
             self.breaker.record_success(probe)
             self._finish(live, results=results)
 
+    # graftlint: hot-loop
     def _execute(self, batch: List[_Request]) -> List[np.ndarray]:
         from deeplearning4j_tpu.optimize.health import non_finite_array_reason
 
@@ -824,7 +831,11 @@ class ModelServer:
             raise InferenceFailedError(
                 f"model produced poisoned predictions: {reason}")
         if self._canary is None and self.auto_canary:
-            self._canary = np.array(batch[0].features[:1])
+            # a concurrent executor may be donating its own row; the
+            # first publication under the lock wins
+            with self._cond:
+                if self._canary is None:
+                    self._canary = np.array(batch[0].features[:1])
         results, lo = [], 0
         for req in batch:
             hi = lo + req.features.shape[0]
@@ -944,6 +955,9 @@ class ModelServer:
                 "(non-finite parameters or a numerically broken graph)")
         try:
             live_out = np.asarray(self._net.output(canary))
+        # graftlint: disable=typed-error  deliberate absorb: the LIVE
+        # model failing the canary must not block reloading a good
+        # candidate — the width contract check is simply skipped
         except Exception:
             live_out = None  # live model can't serve the canary; skip the
         if live_out is not None \

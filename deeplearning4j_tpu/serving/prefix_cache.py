@@ -44,6 +44,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.util.concurrency import assert_owned
+
 
 class _PrefixNode:
     """One cached page of prompt KV: `page_id` in the engine's pool,
@@ -93,14 +95,24 @@ class PrefixCache:
             raise ValueError("max_pages must be >= 0 (or None)")
         self.page_size = page_size
         self.max_pages = max_pages
-        self._nodes: dict = {}   # (parent_seq, digest) -> _PrefixNode
-        self._seq = 0
-        self._clock = 0
+        # the owner's lock (`bind_guard`); None until bound. Mutating
+        # methods assert the calling thread holds it (under tests)
+        self._guard = None
+        self._nodes: dict = {}   # guarded by: _guard [external] — (parent_seq, digest) -> _PrefixNode
+        self._seq = 0  # guarded by: _guard [external]
+        self._clock = 0  # guarded by: _guard [external]
         # structural counters (hit/miss/token accounting lives on the
         # engine, which counts once per BINDING — a page-blocked queue
         # head re-runs lookup every scheduler iteration)
-        self.insertions = 0
-        self.evictions = 0
+        self.insertions = 0  # guarded by: _guard [external]
+        self.evictions = 0  # guarded by: _guard [external]
+
+    def bind_guard(self, lock) -> "PrefixCache":
+        """Register the owner's lock. Every mutating method then runs
+        `assert_owned` against it under tests, turning a silently-racy
+        unlocked call into a hard failure."""
+        self._guard = lock
+        return self
 
     # -- introspection -----------------------------------------------------
     @property
@@ -131,6 +143,7 @@ class PrefixCache:
         owned list memoizing the prompt's per-chunk digests — a page-
         blocked queue head re-runs lookup every scheduler iteration,
         and the prompt is immutable, so hashing it once is enough."""
+        assert_owned(self._guard, "PrefixCache.lookup")
         page = self.page_size
         t0 = int(prompt.shape[0])
         out: List[_PrefixNode] = []
@@ -155,10 +168,12 @@ class PrefixCache:
         return out
 
     def acquire(self, nodes: List[_PrefixNode]) -> None:
+        assert_owned(self._guard, "PrefixCache.acquire")
         for node in nodes:
             node.requests += 1
 
     def release(self, nodes: List[_PrefixNode]) -> None:
+        assert_owned(self._guard, "PrefixCache.release")
         for node in nodes:
             node.requests -= 1
             assert node.requests >= 0, "prefix-cache refcount underflow"
@@ -180,6 +195,7 @@ class PrefixCache:
         transfers to the cache), and the page ids of any nodes evicted
         to respect `max_pages` — the CALLER must return those to its
         free list, or each cap-driven eviction would leak a pool page."""
+        assert_owned(self._guard, "PrefixCache.insert")
         page = self.page_size
         t0 = int(prompt.shape[0])
         cacheable = t0 // page  # pages fully covered by the prompt
@@ -243,6 +259,7 @@ class PrefixCache:
         caching from ever shrinking effective pool capacity. Pinned
         pages (bound requests or interior chain nodes) are never
         touched."""
+        assert_owned(self._guard, "PrefixCache.reclaim")
         freed: List[int] = []
         while len(freed) < n_pages:
             pid = self._evict_one()
@@ -256,4 +273,5 @@ class PrefixCache:
         its free list wholesale after a pool rebuild — weight swap or
         post-failure recovery — which is the only time this runs). A
         stale page can never serve new weights."""
+        assert_owned(self._guard, "PrefixCache.clear")
         self._nodes.clear()

@@ -90,6 +90,7 @@ from deeplearning4j_tpu.serving.model_server import (
     ServiceUnavailableError,
     ServingError,
 )
+from deeplearning4j_tpu.util.concurrency import assert_owned
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -165,7 +166,7 @@ class ReplicaPool:
         self._replicas: List[_Replica] = [
             _Replica(i, srv) for i, srv in enumerate(replicas)]
         self._probe_batch = None if probe_batch is None \
-            else np.asarray(probe_batch)
+            else np.asarray(probe_batch)  # guarded by: _lock
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.watchdog_timeout = watchdog_timeout
@@ -188,24 +189,24 @@ class ReplicaPool:
         self.default_timeout = default_timeout
         self._lock = threading.Lock()
         self._rr = itertools.count()  # round-robin tiebreak
-        self._in_flight = 0
-        self._closed = False
+        self._in_flight = 0  # guarded by: _lock
+        self._closed = False  # guarded by: _lock
         # EWMA of successful predict latency + its absolute deviation:
         # the auto hedge delay is ewma + 4·dev, a cheap p95-style upper
         # bound that adapts to the model without a histogram
-        self._lat_ewma = 0.05
-        self._lat_dev = 0.025
+        self._lat_ewma = 0.05  # guarded by: _lock
+        self._lat_dev = 0.025  # guarded by: _lock
         # pool counters (the stats()/gateway contract)
-        self.served = 0
-        self.failovers = 0
-        self.hedges_fired = 0
-        self.hedge_wins = 0
-        self.evictions = 0
-        self.readmissions = 0
-        self.rolling_reloads = 0
-        self.rollbacks = 0
-        self.shed_overload = 0
-        self.shed_unavailable = 0
+        self.served = 0  # guarded by: _lock
+        self.failovers = 0  # guarded by: _lock
+        self.hedges_fired = 0  # guarded by: _lock
+        self.hedge_wins = 0  # guarded by: _lock
+        self.evictions = 0  # guarded by: _lock
+        self.readmissions = 0  # guarded by: _lock
+        self.rolling_reloads = 0  # guarded by: _lock
+        self.rollbacks = 0  # guarded by: _lock
+        self.shed_overload = 0  # guarded by: _lock
+        self.shed_unavailable = 0  # guarded by: _lock
         self._reload_lock = threading.Lock()
         self._probe_wake = threading.Event()
         self._probe_thread = threading.Thread(
@@ -332,6 +333,7 @@ class ReplicaPool:
                 self._lat_dev = 0.8 * self._lat_dev + 0.2 * err
 
     def _evict_locked(self, rep: _Replica, reason: str) -> None:
+        assert_owned(self._lock, "ReplicaPool._evict_locked")
         if rep.state != "healthy":
             return
         rep.state = "evicted"
@@ -380,7 +382,11 @@ class ReplicaPool:
         # prove recovery — probes would stay inconclusive forever and
         # degraded mode would need an operator after all
         if self._probe_batch is None:
-            self._probe_batch = np.array(np.asarray(x)[:1])
+            # copy outside the lock; first publication under it wins
+            armed = np.array(np.asarray(x)[:1])
+            with self._lock:
+                if self._probe_batch is None:
+                    self._probe_batch = armed
         return out
 
     def __call__(self, x, timeout: Optional[float] = None) -> np.ndarray:
@@ -496,6 +502,9 @@ class ReplicaPool:
             t0 = time.monotonic()
             try:
                 out = rep.server.predict(x, timeout=timeout)
+            # graftlint: disable=typed-error  hedge worker: the failure
+            # becomes this lane's outcome (classified retryable/fatal by
+            # the racer below), never an unhandled thread death
             except BaseException as e:
                 # note here, win or lose the race: sickness counts
                 # toward eviction, queue-full/deadline are load/time
@@ -649,6 +658,9 @@ class ReplicaPool:
             try:
                 verdict[0] = rep.server.probe(batch,
                                               timeout=probe_timeout)
+            # graftlint: disable=typed-error  probe worker: any failure
+            # (hang, crash, typed shed) means one thing — unhealthy; the
+            # verdict is the only channel out of this watchdog thread
             except BaseException:
                 verdict[0] = False
             done.set()
@@ -778,6 +790,9 @@ class ReplicaPool:
                         old_net = rep.server.net
                         try:
                             rep.server.reload(source, step=step)
+                        # graftlint: disable=typed-error  best-effort
+                        # catch-up reload of an evicted replica: failure
+                        # marks it stale for the next readmission probe
                         except BaseException as e:
                             with self._lock:
                                 if not rep.stale:

@@ -333,6 +333,10 @@ class SpeculativeDecoder:
             res_draws = jax.vmap(
                 lambda kx, lg: jax.random.categorical(kx, lg, axis=-1))(
                     kr, rlog).astype(jnp.int32)             # (S, k)
+            # graftlint: disable=rng-reuse  deliberate: res_draws and
+            # full_draws are mutually exclusive per row (jnp.where picks
+            # one), so reusing kr keeps the accepted draw identical to the
+            # single-sample rejection-sampling recurrence
             full_draws = jax.vmap(
                 lambda kx, lg: jax.random.categorical(kx, lg, axis=-1))(
                     kr, scaled.astype(jnp.float32)).astype(jnp.int32)

@@ -1,0 +1,36 @@
+"""True negatives for the donation rule: every read of a donated name
+is preceded by a rebind, or the branch structure makes reuse impossible."""
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda params, batch: (params, batch), donate_argnums=(0,))
+
+
+def rebind_same_statement(params, batch):
+    # the engine idiom: donate and reassign in one tuple assignment
+    params, out = step(params, batch)
+    return params + out
+
+
+def rebind_then_read(params, batch):
+    new = step(params, batch)
+    params = new[0]
+    return params
+
+
+def loop_with_rebind(params, batches):
+    for batch in batches:
+        params, _ = step(params, batch)
+    return params
+
+
+def guarded_branches(params, batch, fast):
+    if fast:
+        _ = step(params, batch)
+        return jnp.zeros(())
+    return params  # the donating branch returned above
+
+
+def undonated_arg(params, batch):
+    _ = step(params, batch)
+    return batch  # only argument 0 is donated

@@ -1,0 +1,33 @@
+"""True positives for the donation rule: a donated buffer is read after
+the jitted call dispatches."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _plain(params, batch):
+    return params, batch
+
+
+step = jax.jit(lambda params, batch: (params, batch), donate_argnums=(0,))
+
+
+def read_after_donation(params, batch):
+    new_params, _ = step(params, batch)
+    return params  # TP: `params` was donated to `step` above
+
+
+def read_in_loop(params, batches):
+    for batch in batches:
+        out = step(params, batch)  # TP (2nd iteration): donated, no rebind
+    return out
+
+
+class Engine:
+    def __init__(self):
+        self._step = jax.jit(lambda c, x: (c, x), donate_argnums=(0,))
+        self._caches = jnp.zeros((4,))
+
+    def tick(self, x):
+        new_caches, y = self._step(self._caches, x)
+        return jnp.sum(self._caches) + y  # TP: self._caches donated, not rebound

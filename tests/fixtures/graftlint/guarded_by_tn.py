@@ -1,0 +1,29 @@
+"""True negatives for the guarded-by rule: writes under the lock, the
+``*_locked`` caller-holds convention, the ``holds`` marker, and
+externally-synchronized fields."""
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._guard = None
+        self.served = 0  # guarded by: _cond
+        self._closed = False  # guarded by: _cond
+        self.insertions = 0  # guarded by: _guard [external]
+
+    def finish(self):
+        with self._cond:
+            self.served += 1  # under the annotated lock
+
+    def _drain_locked(self):
+        self._closed = True  # `_locked` suffix: caller holds _cond
+
+    # graftlint: holds _cond
+    def _bump(self):
+        self.served += 1  # marker: caller promises to hold _cond
+
+    def insert(self):
+        # `[external]` fields are runtime-checked (assert_owned), not
+        # lexically checked — the guard is bound after construction
+        self.insertions += 1

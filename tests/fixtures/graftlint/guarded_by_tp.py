@@ -1,0 +1,18 @@
+"""True positives for the guarded-by rule: annotated fields written
+outside their lock."""
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.served = 0  # guarded by: _cond
+        self._closed = False  # guarded by: _cond
+
+    def finish(self):
+        self.served += 1  # TP: no lock held
+
+    def shutdown(self):
+        self._closed = True  # TP: no lock held
+        with self._cond:
+            self._cond.notify_all()

@@ -1,0 +1,26 @@
+"""True negatives for the host-sync rule: explicit boundaries, host
+values, and cold scopes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._pos = jnp.zeros((8,), jnp.int32)
+
+    # graftlint: hot-loop
+    def _retire(self):
+        logits = jnp.ones((8, 32))
+        # explicit sync at the designated boundary: device_get is the
+        # sanctioned, visible transfer — the rule targets IMPLICIT syncs
+        toks = jax.device_get(jnp.argmax(logits, axis=-1))
+        n = int(toks[0])  # host value by then: no sync
+        counts = np.asarray([1, 2, 3])  # host literal, not a device value
+        return n, counts
+
+    def _cold_path(self):
+        # not hot (no marker, name does not end in _loop): syncs here are
+        # the caller's business — setup/teardown code runs once
+        x = jnp.ones((4,))
+        return float(jnp.sum(x))

@@ -1,0 +1,29 @@
+"""True positives for the host-sync rule: implicit device→host syncs
+inside hot scheduler scopes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._pos = jnp.zeros((8,), jnp.int32)
+
+    def _scheduler_loop(self):
+        # hot by marker, not by path (fixtures live outside serving/)
+        # graftlint: hot-loop
+        def _step():
+            logits = jnp.ones((8, 32))
+            if float(jnp.max(logits)) > 0:  # TP: float() on device value
+                pass
+            done = np.asarray(self._pos)  # TP: np.asarray on device field
+            tok = jnp.argmax(logits).item()  # TP: .item() on device value
+            return done, tok
+
+        return _step
+
+    # graftlint: hot-loop
+    def _admit(self):
+        mask = jax.lax.select(jnp.ones((4,), bool),
+                              jnp.ones((4,)), jnp.zeros((4,)))
+        return bool(jnp.any(mask))  # TP: bool() on device value

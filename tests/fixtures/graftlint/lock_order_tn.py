@@ -1,0 +1,27 @@
+"""True negatives for the lock-order rule: consistent ordering, and
+nested acquisition of unrelated classes' locks."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine_lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def submit(self):
+        with self._lock:
+            with self._engine_lock:  # always _lock -> _engine_lock
+                pass
+
+    def reload(self):
+        with self._lock:
+            with self._engine_lock:
+                pass
+
+    def drain(self):
+        # a single lock at a time imposes no ordering at all
+        with self._engine_lock:
+            pass
+        with self._cond:
+            pass

@@ -1,0 +1,19 @@
+"""True positive for the lock-order rule: two methods of one class take
+the same two locks in opposite orders — a deadlock waiting for load."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine_lock = threading.Lock()
+
+    def submit(self):
+        with self._lock:
+            with self._engine_lock:  # order: _lock -> _engine_lock
+                pass
+
+    def reload(self):
+        with self._engine_lock:
+            with self._lock:  # TP: order: _engine_lock -> _lock
+                pass

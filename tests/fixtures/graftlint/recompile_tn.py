@@ -1,0 +1,42 @@
+"""True negatives for the recompile rule: the legitimate neighbours of
+each hazard."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# jit at module scope, reused by every caller
+step = jax.jit(lambda x: x * 2)
+
+
+def jit_hoisted(batches):
+    # compiled once, called in the loop — the supported pattern
+    out = []
+    for batch in batches:
+        out.append(step(batch))
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,), static_argnames=("mode",))
+def bucketed(x, n, mode="pad"):
+    return x[:n]
+
+
+def hashable_statics(x):
+    # ints / strings / tuples are hashable cache keys
+    return bucketed(x, 2, mode="trim"), bucketed(x, 3)
+
+
+@jax.jit
+def shape_used_not_branched(x):
+    # reading .shape to COMPUTE is fine; only Python control flow on it
+    # specializes the trace
+    scale = 1.0 / x.shape[0]
+    return jnp.sum(x) * scale
+
+
+def host_side_shape_branch(x):
+    # not a jitted body: dispatch-side bucketing is the sanctioned fix
+    if x.shape[0] > 4:
+        return step(x)
+    return x

@@ -1,0 +1,39 @@
+"""True positives for the recompile rule."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def jit_in_loop(batches):
+    out = []
+    for batch in batches:
+        f = jax.jit(lambda x: x * 2)  # TP: fresh wrapper per iteration
+        out.append(f(batch))
+    while out:
+        g = functools.partial(jax.jit, static_argnums=(1,))(
+            lambda x, n: x[:n])  # TP: partial(jax.jit, ...) in a loop
+        out.pop()
+    return g
+
+
+@functools.partial(jax.jit, static_argnums=(1,), static_argnames=("mode",))
+def bucketed(x, n, mode="pad"):
+    return x[:n]
+
+
+def unhashable_static(x):
+    a = bucketed(x, [1, 2])        # TP: list in a static position
+    b = bucketed(x, 2, mode={"m": 1})  # TP: dict for a static kwarg
+    return a, b
+
+
+@jax.jit
+def shape_branchy(x):
+    if x.shape[0] > 4:  # TP: Python branch on a shape inside a jitted body
+        return jnp.sum(x)
+    n = x.ndim
+    while n > 1:  # TP: derived-from-shape loop condition
+        x = jnp.sum(x, axis=0)
+        n = n - 1
+    return x

@@ -1,0 +1,32 @@
+"""True negatives for the rng-reuse rule: split-before-reuse, fold_in
+per iteration, and mutually exclusive branches."""
+import jax
+
+
+def split_between_draws(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a, b
+
+
+def loop_with_fold(key, n):
+    out = []
+    for i in range(n):
+        key = jax.random.fold_in(key, i)  # rebind: fresh key per iter
+        out.append(jax.random.normal(key, (4,)))
+    return out
+
+
+def exclusive_branches(key, greedy):
+    # one consumption per mutually exclusive branch is a single draw
+    if greedy:
+        return jax.random.categorical(key, [0.5, 0.5])
+    return jax.random.uniform(key)
+
+
+def guard_return(key, fast):
+    if fast:
+        return jax.random.normal(key, (2,))
+    k, key = jax.random.split(key)
+    return jax.random.normal(k, (4,))

@@ -1,0 +1,21 @@
+"""True positives for the rng-reuse rule: one key, two draws."""
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # TP: same key, correlated draws
+    return a, b
+
+
+def loop_draw(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (4,)))  # TP: reused every iter
+    return out
+
+
+def vmap_then_direct(key, keys):
+    draws = jax.vmap(lambda k: jax.random.normal(k, (2,)))(key)
+    more = jax.random.bernoulli(key)  # TP: vmap consumed `key` already
+    return draws, more

@@ -1,0 +1,10 @@
+"""Suppression grammar fixture: a disable comment WITHOUT a reason is
+itself an (unsuppressible) finding, and does not silence the target."""
+import jax
+
+step = jax.jit(lambda params, batch: (params, batch), donate_argnums=(0,))
+
+
+def lazy(params, batch):
+    _ = step(params, batch)
+    return params  # graftlint: disable=donation

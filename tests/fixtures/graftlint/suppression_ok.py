@@ -1,0 +1,17 @@
+"""Suppression grammar fixture: findings silenced with mandatory
+reasons, same-line and standalone."""
+import jax
+
+step = jax.jit(lambda params, batch: (params, batch), donate_argnums=(0,))
+
+
+def deliberate(params, batch):
+    _ = step(params, batch)
+    return params  # graftlint: disable=donation  compile probe only: numerics unused
+
+
+def deliberate_standalone(params, batch):
+    _ = step(params, batch)
+    # graftlint: disable=donation  the caller never reuses this buffer;
+    # returning it is a shape witness for the test harness
+    return params

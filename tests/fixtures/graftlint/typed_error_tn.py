@@ -1,0 +1,32 @@
+"""True negatives for the typed-error rule: typed raises, narrow
+catches, firewall handlers that convert and re-raise, and
+programmer-contract ValueErrors."""
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class ServerOverloadedError(ServingError):
+    pass
+
+
+def admit(queue, cap):
+    if cap < 1:
+        raise ValueError("cap must be >= 1")  # contract error: legal
+    if len(queue) >= cap:
+        raise ServerOverloadedError("queue full")  # typed give-up
+
+
+def dispatch(fn):
+    try:
+        return fn()
+    except ServerOverloadedError:  # narrow, typed
+        return None
+
+
+def firewall(fn):
+    try:
+        return fn()
+    except Exception as e:  # broad but converts + re-raises: a firewall
+        raise ServingError(f"device step failed: {e}")

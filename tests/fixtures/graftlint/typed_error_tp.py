@@ -1,0 +1,25 @@
+"""True positives for the typed-error rule: generic raises and silent
+broad catches in a serving path."""
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+def admit(queue, cap):
+    if len(queue) >= cap:
+        raise RuntimeError("queue full")  # TP: untyped serving give-up
+
+
+def dispatch(fn):
+    try:
+        return fn()
+    except Exception:  # TP: broad catch, nothing re-raised
+        return None
+
+
+def probe(fn):
+    try:
+        return fn()
+    except BaseException:  # TP: swallows even KeyboardInterrupt
+        pass

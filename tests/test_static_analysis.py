@@ -1,0 +1,200 @@
+"""graftlint: the static-analysis gate and its fixture corpus.
+
+Three layers:
+
+1. **Per-rule fixture pairs** — each rule must flag every true positive
+   in `tests/fixtures/graftlint/<rule>_tp.py` and stay silent on the
+   paired `<rule>_tn.py` (the nearest legitimate idioms). A rule change
+   that goes blind OR starts crying wolf fails here.
+2. **Suppression + baseline semantics** — a disable comment without a
+   reason is itself an unsuppressible finding; the baseline is a
+   multiset keyed on (rule, path, source line) so grandfathered debt
+   cannot silently grow.
+3. **The repo gate** — `deeplearning4j_tpu/` must lint clean against
+   the checked-in baseline, every suppression must carry a reason, and
+   the CLI must exit nonzero on unsuppressed findings. This is the
+   tier-1 enforcement of the hazard contracts the rules encode.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftlint import run_lint, write_baseline  # noqa: E402
+from tools.graftlint.rules import rule_names  # noqa: E402
+
+FIXTURES = os.path.join("tests", "fixtures", "graftlint")
+
+# rule name -> (fixture stem, minimum TP findings the rule must produce)
+RULE_FIXTURES = {
+    "donation": ("donation", 3),
+    "recompile": ("recompile", 6),
+    "host-sync": ("host_sync", 4),
+    "lock-order": ("lock_order", 1),
+    "guarded-by": ("guarded_by", 2),
+    "typed-error": ("typed_error", 3),
+    "rng-reuse": ("rng", 3),
+}
+
+
+def _lint(paths, **kw):
+    kw.setdefault("baseline_path", None)
+    return run_lint(paths, root=REPO_ROOT, **kw)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the fixture corpus
+
+
+def test_every_rule_has_a_fixture_pair():
+    assert set(RULE_FIXTURES) == set(rule_names())
+    for stem, _ in RULE_FIXTURES.values():
+        for suffix in ("tp", "tn"):
+            path = os.path.join(REPO_ROOT, FIXTURES, f"{stem}_{suffix}.py")
+            assert os.path.isfile(path), f"missing fixture {path}"
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_flags_true_positives(rule):
+    stem, min_findings = RULE_FIXTURES[rule]
+    res = _lint([os.path.join(FIXTURES, f"{stem}_tp.py")], rules=[rule])
+    assert len(res.active) >= min_findings, \
+        f"{rule} went blind: {len(res.active)} < {min_findings} findings"
+    assert all(f.rule == rule for f in res.active)
+    # every TP finding names a real line of the fixture (cross-file
+    # lock-order findings span two sites and carry no single code line)
+    assert all(f.line > 0 for f in res.active)
+    if rule != "lock-order":
+        assert all(f.code for f in res.active)
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_passes_true_negatives(rule):
+    stem, _ = RULE_FIXTURES[rule]
+    res = _lint([os.path.join(FIXTURES, f"{stem}_tn.py")], rules=[rule])
+    assert res.active == [], \
+        f"{rule} cried wolf on legitimate idioms: {res.active}"
+
+
+def test_fixture_pairs_are_rule_isolated():
+    # running ALL rules over a TN file must stay quiet too — a TN for
+    # one rule must not be a TP for another, or the pair stops proving
+    # what it claims
+    for stem, _ in RULE_FIXTURES.values():
+        res = _lint([os.path.join(FIXTURES, f"{stem}_tn.py")])
+        assert res.active == [], f"{stem}_tn.py is not clean: {res.active}"
+
+
+# ---------------------------------------------------------------------------
+# layer 2: suppression + baseline semantics
+
+
+def test_suppression_with_reason_silences():
+    res = _lint([os.path.join(FIXTURES, "suppression_ok.py")])
+    assert res.active == []
+    assert len(res.suppressed) == 2
+    for finding, supp in res.suppressed:
+        assert finding.rule == "donation"
+        assert supp.reason.strip()
+
+
+def test_suppression_without_reason_is_a_finding():
+    res = _lint([os.path.join(FIXTURES, "suppression_bad.py")])
+    rules = sorted(f.rule for f in res.active)
+    # the reasonless disable is flagged AND does not silence its target
+    assert rules == ["bad-suppression", "donation"]
+    assert res.suppressed == []
+
+
+def test_baseline_grandfathers_exact_findings(tmp_path):
+    tp = os.path.join(FIXTURES, "donation_tp.py")
+    fresh = _lint([tp], rules=["donation"])
+    assert fresh.active
+    bl = tmp_path / "baseline.json"
+    write_baseline(fresh.active, str(bl))
+    again = _lint([tp], rules=["donation"], baseline_path=str(bl))
+    assert again.active == [] and again.ok
+    assert len(again.baselined) == len(fresh.active)
+
+
+def test_baseline_is_a_multiset_not_a_wildcard(tmp_path):
+    tp = os.path.join(FIXTURES, "donation_tp.py")
+    fresh = _lint([tp], rules=["donation"])
+    bl = tmp_path / "baseline.json"
+    # grandfather only ONE of the findings: the rest must stay active
+    write_baseline(fresh.active[:1], str(bl))
+    again = _lint([tp], rules=["donation"], baseline_path=str(bl))
+    assert len(again.active) == len(fresh.active) - 1
+    assert len(again.baselined) == 1
+
+
+def test_baseline_for_other_file_does_not_transfer(tmp_path):
+    fresh = _lint([os.path.join(FIXTURES, "donation_tp.py")],
+                  rules=["donation"])
+    bl = tmp_path / "baseline.json"
+    write_baseline(fresh.active, str(bl))
+    other = _lint([os.path.join(FIXTURES, "rng_tp.py")],
+                  rules=["rng-reuse"], baseline_path=str(bl))
+    assert other.active and not other.baselined
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the repo gate (tier-1 enforcement)
+
+
+@pytest.mark.lint
+def test_package_lints_clean():
+    """The whole package has zero unsuppressed findings against the
+    checked-in baseline — the PR gate."""
+    res = run_lint(["deeplearning4j_tpu"], root=REPO_ROOT)
+    assert res.files_checked > 100
+    msgs = "\n".join(f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                     for f in res.active)
+    assert res.ok, f"unsuppressed graftlint findings:\n{msgs}"
+
+
+@pytest.mark.lint
+def test_package_suppressions_all_carry_reasons():
+    res = run_lint(["deeplearning4j_tpu"], root=REPO_ROOT)
+    assert res.suppressed, "expected deliberate, documented suppressions"
+    for finding, supp in res.suppressed:
+        assert supp.reason.strip(), \
+            f"reasonless suppression at {finding.path}:{finding.line}"
+
+
+@pytest.mark.lint
+def test_checked_in_baseline_is_not_stale():
+    """Every baseline entry must still match a real finding — dead
+    entries mean the debt was paid and the baseline should shrink."""
+    bl_path = os.path.join(REPO_ROOT, "tools", "graftlint",
+                           "baseline.json")
+    with open(bl_path) as fh:
+        data = json.load(fh)
+    res = run_lint(["deeplearning4j_tpu"], root=REPO_ROOT)
+    baselined_keys = {f.key() for f in res.baselined}
+    for item in data.get("findings", []):
+        key = (item["rule"], item["path"], item.get("code", ""))
+        assert key in baselined_keys, f"stale baseline entry: {item}"
+
+
+def test_cli_exits_nonzero_on_findings():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         os.path.join(FIXTURES, "rng_tp.py"), "--no-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "rng-reuse" in proc.stdout
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "deeplearning4j_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout

@@ -1,0 +1,1 @@
+"""Repo-internal developer tooling (not shipped with the package)."""

@@ -1,0 +1,24 @@
+"""graftlint: JAX-aware static analysis enforcing this repo's hazard contracts.
+
+The serving + distributed-training stack rests on conventions that neither
+Python nor JAX checks: donated buffers must not be read after the jitted
+call, decode hot loops must not hide implicit host syncs, lock acquisition
+must follow one global order, serving paths must raise typed errors, and a
+PRNG key must be consumed exactly once.  graftlint walks the package ASTs
+and enforces those contracts as a tier-1 test (and a standalone CLI:
+``python -m tools.graftlint deeplearning4j_tpu/``).
+
+See docs/static_analysis.md for the rule catalog, suppression syntax
+(``# graftlint: disable=<rule>  <reason>`` — the reason is mandatory) and
+the baseline workflow.
+"""
+from tools.graftlint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = ["Finding", "LintResult", "run_lint", "load_baseline",
+           "write_baseline"]

@@ -1,0 +1,59 @@
+"""CLI: ``python -m tools.graftlint deeplearning4j_tpu/``.
+
+Exit status: 0 when there are no unsuppressed findings, 1 otherwise —
+usable as a pre-commit hook.  ``--write-baseline`` grandfathers the
+current active findings into tools/graftlint/baseline.json.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.graftlint.core import BASELINE_DEFAULT, run_lint, write_baseline
+from tools.graftlint.rules import rule_names
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis for this repo's hazard "
+                    "contracts (see docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to lint")
+    parser.add_argument("--rule", action="append", choices=rule_names(),
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the checked-in baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather current findings into "
+                             f"{BASELINE_DEFAULT}")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also list suppressed/baselined findings")
+    args = parser.parse_args(argv)
+
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else BASELINE_DEFAULT
+    result = run_lint(args.paths, baseline_path=baseline, rules=args.rule)
+
+    if args.write_baseline:
+        write_baseline(result.active)
+        print(f"wrote {len(result.active)} finding(s) to "
+              f"{BASELINE_DEFAULT}")
+        return 0
+
+    for f in result.active:
+        print(f.render())
+    if args.show_suppressed:
+        for f, sup in result.suppressed:
+            print(f"{f.render()}  [suppressed: {sup.reason}]")
+        for f in result.baselined:
+            print(f"{f.render()}  [baselined]")
+    print(f"graftlint: {result.files_checked} file(s), "
+          f"{len(result.active)} finding(s), "
+          f"{len(result.suppressed)} suppressed, "
+          f"{len(result.baselined)} baselined")
+    return 1 if result.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,273 @@
+"""graftlint engine: file walking, suppressions, baseline, rule driving.
+
+Findings flow through three gates, in order:
+
+1. **inline suppressions** — ``# graftlint: disable=<rule>[,<rule>...]
+   <reason>`` on the flagged line (or on its own line directly above).
+   The reason is mandatory; a disable comment without one is itself a
+   finding (``bad-suppression``) that cannot be suppressed.
+2. **baseline** — ``tools/graftlint/baseline.json`` holds grandfathered
+   findings keyed by ``(rule, path, stripped source line)`` so the key
+   survives unrelated edits that shift line numbers.  Matched findings
+   are reported as "baselined" and do not fail the run.
+3. everything left is **active** and fails the CLI / tier-1 gate.
+
+Rules implement ``check_file(ctx) -> list[Finding]`` and may implement
+``finalize() -> list[Finding]`` for whole-run analyses (the lock-order
+graph); per-file state for finalize is accumulated by the rule instance
+during ``check_file``.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# ``# graftlint: disable=rule-a,rule-b  why this is fine``
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\-]+)[ \t]*(.*)$")
+# ``# graftlint: hot-loop`` — marks the next (or same-line) ``def`` as a
+# hot scope for the host-sync rule.
+_HOT_RE = re.compile(r"#\s*graftlint:\s*hot-loop\b")
+# ``# graftlint: holds <lock>`` — on a ``def`` line: the caller must hold
+# <lock>; guarded-by treats writes inside as covered (the runtime
+# assert_owned() in util/concurrency.py cross-checks the claim).
+_HOLDS_RE = re.compile(r"#\s*graftlint:\s*holds\s+([A-Za-z_][\w.]*)")
+# ``# guarded by: <lock>`` field annotation (parsed here so every rule and
+# the docs agree on one syntax; the guarded-by rule consumes it).
+GUARDED_BY_RE = re.compile(
+    r"#\s*guarded by:\s*([A-Za-z_][\w.()]*)\s*(\[external\])?")
+
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int          # 1-based
+    col: int
+    message: str
+    code: str = ""     # stripped source of the flagged line (baseline key)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.code)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int           # the source line the comment sits on
+    target: int         # the code line it applies to
+    rules: Tuple[str, ...]
+    reason: str
+
+
+class FileCtx:
+    """Parsed view of one source file handed to every rule."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.suppressions: List[Suppression] = []
+        self.bad_suppressions: List[Finding] = []
+        self.hot_marked: set = set()    # line numbers of ``def`` marked hot
+        self.holds: Dict[int, str] = {}  # def line -> lock name
+        self._scan_comments()
+
+    # -- comment scanning --------------------------------------------------
+    def _next_code_line(self, i: int) -> int:
+        """1-based line number of the first code line at or after index i
+        (0-based) that is neither blank nor a pure comment."""
+        for j in range(i, len(self.lines)):
+            stripped = self.lines[j].strip()
+            if stripped and not stripped.startswith("#"):
+                return j + 1
+        return len(self.lines)
+
+    def _scan_comments(self) -> None:
+        for i, raw in enumerate(self.lines):
+            if "graftlint" not in raw:
+                continue
+            lineno = i + 1
+            before = raw.split("#", 1)[0]
+            standalone = not before.strip()
+            m = _DISABLE_RE.search(raw)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                reason = m.group(2).strip()
+                target = self._next_code_line(i + 1) if standalone else lineno
+                if not reason or BAD_SUPPRESSION in rules:
+                    self.bad_suppressions.append(Finding(
+                        BAD_SUPPRESSION, self.path, lineno, 0,
+                        "graftlint disable comment requires a reason: "
+                        "`# graftlint: disable=<rule>  <why this is safe>`"
+                        if not reason else
+                        "bad-suppression findings cannot be suppressed",
+                        code=raw.strip()))
+                else:
+                    self.suppressions.append(
+                        Suppression(lineno, target, rules, reason))
+                continue
+            m = _HOT_RE.search(raw)
+            if m:
+                self.hot_marked.add(
+                    lineno if not standalone
+                    else self._next_code_line(i + 1))
+                continue
+            m = _HOLDS_RE.search(raw)
+            if m:
+                target = lineno if not standalone \
+                    else self._next_code_line(i + 1)
+                self.holds[target] = m.group(1)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.path, line, col, message,
+                       code=self.line_text(line))
+
+    def suppressed(self, f: Finding) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.target == f.line and ("all" in s.rules or f.rule in s.rules):
+                return s
+        return None
+
+
+@dataclass
+class LintResult:
+    active: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, Suppression]] = field(
+        default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.active
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_DEFAULT) -> Dict[Tuple[str, str, str],
+                                                        int]:
+    """Multiset of grandfathered finding keys -> allowed count."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for item in data.get("findings", []):
+        key = (item["rule"], item["path"], item.get("code", ""))
+        out[key] = out.get(key, 0) + int(item.get("count", 1))
+    return out
+
+
+def write_baseline(findings: Iterable[Finding],
+                   path: str = BASELINE_DEFAULT) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    items = [{"rule": r, "path": p, "code": c, "count": n}
+             for (r, p, c), n in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": items}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+# -- file collection ---------------------------------------------------------
+
+def collect_files(paths: Iterable[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+# -- runner ------------------------------------------------------------------
+
+def run_lint(paths: Iterable[str], root: Optional[str] = None,
+             baseline_path: Optional[str] = BASELINE_DEFAULT,
+             rules: Optional[List[str]] = None) -> LintResult:
+    """Lint ``paths`` (files or directories) and gate the findings.
+
+    ``root`` anchors relative finding paths (defaults to the repo root,
+    two levels above this file).  ``rules`` optionally restricts the run
+    to a subset of rule names — fixture tests use this to assert one
+    rule at a time.
+    """
+    from tools.graftlint.rules import build_rules
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    rule_objs = build_rules(rules)
+    baseline = dict(load_baseline(baseline_path)) if baseline_path else {}
+
+    result = LintResult()
+    raw: List[Tuple[Finding, Optional[FileCtx]]] = []
+    ctxs: List[FileCtx] = []
+    for abspath in collect_files(paths, root):
+        rel = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = FileCtx(abspath, rel, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            raw.append((Finding(PARSE_ERROR, rel.replace(os.sep, "/"),
+                                getattr(e, "lineno", 1) or 1, 0,
+                                f"could not parse: {e}"), None))
+            continue
+        result.files_checked += 1
+        ctxs.append(ctx)
+        for f in ctx.bad_suppressions:
+            raw.append((f, ctx))
+        for rule in rule_objs:
+            for f in rule.check_file(ctx):
+                raw.append((f, ctx))
+    ctx_by_path = {c.path: c for c in ctxs}
+    for rule in rule_objs:
+        for f in rule.finalize():
+            raw.append((f, ctx_by_path.get(f.path)))
+
+    for f, ctx in raw:
+        sup = ctx.suppressed(f) if ctx is not None else None
+        if sup is not None and f.rule != BAD_SUPPRESSION:
+            result.suppressed.append((f, sup))
+            continue
+        key = f.key()
+        if baseline.get(key, 0) > 0:
+            baseline[key] -= 1
+            result.baselined.append(f)
+            continue
+        result.active.append(f)
+    result.active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

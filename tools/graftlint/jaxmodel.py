@@ -1,0 +1,192 @@
+"""Shared AST model of a module's JAX surface: jit registry + helpers.
+
+Resolves the three jit-wrapping forms this codebase uses —
+
+  * ``@jax.jit`` / ``@partial(jax.jit, donate_argnums=..., static_argnums=...)``
+    decorated functions (module-level or nested);
+  * ``name = jax.jit(fn, ...)`` local/module assignments;
+  * ``self.attr = <jitted local>`` — the decode engine builds jitted
+    closures in ``_build`` and stores them on the instance, then calls
+    them from the scheduler methods.
+
+Static, donated and jitted-ness travel with the name so call-site rules
+(donation, recompile) can reason about ``self._decode_step(...)``.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.random.split`` / ``self._cond`` -> dotted string; Subscript
+    links are skipped (``self._tok.at[i].set`` -> ``self._tok.at.set``)
+    so ``.at[...]`` updater chains stay recognizable."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+@dataclass
+class JitInfo:
+    donate: Tuple[int, ...] = ()
+    static_nums: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    def_node: Optional[ast.FunctionDef] = None
+    site: Optional[ast.AST] = None     # where the jit wrapping happens
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+class JaxNames:
+    """Tracks how jax / functools.partial are spelled in this module."""
+
+    def __init__(self, tree: ast.Module):
+        self.jit = {"jax.jit"}
+        self.partial = {"functools.partial"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "jit":
+                            self.jit.add(a.asname or a.name)
+                if node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial.add(a.asname or a.name)
+
+    def jit_call_kwargs(self, node: ast.AST) -> Optional[List[ast.keyword]]:
+        """If ``node`` evaluates to a jit-wrapped callable factory —
+        ``jax.jit``, ``jax.jit(...)`` or ``partial(jax.jit, ...)`` —
+        return its keyword list (possibly empty), else None."""
+        if dotted(node) in self.jit and not isinstance(node, ast.Call):
+            return []
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            if fn in self.jit:
+                return list(node.keywords)
+            if fn in self.partial and node.args \
+                    and dotted(node.args[0]) in self.jit:
+                return list(node.keywords)
+        return None
+
+
+def info_from_kwargs(kws: List[ast.keyword],
+                     site: ast.AST) -> JitInfo:
+    info = JitInfo(site=site)
+    for kw in kws:
+        if kw.arg == "donate_argnums":
+            info.donate = _int_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            info.static_nums = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            info.static_names = _str_tuple(kw.value)
+    return info
+
+
+@dataclass
+class ModuleJits:
+    """name -> JitInfo maps at three granularities."""
+    # bare function name (decorated defs, ``x = jax.jit(f)`` assigns),
+    # keyed by name only — scoping is approximated, which is fine for
+    # this codebase's unique local names.
+    by_name: Dict[str, JitInfo] = field(default_factory=dict)
+    # ``self.attr`` assignments of jitted values, keyed by attr name.
+    by_self_attr: Dict[str, JitInfo] = field(default_factory=dict)
+
+    def resolve_call(self, call: ast.Call) -> Optional[JitInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.by_name.get(fn.id)
+        d = dotted(fn)
+        if d and d.startswith("self."):
+            return self.by_self_attr.get(d[5:])
+        return None
+
+
+def collect_jits(tree: ast.Module, names: JaxNames) -> ModuleJits:
+    jits = ModuleJits()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                kws = names.jit_call_kwargs(dec)
+                if kws is not None:
+                    info = info_from_kwargs(kws, dec)
+                    info.def_node = node
+                    jits.by_name[node.name] = info
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Call):
+            kws = names.jit_call_kwargs(node.value)
+            if kws is not None and node.value.args:
+                info = info_from_kwargs(kws, node.value)
+                inner = node.value.args[0]
+                if isinstance(inner, ast.Name):
+                    # remember the wrapped def for shape-branch checks
+                    existing = jits.by_name.get(inner.id)
+                    if existing is not None and existing.def_node is not None:
+                        info.def_node = existing.def_node
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jits.by_name[tgt.id] = info
+                    else:
+                        d = dotted(tgt)
+                        if d and d.startswith("self."):
+                            jits.by_self_attr[d[5:]] = info
+    # second pass: ``self.attr = <known jitted local>`` propagation
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+            info = jits.by_name.get(node.value.id)
+            if info is None:
+                continue
+            for tgt in node.targets:
+                d = dotted(tgt)
+                if d and d.startswith("self."):
+                    jits.by_self_attr[d[5:]] = info
+    return jits
+
+
+def body_functions(tree: ast.Module):
+    """Yield every (funcdef, class_name_or_None) in the module."""
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                stack.append((child, cls))
+            else:
+                stack.append((child, cls))

@@ -1,0 +1,24 @@
+"""Rule registry: six hazard-contract rule classes."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tools.graftlint.rules.donation import DonationRule
+from tools.graftlint.rules.host_sync import HostSyncRule
+from tools.graftlint.rules.locks import GuardedByRule, LockOrderRule
+from tools.graftlint.rules.recompile import RecompileRule
+from tools.graftlint.rules.rng import RngReuseRule
+from tools.graftlint.rules.typed_errors import TypedErrorRule
+
+ALL_RULES = [DonationRule, RecompileRule, HostSyncRule, LockOrderRule,
+             GuardedByRule, TypedErrorRule, RngReuseRule]
+
+
+def rule_names() -> List[str]:
+    return [cls.name for cls in ALL_RULES]
+
+
+def build_rules(only: Optional[List[str]] = None):
+    classes = ALL_RULES if only is None else \
+        [cls for cls in ALL_RULES if cls.name in only]
+    return [cls() for cls in classes]

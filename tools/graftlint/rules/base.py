@@ -1,0 +1,79 @@
+"""Rule base class + shared flow-walk helpers."""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import FileCtx, Finding
+
+
+class Rule:
+    """A rule checks files independently; ``finalize`` runs once after
+    every file has been seen (for cross-file analyses)."""
+
+    name = "rule"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        return []
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+
+def stmt_children(stmt: ast.stmt):
+    """The nested statement blocks of a compound statement, in source
+    order, each tagged with whether it is a loop body (walked twice by
+    flow-sensitive rules so hazards that only bite on the second
+    iteration are seen)."""
+    blocks = []
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        blocks.append((stmt.body, True))
+        blocks.append((stmt.orelse, False))
+    elif isinstance(stmt, ast.If):
+        blocks.append((stmt.body, False))
+        blocks.append((stmt.orelse, False))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        blocks.append((stmt.body, False))
+    elif isinstance(stmt, ast.Try):
+        blocks.append((stmt.body, False))
+        for h in stmt.handlers:
+            blocks.append((h.body, False))
+        blocks.append((stmt.orelse, False))
+        blocks.append((stmt.finalbody, False))
+    return blocks
+
+
+def header_exprs(stmt: ast.stmt):
+    """The expressions evaluated by the statement ITSELF — for compound
+    statements only the header (loop iterable, branch test, with items),
+    never the nested blocks, which flow-sensitive rules visit by
+    recursion.  Simple statements evaluate themselves."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.While, ast.If)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def terminates(stmts) -> bool:
+    """True when the block cannot fall through (ends in return/raise/
+    break/continue) — its flow state must not merge into the join."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def walk_no_nested_functions(node: ast.AST):
+    """ast.walk that does not descend into nested def/lambda/class."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)

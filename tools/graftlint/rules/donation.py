@@ -1,0 +1,164 @@
+"""donation rule: a buffer passed through ``donate_argnums`` is invalid
+after the jitted call dispatches — reading it afterwards returns garbage
+on TPU while working fine on CPU (where XLA skips donation), so tests
+never catch it.  The rule tracks, per function, names donated at a call
+site and flags any later read that is not preceded by a rebind.  The
+engine's own idiom — donating ``self._caches`` and reassigning it in the
+same tuple-assignment statement — is recognized as safe.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint.core import FileCtx, Finding
+from tools.graftlint.jaxmodel import (JaxNames, ModuleJits, collect_jits,
+                                      dotted)
+from tools.graftlint.rules.base import Rule, header_exprs, \
+    terminates, walk_no_nested_functions
+
+
+def _arg_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    d = dotted(node)
+    if d and d.startswith("self.") and d.count(".") == 1:
+        return d
+    return None
+
+
+def _flatten_targets(node: ast.AST, out: List[str]) -> None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            _flatten_targets(e, out)
+    elif isinstance(node, ast.Starred):
+        _flatten_targets(node.value, out)
+    else:
+        k = _arg_key(node)
+        if k is not None:
+            out.append(k)
+
+
+class DonationRule(Rule):
+    name = "donation"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        names = JaxNames(ctx.tree)
+        jits = collect_jits(ctx.tree, names)
+        if not any(i.donate for i in jits.by_name.values()) and \
+                not any(i.donate for i in jits.by_self_attr.values()):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_block(ctx, jits, node.body, {}, out)
+        return out
+
+    # donated: key -> (line of donating call, callee label)
+    def _check_block(self, ctx: FileCtx, jits: ModuleJits,
+                     stmts: List[ast.stmt],
+                     donated: Dict[str, Tuple[int, str]],
+                     out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            self._flag_reads(ctx, stmt, donated, out)
+            rebinds = self._rebinds(stmt)
+            for k in rebinds:
+                donated.pop(k, None)
+            for k, site in self._donations(jits, stmt):
+                if k not in rebinds:
+                    donated[k] = site
+            # nested blocks
+            if isinstance(stmt, ast.If):
+                entry = dict(donated)
+                d1, d2 = dict(donated), dict(donated)
+                self._check_block(ctx, jits, stmt.body, d1, out)
+                self._check_block(ctx, jits, stmt.orelse, d2, out)
+                donated.clear()
+                t1 = terminates(stmt.body)
+                t2 = terminates(stmt.orelse)
+                if t1 and t2:
+                    donated.update(entry)
+                else:
+                    if not t1:
+                        donated.update(d1)
+                    if not t2:
+                        donated.update(d2)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # walk twice: a donation on iteration N is read on N+1
+                self._check_block(ctx, jits, stmt.body, donated, out)
+                self._check_block(ctx, jits, stmt.body, donated, out)
+                self._check_block(ctx, jits, stmt.orelse, donated, out)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._check_block(ctx, jits, stmt.body, donated, out)
+            elif isinstance(stmt, ast.Try):
+                self._check_block(ctx, jits, stmt.body, donated, out)
+                for h in stmt.handlers:
+                    self._check_block(ctx, jits, h.body, donated, out)
+                self._check_block(ctx, jits, stmt.orelse, donated, out)
+                self._check_block(ctx, jits, stmt.finalbody, donated, out)
+
+    def _flag_reads(self, ctx: FileCtx, stmt: ast.stmt,
+                    donated: Dict[str, Tuple[int, str]],
+                    out: List[Finding]) -> None:
+        if not donated:
+            return
+        for expr in header_exprs(stmt):
+            self._flag_reads_expr(ctx, expr, donated, out)
+
+    def _flag_reads_expr(self, ctx: FileCtx, expr: ast.AST,
+                         donated: Dict[str, Tuple[int, str]],
+                         out: List[Finding]) -> None:
+        for node in walk_no_nested_functions(expr):
+            key = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                key = node.id
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                key = _arg_key(node)
+            if key is not None and key in donated:
+                line, callee = donated[key]
+                out.append(ctx.finding(
+                    self.name, node,
+                    f"`{key}` is read after being donated to `{callee}` "
+                    f"(line {line}); a donated buffer is invalid once the "
+                    f"jitted call dispatches — CPU runs skip donation, so "
+                    f"tests will not catch this"))
+
+    def _rebinds(self, stmt: ast.stmt) -> List[str]:
+        out: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                _flatten_targets(t, out)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            _flatten_targets(stmt.target, out)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _flatten_targets(stmt.target, out)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    _flatten_targets(item.optional_vars, out)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                _flatten_targets(t, out)
+        return out
+
+    def _donations(self, jits: ModuleJits, stmt: ast.stmt):
+        for expr in header_exprs(stmt):
+            yield from self._donations_expr(jits, expr)
+
+    def _donations_expr(self, jits: ModuleJits, expr: ast.AST):
+        for node in walk_no_nested_functions(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            info = jits.resolve_call(node)
+            if info is None or not info.donate:
+                continue
+            callee = dotted(node.func) or "<jitted>"
+            for idx in info.donate:
+                if idx < len(node.args):
+                    k = _arg_key(node.args[idx])
+                    if k is not None:
+                        yield k, (node.lineno, callee)

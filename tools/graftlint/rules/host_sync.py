@@ -1,0 +1,164 @@
+"""host-sync rule: implicit device→host transfers in serving hot loops.
+
+``float()``/``int()``/``bool()``/``.item()``/``.tolist()``/``np.asarray``
+on a device array block the Python thread on the device stream — inside
+the decode scheduler that stalls every in-flight request.  Syncs are only
+legal at the designated retire/metrics boundaries, which carry explicit
+``# graftlint: disable=host-sync`` suppressions with reasons.
+
+Scope ("hot" functions): any function in ``serving/`` whose name ends in
+``_loop``, plus any function whose ``def`` line (or the line above it)
+carries a ``# graftlint: hot-loop`` marker.
+
+Device-value tracking is deliberately default-allow: only values the
+rule can *prove* live on device are tracked — results of ``jnp.*`` /
+``jax.lax.*`` / ``jax.random.*`` / ``jax.nn.*`` calls, calls to known
+jitted callables, ``.at[...].set()`` chains, and ``self.<attr>`` fields
+that are assigned device values anywhere in the class.  Unknown values
+never flag, so helper-function indirection cannot produce false
+positives (it can produce false negatives — the runtime profiler is the
+backstop there).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftlint.core import FileCtx, Finding
+from tools.graftlint.jaxmodel import JaxNames, ModuleJits, collect_jits, \
+    dotted
+from tools.graftlint.rules.base import Rule, header_exprs, \
+    stmt_children, walk_no_nested_functions
+
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "jax.random.",
+                    "jax.nn.", "jax.scipy.")
+_DEVICE_EXACT = {"jax.vmap", "jax.pmap", "jax.block_until_ready"}
+_KILL = {"jax.device_get"}
+_NP_NAMES = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist", "__float__", "__int__"}
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        names = JaxNames(ctx.tree)
+        jits = collect_jits(ctx.tree, names)
+        device_attrs = self._device_self_attrs(ctx, jits)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and self._is_hot(ctx, node):
+                tainted: Set[str] = set()
+                self._check_block(ctx, jits, device_attrs, node.body,
+                                  tainted, out)
+        return out
+
+    def _is_hot(self, ctx: FileCtx, fn: ast.FunctionDef) -> bool:
+        if fn.lineno in ctx.hot_marked:
+            return True
+        deco_first = min([fn.lineno] +
+                         [d.lineno for d in fn.decorator_list])
+        if any(line in ctx.hot_marked
+               for line in range(deco_first, fn.lineno + 1)):
+            return True
+        return "/serving/" in "/" + ctx.path and fn.name.endswith("_loop")
+
+    # -- device taint -------------------------------------------------------
+    def _device_self_attrs(self, ctx: FileCtx, jits: ModuleJits) -> Set[str]:
+        attrs: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    self._expr_device(node.value, jits, set(), attrs=set()):
+                for t in node.targets:
+                    d = dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        attrs.add(d[5:])
+        return attrs
+
+    def _call_device(self, call: ast.Call, jits: ModuleJits) -> Optional[bool]:
+        """True → device result, False → host result (kill), None →
+        unknown."""
+        d = dotted(call.func)
+        if d is None:
+            return None
+        if d in _KILL or d in _NP_NAMES or d.startswith("np.") \
+                or d.startswith("numpy."):
+            return False
+        if d.startswith(_DEVICE_PREFIXES) or d in _DEVICE_EXACT:
+            return True
+        if ".at." in d or d.endswith(".block_until_ready"):
+            return True
+        if jits.resolve_call(call) is not None:
+            return True
+        return None
+
+    def _expr_device(self, expr: ast.AST, jits: ModuleJits,
+                     tainted: Set[str], attrs: Set[str]) -> bool:
+        for n in walk_no_nested_functions(expr):
+            if isinstance(n, ast.Call) and \
+                    self._call_device(n, jits) is True:
+                return True
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return True
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.ctx, ast.Load):
+                d = dotted(n)
+                if d and d.startswith("self.") and d.count(".") == 1 \
+                        and d[5:] in attrs:
+                    return True
+        return False
+
+    # -- flow walk ----------------------------------------------------------
+    def _check_block(self, ctx: FileCtx, jits: ModuleJits, attrs: Set[str],
+                     stmts: List[ast.stmt], tainted: Set[str],
+                     out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            self._flag_syncs(ctx, jits, attrs, stmt, tainted, out)
+            if isinstance(stmt, ast.Assign):
+                is_dev = self._expr_device(stmt.value, jits, tainted, attrs)
+                # np.asarray(...)/device_get(...) results are host values
+                if isinstance(stmt.value, ast.Call) and \
+                        self._call_device(stmt.value, jits) is False:
+                    is_dev = False
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            (tainted.add if is_dev
+                             else tainted.discard)(n.id)
+            for body, is_loop in stmt_children(stmt):
+                self._check_block(ctx, jits, attrs, body, tainted, out)
+                if is_loop:
+                    self._check_block(ctx, jits, attrs, body, tainted, out)
+
+    def _flag_syncs(self, ctx: FileCtx, jits: ModuleJits, attrs: Set[str],
+                    stmt: ast.stmt, tainted: Set[str],
+                    out: List[Finding]) -> None:
+        for n in (x for expr in header_exprs(stmt)
+                  for x in walk_no_nested_functions(expr)):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            label = None
+            value = None
+            if d in _CAST_BUILTINS and len(n.args) == 1:
+                label, value = f"{d}()", n.args[0]
+            elif d in _NP_NAMES and n.args:
+                label, value = f"{d}()", n.args[0]
+            elif isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _SYNC_METHODS:
+                label, value = f".{n.func.attr}()", n.func.value
+            if label is None or value is None:
+                continue
+            if self._expr_device(value, jits, tainted, attrs):
+                out.append(ctx.finding(
+                    self.name, n,
+                    f"{label} on a device value inside a hot loop forces "
+                    f"an implicit device→host sync, stalling the scheduler "
+                    f"for every in-flight request; move the sync to a "
+                    f"designated boundary or keep the value on device"))

@@ -1,0 +1,263 @@
+"""Lock discipline rules: acquisition order + guarded-by fields.
+
+**lock-order** builds the intra-class lock-acquisition graph across
+``serving/`` and ``parallel/`` from lexically nested ``with self.<lock>``
+blocks plus one hop of same-class method calls made while a lock is
+held, and flags pairs of locks acquired in both orders (the classic
+deadlock shape).  Lock-looking attributes are those matching
+``lock|cond|mutex|sem``; ``.read()``/``.write()`` rwlock handles map to
+their base lock.
+
+**guarded-by** consumes ``# guarded by: <lock>`` comments on ``self``
+field assignments (conventionally in ``__init__``) and flags any rebind
+(``self.x = ...``, ``self.x += ...``, ``self.x[i] = ...``) of an
+annotated field outside a ``with self.<lock>`` block.  Exemptions:
+``__init__``; methods whose name ends in ``_locked`` (the codebase's
+caller-holds-the-lock convention); methods whose ``def`` line carries
+``# graftlint: holds <lock>`` (cross-checked at runtime by
+``util.concurrency.assert_owned``); and guards annotated ``[external]``
+(e.g. PrefixCache, synchronized by the decode engine's condition) which
+are runtime-checked only.  Writes inside nested functions are not
+checked — a closure runs at call time, not where it is written.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import GUARDED_BY_RE, FileCtx, Finding
+from tools.graftlint.jaxmodel import dotted
+from tools.graftlint.rules.base import Rule
+
+_LOCKISH = re.compile(r"lock|cond|mutex|sem", re.IGNORECASE)
+
+
+def _with_item_lock(expr: ast.AST) -> Optional[str]:
+    """`with self._cond:` / `with self._rwlock.write():` -> base attr
+    name of the lock, else None."""
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+        if d and d.startswith("self.") and \
+                d.split(".")[-1] in ("read", "write", "acquire"):
+            base = d.split(".")[1]
+            return base if _LOCKISH.search(base) else None
+        return None
+    d = dotted(expr)
+    if d and d.startswith("self.") and d.count(".") == 1:
+        base = d[5:]
+        return base if _LOCKISH.search(base) else None
+    return None
+
+
+def _in_scope(path: str) -> bool:
+    p = "/" + path
+    return "/serving/" in p or "/parallel/" in p or \
+        "/fixtures/graftlint/" in p
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+
+    def __init__(self):
+        # (cls.lockA, cls.lockB) -> first (path, line) where A is held
+        # while B is acquired
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # cls.method -> set of lock ids it acquires anywhere
+        self.acquires: Dict[str, Set[str]] = {}
+        # deferred one-hop edges: (held lock id, cls.method, path, line)
+        self.calls: List[Tuple[str, str, str, int]] = []
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        if not _in_scope(ctx.path):
+            return []
+        from tools.graftlint.jaxmodel import body_functions
+        for fn, cls in body_functions(ctx.tree):
+            if cls is None:
+                continue
+            self._scan(ctx, cls, fn, fn.body, [])
+        return []
+
+    def _scan(self, ctx: FileCtx, cls: str, fn: ast.FunctionDef,
+              stmts: List[ast.stmt], held: List[str]) -> None:
+        method = f"{cls}.{fn.name}"
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            # method calls while holding a lock (one-hop propagation)
+            if held:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call):
+                        d = dotted(n.func)
+                        if d and d.startswith("self.") \
+                                and d.count(".") == 1:
+                            for h in held:
+                                self.calls.append(
+                                    (h, f"{cls}.{d[5:]}", ctx.path,
+                                     n.lineno))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in stmt.items:
+                    lock = _with_item_lock(item.context_expr)
+                    if lock is None:
+                        continue
+                    lid = f"{cls}.{lock}"
+                    self.acquires.setdefault(method, set()).add(lid)
+                    for h in held + acquired:
+                        if h != lid:
+                            self.edges.setdefault(
+                                (h, lid), (ctx.path, stmt.lineno))
+                    acquired.append(lid)
+                self._scan(ctx, cls, fn, stmt.body, held + acquired)
+            else:
+                for field_name in ("body", "orelse", "finalbody"):
+                    body = getattr(stmt, field_name, None)
+                    if body:
+                        self._scan(ctx, cls, fn, body, held)
+                for h in getattr(stmt, "handlers", []):
+                    self._scan(ctx, cls, fn, h.body, held)
+
+    def finalize(self) -> List[Finding]:
+        edges = dict(self.edges)
+        for h, callee, path, line in self.calls:
+            for lid in self.acquires.get(callee, ()):
+                if lid != h:
+                    edges.setdefault((h, lid), (path, line))
+        out: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), (path, line) in sorted(edges.items(),
+                                           key=lambda kv: (kv[1], kv[0])):
+            if (b, a) in edges and (b, a) not in seen:
+                seen.add((a, b))
+                rpath, rline = edges[(b, a)]
+                out.append(Finding(
+                    self.name, path, line, 0,
+                    f"inconsistent lock order: `{a}` is held while "
+                    f"acquiring `{b}` here, but `{b}` is held while "
+                    f"acquiring `{a}` at {rpath}:{rline} — pick one "
+                    f"global order or a deadlock is one unlucky "
+                    f"interleaving away",
+                    code=""))
+        return out
+
+
+class GuardedByRule(Rule):
+    name = "guarded-by"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(ctx, node, out)
+        return out
+
+    def _annotations(self, ctx: FileCtx,
+                     cls: ast.ClassDef) -> Dict[str, Tuple[str, bool]]:
+        """field -> (guard spec, external?)"""
+        guards: Dict[str, Tuple[str, bool]] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            m = GUARDED_BY_RE.search(ctx.line_text(node.lineno))
+            if not m:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                d = dotted(t)
+                if d and d.startswith("self.") and d.count(".") == 1:
+                    guards[d[5:]] = (m.group(1), bool(m.group(2)))
+        return guards
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef,
+                     out: List[Finding]) -> None:
+        guards = self._annotations(ctx, cls)
+        if not guards:
+            return
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__" or item.name.endswith("_locked"):
+                continue
+            holds = ctx.holds.get(item.lineno)
+            self._check_fn(ctx, guards, item, item.body,
+                           {holds} if holds else set(), out)
+
+    def _guard_held(self, spec: str, held: Set[str]) -> bool:
+        if spec in held:
+            return True
+        # annotation `_rwlock.write()` is satisfied only by the writer
+        # handle; annotation `_lock` is satisfied by any handle of _lock
+        base = spec.split(".")[0].replace("()", "")
+        if "." not in spec and base in {h.split(".")[0] for h in held}:
+            return True
+        return False
+
+    def _check_fn(self, ctx: FileCtx, guards: Dict[str, Tuple[str, bool]],
+                  fn: ast.FunctionDef, stmts: List[ast.stmt],
+                  held: Set[str], out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # closures run at call time, not here
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                self._check_target(ctx, guards, t, held, out)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: Set[str] = set()
+                for item in stmt.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        d = dotted(expr.func)
+                        if d and d.startswith("self."):
+                            acquired.add(d[5:] + "()")
+                    else:
+                        d = dotted(expr)
+                        if d and d.startswith("self.") \
+                                and d.count(".") == 1:
+                            acquired.add(d[5:])
+                self._check_fn(ctx, guards, fn, stmt.body,
+                               held | acquired, out)
+            else:
+                for field_name in ("body", "orelse", "finalbody"):
+                    body = getattr(stmt, field_name, None)
+                    if body:
+                        self._check_fn(ctx, guards, fn, body, held, out)
+                for h in getattr(stmt, "handlers", []):
+                    self._check_fn(ctx, guards, fn, h.body, held, out)
+
+    def _check_target(self, ctx: FileCtx,
+                      guards: Dict[str, Tuple[str, bool]], target: ast.AST,
+                      held: Set[str], out: List[Finding]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._check_target(ctx, guards, e, held, out)
+            return
+        if isinstance(target, ast.Starred):
+            self._check_target(ctx, guards, target.value, held, out)
+            return
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        d = dotted(node)
+        if not (d and d.startswith("self.") and d.count(".") == 1):
+            return
+        field = d[5:]
+        if field not in guards:
+            return
+        spec, external = guards[field]
+        if external:
+            return  # runtime-checked via assert_owned only
+        if not self._guard_held(spec, held):
+            out.append(ctx.finding(
+                self.name, target,
+                f"write to `self.{field}` (guarded by `{spec}`) outside "
+                f"`with self.{spec}`: annotate the method with "
+                f"`# graftlint: holds {spec.split('.')[0].replace('()', '')}`"
+                f" if the caller holds it, or take the lock"))

@@ -1,0 +1,112 @@
+"""recompile rule: patterns that silently retrace/recompile a jitted
+function.  Three sub-checks:
+
+* ``jax.jit`` (or ``partial(jax.jit, ...)``) invoked inside a loop body —
+  every iteration builds a fresh wrapper with an empty cache;
+* an unhashable literal (list/dict/set/comprehension) passed in a
+  ``static_argnums``/``static_argnames`` position of a known jitted
+  callable — statics are cache keys, unhashables raise or, via
+  conversion, retrace per call;
+* Python ``if``/``while`` branching on ``.shape``-derived values inside a
+  jitted body — the trace specializes per shape class, so every new
+  shape recompiles and the branch silently bakes into the program.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.graftlint.core import FileCtx, Finding
+from tools.graftlint.jaxmodel import JaxNames, collect_jits
+from tools.graftlint.rules.base import Rule, walk_no_nested_functions
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+class RecompileRule(Rule):
+    name = "recompile"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        names = JaxNames(ctx.tree)
+        jits = collect_jits(ctx.tree, names)
+        out: List[Finding] = []
+        self._jit_in_loop(ctx, names, ctx.tree, 0, out)
+        self._unhashable_statics(ctx, jits, out)
+        for info in list(jits.by_name.values()) + \
+                list(jits.by_self_attr.values()):
+            if info.def_node is not None:
+                self._shape_branches(ctx, info.def_node, out)
+        return out
+
+    # -- jit constructed inside a loop --------------------------------------
+    def _jit_in_loop(self, ctx: FileCtx, names: JaxNames, node: ast.AST,
+                     loop_depth: int, out: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            depth = loop_depth
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                depth += 1
+            if isinstance(child, ast.Call) and loop_depth > 0 \
+                    and names.jit_call_kwargs(child) is not None:
+                out.append(ctx.finding(
+                    self.name, child,
+                    "jax.jit called inside a loop: each iteration builds a "
+                    "fresh wrapper with an empty compile cache — hoist the "
+                    "jit out of the loop"))
+            self._jit_in_loop(ctx, names, child, depth, out)
+
+    # -- unhashable values in static positions ------------------------------
+    def _unhashable_statics(self, ctx: FileCtx, jits, out) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info = jits.resolve_call(node)
+            if info is None:
+                continue
+            for idx in info.static_nums:
+                if idx < len(node.args) and \
+                        isinstance(node.args[idx], _UNHASHABLE):
+                    out.append(ctx.finding(
+                        self.name, node.args[idx],
+                        f"unhashable value passed as static arg {idx} of a "
+                        f"jitted call: statics are compile-cache keys and "
+                        f"must be hashable"))
+            for kw in node.keywords:
+                if kw.arg in info.static_names and \
+                        isinstance(kw.value, _UNHASHABLE):
+                    out.append(ctx.finding(
+                        self.name, kw.value,
+                        f"unhashable value passed as static arg "
+                        f"`{kw.arg}` of a jitted call: statics are "
+                        f"compile-cache keys and must be hashable"))
+
+    # -- shape-dependent Python branching inside a jitted body --------------
+    def _shape_branches(self, ctx: FileCtx, fn: ast.FunctionDef,
+                        out: List[Finding]) -> None:
+        tainted: Set[str] = set()
+
+        def expr_is_shapey(expr: ast.AST) -> bool:
+            for n in walk_no_nested_functions(expr):
+                if isinstance(n, ast.Attribute) and n.attr in ("shape",
+                                                               "ndim",
+                                                               "size"):
+                    return True
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Load) and n.id in tainted:
+                    return True
+            return False
+
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and expr_is_shapey(stmt.value):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(stmt, (ast.If, ast.While)) and \
+                    expr_is_shapey(stmt.test):
+                out.append(ctx.finding(
+                    self.name, stmt,
+                    f"shape-dependent Python branch inside jitted "
+                    f"`{fn.name}`: the trace specializes per shape class — "
+                    f"every new shape recompiles and the branch outcome is "
+                    f"baked into the compiled program"))

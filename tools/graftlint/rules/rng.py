@@ -1,0 +1,164 @@
+"""rng-reuse rule: a PRNG key consumed by two sampling primitives yields
+correlated draws — the classic silent JAX bug.  Keys are values: every
+consumption must be preceded by a fresh ``split``/``fold_in``.
+
+Per function, the rule tracks names holding keys and flags a second
+consuming call (``jax.random.categorical``/``uniform``/``normal``/...)
+on the same name without an intervening rebind.  ``split``/``fold_in``/
+``PRNGKey`` do not consume.  Loop bodies are walked twice so a key
+consumed once per iteration without a rebind is caught.  ``jax.vmap``
+over a key-consuming lambda counts as consuming the outer key argument.
+"""
+from __future__ import annotations
+
+import ast
+import copy
+from typing import Dict, List, Tuple
+
+from tools.graftlint.core import FileCtx, Finding
+from tools.graftlint.jaxmodel import dotted
+from tools.graftlint.rules.base import Rule, header_exprs, \
+    stmt_children, terminates, walk_no_nested_functions
+
+_CONSUMING = {"categorical", "uniform", "normal", "bernoulli", "gumbel",
+              "randint", "permutation", "choice", "truncated_normal",
+              "exponential", "beta", "gamma", "dirichlet", "laplace",
+              "logistic", "poisson", "shuffle", "bits", "ball",
+              "rademacher", "cauchy", "multivariate_normal"}
+_RANDOM_MODULES = ("jax.random.", "random.", "jrandom.", "jr.")
+
+
+def _consuming_fn(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d is None:
+        return False
+    for mod in _RANDOM_MODULES:
+        if d.startswith(mod) and d[len(mod):] in _CONSUMING:
+            return True
+    return False
+
+
+def _consumed_key_args(call: ast.Call) -> List[ast.AST]:
+    """Key expressions consumed by this call (first positional arg or
+    ``key=`` kwarg of a consuming primitive; vmap-over-lambda is seen
+    through)."""
+    out: List[ast.AST] = []
+    if _consuming_fn(call):
+        if call.args:
+            out.append(call.args[0])
+        for kw in call.keywords:
+            if kw.arg == "key":
+                out.append(kw.value)
+    # jax.vmap(lambda k: jax.random.X(k, ...))(keys)
+    if isinstance(call.func, ast.Call) and \
+            dotted(call.func.func) in ("jax.vmap", "vmap") and \
+            call.func.args and isinstance(call.func.args[0], ast.Lambda):
+        lam = call.func.args[0]
+        params = [a.arg for a in lam.args.args]
+        consumed_params = set()
+        for n in ast.walk(lam.body):
+            if isinstance(n, ast.Call):
+                for keyarg in _consumed_key_args(n):
+                    if isinstance(keyarg, ast.Name) and \
+                            keyarg.id in params:
+                        consumed_params.add(params.index(keyarg.id))
+        for i in consumed_params:
+            if i < len(call.args):
+                out.append(call.args[i])
+    return out
+
+
+class RngReuseRule(Rule):
+    name = "rng-reuse"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                consumed: Dict[str, Tuple[int, set]] = {}
+                self._check_block(ctx, node.body, consumed, out)
+        return out
+
+    def _key_name(self, expr: ast.AST) -> str:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        d = dotted(expr)
+        if d and d.startswith("self.") and d.count(".") == 1:
+            return d
+        return ""
+
+    def _check_block(self, ctx: FileCtx, stmts: List[ast.stmt],
+                     consumed: Dict[str, Tuple[int, set]],
+                     out: List[Finding], second_pass: bool = False) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for call in (n for expr in header_exprs(stmt)
+                         for n in walk_no_nested_functions(expr)):
+                if not isinstance(call, ast.Call):
+                    continue
+                for keyarg in _consumed_key_args(call):
+                    name = self._key_name(keyarg)
+                    if not name:
+                        continue
+                    if name in consumed:
+                        first_line, reported = consumed[name]
+                        if call.lineno not in reported:
+                            reported.add(call.lineno)
+                            out.append(ctx.finding(
+                                "rng-reuse", call,
+                                f"PRNG key `{name}` was already consumed "
+                                f"at line {first_line} and is consumed "
+                                f"again here without a fresh split — the "
+                                f"two draws are correlated"))
+                        elif second_pass and call.lineno == first_line \
+                                and ("loop", first_line) not in reported:
+                            # the same consumption repeats every loop
+                            # iteration with no rebind in between
+                            reported.add(("loop", first_line))
+                            out.append(ctx.finding(
+                                "rng-reuse", call,
+                                f"PRNG key `{name}` is consumed on every "
+                                f"loop iteration without a fresh split — "
+                                f"all iterations draw the same randomness"))
+                    else:
+                        consumed[name] = (call.lineno, {call.lineno})
+            # rebinds reset consumption
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets = [stmt.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    name = self._key_name(n)
+                    if name:
+                        consumed.pop(name, None)
+            if isinstance(stmt, ast.If):
+                # if/elif branches are mutually exclusive: one consumption
+                # per branch is fine.  Walk each arm from a copy of the
+                # entry state and merge (union) afterwards.
+                entry = copy.deepcopy(consumed)
+                c1 = copy.deepcopy(consumed)
+                c2 = copy.deepcopy(consumed)
+                self._check_block(ctx, stmt.body, c1, out, second_pass)
+                self._check_block(ctx, stmt.orelse, c2, out, second_pass)
+                consumed.clear()
+                t1 = terminates(stmt.body)
+                t2 = terminates(stmt.orelse)
+                if t1 and t2:
+                    consumed.update(entry)  # join is unreachable from arms
+                else:
+                    if not t2:
+                        consumed.update(c2)
+                    if not t1:
+                        consumed.update(c1)
+            else:
+                for body, is_loop in stmt_children(stmt):
+                    self._check_block(ctx, body, consumed, out, second_pass)
+                    if is_loop:
+                        self._check_block(ctx, body, consumed, out, True)
+        return

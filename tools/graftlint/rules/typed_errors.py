@@ -1,0 +1,77 @@
+"""typed-error rule: serving paths speak the typed ServingError hierarchy
+(`retry_after`, `error_type` on the wire) — generic raises and silent
+broad catches break that contract.
+
+Scope: ``serving/`` and ``gateway.py`` (plus the fixture corpus).  Two
+sub-checks:
+
+* ``raise RuntimeError(...)`` / ``raise Exception(...)`` — generic
+  runtime raises must use the ServingError hierarchy so gateways can map
+  them to wire errors with retry hints.  (ValueError/TypeError stay
+  legal: they are programmer-contract errors, not serving outcomes.)
+* ``except Exception`` / ``except BaseException`` / bare ``except``
+  whose handler never raises — silently absorbing unknown failures hides
+  bugs from callers.  Handlers that re-raise (converting to a typed
+  error) pass; deliberate absorb-and-count sites carry a suppression
+  with a reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import FileCtx, Finding
+from tools.graftlint.jaxmodel import dotted
+from tools.graftlint.rules.base import Rule
+
+_GENERIC_RAISES = {"RuntimeError", "Exception", "BaseException"}
+_BROAD_CATCHES = {"Exception", "BaseException"}
+
+
+def _in_scope(path: str) -> bool:
+    p = "/" + path
+    return "/serving/" in p or p.endswith("/gateway.py") or \
+        "/fixtures/graftlint/" in p
+
+
+class TypedErrorRule(Rule):
+    name = "typed-error"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        if not _in_scope(ctx.path):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Raise):
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call):
+                    name = dotted(exc.func)
+                elif exc is not None:
+                    name = dotted(exc)
+                if name in _GENERIC_RAISES:
+                    out.append(ctx.finding(
+                        self.name, node,
+                        f"`raise {name}` in a serving path: use the typed "
+                        f"ServingError hierarchy so gateways can map the "
+                        f"failure to a wire error with a retry hint"))
+            elif isinstance(node, ast.ExceptHandler):
+                t = node.type
+                broad = t is None or dotted(t) in _BROAD_CATCHES or (
+                    isinstance(t, ast.Tuple) and any(
+                        dotted(e) in _BROAD_CATCHES for e in t.elts))
+                if not broad:
+                    continue
+                reraises = any(isinstance(n, ast.Raise)
+                               for n in ast.walk(node))
+                if not reraises:
+                    label = "bare `except:`" if t is None else \
+                        f"`except {dotted(t) or '...'}`"
+                    out.append(ctx.finding(
+                        self.name, node,
+                        f"{label} absorbs unknown failures without "
+                        f"re-raising in a serving path: catch the typed "
+                        f"ServingError hierarchy, or re-raise as a typed "
+                        f"error (suppress with a reason if the absorb is "
+                        f"deliberate)"))
+        return out
