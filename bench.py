@@ -4,9 +4,12 @@ BASELINE.md metrics (the reference publishes no numbers —
 `BASELINE.json "published": {}` — so vs_baseline is reported against the
 first recorded run of this framework, stored in `.bench_baseline.json`).
 
-Usage: `python bench.py [lenet|resnet50|lstm|gpt|word2vec|generate|
-serve_pool|serve_generate|...]` (default: ALL configs; see `_CONFIGS`
-for the full set). Prints ONE JSON line:
+Usage: `python bench.py [--trace[=DIR]] [lenet|resnet50|lstm|gpt|
+word2vec|generate|serve_pool|serve_generate|...]` (default: ALL
+configs; see `_CONFIGS` for the full set). `--trace` wraps each
+config's first steady-state timed pass in a `jax.profiler` capture
+(default DIR /tmp/dl4j_tpu_trace; see PROFILE_gpt_r6.md's prescribed
+capture). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
    "configs": {name: {metric, value, unit, vs_baseline, mfu}, ...}}
 with a computed MFU estimate (XLA-counted step FLOPs / v5e peak) per
@@ -25,12 +28,31 @@ number quoted without a spread is a single-run observation, not a claim.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 import time
 from pathlib import Path
 
 import numpy as np
+
+# set by `--trace[=DIR]`: each config's FIRST steady-state timed pass is
+# wrapped in a `jax.profiler` capture (profiler.trace_capture) — the
+# PROFILE_gpt_r6.md prescribed window (compile + first-contact passes
+# already ran, so the trace holds only steady-state steps). On-chip,
+# `python bench.py --trace gpt_long` is the carried "trace the ~80 ms
+# residue" ask as one command; view the capture in TensorBoard.
+_TRACE_DIR = None
+
+
+def _maybe_trace_capture():
+    """A `trace_capture(_TRACE_DIR)` context when `--trace` armed the
+    capture, else a free no-op."""
+    if _TRACE_DIR is None:
+        return contextlib.nullcontext()
+    from deeplearning4j_tpu.profiler import trace_capture
+
+    return trace_capture(_TRACE_DIR)
 
 
 def _sync(net) -> float:
@@ -87,12 +109,17 @@ def _throughput(net, batches, warmup, bench, scan_steps=1,
     net.fit(bench_it, scan_steps=scan_steps)
     _sync(net)
     dts = []
-    for _ in range(_REPEATS):
+    for rep in range(_REPEATS):
         t0 = time.perf_counter()
-        for _e in range(epochs_per_pass):
-            bench_it.reset()
-            net.fit(bench_it, scan_steps=scan_steps)
-        _sync(net)
+        # --trace: capture the first timed pass only (the sync before
+        # stop_trace keeps the device work in-window). Profiling skews
+        # that pass's wall time; median-of-_REPEATS absorbs it.
+        with _maybe_trace_capture() if rep == 0 \
+                else contextlib.nullcontext():
+            for _e in range(epochs_per_pass):
+                bench_it.reset()
+                net.fit(bench_it, scan_steps=scan_steps)
+            _sync(net)
         dts.append((time.perf_counter() - t0) / epochs_per_pass)
     if return_dts:
         return dts
@@ -501,6 +528,19 @@ def bench_gpt_med():
             attention_block_size=1024, dropout=0.1)
     bench_gpt_med.dropout_rng_overhead_pct = round(
         (out[1] / per_row[1] - 1.0) * 100.0, 2)
+
+    # attention_block_size A/B (ROADMAP item 4 carried ask): same config
+    # re-traced with block 512 — at T=512 this turns full attention into
+    # the blockwise path. Positive pct = block 512 is that much slower
+    # at this shape (expected on-chip: full attention wins at short T,
+    # the gpt/gpt_long sweeps' crossover story, now measured here).
+    blk512 = _gpt_train_bench(
+        "gpt_med_d512_block512_probe",
+        vocab=512, d_model=512, n_heads=8, n_layers=8, T=512,
+        batch_size=64, warmup=3, bench=6,
+        attention_block_size=512, dropout=0.1)
+    bench_gpt_med.attention_block512_overhead_pct = round(
+        (out[1] / blk512[1] - 1.0) * 100.0, 2)
     return out[:4]
 
 
@@ -1303,8 +1343,11 @@ def bench_serve_generate():
     paged p50/p99 arrival→completion latency, `slot_occupancy_pct`,
     `pages_in_use_peak` + `prefill_chunks` (the new paging/chunking
     accounting), the r5 configuration's goodput + latency on the same
-    traffic, their ratio `paged_vs_r5_goodput`, and a GQA variant line
-    (`gpt_configuration(n_kv_heads=...)`) kept OFF the headline."""
+    traffic, their ratio `paged_vs_r5_goodput`, a GQA variant line
+    (`gpt_configuration(n_kv_heads=...)`) kept OFF the headline, and
+    `tracing_overhead_pct` — the goodput cost of serving observability
+    (on by default in the headline) vs the same traffic under the
+    `DL4J_TPU_NO_TRACING` kill switch (target < 2%)."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer import gpt_configuration
@@ -1462,6 +1505,30 @@ def bench_serve_generate():
     bench_serve_generate.paged_kernel_vs_gather = round(
         gather_dms / bench_serve_generate.device_ms_per_token, 3)
 
+    # tracing overhead A/B (ISSUE 12): the headline above ran with
+    # serving observability ON (its default) — every request carried a
+    # span timeline and the flight recorder logged scheduler events.
+    # Re-run the IDENTICAL paged config and traffic under the
+    # DL4J_TPU_NO_TRACING kill switch (fresh engine: traces become
+    # NULL_TRACE, recorder writes drop) and price the delta:
+    # `tracing_overhead_pct` = how much goodput tracing costs (positive
+    # = tracing is that much slower; acceptance target < 2%).
+    prior = os.environ.get("DL4J_TPU_NO_TRACING")
+    os.environ["DL4J_TPU_NO_TRACING"] = "1"
+    try:
+        untraced_goodput = engine_goodput(
+            net, shp["r5_n_slots"] * shp["slots_multiplier"],
+            pool_pages=kv_budget_pages, prompt_buckets=(short_t0,))[0]
+    finally:
+        if prior is None:
+            os.environ.pop("DL4J_TPU_NO_TRACING", None)
+        else:
+            os.environ["DL4J_TPU_NO_TRACING"] = prior
+    bench_serve_generate.untraced_goodput_tokens_per_sec = round(
+        untraced_goodput, 1)
+    bench_serve_generate.tracing_overhead_pct = round(
+        (untraced_goodput / goodput - 1.0) * 100.0, 2)
+
     # GQA variant line (not the headline: baseline comparability)
     gqa_net = build_net(n_kv_heads=shp["gqa_kv_heads"])
     gqa_goodput = engine_goodput(
@@ -1541,7 +1608,14 @@ def main() -> None:
     """No argument: run ALL configs and print ONE JSON line with every
     metric + MFU (the whole perf story, VERDICT r1 #1). With a config name:
     that config only (same line shape, single entry)."""
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    global _TRACE_DIR
+    args = list(sys.argv[1:])
+    for a in list(args):
+        if a == "--trace" or a.startswith("--trace="):
+            _TRACE_DIR = a.split("=", 1)[1] if "=" in a \
+                else "/tmp/dl4j_tpu_trace"
+            args.remove(a)
+    which = args[0] if args else "all"
     if which != "all" and which not in _CONFIGS:
         sys.exit(f"unknown bench config {which!r}; choose from "
                  f"{sorted(_CONFIGS)} or no arg for all")
@@ -1582,6 +1656,11 @@ def main() -> None:
                 ("shed_rate_pct", "shed_rate_pct"),
                 ("device_ms_per_token", "device_ms_per_token"),
                 ("dropout_rng_overhead_pct", "dropout_rng_overhead_pct"),
+                ("attention_block512_overhead_pct",
+                 "attention_block512_overhead_pct"),
+                ("tracing_overhead_pct", "tracing_overhead_pct"),
+                ("untraced_goodput_tokens_per_sec",
+                 "untraced_goodput_tokens_per_sec"),
                 ("paged_kernel_device_ms_per_token",
                  "paged_kernel_device_ms_per_token"),
                 ("paged_gather_device_ms_per_token",

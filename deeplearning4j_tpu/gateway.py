@@ -62,7 +62,15 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import observability
+
 logger = logging.getLogger("deeplearning4j_tpu")
+
+# Data-path RPCs that get a gateway-minted trace: the gateway is the
+# outermost hop, so these requests' span timelines start here and every
+# layer below (pool routing, server admission, engine scheduling) joins
+# the same trace_id via the thread-local binding.
+_TRACED_METHODS = frozenset({"predict", "evaluate", "generate"})
 
 
 class GatewayError(RuntimeError):
@@ -73,13 +81,21 @@ class GatewayError(RuntimeError):
 
     def __init__(self, msg: str, error_type: Optional[str] = None,
                  retry_after: Optional[float] = None,
-                 replica_id: Optional[int] = None):
+                 replica_id: Optional[int] = None,
+                 trace_id: Optional[str] = None,
+                 trace: Optional[dict] = None):
         super().__init__(msg)
         self.error_type = error_type
         self.retry_after = retry_after
         # present when a replicated pool produced the error: which
         # replica it originated on
         self.replica_id = replica_id
+        # present when serving-tier tracing is on: the request's id and
+        # span timeline across gateway → pool → server → engine, so a
+        # wire client holds the same postmortem an in-process caller
+        # reads off the typed error
+        self.trace_id = trace_id
+        self.trace = trace
 
 
 class RequestTooLargeError(RuntimeError):
@@ -364,6 +380,27 @@ class EntryPoint:
                 "server_stats instead")
         return srv.stats()
 
+    def metrics(self, name: Optional[str] = None) -> str:
+        """Prometheus-style text exposition of the serving tier's
+        metrics registry — one model's (by `name`) or every served
+        model's, each block labeled ``{model="<name>"}`` (pools add a
+        ``replica`` label per replica). The unified scrape surface for
+        the counters/gauges/histograms plus every layer's ``stats()``
+        dict flattened to gauges. Requires the serving tier."""
+        names = [name] if name is not None else sorted(self._models)
+        return "".join(
+            self._server(n).metrics_text(labels={"model": n})
+            for n in names)
+
+    def flight_record(self, name: str) -> dict:
+        """Model `name`'s flight-recorder dump: bounded rings of
+        completed request timelines, timelines pinned at typed
+        failures, and scheduler events (admissions, retirements, page
+        reclaims, probe verdicts, breaker transitions). A `ReplicaPool`
+        dump nests each replica's rings under ``"replicas"`` alongside
+        the pool's own routing ring. Requires the serving tier."""
+        return self._server(name).flight_record()
+
     def shutdown(self, drain_timeout: float = 10.0) -> None:
         """Drain and stop every ModelServer (called by
         `GatewayServer.stop`)."""
@@ -447,6 +484,7 @@ class GatewayServer:
                             "error_type": "RequestTooLargeError"})
                         return
                     req_id = None  # this request's id only — never stale
+                    trace = None  # minted per data-path request below
                     try:
                         req = json.loads(raw)
                         if isinstance(req, dict):
@@ -456,8 +494,23 @@ class GatewayServer:
                             raise AttributeError(req["method"])
                         method = getattr(entry, req["method"])
                         params = decode_value(req.get("params", {}))
-                        resp = {"id": req_id,
-                                "result": encode_value(method(**params))}
+                        if req["method"] in _TRACED_METHODS \
+                                and observability.tracing_enabled():
+                            # the gateway is the outermost hop: mint the
+                            # trace here and bind it thread-locally so
+                            # pool/server/engine spans join this id
+                            trace = observability.Trace()
+                            with observability.use_trace(trace), \
+                                    trace.span("gateway",
+                                               method=req["method"]):
+                                result = method(**params)
+                        else:
+                            result = method(**params)
+                        resp = {"id": req_id, "result": encode_value(result)}
+                        if trace is not None:
+                            trace.finish("served")
+                            resp["trace_id"] = trace.trace_id
+                            resp["trace"] = trace.to_dict()
                     # graftlint: disable=typed-error  RPC boundary: any
                     # server-side failure, typed or not, must be serialized
                     # to the client as a wire error (error_type/retry_after
@@ -475,6 +528,20 @@ class GatewayServer:
                         replica_id = getattr(e, "replica_id", None)
                         if replica_id is not None:
                             resp["replica_id"] = int(replica_id)
+                        # the postmortem travels on the wire: the
+                        # gateway-minted timeline when one exists, else
+                        # whatever the typed error carried up
+                        if trace is not None:
+                            trace.finish(type(e).__name__)
+                            resp["trace_id"] = trace.trace_id
+                            resp["trace"] = trace.to_dict()
+                        else:
+                            err_tid = getattr(e, "trace_id", None)
+                            if err_tid is not None:
+                                resp["trace_id"] = err_tid
+                            err_trace = getattr(e, "trace", None)
+                            if err_trace is not None:
+                                resp["trace"] = err_trace
                     if not self._respond(resp):
                         return
 
@@ -519,13 +586,20 @@ class GatewayClient:
     # naturally deduplicated on the server side (generate is seeded, so a
     # re-send recomputes the identical tokens)
     _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
-                             "server_stats", "pool_stats", "generate"})
+                             "server_stats", "pool_stats", "generate",
+                             "metrics", "flight_record"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05):
         self._host, self._port, self._timeout = host, port, timeout
         self.retry_backoff = retry_backoff
         self._next_id = 0
+        # the most recent response's trace (None when tracing is off or
+        # the method is not a traced data-path RPC) — lets callers
+        # correlate a result with the server-side span timeline without
+        # widening every return type
+        self.last_trace_id: Optional[str] = None
+        self.last_trace: Optional[dict] = None
         self._connect()
 
     def _connect(self) -> None:
@@ -564,11 +638,15 @@ class GatewayClient:
         if not line:
             raise ConnectionError("gateway closed the connection")
         resp = json.loads(line)
+        self.last_trace_id = resp.get("trace_id")
+        self.last_trace = resp.get("trace")
         if "error" in resp:
             raise GatewayError(resp["error"],
                                error_type=resp.get("error_type"),
                                retry_after=resp.get("retry_after"),
-                               replica_id=resp.get("replica_id"))
+                               replica_id=resp.get("replica_id"),
+                               trace_id=resp.get("trace_id"),
+                               trace=resp.get("trace"))
         return decode_value(resp["result"])
 
     def close(self):

@@ -120,3 +120,21 @@ def trace_annotation(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+@contextmanager
+def trace_capture(log_dir: str):
+    """Capture a `jax.profiler` trace over the with-block (the
+    block-scoped sibling of `XlaTraceListener`'s iteration window —
+    `bench.py --trace` wraps one timed benchmark rep in this). The
+    trace always stops, even when the block raises, so an aborted
+    bench never leaves the profiler armed for the next one."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("XLA trace written to %s (view in TensorBoard)",
+                    log_dir)

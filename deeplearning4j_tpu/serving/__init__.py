@@ -19,6 +19,16 @@ from deeplearning4j_tpu.serving.chaos import (
     SlowInferenceInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+from deeplearning4j_tpu.serving.observability import (
+    FlightRecorder,
+    MetricsRegistry,
+    Trace,
+    attach_trace,
+    current_trace,
+    maybe_trace,
+    tracing_enabled,
+    use_trace,
+)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
 from deeplearning4j_tpu.serving.model_server import (
@@ -43,8 +53,10 @@ __all__ = [
     "CircuitBreaker",
     "DeadlineExceededError",
     "DecodeEngine",
+    "FlightRecorder",
     "InferenceFailedError",
     "InjectedServingFault",
+    "MetricsRegistry",
     "ModelServer",
     "ModelValidationError",
     "OutOfPagesError",
@@ -60,4 +72,10 @@ __all__ = [
     "ServiceUnavailableError",
     "ServingError",
     "SlowInferenceInjector",
+    "Trace",
+    "attach_trace",
+    "current_trace",
+    "maybe_trace",
+    "tracing_enabled",
+    "use_trace",
 ]

@@ -110,6 +110,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import observability
 from deeplearning4j_tpu.serving.model_server import (
     DeadlineExceededError,
     InferenceFailedError,
@@ -137,7 +138,8 @@ class _GenRequest:
     __slots__ = ("prompt", "n_tokens", "temperature", "seed", "deadline",
                  "event", "tokens", "error", "enqueued_at", "probe",
                  "slot", "completed_at", "n_pages", "pages",
-                 "prefill_pos", "hit_len", "n_shared", "nodes", "digests")
+                 "prefill_pos", "hit_len", "n_shared", "nodes", "digests",
+                 "trace")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -164,6 +166,9 @@ class _GenRequest:
         self.n_shared = 0
         self.nodes: Optional[list] = None
         self.digests: list = []  # memoized per-chunk prompt digests
+        # the request timeline, carried across the caller-thread →
+        # scheduler-thread hop (thread-locals do not cross it)
+        self.trace = observability.NULL_TRACE
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -311,6 +316,15 @@ class DecodeEngine:
         (`serving.speculative.SpeculativeDecoder`): up to k+1 tokens
         per scheduler iteration in two dispatches, greedy argmax-exact
         and sampled distribution-exact for any draft.
+    recorder, metrics : optional shared
+        `serving.observability.FlightRecorder` / `MetricsRegistry` — a
+        `ModelServer`-owned engine passes its own so one
+        ``flight_record`` / ``metrics`` surface covers both layers;
+        a standalone engine builds private instances. Request
+        timelines (queue-wait, admission, prefix-bind, prefill chunks,
+        decode/spec-verify dispatches) ride `_GenRequest.trace`; all
+        recording is host-side and kill-switched by
+        ``DL4J_TPU_NO_TRACING=1``.
     """
 
     def __init__(self, net, *, n_slots: int = 4,
@@ -329,7 +343,9 @@ class DecodeEngine:
                  step_hooks: Sequence[Callable] = (),
                  decode_chunk: int = 4,
                  prefix_cache=None,
-                 speculative: Optional[dict] = None):
+                 speculative: Optional[dict] = None,
+                 recorder=None,
+                 metrics=None):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -401,6 +417,27 @@ class DecodeEngine:
         self.spec_proposed = 0  # guarded by: _cond
         self.spec_accepted = 0  # guarded by: _cond
         self.spec_emitted = 0  # guarded by: _cond
+        # observability: a ModelServer-owned engine shares the server's
+        # recorder + registry (one flight_record / metrics surface per
+        # replica); a standalone engine gets its own
+        self.recorder = recorder if recorder is not None \
+            else observability.FlightRecorder()
+        self.metrics = metrics if metrics is not None \
+            else observability.MetricsRegistry()
+        self.metrics.register_stats("decode_engine", self.stats)
+        self._gen_latency_hist = self.metrics.histogram(
+            "decode_engine_generate_latency_ms")
+        self.metrics.gauge("decode_engine_queued",
+                           lambda: len(self._queue))
+        self.metrics.gauge(
+            "decode_engine_pages_in_use",
+            lambda: self.pool_pages - len(self._free_pages))
+        if self.breaker is not None \
+                and getattr(self.breaker, "on_event", None) is None:
+            # standalone engines wire breaker transitions themselves; a
+            # server-owned breaker already feeds the shared recorder
+            self.breaker.on_event = lambda state: self.recorder.event(
+                "breaker", state=state)
         self._build(net)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-engine-scheduler")
@@ -724,7 +761,7 @@ class DecodeEngine:
             pc_kw = {} if self._prefix_cache_cfg is True \
                 else dict(self._prefix_cache_cfg)
             self._prefix_cache = PrefixCache(page, **pc_kw) \
-                .bind_guard(self._cond)
+                .bind_guard(self._cond).bind_recorder(self.recorder)
         self._spec = None
         if self._speculative_cfg is not None:
             from deeplearning4j_tpu.serving.speculative import (
@@ -851,6 +888,45 @@ class DecodeEngine:
         # back to the pool — a cap-driven eviction must never leak
         self._free_pages.extend(freed)
 
+    # -- observability -----------------------------------------------------
+    # graftlint: hot-loop
+    def _finish_obs(self, req: _GenRequest,
+                    err: Optional[BaseException] = None, **attrs) -> None:
+        """Terminal path for one generation request: stamp the
+        timeline's decision, attach it to the typed error (in-process
+        callers and the gateway payload both carry it), ring the flight
+        recorder, deliver. Pure host-side work — safe inside hot-loop
+        scopes. A batch-shared error instance is stamped last-writer-
+        wins (see `observability.attach_trace`)."""
+        decision = "served" if err is None else type(err).__name__
+        req.trace.finish(decision)
+        if err is not None:
+            observability.attach_trace(err, req.trace)
+        self.recorder.record(req.trace, decision, kind="generate",
+                             tokens=len(req.tokens), **attrs)
+        req.finish(err)
+
+    # graftlint: hot-loop
+    def _shed_obs(self, trace, err: BaseException, **attrs) -> None:
+        """Door-shed path (no request handle yet): finish the timeline
+        with the typed decision and pin it in the failures ring."""
+        decision = type(err).__name__
+        trace.finish(decision)
+        observability.attach_trace(err, trace)
+        self.recorder.record(trace, decision, kind="generate", **attrs)
+
+    def flight_record(self) -> dict:
+        """Dump the flight recorder (request timelines + scheduler
+        events) — shared with the owning `ModelServer` when there is
+        one."""
+        return self.recorder.dump()
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def metrics_text(self, labels=None) -> str:
+        return self.metrics.exposition(labels=labels)
+
     # -- public surface ----------------------------------------------------
     def submit(self, prompt_ids, n_tokens: int, *,
                temperature: float = 0.0, seed: int = 0,
@@ -882,32 +958,41 @@ class DecodeEngine:
                 f"request needs {need} KV pages of {self.page_size} "
                 f"tokens but the pool holds only {self.pool_pages} — "
                 "raise pool_pages or shorten the request")
+        trace = observability.maybe_trace()
         with self._cond:
             if self._closed:  # before the breaker door check: a closed
                 # engine must say "closed" (terminal), not "retry later"
-                raise ServerClosedError("decode engine is shut down")
+                err = ServerClosedError("decode engine is shut down")
+                self._shed_obs(trace, err)
+                raise err
         if self.breaker is not None:
             try:
                 self.breaker.reject_if_open()
-            except ServiceUnavailableError:
+            except ServiceUnavailableError as e:
                 with self._cond:
                     self.shed_unavailable += 1
+                self._shed_obs(trace, e)
                 raise
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
         req = _GenRequest(prompt.astype(np.int32), int(n_tokens),
                           float(temperature), int(seed), deadline)
         req.n_pages = need
+        req.trace = trace
         with self._cond:
             if self._closed:
-                raise ServerClosedError("decode engine is shut down")
+                err = ServerClosedError("decode engine is shut down")
+                self._shed_obs(trace, err)
+                raise err
             if len(self._queue) >= self.max_queue:
                 self.shed_overload += 1
                 retry = max(0.001, self._step_ewma
                             * (len(self._queue) / self.n_slots + 1))
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"generation queue full ({self.max_queue} pending); "
                     f"retry in {retry:.3f}s", retry_after=retry)
+                self._shed_obs(trace, err, queue_depth=len(self._queue))
+                raise err
             if self._pages_demand_queued \
                     and self._pages_demand_queued + need \
                     > self.max_queued_pages:
@@ -925,15 +1010,32 @@ class DecodeEngine:
                 n_live = sum(1 for r in self._slots if r is not None)
                 retry = max(0.001, self._step_ewma
                             * (len(self._queue) + n_live + 1))
-                raise OutOfPagesError(
+                err = OutOfPagesError(
                     f"KV page pool exhausted ({held}/{self.pool_pages} "
                     f"pages in use, {self._pages_demand_queued} queued "
                     f"demand of {self.max_queued_pages} allowed; {need} "
                     f"more needed); retry in {retry:.3f}s",
                     retry_after=retry)
+                # the shed timeline AND the events ring both name the
+                # page-demand decision — a flight_record dump after an
+                # OutOfPages burst shows exactly which reservation the
+                # door refused and what the pool looked like
+                demand = self._pages_demand_queued
+                self._shed_obs(trace, err, pages_needed=need,
+                               pages_in_use=held,
+                               queued_page_demand=demand,
+                               max_queued_pages=self.max_queued_pages)
+                self.recorder.event(
+                    "shed", error="OutOfPagesError", pages_needed=need,
+                    pages_in_use=held, queued_page_demand=demand,
+                    max_queued_pages=self.max_queued_pages)
+                raise err
             self._pages_demand_queued += need
             self.submitted += 1
             self._queue.append(req)
+            trace.event("enqueue", queue_depth=len(self._queue),
+                        pages_reserved=need,
+                        prompt_len=int(T0), n_tokens=int(n_tokens))
             self._cond.notify_all()
         return req
 
@@ -1034,6 +1136,7 @@ class DecodeEngine:
             self._swap_done.clear()
             self._draining = True
             self._cond.notify_all()
+        self.recorder.event("drain", reason="weight-swap")
         if not self._swap_done.wait(timeout):
             with self._cond:
                 # race guard: the scheduler may already be PAST the
@@ -1101,7 +1204,7 @@ class DecodeEngine:
                     while self._queue:
                         req = self._queue.popleft()
                         self._pages_demand_queued -= req.n_pages
-                        req.finish(ServerClosedError(
+                        self._finish_obs(req, ServerClosedError(
                             "engine shut down before this request "
                             "could be served"))
                     if not any(r is not None for r in self._slots):
@@ -1150,7 +1253,7 @@ class DecodeEngine:
         while self._queue:
             req = self._queue.popleft()
             self._pages_demand_queued -= req.n_pages
-            req.finish(err)  # never acquired the breaker
+            self._finish_obs(req, err)  # never acquired the breaker
         for s, req in enumerate(self._slots):
             if req is not None:
                 self._slots[s] = None
@@ -1161,7 +1264,7 @@ class DecodeEngine:
                     # half-open probe would wedge the shared breaker in
                     # half_open and reject ALL traffic until a reload
                     self.breaker.record_failure(req.probe)
-                req.finish(err)
+                self._finish_obs(req, err)
         self._cond.notify_all()
 
     # graftlint: hot-loop
@@ -1208,22 +1311,30 @@ class DecodeEngine:
                         # is pinned so reclaim cannot eat it
                         self._prefix_cache.acquire(nodes)
                         try:
-                            self._free_pages.extend(
-                                self._prefix_cache.reclaim(
-                                    need - len(self._free_pages)))
+                            reclaimed = self._prefix_cache.reclaim(
+                                need - len(self._free_pages))
                         finally:
                             self._prefix_cache.release(nodes)
+                        self._free_pages.extend(reclaimed)
+                        if reclaimed:
+                            self.recorder.event(
+                                "page-reclaim", pages=len(reclaimed),
+                                free_after=len(self._free_pages))
                     if need > len(self._free_pages):
                         return  # page-blocked: wait for a retirement
                 req = self._queue.popleft()
                 self._pages_demand_queued -= req.n_pages
-            if req.expired():
+            now = time.monotonic()
+            if req.expired(now):
                 with self._cond:
                     self.shed_deadline += 1
-                req.finish(DeadlineExceededError(
+                req.trace.add_timed("queue-wait", req.enqueued_at, now,
+                                    decision="expired")
+                self._finish_obs(req, DeadlineExceededError(
                     "deadline expired while queued; request shed before "
                     "prefill"))
                 continue
+            req.trace.add_timed("queue-wait", req.enqueued_at, now)
             probe = False
             if self.breaker is not None:
                 try:
@@ -1231,7 +1342,7 @@ class DecodeEngine:
                 except ServiceUnavailableError as e:
                     with self._cond:
                         self.shed_unavailable += 1
-                    req.finish(e)
+                    self._finish_obs(req, e)
                     continue
             req.probe = probe
             slot = free[0]
@@ -1250,6 +1361,15 @@ class DecodeEngine:
                     [self._free_pages.pop() for _ in range(need)]
                 held = self.pool_pages - len(self._free_pages)
                 self.pages_in_use_peak = max(self.pages_in_use_peak, held)
+            if nodes:
+                req.trace.event("prefix-bind", shared_pages=req.n_shared,
+                                hit_tokens=req.hit_len)
+            req.trace.event("admission", slot=slot, pages=len(req.pages),
+                            shared_pages=req.n_shared,
+                            pages_in_use=held)
+            self.recorder.event("admit", slot=slot, pages=len(req.pages),
+                                hit_tokens=req.hit_len,
+                                pages_in_use=held)
             row = np.zeros((self._n_pages_max,), np.int32)
             row[:len(req.pages)] = req.pages
             self._page_table = self._page_table.at[slot].set(
@@ -1300,7 +1420,12 @@ class DecodeEngine:
                 kp, kdec, jnp.asarray(req.temperature, jnp.float32))
             return jax.device_get((tok0, ok))
 
+        tp0 = time.monotonic()
         first, ok = _dispatched(run)
+        # host clock around the dispatch+materialization — already
+        # synced, so the span costs no extra device round-trip
+        req.trace.add_timed("prefill", tp0, time.monotonic(),
+                            bucket=bucket, prompt_len=t0)
         first = int(first[0])
         if not bool(ok):
             raise InferenceFailedError(
@@ -1388,8 +1513,11 @@ class DecodeEngine:
                 jnp.asarray(req.temperature, jnp.float32))
             return jax.device_get((tok0, ok))
 
+        tp0 = time.monotonic()
         try:
             first, ok = _dispatched(run)
+            req.trace.add_timed("prefill-chunk", tp0, time.monotonic(),
+                                chunk_off=off, width=W, final=final)
             if not bool(ok):
                 raise InferenceFailedError(
                     "model produced non-finite activations during chunked "
@@ -1444,7 +1572,7 @@ class DecodeEngine:
             InferenceFailedError(
                 f"prefill failed: {type(e).__name__}: {e}")
         logger.warning("decode engine: prefill failure (%s)", err)
-        req.finish(err)
+        self._finish_obs(req, err, phase="prefill")
         if self._donate and getattr(e, "_dispatch_failure", False):
             # the raised DISPATCH may have invalidated the DONATED page
             # pools — every in-flight slot's KV is gone with them, so
@@ -1471,7 +1599,7 @@ class DecodeEngine:
                     r.nodes = None  # ... and the prefix cache clears
                     if self.breaker is not None:
                         self.breaker.record_failure(r.probe)
-                    r.finish(err)
+                    self._finish_obs(r, err)
             self._cond.notify_all()
 
     def _retire(self, slot: int, req: _GenRequest, *,
@@ -1487,7 +1615,10 @@ class DecodeEngine:
             self._cond.notify_all()
         if self.breaker is not None:
             self.breaker.record_success(req.probe)
-        req.finish()
+        self._gen_latency_hist.observe(
+            1e3 * (time.monotonic() - req.enqueued_at))
+        self.recorder.event("retire", slot=slot, tokens=len(req.tokens))
+        self._finish_obs(req)
 
     # graftlint: hot-loop
     def _expire_in_flight(self) -> None:
@@ -1510,7 +1641,9 @@ class DecodeEngine:
             self._queue = keep
             self.shed_deadline += len(expired_queued)
         for req in expired_queued:
-            req.finish(DeadlineExceededError(
+            req.trace.add_timed("queue-wait", req.enqueued_at, now,
+                                decision="expired")
+            self._finish_obs(req, DeadlineExceededError(
                 "deadline expired while queued; request shed before "
                 "prefill"))
         for s in range(self.n_slots):
@@ -1526,7 +1659,7 @@ class DecodeEngine:
                     # the device work done so far was healthy; expiry is
                     # a deadline event, not a model failure
                     self.breaker.record_success(req.probe)
-                req.finish(DeadlineExceededError(
+                self._finish_obs(req, DeadlineExceededError(
                     f"deadline expired after {len(req.tokens)} of "
                     f"{req.n_tokens} tokens; slot freed"))
 
@@ -1576,7 +1709,7 @@ class DecodeEngine:
                 self._active[s] = False
                 self._free_request_pages_locked(req)
                 self._cond.notify_all()
-            req.finish(err)
+            self._finish_obs(req, err, phase="decode")
         if getattr(e, "_dispatch_failure", False):
             # only a failed DISPATCH can have invalidated the donated
             # pool buffers; hook failures leave them valid. Mid-prefill
@@ -1621,7 +1754,7 @@ class DecodeEngine:
                 self._cond.notify_all()
             if self.breaker is not None:
                 self.breaker.record_failure(req.probe)
-            req.finish(nf_err)
+            self._finish_obs(req, nf_err, phase="decode")
         elif done:
             self._retire(s, req)
 
@@ -1670,6 +1803,7 @@ class DecodeEngine:
 
             out, n_emit, oks = _dispatched(run)
             self._hook("post_decode", info)
+            t1c = time.monotonic()
         # graftlint: disable=typed-error  converts to a typed failure:
         # _decode_failure wraps the cause in InferenceFailedError for the
         # affected slots and recovers the pool
@@ -1679,7 +1813,7 @@ class DecodeEngine:
         emitted = int(sum(max(1, int(n_emit[s])) for s, _ in live))
         with self._cond:
             self._step_ewma = (0.8 * self._step_ewma
-                               + 0.2 * (time.monotonic() - t0c)
+                               + 0.2 * (t1c - t0c)
                                * len(live) / max(1, emitted))
             self.decode_steps += 1
             self.active_slot_steps += len(live)
@@ -1695,6 +1829,8 @@ class DecodeEngine:
         delivered = 0
         for s, req in live:
             n = max(1, int(n_emit[s]))
+            req.trace.add_timed("spec-verify", t0c, t1c, k=k,
+                                emitted=n, active=len(live))
             before = len(req.tokens)
             self._retire_or_poison(s, req, out[s, :n],
                                    np.repeat(oks[s], n), n)
@@ -1753,13 +1889,16 @@ class DecodeEngine:
         except BaseException as e:
             self._decode_failure(live, e)
             return
+        t1 = time.monotonic()
         n_steps = toks.shape[0]
         with self._cond:
             self._step_ewma = (0.8 * self._step_ewma
-                               + 0.2 * (time.monotonic() - t0) / n_steps)
+                               + 0.2 * (t1 - t0) / n_steps)
             self.decode_steps += n_steps
             self.active_slot_steps += len(live) * n_steps
         for s, req in live:
+            req.trace.add_timed("decode", t0, t1, steps=n_steps,
+                                active=len(live))
             # per-step, per-slot non-finite screen (predict's breaker
             # discipline): a poisoned step fails THIS request typed —
             # unless it already completed via EOS at an earlier step of
@@ -1813,17 +1952,21 @@ class DecodeEngine:
                 self._queue = keep
                 self._pages_demand_queued = reserved
             for r in misfit:
-                r.finish(ServingError(
+                self._finish_obs(r, ServingError(
                     f"request (prompt {r.prompt.shape[0]} + n_tokens "
                     f"{r.n_tokens}) no longer fits the swapped engine's "
                     f"max_len {self.max_len} / {self.pool_pages}-page "
                     "pool"))
+            self.recorder.event("swap", decision="complete",
+                                misfit=len(misfit))
         # graftlint: disable=typed-error  deliberate absorb: a rejected
         # swap keeps the OLD weights serving; the error is stored for
         # drain_and_swap's caller to re-raise
         except BaseException as e:
             with self._cond:
                 self._swap_error = e
+            self.recorder.event("swap", decision="rejected",
+                                error=type(e).__name__)
             logger.warning("decode engine: weight swap rejected (%s); "
                            "old weights still serving", e)
         finally:

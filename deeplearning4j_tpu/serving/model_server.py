@@ -67,6 +67,19 @@ Chaos seam: `infer_hooks=[hook]` fires `hook(phase, info)` at
 `serving.chaos.SlowInferenceInjector` and `BrokenModelInjector` use it to
 drive the overload and breaker ladders end to end
 (`tests/test_serving.py`).
+
+Observability (`serving/observability.py`): every request joins (or
+mints) a `Trace` — queue-wait and device-step spans recorded by the
+executor, the end decision (``served`` / typed-error class name)
+stamped at the `predict` exit and attached to the raised
+`ServingError` (`attach_trace`) so gateway error payloads carry the
+timeline. The server owns a `MetricsRegistry` (predict-latency
+histogram, queue-depth/in-flight gauges, its own ``stats()`` adopted
+as a component snapshot) and a `FlightRecorder` ring (completed
+timelines, breaker transitions, reload/rollback events), both shared
+with the lazily-built decode engine and exposed via
+`metrics_text()`/`flight_record()` → the gateway ``metrics`` /
+``flight_record`` RPCs. See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -80,6 +93,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import observability
 from deeplearning4j_tpu.util.concurrency import assert_owned
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -345,7 +359,7 @@ class CircuitBreaker:
 
 class _Request:
     __slots__ = ("features", "deadline", "event", "result", "error",
-                 "enqueued_at")
+                 "enqueued_at", "trace")
 
     def __init__(self, features, deadline: Optional[float]):
         self.features = features
@@ -354,6 +368,9 @@ class _Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
+        # the request's timeline, carried across the caller-thread →
+        # executor-thread hop (thread-locals don't cross it)
+        self.trace = observability.NULL_TRACE
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -412,6 +429,20 @@ class ModelServer:
         self.infer_hooks: List[Callable] = list(infer_hooks)
         self.breaker = CircuitBreaker(failure_threshold=breaker_threshold,
                                       reset_timeout=breaker_reset_timeout)
+        # observability: registry + flight recorder, shared with the
+        # decode engine (built lazily below) so one snapshot / one dump
+        # covers both serving paths. Breaker transitions ring as events.
+        self.metrics = observability.MetricsRegistry()
+        self.recorder = observability.FlightRecorder()
+        self.metrics.register_stats("model_server", self.stats)
+        self._latency_hist = self.metrics.histogram(
+            "model_server_predict_latency_ms")
+        self._step_hist = self.metrics.histogram("model_server_step_ms")
+        self.metrics.gauge("model_server_queue_depth",
+                           lambda: len(self._queue))
+        self.metrics.gauge("model_server_in_flight",
+                           lambda: self._in_flight)
+        self.breaker.on_event = self._breaker_event
         self._canary = None if canary is None else np.asarray(canary)  # guarded by: _cond
         # with auto_canary, the first successfully-served request donates
         # its leading row as the reload-validation batch — a server that
@@ -456,6 +487,36 @@ class ModelServer:
     def net(self):
         """The live model (read-only peek; swapped by `reload`)."""
         return self._net
+
+    def _breaker_event(self, state: str) -> None:
+        # fired by CircuitBreaker OUTSIDE its lock (see _fire)
+        self.recorder.event("breaker", state=state)
+        self.metrics.counter("model_server_breaker_transitions").inc()
+
+    def _shed_obs(self, trace, err: BaseException, kind: str = "predict"):
+        """Stamp a typed give-up onto the request's timeline, attach the
+        timeline to the error (so it rides the wire), and pin it in the
+        flight recorder's failure ring."""
+        decision = type(err).__name__
+        trace.finish(decision)
+        observability.attach_trace(err, trace)
+        self.recorder.record(trace, decision, kind=kind)
+
+    def flight_record(self) -> dict:
+        """Serialized flight-recorder dump (completed request timelines,
+        pinned failures, breaker/reload scheduler events) — the payload
+        of the gateway ``flight_record`` RPC."""
+        return self.recorder.dump()
+
+    def metrics_text(self, labels=None) -> str:
+        """Prometheus-style text exposition of the metrics registry —
+        the payload of the gateway ``metrics`` RPC. `labels` (e.g.
+        ``{"model": name}``) keep multi-model expositions collision-
+        free on one scrape page."""
+        return self.metrics.exposition(labels=labels)
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
 
     def stats(self) -> dict:
         with self._cond:
@@ -524,41 +585,59 @@ class ModelServer:
                 f"{x.shape} — wrap a single example as x[None]")
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
+        # join the upstream trace (gateway/pool, via thread-local) or
+        # mint one at this in-process entry point
+        trace = observability.maybe_trace()
         # fail fast at the door while the breaker is open: these requests
         # must not consume queue capacity that recovered traffic needs
         # (reject_if_open never takes the half-open probe slot — only the
         # executor's acquire/record pair may)
         try:
             self.breaker.reject_if_open()
-        except ServiceUnavailableError:
+        except ServiceUnavailableError as e:
             with self._cond:
                 self.shed_unavailable += 1
+            self._shed_obs(trace, e)
             raise
         req = _Request(x, deadline)
+        req.trace = trace
+        err: Optional[ServingError] = None
         with self._cond:
             if self._closed:
-                raise ServerClosedError("model server is shut down")
-            if len(self._queue) >= self.max_queue:
+                err = ServerClosedError("model server is shut down")
+            elif len(self._queue) >= self.max_queue:
                 self.shed_overload += 1
                 # backlog ÷ capacity × EWMA step latency: how long until
                 # the queue has likely drained enough to admit us
                 retry = max(0.001, self._step_latency_ewma
                             * (len(self._queue) / max(1, len(self._threads))
                                / max(1, self.max_batch_size) + 1))
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"request queue full ({self.max_queue} pending); "
                     f"retry in {retry:.3f}s", retry_after=retry)
-            self._queue.append(req)
-            self._cond.notify()
+            else:
+                trace.event("admission", queue_depth=len(self._queue))
+                self._queue.append(req)
+                self._cond.notify()
+        if err is not None:
+            self._shed_obs(trace, err)
+            raise err
         wait = None if deadline is None \
             else max(0.0, deadline - time.monotonic()) + 30.0
         if not req.event.wait(wait):  # executor always finishes requests;
-            raise InferenceFailedError(  # this is a belt-and-braces bound
+            err = InferenceFailedError(  # this is a belt-and-braces bound
                 "request was never completed (executor stalled)")
+            self._shed_obs(trace, err)
+            raise err
         if req.error is not None:
+            self._shed_obs(trace, req.error)
             raise req.error
         with self._cond:
             self.served += 1
+        trace.finish("served")
+        self._latency_hist.observe(
+            1e3 * (time.monotonic() - req.enqueued_at))
+        self.recorder.record(trace, "served", kind="predict")
         return req.result
 
     def __call__(self, x, timeout: Optional[float] = None) -> np.ndarray:
@@ -629,6 +708,8 @@ class ModelServer:
             if engine is not None:
                 engine.drain_and_swap(net)
             self.breaker.reset()
+            self.recorder.event("reload", decision="rolled-back",
+                                model_version=version)
             logger.warning("model server: restored previous model "
                            "(model_version=%d)", version)
             return version
@@ -656,6 +737,11 @@ class ModelServer:
                 cfg = dict(self._generation_cfg)
                 cfg.setdefault("max_queue", self.max_queue)
                 cfg.setdefault("breaker", self.breaker)
+                # one recorder/registry across both serving paths: the
+                # engine's scheduler events and generate timelines land
+                # in the same dump as predicts and breaker transitions
+                cfg.setdefault("recorder", self.recorder)
+                cfg.setdefault("metrics", self.metrics)
                 self._engine = DecodeEngine(self._net, **cfg)
             return self._engine
 
@@ -774,6 +860,9 @@ class ModelServer:
                     self._cond.notify_all()
             if not live:
                 continue
+            for req in live:  # host-side bookkeeping only
+                req.trace.add_timed("queue-wait", req.enqueued_at, now,
+                                    batch=len(live))
             try:
                 probe = self.breaker.acquire()
             except ServiceUnavailableError as e:
@@ -820,9 +909,18 @@ class ModelServer:
             self._hook("pre_step", info)
             out = np.asarray(self._net.output(feats))
             self._hook("post_step", info)
+        t1 = time.monotonic()
+        # one device step serves the whole micro-batch: the same span
+        # lands on every member's timeline (host floats only — never
+        # device values, per the host-sync recorder discipline)
+        for req in batch:
+            req.trace.add_timed("device-step", t0, t1, rows=rows,
+                                padded=padded, requests=len(batch),
+                                model_version=info["model_version"])
+        self._step_hist.observe(1e3 * (t1 - t0))
         with self._cond:  # concurrent executors must not lose updates
             self._step_latency_ewma = (0.8 * self._step_latency_ewma
-                                       + 0.2 * (time.monotonic() - t0))
+                                       + 0.2 * (t1 - t0))
             self.batches += 1
             self.rows_dispatched += rows
         out = out[:rows]
@@ -862,12 +960,14 @@ class ModelServer:
             try:
                 candidate = self._load_candidate(source, step)
                 self._validate_candidate(candidate, canary)
-            except Exception:
+            except Exception as e:
                 # every pre-swap failure is a rejected deploy: integrity
                 # (CheckpointCorruptError) and canary rejections alike
                 # must show in the telemetry counter
                 with self._cond:
                     self.reload_rejections += 1
+                self.recorder.event("reload", decision="rejected",
+                                    error=type(e).__name__)
                 raise
             with self._rwlock.write():
                 old_net = self._net
@@ -901,9 +1001,13 @@ class ModelServer:
                         self.model_version += 1
                     with self._cond:
                         self.reload_rejections += 1
+                    self.recorder.event("reload", decision="rolled-back",
+                                        model_version=self.model_version)
                     raise
             self.breaker.reset()
             self.reloads += 1
+            self.recorder.event("reload", decision="complete",
+                                model_version=version)
             logger.warning("model server: hot reload complete "
                            "(model_version=%d)", version)
             return version

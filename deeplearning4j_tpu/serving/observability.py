@@ -1,0 +1,645 @@
+"""Serving-tier observability: request tracing, metrics registry, and
+flight recorder for the gateway → ReplicaPool → ModelServer →
+DecodeEngine stack.
+
+The serving layers (PRs 4-9) expose only point-in-time ``stats()``
+counters — when a request sheds, fails over, hedges, or takes a p99
+excursion there is no record of *where the time went* or *which layer
+decided what*. This module closes that gap with three pieces, in the
+spirit of Dapper-style always-on tracing:
+
+- **Request tracing** (`Trace`/`Span`): a ``trace_id`` minted at the
+  gateway (or at `ReplicaPool`/`ModelServer`/`DecodeEngine` entry for
+  in-process callers) and threaded through every layer. Each layer
+  records typed spans — queue-wait, admission, prefix-bind, per-chunk
+  prefill, decode, speculative verify rounds, failover hops, hedge
+  fire/win, reload drain — with `time.monotonic()` timestamps and the
+  decision that ended them (``served`` / a typed-error class name /
+  ``evicted`` / ``rolled-back``). Propagation is by thread-local
+  (`use_trace`/`current_trace`) across the synchronous gateway → pool
+  → server call chain, and by the request object (`_Request.trace`,
+  `_GenRequest.trace`) across the executor/scheduler thread hop. The
+  timeline rides responses and every `ServingError` (`attach_trace`),
+  so `GatewayError` payloads carry it over the wire.
+
+- **Metrics registry** (`MetricsRegistry`): lock-cheap counters,
+  gauges (bindable to a callable) and bounded-bucket histograms, plus
+  `register_stats` adapters that pull today's ad-hoc ``stats()`` dicts
+  into one `snapshot()` and a Prometheus-style `exposition()` text
+  format served by the gateway ``metrics`` RPC.
+
+- **Flight recorder** (`FlightRecorder`): fixed-size rings of completed
+  request timelines and scheduler events (admissions, retirements,
+  page reclaims, probe verdicts, breaker transitions). Timelines that
+  end in a typed failure are additionally pinned in a separate
+  ``failures`` ring (the auto-snapshot: a burst of successes cannot
+  push a postmortem out), dumpable via the gateway ``flight_record``
+  RPC.
+
+Hot-path discipline: every recording call is pure host-side arithmetic
+(monotonic reads, int/str attrs, deque appends). Nothing here may
+receive a device array — formatting one would block the scheduler
+thread on the device stream, which is exactly the hazard the graftlint
+``host-sync`` rule now also flags for recorder calls inside
+``# graftlint: hot-loop`` scopes. The whole subsystem is kill-switched
+by ``DL4J_TPU_NO_TRACING=1`` (spans become no-ops on the shared
+`NULL_TRACE`, the recorder drops writes); `bench.py serve_generate`
+prices the on-vs-off goodput delta as ``tracing_overhead_pct``.
+
+Spans also name host phases in XLA/Perfetto traces: when
+``DL4J_TPU_XLA_SPAN_ANNOTATIONS=1``, `Trace.span` wraps
+`profiler.trace_annotation`, so a `jax.profiler` capture (e.g.
+``bench.py --trace``) shows ``serve:prefill-chunk`` etc. interleaved
+with the XLA op timeline. Off by default: annotations cost a context
+manager per span even with no profiler attached.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from bisect import bisect_right
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
+    "NULL_TRACE", "Span", "Trace", "attach_trace", "current_trace",
+    "maybe_trace", "new_trace_id", "tracing_enabled", "use_trace",
+]
+
+_KILL_ENV = "DL4J_TPU_NO_TRACING"
+_XLA_ANNOTATE_ENV = "DL4J_TPU_XLA_SPAN_ANNOTATIONS"
+
+
+def tracing_enabled() -> bool:
+    """The kill switch: ``DL4J_TPU_NO_TRACING=1`` turns every trace
+    into `NULL_TRACE` and every recorder write into a no-op — the
+    baseline side of the in-bench ``tracing_overhead_pct`` A/B."""
+    return os.environ.get(_KILL_ENV, "") not in ("1", "true", "yes")
+
+
+def _xla_annotations_enabled() -> bool:
+    return os.environ.get(_XLA_ANNOTATE_ENV, "") in ("1", "true", "yes")
+
+
+def annotation(name: str):
+    """A ``serve:<name>`` `profiler.trace_annotation` context when
+    ``DL4J_TPU_XLA_SPAN_ANNOTATIONS=1``, else a free no-op — lets
+    serving internals (draft mirrors, verify drivers) name themselves
+    in a `jax.profiler` capture without paying for the context manager
+    when no one is looking."""
+    if _xla_annotations_enabled():
+        from deeplearning4j_tpu.profiler import trace_annotation
+
+        return trace_annotation(f"serve:{name}")
+    return _NullContext()
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+# -- spans / traces --------------------------------------------------------
+
+class Span:
+    """One typed interval on a request timeline. ``decision`` is how it
+    ended: None (still open / informational event), ``"ok"``, or the
+    layer's verdict (``"served"``, a typed-error class name,
+    ``"evicted"``, ``"rolled-back"``)."""
+
+    __slots__ = ("name", "t0", "t1", "decision", "attrs")
+
+    def __init__(self, name: str, t0: float, t1: Optional[float] = None,
+                 decision: Optional[str] = None,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.decision = decision
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "t0": self.t0,
+             "t1": self.t1 if self.t1 is not None else self.t0}
+        if self.decision is not None:
+            d["decision"] = self.decision
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+class Trace:
+    """A request's causal timeline: a ``trace_id`` plus a bounded,
+    thread-safe list of `Span`s (monotonic-clock timestamps — compare
+    within a process, not across hosts). Spans are appended from
+    several threads (gateway handler, pool hedges, server executor,
+    engine scheduler); `to_dict` orders them by start time, which is
+    causal order for the single request they all describe."""
+
+    MAX_SPANS = 512
+
+    __slots__ = ("trace_id", "decision", "_spans", "_lock", "_dropped",
+                 "created_at")
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.decision: Optional[str] = None
+        self.created_at = time.time()
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def _append(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) >= self.MAX_SPANS:
+                self._dropped += 1
+                return
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record ``name`` over the with-block. An escaping exception
+        stamps the span's decision with the exception class name and
+        re-raises; otherwise the decision is ``"ok"`` (callers may
+        overwrite via the yielded span). With
+        ``DL4J_TPU_XLA_SPAN_ANNOTATIONS=1`` the block is also wrapped
+        in `profiler.trace_annotation`, naming the phase in any active
+        `jax.profiler` capture."""
+        sp = Span(name, time.monotonic(), attrs=attrs or None)
+        self._append(sp)
+        try:
+            if _xla_annotations_enabled():
+                from deeplearning4j_tpu.profiler import trace_annotation
+
+                with trace_annotation(f"serve:{name}"):
+                    yield sp
+            else:
+                yield sp
+        except BaseException as e:
+            sp.t1 = time.monotonic()
+            sp.decision = type(e).__name__
+            raise
+        sp.t1 = time.monotonic()
+        if sp.decision is None:
+            sp.decision = "ok"
+
+    def event(self, name: str, **attrs) -> None:
+        """A point-in-time mark (zero-width span)."""
+        self._append(Span(name, time.monotonic(), attrs=attrs or None))
+
+    def add_timed(self, name: str, t0: float, t1: float,
+                  decision: Optional[str] = None, **attrs) -> None:
+        """Record an interval measured by the caller (e.g. queue-wait
+        from a request's ``enqueued_at`` to its admission)."""
+        self._append(Span(name, t0, t1, decision, attrs or None))
+
+    def finish(self, decision: str) -> None:
+        self.decision = decision
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            spans = sorted(self._spans, key=lambda s: s.t0)
+            out = {"trace_id": self.trace_id,
+                   "spans": [s.to_dict() for s in spans]}
+            if self.decision is not None:
+                out["decision"] = self.decision
+            if self._dropped:
+                out["dropped_spans"] = self._dropped
+            return out
+
+    def __bool__(self) -> bool:
+        return True
+
+
+class _NullContext:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullTrace:
+    """Shared no-op trace returned by `maybe_trace` when the kill
+    switch is set — callers record unconditionally and pay one falsy
+    method call instead of branching everywhere."""
+
+    __slots__ = ()
+    trace_id = None
+    decision = None
+    _null_ctx = _NullContext()
+
+    def span(self, name, **attrs):
+        return self._null_ctx
+
+    def event(self, name, **attrs):
+        pass
+
+    def add_timed(self, name, t0, t1, decision=None, **attrs):
+        pass
+
+    def finish(self, decision):
+        pass
+
+    def to_dict(self):
+        return None
+
+    def __bool__(self):
+        return False
+
+
+NULL_TRACE = _NullTrace()
+
+_tls = threading.local()
+
+
+def current_trace() -> Optional[Trace]:
+    """The trace bound to this thread by `use_trace` (None outside)."""
+    return getattr(_tls, "trace", None)
+
+
+@contextmanager
+def use_trace(trace):
+    """Bind `trace` to the current thread so downstream layers on the
+    same synchronous call chain (`maybe_trace`) join it instead of
+    minting their own — how the gateway's trace_id reaches the engine
+    without threading a parameter through every signature."""
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = trace
+    try:
+        yield trace
+    finally:
+        _tls.trace = prev
+
+
+def maybe_trace(trace=None):
+    """Resolve the trace for a request entering a serving layer: an
+    explicit one wins, else the thread-local one (an upstream layer's),
+    else mint a fresh `Trace` — or `NULL_TRACE` when kill-switched."""
+    t = trace if trace is not None else current_trace()
+    if t is not None:
+        return t
+    return Trace() if tracing_enabled() else NULL_TRACE
+
+
+def attach_trace(err: BaseException, trace) -> None:
+    """Stamp ``trace_id`` and the serialized timeline onto a
+    `ServingError` (best-effort — same idiom as the pool's replica_id
+    tagging) so in-process callers and the gateway error payload both
+    carry the timeline. A batch-shared exception instance can be
+    stamped by several waiter threads; last writer wins, and each
+    writer's timeline names the same batch, so any of them serves the
+    postmortem."""
+    if not trace:
+        return
+    try:
+        err.trace_id = trace.trace_id
+        err.trace = trace.to_dict()
+    # graftlint: disable=typed-error  best-effort attachment: a slotted
+    # or exotic exception type that rejects new attributes must not turn
+    # error delivery itself into a second failure
+    except Exception:
+        pass
+
+
+# -- metrics registry ------------------------------------------------------
+
+class Counter:
+    """Monotonic counter. One uncontended lock per `inc` — cheap
+    against the ~ms-scale operations it counts."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        return self._v
+
+
+class Gauge:
+    """Point-in-time value: either `set()` by the owner or bound to a
+    zero-argument callable sampled at snapshot time (how queue depth /
+    pages-in-use track the live scheduler state without a write on
+    every transition)."""
+
+    __slots__ = ("name", "_v", "_fn")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self._v = 0.0
+        self._fn = fn
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            # graftlint: disable=typed-error  a gauge reads live
+            # component state that may be mid-teardown; a scrape must
+            # report None, never propagate the component's failure
+            except Exception:
+                return None
+        return self._v
+
+
+#: upper bounds (ms) for latency histograms — bounded cardinality by
+#: construction, wide enough for queue-wait through whole-generate.
+DEFAULT_LATENCY_BUCKETS_MS = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram (`buckets` are inclusive upper bounds;
+    one implicit +Inf bucket). `observe` is a bisect plus two adds
+    under an uncontended lock."""
+
+    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_lock")
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect_right(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"buckets": list(self.buckets),
+                    "counts": list(self._counts),
+                    "count": self._count,
+                    "sum": round(self._sum, 3)}
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _flatten_numeric(prefix: str, obj, out: List) -> None:
+    if isinstance(obj, bool):
+        out.append((prefix, int(obj)))
+    elif isinstance(obj, (int, float)):
+        out.append((prefix, obj))
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten_numeric(f"{prefix}_{_sanitize(str(k))}", v, out)
+    # strings / lists / None are identity or timeline data, not metrics
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms plus ``stats()`` adapters.
+
+    `snapshot()` is the one structured view — first-class instruments
+    under ``counters``/``gauges``/``histograms`` and every registered
+    component's ad-hoc ``stats()`` dict under ``components`` (the
+    schema the contract test in `tests/test_observability.py` pins).
+    `exposition()` renders the same data as Prometheus text; numeric
+    leaves of component stats become gauges with underscore-joined
+    paths, so today's counters are scrapeable without re-plumbing each
+    one as a first-class instrument."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._stats_fns: Dict[str, Callable[[], dict]] = {}
+
+    # get-or-create: layers can share one registry without coordinating
+    # construction order
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, fn)
+            elif fn is not None:
+                g._fn = fn
+            return g
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS
+                  ) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets)
+            return h
+
+    def register_stats(self, name: str, fn: Callable[[], dict]) -> None:
+        """Adopt a component's existing ``stats()`` provider under
+        ``components[name]`` in the snapshot."""
+        with self._lock:
+            self._stats_fns[name] = fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+            stats_fns = dict(self._stats_fns)
+        components = {}
+        for name, fn in stats_fns.items():
+            try:
+                components[name] = fn()
+            # graftlint: disable=typed-error  a dying component must
+            # not take the whole metrics snapshot down; its slot names
+            # the failure instead
+            except Exception as e:
+                components[name] = {"error": type(e).__name__}
+        return {
+            "counters": {n: c.value for n, c in counters.items()},
+            "gauges": {n: g.value for n, g in gauges.items()},
+            "histograms": {n: h.snapshot() for n, h in hists.items()},
+            "components": components,
+        }
+
+    def exposition(self, namespace: str = "dl4j",
+                   labels: Optional[dict] = None) -> str:
+        """Prometheus-style text exposition of `snapshot()`."""
+        snap = self.snapshot()
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(
+                f'{_sanitize(str(k))}="{v}"'
+                for k, v in sorted(labels.items())) + "}"
+        lines: List[str] = []
+
+        def emit(name, kind, value):
+            full = f"{namespace}_{_sanitize(name)}"
+            lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"{full}{lab} {value}")
+
+        for name, v in sorted(snap["counters"].items()):
+            emit(name, "counter", v)
+        for name, v in sorted(snap["gauges"].items()):
+            if v is not None:
+                emit(name, "gauge", v)
+        for name, h in sorted(snap["histograms"].items()):
+            full = f"{namespace}_{_sanitize(name)}"
+            lines.append(f"# TYPE {full} histogram")
+            cum = 0
+            for bound, cnt in zip(h["buckets"], h["counts"]):
+                cum += cnt
+                if labels:
+                    le = lab[:-1] + f',le="{bound}"}}'
+                else:
+                    le = f'{{le="{bound}"}}'
+                lines.append(f"{full}_bucket{le} {cum}")
+            if labels:
+                le = lab[:-1] + ',le="+Inf"}'
+            else:
+                le = '{le="+Inf"}'
+            lines.append(f"{full}_bucket{le} {h['count']}")
+            lines.append(f"{full}_sum{lab} {h['sum']}")
+            lines.append(f"{full}_count{lab} {h['count']}")
+        flat: List = []
+        for comp, stats in sorted(snap["components"].items()):
+            _flatten_numeric(_sanitize(comp), stats, flat)
+        for name, v in flat:
+            emit(f"stats_{name}", "gauge", v)
+        return "\n".join(lines) + "\n"
+
+
+# -- flight recorder -------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded rings of (a) completed request timelines, (b) timelines
+    that ended in a typed failure (the auto-snapshot ring: success
+    traffic cannot push a postmortem out before anyone looks), and
+    (c) scheduler/control-plane events. Traces are stored by reference
+    and serialized at `dump()` time, so spans recorded after the
+    initial `record` (e.g. a pool-level failover wrapping a replica's
+    already-recorded attempt) still appear in the dump.
+
+    Sizing: the defaults (256 requests / 64 failures / 1024 events)
+    hold a few seconds of saturated decode traffic — see
+    docs/observability.md for the arithmetic. All writes are O(1) deque
+    appends and respect the `tracing_enabled` kill switch."""
+
+    def __init__(self, capacity: int = 256, failure_capacity: int = 64,
+                 event_capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._requests = deque(maxlen=capacity)
+        self._failures = deque(maxlen=failure_capacity)
+        self._events = deque(maxlen=event_capacity)
+
+    def record(self, trace, decision: str, kind: str = "request",
+               **attrs) -> None:
+        """Ring a completed request timeline. ``decision`` is the
+        verdict that ended it (``served`` or a typed-error class name);
+        non-served timelines are also pinned in the failures ring."""
+        if not trace or not tracing_enabled():
+            return
+        entry = {"kind": kind, "decision": decision,
+                 "wall_time": time.time(), "trace": trace}
+        if attrs:
+            entry["attrs"] = attrs
+        with self._lock:
+            self._requests.append(entry)
+            if decision != "served":
+                self._failures.append(entry)
+
+    def event(self, kind: str, **attrs) -> None:
+        """Ring a scheduler/control-plane event (admission, retirement,
+        page reclaim, probe verdict, breaker transition, chaos)."""
+        if not tracing_enabled():
+            return
+        e = {"kind": kind, "t": time.monotonic(), "wall_time": time.time()}
+        if attrs:
+            e.update(attrs)
+        with self._lock:
+            self._events.append(e)
+
+    @staticmethod
+    def _ser(entry: dict) -> dict:
+        out = {k: v for k, v in entry.items() if k != "trace"}
+        tr = entry["trace"]
+        out["trace"] = tr.to_dict() if hasattr(tr, "to_dict") else tr
+        return out
+
+    def dump(self) -> dict:
+        with self._lock:
+            requests = list(self._requests)
+            failures = list(self._failures)
+            events = list(self._events)
+        return {
+            "requests": [self._ser(e) for e in requests],
+            "failures": [self._ser(e) for e in failures],
+            "events": events,
+            "capacity": {"requests": self._requests.maxlen,
+                         "failures": self._failures.maxlen,
+                         "events": self._events.maxlen},
+        }
+
+
+# -- stats-schema contracts ------------------------------------------------
+# The single source of truth for the key sets the serving layers'
+# ``stats()`` dicts promise (tests and external scrapers rely on them;
+# the gateway `server_stats`/`pool_stats` RPCs return these dicts
+# verbatim). Layers may ADD keys; removing or renaming one is a
+# breaking change and must update these sets plus
+# docs/observability.md. Pinned in one place by
+# tests/test_observability.py via `MetricsRegistry.snapshot()`.
+
+MODEL_SERVER_STATS_KEYS = frozenset({
+    "served", "batches", "batch_fill_pct", "shed_overload",
+    "shed_deadline", "shed_unavailable", "failures", "reloads",
+    "reload_rejections", "breaker_state", "breaker_opens",
+    "model_version", "queued", "in_flight", "queue_depth",
+    "ewma_latency_ms",
+})
+
+DECODE_ENGINE_STATS_KEYS = frozenset({
+    "submitted", "served", "shed_overload", "shed_out_of_pages",
+    "shed_deadline", "shed_unavailable", "failures", "prefills",
+    "prefill_chunks", "decode_steps", "tokens_generated",
+    "slot_occupancy_pct", "n_slots", "active_slots", "queued", "swaps",
+    "max_len", "page_size", "pool_pages", "pages_in_use",
+    "pages_in_use_peak", "queued_page_demand", "max_queued_pages",
+})
+
+REPLICA_POOL_STATS_KEYS = frozenset({
+    "n_replicas", "healthy_replicas", "pool_in_flight",
+    "admission_budget", "served", "failovers", "hedges_fired",
+    "hedge_wins", "evictions", "readmissions", "rolling_reloads",
+    "rollbacks", "shed_overload", "shed_unavailable", "ewma_latency_ms",
+    "replicas",
+})
+
+POOL_REPLICA_STATS_KEYS = frozenset({
+    "state", "consecutive_failures", "evictions", "stale",
+}) | MODEL_SERVER_STATS_KEYS

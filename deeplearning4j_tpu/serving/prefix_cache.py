@@ -106,12 +106,21 @@ class PrefixCache:
         # head re-runs lookup every scheduler iteration)
         self.insertions = 0  # guarded by: _guard [external]
         self.evictions = 0  # guarded by: _guard [external]
+        self._recorder = None  # optional FlightRecorder (engine's)
 
     def bind_guard(self, lock) -> "PrefixCache":
         """Register the owner's lock. Every mutating method then runs
         `assert_owned` against it under tests, turning a silently-racy
         unlocked call into a hard failure."""
         self._guard = lock
+        return self
+
+    def bind_recorder(self, recorder) -> "PrefixCache":
+        """Register the owner's flight recorder: cache invalidations and
+        cap-driven eviction bursts land in the scheduler-event ring (its
+        lock is a leaf, so emitting under the engine's condition lock is
+        deadlock-free)."""
+        self._recorder = recorder
         return self
 
     # -- introspection -----------------------------------------------------
@@ -121,6 +130,8 @@ class PrefixCache:
 
     def stats(self) -> dict:
         return {"cached_pages": len(self._nodes),
+                "pinned_pages": sum(1 for n in self._nodes.values()
+                                    if n.requests or n.children),
                 "insertions": self.insertions,
                 "evictions": self.evictions,
                 "page_size": self.page_size,
@@ -230,6 +241,12 @@ class PrefixCache:
             nodes.append(node)
             parent = node
             self.insertions += 1
+        if freed and self._recorder is not None:
+            # cap pressure displaced resident prefixes — one aggregated
+            # event per promotion, not one per page
+            self._recorder.event("prefix-cache", decision="cap-evict",
+                                 pages=len(freed),
+                                 cached_pages=len(self._nodes))
         return nodes, freed
 
     # -- eviction ----------------------------------------------------------
@@ -274,4 +291,8 @@ class PrefixCache:
         post-failure recovery — which is the only time this runs). A
         stale page can never serve new weights."""
         assert_owned(self._guard, "PrefixCache.clear")
+        dropped = len(self._nodes)
         self._nodes.clear()
+        if dropped and self._recorder is not None:
+            self._recorder.event("prefix-cache", decision="invalidate",
+                                 dropped=dropped)

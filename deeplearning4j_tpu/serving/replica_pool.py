@@ -81,6 +81,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import observability
 from deeplearning4j_tpu.serving.model_server import (
     DeadlineExceededError,
     InferenceFailedError,
@@ -207,6 +208,19 @@ class ReplicaPool:
         self.rollbacks = 0  # guarded by: _lock
         self.shed_overload = 0  # guarded by: _lock
         self.shed_unavailable = 0  # guarded by: _lock
+        # observability: the pool keeps its own registry + recorder for
+        # routing-layer views (failovers, hedges, probe verdicts,
+        # evictions, reloads); each replica's ModelServer keeps its own
+        # pair — `flight_record()` / `metrics_text()` merge both levels
+        self.metrics = observability.MetricsRegistry()
+        self.recorder = observability.FlightRecorder()
+        self.metrics.register_stats("replica_pool", self.stats)
+        self._pool_latency_hist = self.metrics.histogram(
+            "replica_pool_predict_latency_ms")
+        self.metrics.gauge("replica_pool_in_flight",
+                           lambda: self._in_flight)
+        self.metrics.gauge("replica_pool_healthy_replicas",
+                           self.healthy_replicas)
         self._reload_lock = threading.Lock()
         self._probe_wake = threading.Event()
         self._probe_thread = threading.Thread(
@@ -279,6 +293,40 @@ class ReplicaPool:
                 "replicas": per_replica,
             }
 
+    def flight_record(self) -> dict:
+        """Two-level dump: the pool's own ring (routing decisions,
+        failovers, hedges, probe verdicts, evictions, reload events)
+        plus every replica's `ModelServer.flight_record()` (string
+        replica-id keys — the same JSON-safe contract as `stats`)."""
+        return {
+            "pool": self.recorder.dump(),
+            "replicas": {str(rep.id): rep.server.flight_record()
+                         for rep in self._replicas},
+        }
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def metrics_text(self, labels=None) -> str:
+        """One Prometheus text page for the whole pool: the pool's own
+        instruments plus each replica's exposition labeled
+        ``{replica="<id>"}`` (merged with caller `labels`, e.g. the
+        gateway's ``{"model": name}``)."""
+        parts = [self.metrics.exposition(labels=labels)]
+        for rep in self._replicas:
+            parts.append(rep.server.metrics.exposition(
+                labels=dict(labels or {}, replica=str(rep.id))))
+        return "".join(parts)
+
+    def _shed_obs(self, trace, err: BaseException,
+                  kind: str = "predict") -> None:
+        """Pool-door shed / terminal failure: stamp the timeline, attach
+        it to the typed error, pin it in the pool's failures ring."""
+        decision = type(err).__name__
+        trace.finish(decision)
+        observability.attach_trace(err, trace)
+        self.recorder.record(trace, decision, kind=kind)
+
     # -- routing -----------------------------------------------------------
     def _pick(self, exclude=()) -> Optional[_Replica]:
         """Least-loaded healthy replica, preferring ones not in
@@ -340,6 +388,7 @@ class ReplicaPool:
         rep.probe_successes = 0
         rep.evictions += 1
         self.evictions += 1
+        self.recorder.event("evict", replica=rep.id, reason=reason)
         logger.warning("replica pool: evicted replica %d (%s)",
                        rep.id, reason)
 
@@ -368,14 +417,31 @@ class ReplicaPool:
         transparent failover on retryable typed failures (up to
         `max_failovers` re-routes), optional hedging. Raises the same
         typed `ServingError` family as `ModelServer.predict`; every
-        replica-originated error carries `.replica_id`."""
+        replica-originated error carries `.replica_id` — and, with
+        tracing on, `.trace_id`/`.trace`: the request's span timeline
+        across pool routing and the replica's server/engine."""
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
-        self._admit()
+        trace = observability.maybe_trace()
+        t0 = time.monotonic()
         try:
-            out = self._predict_failover(np.asarray(x), deadline)
+            self._admit()
+        except ServingError as e:
+            self._shed_obs(trace, e)
+            raise
+        try:
+            # bind the trace to this thread: the replica's ModelServer
+            # (same synchronous chain) joins it instead of minting one
+            with observability.use_trace(trace):
+                out = self._predict_failover(np.asarray(x), deadline)
+        except ServingError as e:
+            self._shed_obs(trace, e)
+            raise
         finally:
             self._release()
+        trace.finish("served")
+        self.recorder.record(trace, "served", kind="predict")
+        self._pool_latency_hist.observe(1e3 * (time.monotonic() - t0))
         # auto-arm the probe batch from the first served predict (the
         # pool-level mirror of ModelServer's auto_canary): without it, a
         # replica evicted before ANY canary armed anywhere could never
@@ -429,6 +495,13 @@ class ReplicaPool:
                 reroutes += 1
                 with self._lock:
                     self.failovers += 1
+                trace = observability.current_trace()
+                if trace:
+                    trace.event("failover", hop=reroutes, replica=rid,
+                                error=type(e).__name__)
+                self.recorder.event("failover", replica=rid,
+                                    hop=reroutes,
+                                    error=type(e).__name__)
                 logger.warning(
                     "replica pool: failover %d/%d after %s on replica %d",
                     reroutes, self.max_failovers, type(e).__name__, rid)
@@ -452,6 +525,9 @@ class ReplicaPool:
         if rep.state != "healthy":  # evicted between pick and dispatch
             raise _tag(ReplicaEvictedError(
                 f"replica {rep.id} evicted before dispatch"), rep.id)
+        trace = observability.current_trace()
+        if trace:
+            trace.event("route", replica=rep.id, load=rep.load())
         t0 = time.monotonic()
         try:
             out = call()
@@ -497,11 +573,17 @@ class ReplicaPool:
                 primary.id)
         cond = threading.Condition()
         outcomes: List[tuple] = []  # (tag, replica, result, error, dt)
+        # the caller's trace, re-bound inside each hedge lane's worker
+        # thread (thread-locals do not cross the spawn) so both lanes'
+        # server spans land on the ONE request timeline
+        trace = observability.current_trace() or observability.NULL_TRACE
 
         def run(rep: _Replica, tag: str) -> None:
             t0 = time.monotonic()
+            trace.event(f"{tag}-dispatch", replica=rep.id)
             try:
-                out = rep.server.predict(x, timeout=timeout)
+                with observability.use_trace(trace):
+                    out = rep.server.predict(x, timeout=timeout)
             # graftlint: disable=typed-error  hedge worker: the failure
             # becomes this lane's outcome (classified retryable/fatal by
             # the racer below), never an unhandled thread death
@@ -540,6 +622,10 @@ class ReplicaPool:
                             self.served += 1
                             if tag == "hedge":
                                 self.hedge_wins += 1
+                        if tag == "hedge":
+                            trace.event("hedge-win", replica=rep.id)
+                            self.recorder.event("hedge-win",
+                                                replica=rep.id)
                         return out
                 errors = {tag: err
                           for tag, rep, out, err, dt in outcomes
@@ -584,6 +670,11 @@ class ReplicaPool:
                             and hedge_rep.id != primary.id:
                         with self._lock:
                             self.hedges_fired += 1
+                        trace.event("hedge-fire", replica=hedge_rep.id,
+                                    primary=primary.id)
+                        self.recorder.event("hedge-fire",
+                                            replica=hedge_rep.id,
+                                            primary=primary.id)
                         threading.Thread(target=run,
                                          args=(hedge_rep, "hedge"),
                                          daemon=True).start()
@@ -608,7 +699,12 @@ class ReplicaPool:
         with `predict`."""
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
-        self._admit()
+        trace = observability.maybe_trace()
+        try:
+            self._admit()
+        except ServingError as e:
+            self._shed_obs(trace, e, kind="generate")
+            raise
         try:
             def attempt(rep, tried):
                 rem = self._remaining(deadline)
@@ -618,9 +714,16 @@ class ReplicaPool:
                         seed=seed, timeout=rem),
                     track_latency=False)
 
-            return self._route_with_failover(attempt)
+            with observability.use_trace(trace):
+                out = self._route_with_failover(attempt)
+        except ServingError as e:
+            self._shed_obs(trace, e, kind="generate")
+            raise
         finally:
             self._release()
+        trace.finish("served")
+        self.recorder.record(trace, "served", kind="generate")
+        return out
 
     # -- health probing ----------------------------------------------------
     def _probe_input(self) -> Optional[np.ndarray]:
@@ -688,6 +791,9 @@ class ReplicaPool:
         treating busyness as sickness would let a saturating burst
         evict healthy replicas and cascade the pool into degraded
         mode."""
+        self.recorder.event("probe", replica=rep.id, state=rep.state,
+                            verdict="inconclusive" if ok is None
+                            else bool(ok))
         with self._lock:
             if rep.state == "draining" or ok is None:
                 return
@@ -705,6 +811,7 @@ class ReplicaPool:
                         rep.consecutive_failures = 0
                         rep.probe_successes = 0
                         self.readmissions += 1
+                        self.recorder.event("readmit", replica=rep.id)
                         logger.warning(
                             "replica pool: re-admitted replica %d after "
                             "%d consecutive probe successes", rep.id,
@@ -778,6 +885,7 @@ class ReplicaPool:
         weights can never split the pool either. Returns the
         per-replica new model versions (healthy replicas only)."""
         with self._reload_lock:
+            self.recorder.event("rolling-reload", decision="start")
             done: List[tuple] = []  # (replica, old_net, was_stale)
             newly_stale: List[_Replica] = []
             versions: List[int] = []
@@ -857,6 +965,9 @@ class ReplicaPool:
                         rep.stale = False
                 with self._lock:
                     self.rollbacks += 1
+                self.recorder.event("rolling-reload",
+                                    decision="rolled-back",
+                                    completed=len(done))
                 logger.warning(
                     "replica pool: rolling reload FAILED after %d/%d "
                     "replicas — whole pool rolled back to old weights",
@@ -864,6 +975,8 @@ class ReplicaPool:
                 raise
             with self._lock:
                 self.rolling_reloads += 1
+            self.recorder.event("rolling-reload", decision="complete",
+                                replicas=len(done))
             logger.warning("replica pool: rolling reload complete "
                            "across %d replicas", len(done))
             return versions
@@ -893,6 +1006,8 @@ class ReplicaPool:
         with self._lock:
             if rep.state == "healthy":
                 rep.state = "draining"
+        self.recorder.event("drain", replica=rep.id,
+                            reason="rolling-reload")
         deadline = time.monotonic() + drain_timeout
         while rep.server.pending() and time.monotonic() < deadline:
             time.sleep(0.005)

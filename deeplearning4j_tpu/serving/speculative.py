@@ -47,6 +47,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import observability
+
 
 def resolve_draft_net(draft, target_net):
     """Materialize the `speculative={"draft": ...}` config value:
@@ -119,6 +121,10 @@ class SpeculativeDecoder:
         # cache reference), so "self" is the acceptance-rate-ceiling /
         # dispatch-amortization config, not a memory-neutral one
         self.self_draft = draft_net is target_net
+        # host-side mirror counters (surfaced via stats() → the engine's
+        # metrics registry): how often the draft pools were (re)filled
+        self.draft_prefills = 0
+        self.draft_chunk_prefills = 0
         dplan = tplan if self.self_draft else GPTPlan(draft_net)
         self.draft_plan = dplan
         if dplan.emb.n_in != tplan.emb.n_in:
@@ -411,21 +417,28 @@ class SpeculativeDecoder:
         import jax
         import jax.numpy as jnp
 
-        self._caches = self._draft_prefill(
-            self.draft_net._params, self._caches, jnp.asarray(ids), wpids)
-        jax.device_get(self._caches[0][0][0, 0, 0, 0])
+        with observability.annotation("draft-prefill"):
+            self._caches = self._draft_prefill(
+                self.draft_net._params, self._caches, jnp.asarray(ids),
+                wpids)
+            jax.device_get(self._caches[0][0][0, 0, 0, 0])
+        self.draft_prefills += 1
 
     def prefill_chunk(self, page_row, ids, off, woff, pids) -> None:
         """Mirror one target prefill chunk into the draft pools."""
         import jax
         import jax.numpy as jnp
 
-        self._caches = self._draft_prefill_chunk(
-            self.draft_net._params, self._caches, page_row,
-            jnp.asarray(ids), jnp.asarray(off, jnp.int32),
-            jnp.asarray(woff, jnp.int32),
-            jnp.asarray(np.asarray(pids, np.int32)))
-        jax.device_get(self._caches[0][0][0, 0, 0, 0])
+        with observability.annotation("draft-prefill-chunk"):
+            self._caches = self._draft_prefill_chunk(
+                self.draft_net._params, self._caches, page_row,
+                jnp.asarray(ids), jnp.asarray(off, jnp.int32),
+                jnp.asarray(woff, jnp.int32),
+                jnp.asarray(np.asarray(pids, np.int32)))
+            jax.device_get(self._caches[0][0][0, 0, 0, 0])
+        self.draft_chunk_prefills += 1
 
     def stats(self) -> dict:
-        return {"k": self.k, "draft_is_target": self.self_draft}
+        return {"k": self.k, "draft_is_target": self.self_draft,
+                "draft_prefills": self.draft_prefills,
+                "draft_chunk_prefills": self.draft_chunk_prefills}

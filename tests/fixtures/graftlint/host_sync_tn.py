@@ -19,6 +19,15 @@ class Scheduler:
         counts = np.asarray([1, 2, 3])  # host literal, not a device value
         return n, counts
 
+    # graftlint: hot-loop
+    def _record_retire(self):
+        logits = jnp.ones((8, 32))
+        toks = jax.device_get(jnp.argmax(logits, axis=-1))
+        # host scalars recorded: recording itself is free — only device
+        # values riding into the ring are the hazard
+        self.recorder.event("retire", tok=int(toks[0]), n=len(toks))
+        self.trace.add_timed("decode", 0.0, 1.0, steps=3)
+
     def _cold_path(self):
         # not hot (no marker, name does not end in _loop): syncs here are
         # the caller's business — setup/teardown code runs once

@@ -27,3 +27,10 @@ class Scheduler:
         mask = jax.lax.select(jnp.ones((4,), bool),
                               jnp.ones((4,)), jnp.zeros((4,)))
         return bool(jnp.any(mask))  # TP: bool() on device value
+
+    # graftlint: hot-loop
+    def _record_step(self):
+        logits = jnp.ones((8, 32))
+        # TP: device value recorded — the ring pins the buffer and the
+        # sync is deferred to whenever the timeline serializes
+        self.recorder.event("step", top=jnp.max(logits))

@@ -35,7 +35,7 @@ FIXTURES = os.path.join("tests", "fixtures", "graftlint")
 RULE_FIXTURES = {
     "donation": ("donation", 3),
     "recompile": ("recompile", 6),
-    "host-sync": ("host_sync", 4),
+    "host-sync": ("host_sync", 5),
     "lock-order": ("lock_order", 1),
     "guarded-by": ("guarded_by", 2),
     "typed-error": ("typed_error", 3),

@@ -10,6 +10,13 @@ Scope ("hot" functions): any function in ``serving/`` whose name ends in
 ``_loop``, plus any function whose ``def`` line (or the line above it)
 carries a ``# graftlint: hot-loop`` marker.
 
+Observability calls are held to the same bar: a device value passed as
+an argument to ``<x>.trace.<m>(...)`` / ``<x>.recorder.<m>(...)``
+(``m`` in span/event/add_timed/record/finish) inside a hot scope flags
+— the ring stores the reference, so the sync is merely deferred to
+whenever the timeline is serialized (plus the buffer stays pinned until
+then). Recording must pass host scalars.
+
 Device-value tracking is deliberately default-allow: only values the
 rule can *prove* live on device are tracked — results of ``jnp.*`` /
 ``jax.lax.*`` / ``jax.random.*`` / ``jax.nn.*`` calls, calls to known
@@ -37,6 +44,14 @@ _KILL = {"jax.device_get"}
 _NP_NAMES = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _CAST_BUILTINS = {"float", "int", "bool", "complex"}
 _SYNC_METHODS = {"item", "tolist", "__float__", "__int__"}
+# observability recording calls (serving/observability.py): a device
+# value passed as a span/event attribute is a deferred sync — it rides
+# the ring until `to_dict`/`dump` serializes the timeline, at which
+# point the JSON encoder materializes it (and until then it pins the
+# buffer alive). Only calls on receivers NAMED like traces/recorders
+# are checked (default-allow, same philosophy as device tracking).
+_RECORD_METHODS = {"span", "event", "add_timed", "record", "finish"}
+_RECORD_RECEIVERS = {"trace", "recorder"}
 
 
 class HostSyncRule(Rule):
@@ -154,6 +169,9 @@ class HostSyncRule(Rule):
                     n.func.attr in _SYNC_METHODS:
                 label, value = f".{n.func.attr}()", n.func.value
             if label is None or value is None:
+                if self._is_record_call(n):
+                    self._flag_record_args(ctx, jits, attrs, n, tainted,
+                                           out)
                 continue
             if self._expr_device(value, jits, tainted, attrs):
                 out.append(ctx.finding(
@@ -162,3 +180,31 @@ class HostSyncRule(Rule):
                     f"an implicit device→host sync, stalling the scheduler "
                     f"for every in-flight request; move the sync to a "
                     f"designated boundary or keep the value on device"))
+
+    # -- recorder-call hygiene ----------------------------------------------
+    @staticmethod
+    def _is_record_call(n: ast.Call) -> bool:
+        """`<...>.trace.<m>(...)` / `<...>.recorder.<m>(...)` for a
+        recording method `m` — the observability surface."""
+        if not isinstance(n.func, ast.Attribute) or \
+                n.func.attr not in _RECORD_METHODS:
+            return False
+        base = dotted(n.func.value)
+        return base is not None and \
+            base.split(".")[-1] in _RECORD_RECEIVERS
+
+    def _flag_record_args(self, ctx: FileCtx, jits: ModuleJits,
+                          attrs: Set[str], n: ast.Call,
+                          tainted: Set[str], out: List[Finding]) -> None:
+        receiver = dotted(n.func.value)
+        for arg in list(n.args) + [kw.value for kw in n.keywords]:
+            if self._expr_device(arg, jits, tainted, attrs):
+                out.append(ctx.finding(
+                    self.name, n,
+                    f"device value passed to {receiver}.{n.func.attr}() "
+                    f"inside a hot loop — recording must stay host-side: "
+                    f"the ring holds the buffer alive and serializing the "
+                    f"timeline later forces the sync at an arbitrary "
+                    f"moment; materialize at a designated boundary and "
+                    f"pass the host scalar"))
+                return  # one finding per call is enough to act on
