@@ -1450,23 +1450,25 @@ def bench_serve_generate():
     # extra tokens is the decode path's device-side price per token
     half_outs = np.maximum(1, outs // 2)
 
-    def paged_dms(g_full=None):
+    def paged_dms(g_full=None, **extra_kw):
         """device_ms_per_token of the paged config under the CURRENT
         dispatch environment: full vs halved output lengths, the
         per-pass fixed cost (prefills, arrival idle, tunnel dispatch
         floor) differenced out. ONE implementation for the kernel and
-        gather sides so the committed ratio can never compare numbers
-        computed under different rules. `g_full`: reuse an
-        already-measured full-lengths goodput instead of re-running."""
+        gather sides (and the int8-KV A/B, via `extra_kw`) so a
+        committed ratio can never compare numbers computed under
+        different rules. `g_full`: reuse an already-measured
+        full-lengths goodput instead of re-running."""
         if g_full is None:
             g_full = engine_goodput(
                 net, shp["r5_n_slots"] * shp["slots_multiplier"],
                 pool_pages=kv_budget_pages,
-                prompt_buckets=(short_t0,))[0]
+                prompt_buckets=(short_t0,), **extra_kw)[0]
         g_half = engine_goodput(
             net, shp["r5_n_slots"] * shp["slots_multiplier"],
             outs_override=half_outs,
-            pool_pages=kv_budget_pages, prompt_buckets=(short_t0,))[0]
+            pool_pages=kv_budget_pages, prompt_buckets=(short_t0,),
+            **extra_kw)[0]
         toks_full, toks_half = int(outs.sum()), int(half_outs.sum())
         dt_full, dt_half = toks_full / g_full, toks_half / g_half
         if dt_full > dt_half and toks_full > toks_half:
@@ -1574,6 +1576,48 @@ def bench_serve_generate():
     bench_serve_generate.spec_accept_rate = sp_stats["spec_accept_rate"]
     bench_serve_generate.spec_tokens_per_step = \
         sp_stats["spec_tokens_per_step"]
+
+    # -- quantized KV tier (ISSUE 13): int8 paged KV vs full-precision
+    # pools, priced with the SAME differencing rule as every other
+    # serving A/B. Both sides request quantize={"kv": "int8"}; the
+    # bf16 side flips the DL4J_TPU_NO_INT8_KV kill switch, which makes
+    # a fresh engine build full-precision pools — so the ratio measures
+    # exactly what the switch toggles in production. >1 = int8 wins.
+    int8_kw = dict(quantize={"kv": "int8"})
+    int8_dms = paged_dms(**int8_kw)
+    prior = os.environ.get("DL4J_TPU_NO_INT8_KV")
+    os.environ["DL4J_TPU_NO_INT8_KV"] = "1"
+    try:
+        bf16_dms = paged_dms(**int8_kw)
+    finally:
+        if prior is None:
+            os.environ.pop("DL4J_TPU_NO_INT8_KV", None)
+        else:
+            os.environ["DL4J_TPU_NO_INT8_KV"] = prior
+    bench_serve_generate.int8_kv_device_ms_per_token = int8_dms
+    bench_serve_generate.bf16_kv_device_ms_per_token = bf16_dms
+    bench_serve_generate.int8_kv_vs_bf16_device_ms_per_token = round(
+        bf16_dms / int8_dms, 3) if int8_dms > 0 else None
+
+    # slots-per-chip on the IDENTICAL KV-pool byte budget: int8 pages
+    # cost half the bytes of the bf16 compute dtype's, so the same
+    # budget holds 2x the pages — run 2x the slots over the same
+    # traffic and require ZERO OutOfPagesError sheds. The committed
+    # line degrades toward 1.0 with every shed, so a 2.0 here is a
+    # measured admission win, not an arithmetic identity.
+    (int8_goodput, _, _, _, int8_stats) = engine_goodput(
+        net, 2 * n_slots, pool_pages=2 * kv_budget_pages,
+        prompt_buckets=(short_t0,), **int8_kw)
+    admitted = 1.0 - (int8_stats["shed_out_of_pages"]
+                      / max(1, int8_stats["submitted"]))
+    bench_serve_generate.int8_kv_slots_per_chip = round(2.0 * admitted, 2)
+    bench_serve_generate.int8_kv_out_of_pages_sheds = \
+        int8_stats["shed_out_of_pages"]
+    bench_serve_generate.int8_kv_goodput_tokens_per_sec = round(
+        int8_goodput, 1)
+    bench_serve_generate.kv_bytes_per_token = {
+        "int8": int8_stats["kv_bytes_per_token"],
+        "bf16": stats["kv_bytes_per_token"]}
     return ("serve_generate_paged_goodput_tokens_per_sec", goodput, None,
             spread)
 
@@ -1692,7 +1736,19 @@ def main() -> None:
                 ("latency_tier_p50_speedup", "latency_tier_p50_speedup"),
                 ("prefix_hit_tokens_pct", "prefix_hit_tokens_pct"),
                 ("spec_accept_rate", "spec_accept_rate"),
-                ("spec_tokens_per_step", "spec_tokens_per_step")):
+                ("spec_tokens_per_step", "spec_tokens_per_step"),
+                ("int8_kv_device_ms_per_token",
+                 "int8_kv_device_ms_per_token"),
+                ("bf16_kv_device_ms_per_token",
+                 "bf16_kv_device_ms_per_token"),
+                ("int8_kv_vs_bf16_device_ms_per_token",
+                 "int8_kv_vs_bf16_device_ms_per_token"),
+                ("int8_kv_slots_per_chip", "int8_kv_slots_per_chip"),
+                ("int8_kv_out_of_pages_sheds",
+                 "int8_kv_out_of_pages_sheds"),
+                ("int8_kv_goodput_tokens_per_sec",
+                 "int8_kv_goodput_tokens_per_sec"),
+                ("kv_bytes_per_token", "kv_bytes_per_token")):
             extra = getattr(_CONFIGS[name], attr, None)
             if extra is not None:
                 entries[name][key] = extra

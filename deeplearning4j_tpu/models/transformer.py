@@ -122,6 +122,19 @@ class GPTPlan:
         self.dtype = net.dtype
         self.cdt = net.compute_dtype or net.dtype
 
+    def kv_geometry(self):
+        """Per-block (Hkv, head_dim) pairs — the KV-cache geometry the
+        paged pools allocate per block. One source of truth for the
+        serving tier's byte accounting (`quantize.kv_bytes_per_token`,
+        the engine's ``kv_bytes_per_token`` stat, the bench's
+        slots-per-chip line) so a GQA or head-width change reprices all
+        of them at once."""
+        out = []
+        for i in self.block_is:
+            layer = self.layers[i]
+            out.append((layer._kv_heads, layer.n_out // layer.n_heads))
+        return out
+
     def cast_blocks(self, params):
         """Embedding + block params in the compute dtype; head params
         stay in the param dtype."""

@@ -250,6 +250,34 @@ def paged_gather(k_pool: jnp.ndarray, v_pool: jnp.ndarray,
     return k, v
 
 
+def paged_gather_quant(k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                       k_scale: jnp.ndarray, v_scale: jnp.ndarray,
+                       page_table: jnp.ndarray, dtype=jnp.float32):
+    """`paged_gather` over INT8 pools: gather the int8 pages and their
+    per-(head, position) f32 scale pages (`k_scale`/`v_scale`:
+    (P, Hkv, page), riding the same page table), dequantize, and return
+    dense `dtype` caches in the decode layouts. This is the int8 tier's
+    CPU/tier-1/kill-switch numerics ORACLE: the Pallas int8 kernel's
+    page-loop dequant is parity-pinned against exactly this path
+    (tests/test_pallas_paged_attention.py and the dispatch probe), the
+    same role `paged_gather` plays for the full-precision kernel.
+    Trash-page semantics hold for free: int8 zeros dequantize to exact
+    0.0 under any scale, so unwritten regions stay finite and are
+    masked by position downstream."""
+    P, Hkv, D, page = k_pool.shape
+    S, n_pages = page_table.shape
+    L = n_pages * page
+    k = jnp.take(k_pool, page_table, axis=0)   # (S, n_pages, Hkv, D, page)
+    ks = jnp.take(k_scale, page_table, axis=0)  # (S, n_pages, Hkv, page)
+    k = k.astype(jnp.float32) * ks[:, :, :, None, :]
+    k = jnp.transpose(k, (0, 2, 3, 1, 4)).reshape(S, Hkv, D, L)
+    v = jnp.take(v_pool, page_table, axis=0)   # (S, n_pages, Hkv, page, D)
+    vs = jnp.take(v_scale, page_table, axis=0)
+    v = v.astype(jnp.float32) * vs[..., None]
+    v = jnp.transpose(v, (0, 2, 1, 3, 4)).reshape(S, Hkv, L, D)
+    return k.astype(dtype), v.astype(dtype)
+
+
 def paged_attention_step(q: jnp.ndarray, k_pool: jnp.ndarray,
                          v_pool: jnp.ndarray, page_table: jnp.ndarray,
                          pos) -> jnp.ndarray:
@@ -269,7 +297,8 @@ def paged_attention_step(q: jnp.ndarray, k_pool: jnp.ndarray,
 def paged_attention_step_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
                               v_pool: jnp.ndarray,
                               page_table: jnp.ndarray, pos,
-                              active=None) -> jnp.ndarray:
+                              active=None, k_scale=None,
+                              v_scale=None) -> jnp.ndarray:
     """`paged_attention_step` behind the kernel-dispatch contract: on
     TPU the Pallas paged-attention kernel walks the page table in place
     (`ops/pallas_paged_attention.py` — no dense transient, each cache
@@ -279,7 +308,10 @@ def paged_attention_step_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
     Inactive lanes (optional `active` (S,) bool) are a compute skip on
     the kernel path (exact-zero rows) and plain masked-downstream
     garbage on the gather path — both discarded by the engine.
-    Returns (S, H*D)."""
+    int8 pools pass their f32 scale pools as `k_scale`/`v_scale`
+    ((P+1, Hkv, page)): the kernel dequantizes inside the page loop,
+    the fallback dequantizes via `paged_gather_quant` — same dispatch
+    contract, halved DMA bytes. Returns (S, H*D)."""
     from deeplearning4j_tpu.ops.pallas_paged_attention import (
         paged_attention_or_none,
     )
@@ -289,16 +321,22 @@ def paged_attention_step_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
     if pos.ndim == 0:
         pos = jnp.broadcast_to(pos, (S,))
     out = paged_attention_or_none(q[:, None], k_pool, v_pool, page_table,
-                                  pos, active)
+                                  pos, active, k_scale=k_scale,
+                                  v_scale=v_scale)
     if out is not None:
         return out.reshape(S, H * D)
+    if k_scale is not None:
+        kd, vd = paged_gather_quant(k_pool, v_pool, k_scale, v_scale,
+                                    page_table, q.dtype)
+        return cached_attention_step(q, kd, vd, pos)
     return paged_attention_step(q, k_pool, v_pool, page_table, pos)
 
 
 def paged_attention_chunk_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
                                v_pool: jnp.ndarray,
                                page_table: jnp.ndarray, pos0,
-                               active=None) -> jnp.ndarray:
+                               active=None, k_scale=None,
+                               v_scale=None) -> jnp.ndarray:
     """Chunk-width paged attention behind the same dispatch contract —
     the speculative (k+1)-verify and chunked-prefill-suffix shapes.
     `q`: (S, C, H, D) — C CONTIGUOUS query tokens per slot starting at
@@ -306,7 +344,9 @@ def paged_attention_chunk_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
     `<= pos0[s] + c`, the `cached_attention_chunk` mask). Kernel path:
     one fused page-walk dispatch; fallback: `paged_gather` + slot-vmapped
     `cached_attention_chunk` (exactly `_verify_block_attention`, and for
-    S=1 exactly `_prefill_chunk_block_attention`). Returns (S, C, H*D)."""
+    S=1 exactly `_prefill_chunk_block_attention`). int8 pools pass
+    `k_scale`/`v_scale` exactly as in `paged_attention_step_auto`.
+    Returns (S, C, H*D)."""
     from deeplearning4j_tpu.ops.pallas_paged_attention import (
         paged_attention_or_none,
     )
@@ -316,10 +356,15 @@ def paged_attention_chunk_auto(q: jnp.ndarray, k_pool: jnp.ndarray,
     if pos0.ndim == 0:
         pos0 = jnp.broadcast_to(pos0, (S,))
     out = paged_attention_or_none(q, k_pool, v_pool, page_table, pos0,
-                                  active)
+                                  active, k_scale=k_scale,
+                                  v_scale=v_scale)
     if out is not None:
         return out.reshape(S, C, H * D)
-    kd, vd = paged_gather(k_pool, v_pool, page_table)
+    if k_scale is not None:
+        kd, vd = paged_gather_quant(k_pool, v_pool, k_scale, v_scale,
+                                    page_table, q.dtype)
+    else:
+        kd, vd = paged_gather(k_pool, v_pool, page_table)
     qpos = pos0[:, None] + jnp.arange(C)[None, :]
     return jax.vmap(cached_attention_chunk)(q, kd, vd, qpos)
 

@@ -74,15 +74,30 @@ logger = logging.getLogger("deeplearning4j_tpu")
 NEG_INF = -1e30  # matches ops/attention.py: exp()/where() stay NaN-free
 
 
-def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_scr, m_scr, l_scr, *, page: int, C: int, G: int,
-                  Hkv: int, hd: int, sm_scale: float):
+def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, *rest,
+                  page: int, C: int, G: int, Hkv: int, hd: int,
+                  sm_scale: float, quantized: bool = False):
     """Grid (S, n_pages), pages sequential: one (C·G, page) score tile
     per KV head per page, accumulated with the online-softmax
     recurrence in VMEM scratch. Scalar-prefetch refs: the page table
     (drives the K/V BlockSpec index maps — the in-place walk), the
-    per-slot start positions, and the active gate."""
+    per-slot start positions, and the active gate.
+
+    `quantized=True` is the int8-KV variant (ROADMAP item 1's
+    "dequant inside the page loop"): `k_ref`/`v_ref` hold int8 pages —
+    HALF the DMA bytes of bf16, the decode path's bandwidth bound on
+    top of PR 9's no-gather win — and two extra (1, Hkv, page) f32
+    scale refs ride the same page-table index map. Dequant happens
+    in VMEM right before each matmul: one f32 multiply per element by
+    the per-(head, position) scale row, then the cast to the MXU feed
+    dtype. Numerics are pinned against the `paged_gather_quant` + dense
+    reference by the dispatch probe and the interpret-mode tests."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        o_ref, acc_scr, m_scr, l_scr = rest
 
     s = pl.program_id(0)
     j = pl.program_id(1)
@@ -115,7 +130,17 @@ def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, o_ref,
             # query heads h*G..(h+1)*G-1 share KV head h; fold (C, G)
             # into the sublane axis so one matmul serves the group
             qh = q[:, h * G:(h + 1) * G, :].reshape(CG, hd).astype(dt)
-            kh = k_ref[0, h].astype(dt)                    # (hd, page)
+            if quantized:
+                # dequant-in-VMEM: int8 page × per-position f32 scale
+                # row, then the MXU-feed cast — the DMA moved 1 byte
+                # per element, the matmul sees full-precision values
+                ks = ks_ref[0, h].reshape(1, page)
+                kh = (k_ref[0, h].astype(jnp.float32) * ks).astype(dt)
+                vs = vs_ref[0, h].reshape(page, 1)
+                vh = (v_ref[0, h].astype(jnp.float32) * vs).astype(dt)
+            else:
+                kh = k_ref[0, h].astype(dt)                # (hd, page)
+                vh = v_ref[0, h].astype(dt)                # (page, hd)
             sc = _dot(qh, kh, ((1,), (0,)), dt) * sm_scale
             sc = jnp.where(mask, sc, NEG_INF)
             m_prev = m_scr[h][:, :1]
@@ -128,7 +153,6 @@ def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, o_ref,
             p = jnp.where(sc <= NEG_INF / 2, 0.0, p)
             corr = jnp.exp(m_prev - m_new)
             l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-            vh = v_ref[0, h].astype(dt)                    # (page, hd)
             acc_scr[h] = acc_scr[h] * corr + _dot(p.astype(dt), vh,
                                                   ((1,), (0,)), dt)
             m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
@@ -147,6 +171,8 @@ def _paged_kernel(pt_ref, p0_ref, gate_ref, q_ref, k_ref, v_ref, o_ref,
 def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                     v_pool: jnp.ndarray, page_table: jnp.ndarray,
                     positions: jnp.ndarray, *,
+                    k_scale: Optional[jnp.ndarray] = None,
+                    v_scale: Optional[jnp.ndarray] = None,
                     active: Optional[jnp.ndarray] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Paged decode/verify/chunk attention, streamed from the pool.
@@ -164,6 +190,11 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     engine's masking; the gather path computes garbage-but-finite
     values for them instead, equally discarded).
 
+    int8 pools pass `k_scale`/`v_scale` ((P+1, Hkv, page) f32): the
+    scale pages ride the SAME page-table index map as the payload
+    pages and the kernel dequantizes in VMEM inside the page loop —
+    the `serving/quantize.py` tier's fast path.
+
     Returns (S, C, H, hd) in q.dtype.
     """
     from jax.experimental import pallas as pl
@@ -173,26 +204,40 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     _, Hkv, _, page = k_pool.shape
     n_pages = page_table.shape[1]
     G = H // Hkv
+    quantized = k_scale is not None
     sdt = _stat_dtype(q.dtype)
     gate = jnp.ones((S,), jnp.int32) if active is None \
         else jnp.asarray(active).astype(jnp.int32)
     kernel = functools.partial(
         _paged_kernel, page=page, C=C, G=G, Hkv=Hkv, hd=hd,
-        sm_scale=1.0 / float(hd) ** 0.5)
+        sm_scale=1.0 / float(hd) ** 0.5, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, C, H, hd),
+                     lambda s, j, pt, p0, g: (s, 0, 0, 0)),
+        # THE page-table walk: the block index map dereferences the
+        # prefetched table, so the pipeline DMAs pool page
+        # `page_table[s, j]` straight into VMEM — no dense transient
+        pl.BlockSpec((1, Hkv, hd, page),
+                     lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
+        pl.BlockSpec((1, Hkv, page, hd),
+                     lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
+    ]
+    operands = [page_table.astype(jnp.int32),
+                positions.astype(jnp.int32), gate, q, k_pool, v_pool]
+    if quantized:
+        # the scale pages walk the same table: one (Hkv, page) f32 tile
+        # per referenced page, prefetched alongside its int8 payload
+        in_specs += [
+            pl.BlockSpec((1, Hkv, page),
+                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0)),
+            pl.BlockSpec((1, Hkv, page),
+                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0)),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, C, H, hd),
-                         lambda s, j, pt, p0, g: (s, 0, 0, 0)),
-            # THE page-table walk: the block index map dereferences the
-            # prefetched table, so the pipeline DMAs pool page
-            # `page_table[s, j]` straight into VMEM — no dense transient
-            pl.BlockSpec((1, Hkv, hd, page),
-                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, page, hd),
-                         lambda s, j, pt, p0, g: (pt[s, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, C, H, hd),
                                lambda s, j, pt, p0, g: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -209,19 +254,24 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit()),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), positions.astype(jnp.int32), gate,
-      q, k_pool, v_pool)
+    )(*operands)
 
 
 def vmem_bytes_estimate(C: int, H: int, Hkv: int, hd: int, page: int,
-                        itemsize: int) -> int:
+                        itemsize: int, kv_itemsize: Optional[int] = None
+                        ) -> int:
     """Resident VMEM of one grid step: double-buffered q/K/V/out tiles
     plus the f32 accumulator scratch. Used to decline shapes that
     cannot fit under the generation-derived ceiling before Mosaic
-    discovers it mid-serving."""
+    discovers it mid-serving. `kv_itemsize` prices the K/V page tiles
+    separately from the q/out tiles (int8 pools: 1 byte per element
+    plus the double-buffered f32 scale tiles); default: `itemsize`."""
     CG = C * (H // Hkv)
-    tiles = 2 * itemsize * (2 * C * H * hd            # q + out
-                            + 2 * Hkv * hd * page)    # K + V page tiles
+    kvi = itemsize if kv_itemsize is None else kv_itemsize
+    tiles = 2 * itemsize * 2 * C * H * hd             # q + out
+    tiles += 2 * kvi * 2 * Hkv * hd * page            # K + V page tiles
+    if kv_itemsize == 1:
+        tiles += 2 * 4 * 2 * Hkv * page               # f32 scale tiles
     scratch = 4 * (Hkv * CG * hd + 2 * Hkv * CG * 128)
     return tiles + scratch
 
@@ -240,33 +290,66 @@ def _platform_supported() -> bool:
         return False
 
 
-def _eager_probe(dtype, C: int, H: int, Hkv: int, hd: int,
-                 page: int) -> bool:
+def _int8_kv_allowed() -> bool:
+    """The int8-KV kill switch at the DISPATCH layer: with
+    ``DL4J_TPU_NO_INT8_KV=1`` the int8 kernel declines and callers run
+    the `paged_gather_quant` + dense reference. (The engine honors the
+    same switch at BUILD time — pools stay full-precision — so flipping
+    it before construction is the bench's whole-tier A/B lever; here it
+    additionally protects a live engine whose pools are already
+    int8.)"""
+    import os
+
+    return os.environ.get("DL4J_TPU_NO_INT8_KV", "") \
+        not in ("1", "true", "yes")
+
+
+def _eager_probe(dtype, C: int, H: int, Hkv: int, hd: int, page: int,
+                 quantized: bool = False) -> bool:
     """Compile + run the kernel once at this exact shape class on tiny
     concrete pools, out of trace, and CHECK the output against the
     gather+dense reference — the dispatch contract's parity-probed
     variant: a toolchain that compiles-but-miscompiles falls back to
-    XLA instead of serving wrong tokens."""
+    XLA instead of serving wrong tokens. The int8 variant probes with
+    int8 pools + f32 scale pages against the `paged_gather_quant`
+    oracle, so the page-loop dequant is parity-checked before the
+    first live dispatch."""
     import numpy as np
 
     from deeplearning4j_tpu.ops.attention import (
         cached_attention_chunk,
         paged_gather,
+        paged_gather_quant,
     )
 
     S, n_pages = 2, 2
     P = S * n_pages
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((S, C, H, hd)), dtype)
-    k_pool = jnp.asarray(
-        rng.standard_normal((P + 1, Hkv, hd, page)), dtype)
-    v_pool = jnp.asarray(
-        rng.standard_normal((P + 1, Hkv, page, hd)), dtype)
     pt = jnp.asarray(1 + np.arange(P).reshape(S, n_pages), jnp.int32)
     p0 = jnp.asarray([page - 1, 2 * page - 1], jnp.int32)
-    out = np.asarray(paged_attention(q, k_pool, v_pool, pt, p0))
-    kd, vd = paged_gather(k_pool, v_pool, pt)
     qpos = p0[:, None] + jnp.arange(C)[None, :]
+    if quantized:
+        k_pool = jnp.asarray(rng.integers(
+            -127, 128, (P + 1, Hkv, hd, page)), jnp.int8)
+        v_pool = jnp.asarray(rng.integers(
+            -127, 128, (P + 1, Hkv, page, hd)), jnp.int8)
+        k_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (P + 1, Hkv, page)), jnp.float32)
+        v_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (P + 1, Hkv, page)), jnp.float32)
+        out = np.asarray(paged_attention(
+            q, k_pool, v_pool, pt, p0, k_scale=k_scale,
+            v_scale=v_scale))
+        kd, vd = paged_gather_quant(k_pool, v_pool, k_scale, v_scale,
+                                    pt, dtype)
+    else:
+        k_pool = jnp.asarray(
+            rng.standard_normal((P + 1, Hkv, hd, page)), dtype)
+        v_pool = jnp.asarray(
+            rng.standard_normal((P + 1, Hkv, page, hd)), dtype)
+        out = np.asarray(paged_attention(q, k_pool, v_pool, pt, p0))
+        kd, vd = paged_gather(k_pool, v_pool, pt)
     ref = np.asarray(jax.vmap(cached_attention_chunk)(q, kd, vd, qpos))
     ref = ref.reshape(S, C, H, hd)
     if not np.all(np.isfinite(out.astype(np.float32))):
@@ -277,19 +360,28 @@ def _eager_probe(dtype, C: int, H: int, Hkv: int, hd: int,
 
 
 def paged_attention_or_none(q, k_pool, v_pool, page_table, positions,
-                            active=None) -> Optional[jnp.ndarray]:
+                            active=None, k_scale=None,
+                            v_scale=None) -> Optional[jnp.ndarray]:
     """Dispatch probe (the reflective cuDNN-helper load): returns None
     when the kernel can't serve this call — CPU backend, kill switch,
     unsupported dtype, VMEM overflow at this shape — or when the shape
     class failed its compile+parity probe. Callers fall back to
-    `paged_gather` + the dense step/chunk."""
+    `paged_gather` + the dense step/chunk (`paged_gather_quant` for
+    int8 pools). The int8 variant (scales present) is additionally
+    gated by ``DL4J_TPU_NO_INT8_KV`` and probes its own shape-class
+    key."""
     S, C, H, hd = q.shape
     _, Hkv, _, page = k_pool.shape
+    quantized = k_scale is not None
     if not _platform_supported() \
             or q.dtype not in (jnp.float32, jnp.bfloat16) \
             or H % Hkv:
         return None
-    est = vmem_bytes_estimate(C, H, Hkv, hd, page, q.dtype.itemsize)
+    if quantized and not _int8_kv_allowed():
+        return None
+    kv_itemsize = 1 if quantized else q.dtype.itemsize
+    est = vmem_bytes_estimate(C, H, Hkv, hd, page, q.dtype.itemsize,
+                              kv_itemsize=kv_itemsize)
     if est > _vmem_limit():
         logger.warning(
             "pallas paged-attention declined: shape (C=%d, H=%d, Hkv=%d, "
@@ -297,13 +389,15 @@ def paged_attention_or_none(q, k_pool, v_pool, page_table, positions,
             "the gather path", C, H, Hkv, hd, page, est >> 20,
             _vmem_limit() >> 20)
         return None
-    key = (jnp.dtype(q.dtype).name, C, H, Hkv, hd, page)
+    key = (jnp.dtype(q.dtype).name, C, H, Hkv, hd, page,
+           "int8" if quantized else "dense")
     if not _probe_verdict(_probe_cache, key, _eager_probe,
-                          (q.dtype, C, H, Hkv, hd, page),
+                          (q.dtype, C, H, Hkv, hd, page, quantized),
                           "pallas paged-attention"):
         return None
     try:
         return paged_attention(q, k_pool, v_pool, page_table, positions,
+                               k_scale=k_scale, v_scale=v_scale,
                                active=active)
     except Exception as e:  # per-shape staging failure: fall back
         logger.warning("pallas paged-attention declined for shape %s "

@@ -30,6 +30,12 @@ from deeplearning4j_tpu.serving.observability import (
     use_trace,
 )
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+from deeplearning4j_tpu.serving.quantize import (
+    argmax_drift_rate,
+    drift_report,
+    perplexity,
+    quantize_net_weights,
+)
 from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
 from deeplearning4j_tpu.serving.model_server import (
     CircuitBreaker,
@@ -73,9 +79,13 @@ __all__ = [
     "ServingError",
     "SlowInferenceInjector",
     "Trace",
+    "argmax_drift_rate",
     "attach_trace",
     "current_trace",
+    "drift_report",
     "maybe_trace",
+    "perplexity",
+    "quantize_net_weights",
     "tracing_enabled",
     "use_trace",
 ]

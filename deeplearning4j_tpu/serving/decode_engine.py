@@ -325,6 +325,21 @@ class DecodeEngine:
         decode/spec-verify dispatches) ride `_GenRequest.trace`; all
         recording is host-side and kill-switched by
         ``DL4J_TPU_NO_TRACING=1``.
+    quantize : None or ``{"kv": "int8"}`` — the quantized KV tier
+        (`serving/quantize.py`): pools allocate int8 elements plus
+        per-(head, position) f32 scale pools riding the same page
+        table/free list, K/V quantize symmetrically per head at every
+        cache write, and attention dequantizes at the read site (the
+        Pallas page loop on TPU, `paged_gather_quant` on CPU/fallback).
+        Halves KV bytes per token — the decode path's bandwidth
+        bound — at the price of bounded numeric drift, which the
+        `ModelServer` drift gates police. ``DL4J_TPU_NO_INT8_KV=1``
+        overrides to full-precision pools (the bench's A/B lever).
+    excursion : p99-excursion auto-dump config: None (on, defaults),
+        False (off), or ``{"quantile": 0.99, "min_count": 50}`` — a
+        generate-latency observation past the histogram's live
+        quantile bound pins that request's timeline in the flight
+        recorder's failures ring with an ``excursion`` event.
     """
 
     def __init__(self, net, *, n_slots: int = 4,
@@ -345,7 +360,9 @@ class DecodeEngine:
                  prefix_cache=None,
                  speculative: Optional[dict] = None,
                  recorder=None,
-                 metrics=None):
+                 metrics=None,
+                 quantize: Optional[dict] = None,
+                 excursion=None):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -362,6 +379,17 @@ class DecodeEngine:
             raise ValueError("pool_pages must be >= 1")
         if max_queued_pages is not None and max_queued_pages < 0:
             raise ValueError("max_queued_pages must be >= 0")
+        if quantize is not None:
+            unknown = set(quantize) - {"kv"}
+            if unknown:
+                raise ValueError("unknown quantize keys: %s"
+                                 % sorted(unknown))
+            if quantize.get("kv") not in (None, "int8"):
+                raise ValueError("quantize['kv'] must be 'int8', got %r"
+                                 % (quantize.get("kv"),))
+        self._quantize_cfg = dict(quantize) if quantize else None
+        if excursion not in (None, False) and not isinstance(excursion, dict):
+            raise ValueError("excursion must be None, False, or a dict")
         self.n_slots = n_slots
         self.max_queue = max_queue
         self.default_timeout = default_timeout
@@ -427,6 +455,14 @@ class DecodeEngine:
         self.metrics.register_stats("decode_engine", self.stats)
         self._gen_latency_hist = self.metrics.histogram(
             "decode_engine_generate_latency_ms")
+        if excursion is not False:
+            exc_cfg = dict(excursion) if excursion else {}
+            self._gen_latency_hist.enable_excursion(
+                quantile=float(exc_cfg.get("quantile", 0.99)),
+                min_count=int(exc_cfg.get("min_count", 50)),
+                hook=lambda v, bound, trace: self.recorder.pin(
+                    trace, "excursion", latency_ms=round(v, 3),
+                    bound_ms=round(bound, 3)))
         self.metrics.gauge("decode_engine_queued",
                            lambda: len(self._queue))
         self.metrics.gauge(
@@ -505,6 +541,18 @@ class DecodeEngine:
         donate = jax.default_backend() != "cpu"
         self._donate = donate
 
+        # quantized-KV tier: resolved at BUILD time so the kill switch
+        # (DL4J_TPU_NO_INT8_KV) flips the pool dtypes themselves, not
+        # just the kernel dispatch — the bench A/B compares genuinely
+        # different cache residency, and a killed build serves the
+        # exact full-precision numerics
+        from deeplearning4j_tpu.serving import quantize as _qz
+        kv_quant = "int8" if (self._quantize_cfg is not None
+                              and self._quantize_cfg.get("kv") == "int8"
+                              and _qz.int8_kv_enabled()) else None
+        quantize_heads = _qz.quantize_heads
+        write_scale_pages = _qz._write_scale_pages
+
         from deeplearning4j_tpu.models.transformer import _top_k_filter
 
         def scale_and_filter(logits, temps):
@@ -570,19 +618,35 @@ class DecodeEngine:
                 q, k, v = _block_heads(layer, p, x[:, None, :],
                                        pos[:, None])
                 q, k, v = q[:, 0], k[:, 0], v[:, 0]
-                kp_, vp_ = caches[bi]
-                kp_ = kp_.at[pids, :, :, loff].set(k)
-                vp_ = vp_.at[pids, :, loff, :].set(v)
+                if kv_quant:
+                    # quantize the single-position (S, Hkv, hd) write
+                    # per head; the scale lands at the SAME
+                    # (page, head, offset) the payload does, so trash-
+                    # page redirection masks both together
+                    kp_, vp_, ks_, vs_ = caches[bi]
+                    kq, ksc = quantize_heads(k)
+                    vq, vsc = quantize_heads(v)
+                    kp_ = kp_.at[pids, :, :, loff].set(kq)
+                    vp_ = vp_.at[pids, :, loff, :].set(vq)
+                    ks_ = ks_.at[pids, :, loff].set(ksc)
+                    vs_ = vs_.at[pids, :, loff].set(vsc)
+                else:
+                    kp_, vp_ = caches[bi]
+                    ks_ = vs_ = None
+                    kp_ = kp_.at[pids, :, :, loff].set(k)
+                    vp_ = vp_.at[pids, :, loff, :].set(v)
                 # kernel-dispatched paged attention: on TPU the Pallas
                 # kernel streams pages straight from the pool (no dense
                 # gather transient — the decode path's dominant cache-
                 # byte cost halves); on CPU/fallback the gather + dense
                 # step reference numerics run unchanged
                 att = paged_attention_step_auto(q, kp_, vp_, page_table,
-                                                pos, active)
+                                                pos, active,
+                                                k_scale=ks_, v_scale=vs_)
                 att = att @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                new_caches.append((kp_, vp_))
+                new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
+                                  else (kp_, vp_))
             logits = plan.final_logits(bp, params, x)
             nxt, new_keys = sample_slots(logits, keys, temps)
             nxt = jnp.where(active, nxt, tok)
@@ -648,12 +712,23 @@ class DecodeEngine:
                 d = x.shape[-1]
                 att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                kp_, vp_ = caches[bi]
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, P)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, P, hd)
-                kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids,
-                                       jnp.zeros((), jnp.int32))
-                new_caches.append((kp_, vp_))
+                z0 = jnp.zeros((), jnp.int32)
+                if kv_quant:
+                    # the prompt span quantizes per (head, position):
+                    # abs-max over the hd axis of each lane-last layout
+                    kp_, vp_, ks_, vs_ = caches[bi]
+                    kcol, kscol = quantize_heads(kcol, axis=2)
+                    vrow, vscol = quantize_heads(vrow, axis=3)
+                    ks_ = write_scale_pages(ks_, kscol, wpids, z0, page)
+                    vs_ = write_scale_pages(vs_, vscol, wpids, z0, page)
+                    kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids, z0)
+                    new_caches.append((kp_, vp_, ks_, vs_))
+                else:
+                    kp_, vp_ = caches[bi]
+                    kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids, z0)
+                    new_caches.append((kp_, vp_))
             logits = plan.final_logits(bp, params, x[0, t0 - 1][None])
             # kp samples the prefill token, kdec seeds the slot's decode
             # key — the same split generate() draws from PRNGKey(seed).
@@ -701,9 +776,17 @@ class DecodeEngine:
                 p = bp[i]
                 layer = layers[i]
                 q, k, v = _block_heads(layer, p, x, qpos)
-                kp_, vp_ = caches[bi]
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, C)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, C, hd)
+                if kv_quant:
+                    kp_, vp_, ks_, vs_ = caches[bi]
+                    kcol, kscol = quantize_heads(kcol, axis=2)
+                    vrow, vscol = quantize_heads(vrow, axis=3)
+                    ks_ = write_scale_pages(ks_, kscol, wpids, woff, page)
+                    vs_ = write_scale_pages(vs_, vscol, wpids, woff, page)
+                else:
+                    kp_, vp_ = caches[bi]
+                    ks_ = vs_ = None
                 kp_, vp_ = write_pages(kp_, vp_, kcol, vrow, wpids, woff)
                 # attend AFTER the write: the chunk attends to itself
                 # through the cache, which is exactly causal with the
@@ -712,11 +795,13 @@ class DecodeEngine:
                 # (`_prefill_chunk_block_attention` numerics) elsewhere
                 att = paged_attention_chunk_auto(q, kp_, vp_,
                                                  page_row[None],
-                                                 off[None])
+                                                 off[None],
+                                                 k_scale=ks_, v_scale=vs_)
                 d = x.shape[-1]
                 att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                new_caches.append((kp_, vp_))
+                new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
+                                  else (kp_, vp_))
             r = jnp.clip(t0 - 1 - off, 0, Cw - 1)
             logits = plan.final_logits(bp, params, x[0, r][None])
             greedy = _sample_logits(logits, kp, 0.0, 0)
@@ -750,6 +835,11 @@ class DecodeEngine:
         self._decode_chunked = decode_chunked
         self._prefill = prefill
         self._prefill_chunk_fn = prefill_chunk_fn
+        self._kv_quant = kv_quant
+        self._kv_quant_bits = 8 if kv_quant \
+            else 8 * jnp.dtype(cdt).itemsize
+        self._kv_bytes_per_token = _qz.kv_bytes_per_token(
+            plan.kv_geometry(), kv_quant, jnp.dtype(cdt).itemsize)
         # latency tier: prefix cache + speculative decoder are rebuilt
         # with the geometry on every (re)build, so a weight swap always
         # starts them cold — stale pages can never serve new weights
@@ -784,7 +874,7 @@ class DecodeEngine:
                 target_plan=plan, target_net=net,
                 draft_net=self._draft_net, k=k, n_slots=S, page=page,
                 L_logical=L_logical, pool_pages=pool_pages,
-                top_k=self.top_k, donate=donate)
+                top_k=self.top_k, donate=donate, kv_quant=kv_quant)
         self._reset_device_state()
 
     def _reset_device_state(self) -> None:
@@ -805,8 +895,21 @@ class DecodeEngine:
             hd = layer.n_out // layer.n_heads
             Hkv = layer._kv_heads
             # +1: page 0 is the reserved trash page for masked writes
-            caches.append((jnp.zeros((P + 1, Hkv, hd, page), plan.cdt),
-                           jnp.zeros((P + 1, Hkv, page, hd), plan.cdt)))
+            if self._kv_quant:
+                # int8 payload pools + f32 per-(head, position) scale
+                # pools riding the same page table; zero scales never
+                # dequantize stale garbage (0 * s == 0 either way), but
+                # 1.0 keeps the trash page's dequant exactly 0.0 in one
+                # multiply like a real all-zero write would
+                caches.append(
+                    (jnp.zeros((P + 1, Hkv, hd, page), jnp.int8),
+                     jnp.zeros((P + 1, Hkv, page, hd), jnp.int8),
+                     jnp.ones((P + 1, Hkv, page), jnp.float32),
+                     jnp.ones((P + 1, Hkv, page), jnp.float32)))
+            else:
+                caches.append(
+                    (jnp.zeros((P + 1, Hkv, hd, page), plan.cdt),
+                     jnp.zeros((P + 1, Hkv, page, hd), plan.cdt)))
         self._caches = caches
         self._page_table = jnp.zeros((S, self._n_pages_max), jnp.int32)
         self._tok = jnp.zeros((S,), jnp.int32)
@@ -1098,6 +1201,11 @@ class DecodeEngine:
                "max_queued_pages": self.max_queued_pages,
                "page_fragmentation_pct": round(frag, 1),
                "prefill_chunk": self.prefill_chunk,
+               # quantized-KV tier: numeric (not string) so the keys
+               # survive `_flatten_numeric` into Prometheus exposition;
+               # bits reflect the BUILT pools (kill switch included)
+               "kv_quant_bits": self._kv_quant_bits,
+               "kv_bytes_per_token": self._kv_bytes_per_token,
                "prompt_buckets": list(self.prompt_buckets)}
         if self._prefix_cache is not None:
             hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
@@ -1615,8 +1723,10 @@ class DecodeEngine:
             self._cond.notify_all()
         if self.breaker is not None:
             self.breaker.record_success(req.probe)
+        # trace rides along so a p99 excursion can pin THIS request's
+        # timeline in the failure ring (observability excursion hook)
         self._gen_latency_hist.observe(
-            1e3 * (time.monotonic() - req.enqueued_at))
+            1e3 * (time.monotonic() - req.enqueued_at), trace=req.trace)
         self.recorder.event("retire", slot=slot, tokens=len(req.tokens))
         self._finish_obs(req)
 
@@ -1923,6 +2033,17 @@ class DecodeEngine:
             # "old weights still serving"
             self._swap_in_progress = True
         try:
+            if net is self._net:
+                # swap target IS the net the pools/prefix pages were
+                # built under (ModelServer.restore_model hands back the
+                # same object on rollback): skip the rebuild, keeping
+                # warm page pools and every prefix-cache entry — a
+                # failed canary rolls back FREE instead of serving the
+                # next burst cold (ROADMAP item 5)
+                with self._cond:
+                    self.swaps += 1
+                self.recorder.event("swap", decision="preserved-pools")
+                return
             self._build(net)
             misfit = []
             with self._cond:

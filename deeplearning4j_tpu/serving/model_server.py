@@ -413,13 +413,60 @@ class ModelServer:
                  auto_canary: bool = True,
                  infer_hooks: Sequence[Callable] = (),
                  pad_batches: bool = True,
-                 generation: Optional[dict] = None):
+                 generation: Optional[dict] = None,
+                 quantize: Optional[dict] = None,
+                 drift_gate: Optional[dict] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if max_concurrent < 1:
             raise ValueError("max_concurrent must be >= 1")
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        # quantized serving tier (serving/quantize.py): weights are
+        # quantized HERE — at construction and again on every reload
+        # candidate, BEFORE canary/drift validation, so the exact
+        # numerics that will serve are the numerics that get gated
+        if quantize is not None:
+            unknown = set(quantize) - {"weights", "kv"}
+            if unknown:
+                raise ValueError(f"unknown quantize keys: {sorted(unknown)}")
+            if quantize.get("weights") not in (None, "int8", "bf16"):
+                raise ValueError(
+                    "quantize['weights'] must be 'int8' or 'bf16', got "
+                    f"{quantize.get('weights')!r}")
+            if quantize.get("kv") not in (None, "int8"):
+                raise ValueError("quantize['kv'] must be 'int8', got "
+                                 f"{quantize.get('kv')!r}")
+        self._quantize_cfg = dict(quantize) if quantize else None
+        if drift_gate is not None:
+            unknown = set(drift_gate) - {"eval_set", "max_argmax_drift",
+                                         "max_ppl_delta"}
+            if unknown:
+                raise ValueError(
+                    f"unknown drift_gate keys: {sorted(unknown)}")
+            if drift_gate.get("eval_set") is None:
+                raise ValueError(
+                    "drift_gate needs an 'eval_set' (pinned (B, T) token "
+                    "ids the argmax-drift / perplexity gates score)")
+        self._drift_gate = dict(drift_gate) if drift_gate else None
+        self.drift_gate_checks = 0  # guarded by: _cond
+        self.drift_gate_failures = 0  # guarded by: _cond
+        self._last_drift: Optional[dict] = None  # guarded by: _cond
+        wq = self._quantize_cfg.get("weights") if self._quantize_cfg \
+            else None
+        self._weight_bits = {"int8": 8, "bf16": 16}.get(wq, 32)
+        if wq is not None:
+            from deeplearning4j_tpu.serving.quantize import (
+                quantize_net_weights,
+            )
+
+            raw = net
+            net = quantize_net_weights(net, wq)
+            # the raw full-precision net IS the drift reference (and the
+            # only honest one: the quantized clone can't re-derive it)
+            self._raw_net = raw
+        else:
+            self._raw_net = net
         self._net = net  # guarded by: _rwlock.write()
         self.max_queue = max_queue
         self.max_batch_size = max_batch_size
@@ -475,6 +522,10 @@ class ModelServer:
         self.failures = 0        # guarded by: _cond — bad device steps
         self.reloads = 0  # guarded by: _reload_lock
         self.reload_rejections = 0  # guarded by: _cond
+        if wq is not None and self._drift_gate is not None:
+            # gate the construction-time quantization too: a server must
+            # not START serving numerics it would refuse to reload into
+            self._check_drift_gate(self._raw_net, self._net)
         self._threads = [
             threading.Thread(target=self._serve_loop, daemon=True,
                              name=f"model-server-exec-{i}")
@@ -547,7 +598,17 @@ class ModelServer:
                # "queued" — the routing contract name vs the historical
                # one; both are pinned by tests
                "in_flight": in_flight, "queue_depth": queued,
-               "ewma_latency_ms": round(ewma_ms, 3)}
+               "ewma_latency_ms": round(ewma_ms, 3),
+               # quantized-serving tier: numeric, unconditional (the
+               # stats-schema contract + Prometheus exposition carry
+               # them for every config, quantized or not)
+               "weight_bits": self._weight_bits,
+               "drift_gate_checks": self.drift_gate_checks,
+               "drift_gate_failures": self.drift_gate_failures}
+        with self._cond:
+            last_drift = self._last_drift
+        if last_drift is not None:
+            out["drift"] = dict(last_drift)
         engine = self._engine
         if engine is not None:
             gen = engine.stats()
@@ -701,7 +762,8 @@ class ModelServer:
         with self._reload_lock:
             with self._rwlock.write():
                 self._net = net
-                self.model_version += 1
+                self._raw_net = net  # restored weights are their own
+                self.model_version += 1  # drift reference
                 version = self.model_version
             with self._engine_lock:
                 engine = self._engine
@@ -742,6 +804,11 @@ class ModelServer:
                 # in the same dump as predicts and breaker transitions
                 cfg.setdefault("recorder", self.recorder)
                 cfg.setdefault("metrics", self.metrics)
+                # the server's KV quantization flows to the engine
+                # unless the generation cfg overrides it explicitly
+                if self._quantize_cfg and self._quantize_cfg.get("kv"):
+                    cfg.setdefault(
+                        "quantize", {"kv": self._quantize_cfg["kv"]})
                 self._engine = DecodeEngine(self._net, **cfg)
             return self._engine
 
@@ -959,7 +1026,20 @@ class ModelServer:
         with self._reload_lock:
             try:
                 candidate = self._load_candidate(source, step)
+                raw_candidate = candidate
+                wq = self._quantize_cfg.get("weights") \
+                    if self._quantize_cfg else None
+                if wq is not None:
+                    from deeplearning4j_tpu.serving.quantize import (
+                        quantize_net_weights,
+                    )
+
+                    # quantize BEFORE validation: the canary + drift
+                    # gates must score the numerics that will serve
+                    candidate = quantize_net_weights(raw_candidate, wq)
                 self._validate_candidate(candidate, canary)
+                if wq is not None and self._drift_gate is not None:
+                    self._check_drift_gate(raw_candidate, candidate)
             except Exception as e:
                 # every pre-swap failure is a rejected deploy: integrity
                 # (CheckpointCorruptError) and canary rejections alike
@@ -971,7 +1051,9 @@ class ModelServer:
                 raise
             with self._rwlock.write():
                 old_net = self._net
+                old_raw = self._raw_net
                 self._net = candidate
+                self._raw_net = raw_candidate
                 self.model_version += 1
                 version = self.model_version
             # generation tier: the decode engine drains its slots (every
@@ -998,6 +1080,7 @@ class ModelServer:
                     # never aliases a later successful reload
                     with self._rwlock.write():
                         self._net = old_net
+                        self._raw_net = old_raw
                         self.model_version += 1
                     with self._cond:
                         self.reload_rejections += 1
@@ -1070,6 +1153,51 @@ class ModelServer:
                 f"reload candidate rejected: output shape {out.shape[1:]} "
                 f"!= live model's {live_out.shape[1:]} — clients would "
                 "observe a silent contract break")
+
+    def _check_drift_gate(self, reference, candidate) -> None:
+        """Quantization drift gates (serving/quantize.py): score the
+        QUANTIZED candidate against its own full-precision reference on
+        the pinned eval set — argmax token-disagreement rate (the
+        number greedy serving actually exposes) and perplexity delta.
+        A breach raises `ModelValidationError` BEFORE any swap, so the
+        old weights keep serving and the reload machinery rolls back
+        free. The reference is the raw candidate, never the live net:
+        new weights legitimately differ from old ones — the gate
+        polices what quantization changed, nothing else."""
+        from deeplearning4j_tpu.serving.quantize import drift_report
+
+        gate = self._drift_gate
+        ids = np.asarray(gate["eval_set"])
+        try:
+            ref_out = np.asarray(reference.output(ids))
+            cand_out = np.asarray(candidate.output(ids))
+        except Exception as e:
+            raise ModelValidationError(
+                f"drift gate could not score the eval set "
+                f"{ids.shape}: {type(e).__name__}: {e}") from e
+        report = drift_report(ref_out, cand_out, ids)
+        max_drift = gate.get("max_argmax_drift")
+        max_ppl = gate.get("max_ppl_delta")
+        breaches = []
+        if max_drift is not None and report["argmax_drift"] > max_drift:
+            breaches.append(
+                f"argmax drift {report['argmax_drift']:.4f} > "
+                f"{max_drift}")
+        if max_ppl is not None and report["ppl_delta"] > max_ppl:
+            breaches.append(
+                f"perplexity delta {report['ppl_delta']:.4f} > {max_ppl}")
+        with self._cond:
+            self.drift_gate_checks += 1
+            if breaches:
+                self.drift_gate_failures += 1
+            self._last_drift = report
+        if breaches:
+            self.recorder.event("drift-gate", decision="rejected",
+                                **report)
+            raise ModelValidationError(
+                "quantized candidate rejected by drift gate: "
+                + "; ".join(breaches))
+        self.recorder.event("drift-gate", decision="accepted", **report)
 
     # -- shutdown ----------------------------------------------------------
     def shutdown(self, drain_timeout: float = 10.0) -> bool:

@@ -367,9 +367,21 @@ DEFAULT_LATENCY_BUCKETS_MS = (
 class Histogram:
     """Fixed-bucket histogram (`buckets` are inclusive upper bounds;
     one implicit +Inf bucket). `observe` is a bisect plus two adds
-    under an uncontended lock."""
+    under an uncontended lock.
 
-    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_lock")
+    **p99-excursion auto-dump** (`enable_excursion`): an observation
+    landing past the histogram's own live `quantile` bound fires the
+    configured hook OUTSIDE the lock with ``(value, bound, trace)`` —
+    the engine wires this to `FlightRecorder.pin`, so the excursion
+    request's full timeline lands in the failures ring the moment the
+    tail event happens, instead of being reconstructed from counters
+    after the fact. The bound is computed from the bucket counts
+    BEFORE the new observation (an excursion cannot raise the bar it
+    is judged against) and only once `min_count` observations exist
+    (a cold histogram's 'p99' is noise)."""
+
+    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_lock",
+                 "_exc_quantile", "_exc_min_count", "_exc_hook")
 
     def __init__(self, name: str,
                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
@@ -381,13 +393,60 @@ class Histogram:
         self._count = 0
         self._sum = 0.0
         self._lock = threading.Lock()
+        self._exc_quantile = 0.99
+        self._exc_min_count = 50
+        self._exc_hook: Optional[Callable] = None
 
-    def observe(self, v: float) -> None:
-        i = bisect_right(self.buckets, v)
+    def enable_excursion(self, quantile: float = 0.99,
+                         min_count: int = 50,
+                         hook: Optional[Callable] = None) -> None:
+        """Arm the excursion hook: observations past the live
+        `quantile` bound (once `min_count` observations exist) call
+        ``hook(value, bound, trace)`` outside the histogram lock."""
+        if not 0.0 < quantile < 1.0:
+            raise ValueError("excursion quantile must be in (0, 1)")
+        if min_count < 1:
+            raise ValueError("excursion min_count must be >= 1")
+        self._exc_quantile = float(quantile)
+        self._exc_min_count = int(min_count)
+        self._exc_hook = hook
+
+    def _quantile_bound_locked(self, q: float) -> Optional[float]:
+        """Smallest bucket upper bound covering quantile `q` of the
+        recorded observations — None when the quantile falls in the
+        implicit +Inf bucket (no finite bar to judge against)."""
+        if not self._count:
+            return None
+        target = q * self._count
+        cum = 0
+        for bound, cnt in zip(self.buckets, self._counts):
+            cum += cnt
+            if cum >= target:
+                return bound
+        return None
+
+    def quantile_bound(self, q: float) -> Optional[float]:
+        """Public read of the live bucket-quantile bound (telemetry,
+        tests, the bench's excursion line)."""
         with self._lock:
+            return self._quantile_bound_locked(q)
+
+    def observe(self, v: float, trace=None) -> None:
+        i = bisect_right(self.buckets, v)
+        fire_bound = None
+        with self._lock:
+            if self._exc_hook is not None \
+                    and self._count >= self._exc_min_count:
+                bound = self._quantile_bound_locked(self._exc_quantile)
+                if bound is not None and v > bound:
+                    fire_bound = bound
             self._counts[i] += 1
             self._count += 1
             self._sum += v
+        if fire_bound is not None:
+            # outside the lock: the hook appends to recorder rings and
+            # must not serialize every concurrent observe behind it
+            self._exc_hook(v, fire_bound, trace)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -573,6 +632,27 @@ class FlightRecorder:
             if decision != "served":
                 self._failures.append(entry)
 
+    def pin(self, trace, decision: str, kind: str = "excursion",
+            **attrs) -> None:
+        """Pin a request timeline in the FAILURES ring without a
+        request completion — the p99-excursion auto-dump: the latency
+        histogram's excursion hook calls this the moment an
+        observation lands past the quantile bound, so the tail
+        request's full span timeline survives success traffic (the
+        failures ring is the one a burst of served requests cannot
+        push a postmortem out of). Also rings a matching control-plane
+        event carrying the trace id."""
+        if not trace or not tracing_enabled():
+            return
+        entry = {"kind": kind, "decision": decision,
+                 "wall_time": time.time(), "trace": trace}
+        if attrs:
+            entry["attrs"] = attrs
+        with self._lock:
+            self._failures.append(entry)
+        self.event(kind, decision=decision,
+                   trace_id=getattr(trace, "trace_id", None), **attrs)
+
     def event(self, kind: str, **attrs) -> None:
         """Ring a scheduler/control-plane event (admission, retirement,
         page reclaim, probe verdict, breaker transition, chaos)."""
@@ -621,6 +701,10 @@ MODEL_SERVER_STATS_KEYS = frozenset({
     "reload_rejections", "breaker_state", "breaker_opens",
     "model_version", "queued", "in_flight", "queue_depth",
     "ewma_latency_ms",
+    # quantized serving tier: weight precision actually serving (32 /
+    # 16 / 8) and the drift-gate verdict counters — all numeric, so
+    # `_flatten_numeric` carries them into the Prometheus exposition
+    "weight_bits", "drift_gate_checks", "drift_gate_failures",
 })
 
 DECODE_ENGINE_STATS_KEYS = frozenset({
@@ -630,6 +714,10 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
     "slot_occupancy_pct", "n_slots", "active_slots", "queued", "swaps",
     "max_len", "page_size", "pool_pages", "pages_in_use",
     "pages_in_use_peak", "queued_page_demand", "max_queued_pages",
+    # quantized KV tier: bits per cache element actually allocated
+    # (8 = int8 pools, else the compute dtype's width) and the
+    # per-generated-token KV byte cost including the scale sidecar
+    "kv_quant_bits", "kv_bytes_per_token",
 })
 
 REPLICA_POOL_STATS_KEYS = frozenset({

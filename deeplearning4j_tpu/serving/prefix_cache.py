@@ -33,6 +33,17 @@ style prefix caching, Kwon et al., SOSP '23):
 - **invalidation**: `clear()` drops every node; the engine calls it
   whenever the paged pools rebuild (weight swap via `drain_and_swap`,
   post-failure recovery) so stale pages can never serve new weights.
+  A swap back to the SAME net object the pools were built under — the
+  canary ladder's rollback (`ModelServer.restore_model` hands back the
+  exact old net) — skips the rebuild entirely and PRESERVES the cache:
+  the pages were computed under precisely those weights, so a failed
+  deploy no longer pays a cold prefix cache on top of the rollback.
+- **quantized pools**: with the engine's int8 KV tier
+  (`quantize={"kv": "int8"}`, serving/quantize.py) cached pages hold
+  int8 payloads plus their f32 scale-pool rows. Sharing is unchanged —
+  the scale pages ride the same page table and refcounts — and a
+  prefix hit re-serves pages exactly as quantized by the request that
+  wrote them, so hit and miss paths decode identical values.
 
 Thread-safety: externally synchronized — every method is called by the
 `DecodeEngine` under its scheduler condition lock.

@@ -48,7 +48,14 @@ robustness ladders of PRs 1–4 built in:
   replica's canary or post-reload probe fails, the WHOLE pool rolls
   back to the old weights (`ModelServer.restore_model`) — a bad
   checkpoint never takes traffic, not even on the replicas that
-  individually accepted it.
+  individually accepted it. Quantized serving rides this ladder
+  unchanged: replicas built with `quantize={"weights": ...}` quantize
+  each reload candidate BEFORE canary validation and score it against
+  the candidate's own full-precision outputs via the drift gates
+  (`drift_gate={...}`, serving/quantize.py) — a quantization-broken
+  candidate (clipped scales, outlier channels) is rejected exactly
+  like a corrupt checkpoint and the pool rolls back free, with zero
+  failed requests under live traffic (tests/test_quantize.py drill).
 - **degraded mode** — with every replica evicted the pool serves the
   typed `ServiceUnavailableError` with `retry_after=probe_interval`
   and KEEPS PROBING: the moment replicas pass `readmit_successes`

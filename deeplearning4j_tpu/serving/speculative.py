@@ -88,7 +88,8 @@ class SpeculativeDecoder:
 
     def __init__(self, *, target_plan, target_net, draft_net, k: int,
                  n_slots: int, page: int, L_logical: int,
-                 pool_pages: int, top_k: int, donate: bool):
+                 pool_pages: int, top_k: int, donate: bool,
+                 kv_quant: Optional[str] = None):
         if k < 1:
             raise ValueError("speculative k must be >= 1")
         import jax
@@ -107,7 +108,16 @@ class SpeculativeDecoder:
             paged_attention_step_auto,
         )
         from deeplearning4j_tpu.serving.decode_engine import _write_pages
+        from deeplearning4j_tpu.serving.quantize import (
+            _write_scale_pages,
+            quantize_heads,
+        )
 
+        # the engine's resolved KV quantization mode is inherited
+        # verbatim: the verify step writes into the ENGINE's pools, and
+        # the draft pools mirror the same halved-residency layout so
+        # "same page ids" stays memory-true
+        self._kv_quant = kv_quant
         self.k = int(k)
         self.n_slots = n_slots
         self.page = page
@@ -167,12 +177,23 @@ class SpeculativeDecoder:
                 d = x.shape[-1]
                 att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                kp_, vp_ = dcaches[bi]
                 kcol = jnp.transpose(kh, (0, 2, 3, 1))
                 vrow = jnp.transpose(vh, (0, 2, 1, 3))
-                kp_, vp_ = _write_pages(kp_, vp_, kcol, vrow, wpids,
-                                        jnp.zeros((), jnp.int32), page)
-                new_caches.append((kp_, vp_))
+                z0 = jnp.zeros((), jnp.int32)
+                if kv_quant:
+                    kp_, vp_, ks_, vs_ = dcaches[bi]
+                    kcol, kscol = quantize_heads(kcol, axis=2)
+                    vrow, vscol = quantize_heads(vrow, axis=3)
+                    ks_ = _write_scale_pages(ks_, kscol, wpids, z0, page)
+                    vs_ = _write_scale_pages(vs_, vscol, wpids, z0, page)
+                    kp_, vp_ = _write_pages(kp_, vp_, kcol, vrow, wpids,
+                                            z0, page)
+                    new_caches.append((kp_, vp_, ks_, vs_))
+                else:
+                    kp_, vp_ = dcaches[bi]
+                    kp_, vp_ = _write_pages(kp_, vp_, kcol, vrow, wpids,
+                                            z0, page)
+                    new_caches.append((kp_, vp_))
             return new_caches
 
         @partial(jax.jit, donate_argnums=(1,) if donate else ())
@@ -191,17 +212,27 @@ class SpeculativeDecoder:
                 p = bp[i]
                 layer = dplan.layers[i]
                 q, kh, vh = _block_heads(layer, p, x, qpos)
-                kp_, vp_ = dcaches[bi]
                 kcol = jnp.transpose(kh, (0, 2, 3, 1))
                 vrow = jnp.transpose(vh, (0, 2, 1, 3))
+                if kv_quant:
+                    kp_, vp_, ks_, vs_ = dcaches[bi]
+                    kcol, kscol = quantize_heads(kcol, axis=2)
+                    vrow, vscol = quantize_heads(vrow, axis=3)
+                    ks_ = _write_scale_pages(ks_, kscol, wpids, woff, page)
+                    vs_ = _write_scale_pages(vs_, vscol, wpids, woff, page)
+                else:
+                    kp_, vp_ = dcaches[bi]
+                    ks_ = vs_ = None
                 kp_, vp_ = _write_pages(kp_, vp_, kcol, vrow, wpids, woff,
                                         page)
                 att = paged_attention_chunk_auto(q, kp_, vp_,
-                                                 page_row[None], off[None])
+                                                 page_row[None], off[None],
+                                                 k_scale=ks_, v_scale=vs_)
                 d = x.shape[-1]
                 att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                new_caches.append((kp_, vp_))
+                new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
+                                  else (kp_, vp_))
             return new_caches
 
         # -- draft proposal: k+1 scanned draft steps ------------------------
@@ -236,15 +267,28 @@ class SpeculativeDecoder:
                     q, kh, vh = _block_heads(layer, p, x[:, None, :],
                                              p_j[:, None])
                     q, kh, vh = q[:, 0], kh[:, 0], vh[:, 0]
-                    kp_, vp_ = caches[bi]
-                    kp_ = kp_.at[pids, :, :, loff].set(kh)
-                    vp_ = vp_.at[pids, :, loff, :].set(vh)
+                    if kv_quant:
+                        kp_, vp_, ks_, vs_ = caches[bi]
+                        kq, ksc = quantize_heads(kh)
+                        vq, vsc = quantize_heads(vh)
+                        kp_ = kp_.at[pids, :, :, loff].set(kq)
+                        vp_ = vp_.at[pids, :, loff, :].set(vq)
+                        ks_ = ks_.at[pids, :, loff].set(ksc)
+                        vs_ = vs_.at[pids, :, loff].set(vsc)
+                    else:
+                        kp_, vp_ = caches[bi]
+                        ks_ = vs_ = None
+                        kp_ = kp_.at[pids, :, :, loff].set(kh)
+                        vp_ = vp_.at[pids, :, loff, :].set(vh)
                     att = paged_attention_step_auto(q, kp_, vp_,
                                                     page_table, p_j,
-                                                    active)
+                                                    active,
+                                                    k_scale=ks_,
+                                                    v_scale=vs_)
                     att = att @ p["Wo"] + p["bo"]
                     x = _block_ffn(layer, p, x + att)
-                    new_caches.append((kp_, vp_))
+                    new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
+                                      else (kp_, vp_))
                 logits = dplan.final_logits(bp, dparams, x)
                 scaled = scale_and_filter(logits, temps)
                 qdist = jax.nn.softmax(scaled.astype(jnp.float32), axis=-1)
@@ -282,7 +326,11 @@ class SpeculativeDecoder:
                 p = bp[i]
                 layer = tplan.layers[i]
                 q, kh, vh = _block_heads(layer, p, x, qpos)
-                kp_, vp_ = caches[bi]
+                if kv_quant:
+                    kp_, vp_, ks_, vs_ = caches[bi]
+                else:
+                    kp_, vp_ = caches[bi]
+                    ks_ = vs_ = None
                 for j in range(C):
                     p_j = pos + j
                     wpos = jnp.minimum(p_j, L_logical - 1)
@@ -290,16 +338,26 @@ class SpeculativeDecoder:
                     pids = jnp.where(writable,
                                      page_table[rows, wpos // page], 0)
                     loff = wpos % page
-                    kp_ = kp_.at[pids, :, :, loff].set(kh[:, j])
-                    vp_ = vp_.at[pids, :, loff, :].set(vh[:, j])
+                    if kv_quant:
+                        kq, ksc = quantize_heads(kh[:, j])
+                        vq, vsc = quantize_heads(vh[:, j])
+                        kp_ = kp_.at[pids, :, :, loff].set(kq)
+                        vp_ = vp_.at[pids, :, loff, :].set(vq)
+                        ks_ = ks_.at[pids, :, loff].set(ksc)
+                        vs_ = vs_.at[pids, :, loff].set(vsc)
+                    else:
+                        kp_ = kp_.at[pids, :, :, loff].set(kh[:, j])
+                        vp_ = vp_.at[pids, :, loff, :].set(vh[:, j])
                 # one (k+1)-wide paged chunk per slot: the kernel walks
                 # the page table in place; the fallback is exactly
                 # `_verify_block_attention` (gather + vmapped chunk)
                 att = paged_attention_chunk_auto(q, kp_, vp_, page_table,
-                                                 pos, active)
+                                                 pos, active,
+                                                 k_scale=ks_, v_scale=vs_)
                 att = att @ p["Wo"] + p["bo"]
                 x = _block_ffn(layer, p, x + att)
-                new_caches.append((kp_, vp_))
+                new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
+                                  else (kp_, vp_))
             logits = tplan.final_logits(bp, params, x)       # (S, C, V)
 
             # --- acceptance (Leviathan rejection sampling; greedy =
@@ -391,8 +449,18 @@ class SpeculativeDecoder:
             layer = dplan.layers[i]
             hd = layer.n_out // layer.n_heads
             Hkv = layer._kv_heads
-            caches.append((jnp.zeros((P + 1, Hkv, hd, page), dplan.cdt),
-                           jnp.zeros((P + 1, Hkv, page, hd), dplan.cdt)))
+            if self._kv_quant:
+                # int8 draft pools + f32 scale sidecars, mirroring the
+                # engine's layout (see DecodeEngine._reset_device_state)
+                caches.append(
+                    (jnp.zeros((P + 1, Hkv, hd, page), jnp.int8),
+                     jnp.zeros((P + 1, Hkv, page, hd), jnp.int8),
+                     jnp.ones((P + 1, Hkv, page), jnp.float32),
+                     jnp.ones((P + 1, Hkv, page), jnp.float32)))
+            else:
+                caches.append(
+                    (jnp.zeros((P + 1, Hkv, hd, page), dplan.cdt),
+                     jnp.zeros((P + 1, Hkv, page, hd), dplan.cdt)))
         self._caches = caches
         self._keys = jnp.stack(
             [jax.random.PRNGKey(1000 + i) for i in range(S)])

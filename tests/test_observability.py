@@ -309,6 +309,44 @@ def test_exposition_text_format():
     assert not any("state" in ln for ln in lines)
 
 
+def test_histogram_excursion_hook_semantics():
+    """The p99-excursion primitive: no firing below `min_count`, the
+    bound is the live bucket-quantile computed BEFORE the observation
+    lands, only strictly-past-the-bound values fire, and the hook
+    receives (value, bound, trace) outside the lock."""
+    h = obs.Histogram("lat_ms", buckets=(1, 10, 100))
+    fired = []
+    h.enable_excursion(quantile=0.5, min_count=2,
+                       hook=lambda v, b, tr: fired.append((v, b, tr)))
+    h.observe(0.5)
+    h.observe(500.0, trace="t0")  # count=1 < min_count: silent
+    assert fired == []
+    h.observe(0.5)
+    assert h.quantile_bound(0.5) == 1.0
+    h.observe(1.0, trace="t1")    # == bound: NOT an excursion
+    assert fired == []
+    h.observe(50.0, trace="t2")   # past the bound: fires
+    assert fired == [(50.0, 1.0, "t2")]
+    with pytest.raises(ValueError):
+        h.enable_excursion(quantile=1.5)
+    with pytest.raises(ValueError):
+        h.enable_excursion(min_count=0)
+
+
+def test_histogram_excursion_silent_in_inf_bucket():
+    """When the quantile falls in the implicit +Inf bucket there is no
+    finite bar to judge against — the hook must stay silent instead of
+    firing on every observation."""
+    h = obs.Histogram("lat_ms", buckets=(1,))
+    fired = []
+    h.enable_excursion(quantile=0.5, min_count=1,
+                       hook=lambda v, b, tr: fired.append(v))
+    for _ in range(4):
+        h.observe(100.0)  # all mass in +Inf
+    h.observe(500.0)
+    assert fired == []
+
+
 # -------------------------------------------------------- flight recorder
 
 
@@ -393,6 +431,41 @@ def test_stats_schema_contracts_via_metrics_snapshot(net):
             assert obs.POOL_REPLICA_STATS_KEYS <= set(rep)
     finally:
         pool.shutdown(drain_timeout=3.0)
+
+
+def test_quantization_stats_keys_in_contract_and_exposition(net):
+    """ISSUE 13 schema satellite: the quantized-serving keys are part
+    of the frozenset contracts and land on the Prometheus page
+    UNCONDITIONALLY — a dense/unquantized deployment scrapes the same
+    schema with full-precision values, so dashboards never branch."""
+    assert {"weight_bits", "drift_gate_checks", "drift_gate_failures"} \
+        <= obs.MODEL_SERVER_STATS_KEYS
+    assert {"kv_quant_bits", "kv_bytes_per_token"} \
+        <= obs.DECODE_ENGINE_STATS_KEYS
+    srv = ModelServer(net, quantize={"weights": "bf16", "kv": "int8"},
+                      generation={"n_slots": 2, "max_len": 32,
+                                  "prompt_buckets": (8,)})
+    try:
+        srv.generate(_prompts(1, 5)[0], 3)
+        s = srv.stats()
+        assert s["weight_bits"] == 16
+        assert s["generation"]["kv_quant_bits"] == 8
+        text = srv.metrics_text()
+        assert "dl4j_stats_model_server_weight_bits 16" in text
+        assert "dl4j_stats_model_server_drift_gate_checks 0" in text
+        assert "dl4j_stats_model_server_drift_gate_failures 0" in text
+        assert "dl4j_stats_decode_engine_kv_quant_bits 8" in text
+        assert "dl4j_stats_decode_engine_kv_bytes_per_token" in text
+    finally:
+        srv.shutdown()
+    # unquantized engine: SAME keys, full-precision values
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,))
+    try:
+        assert eng.stats()["kv_quant_bits"] == 32
+        assert "dl4j_stats_decode_engine_kv_quant_bits 32" \
+            in eng.metrics_text()
+    finally:
+        eng.shutdown()
 
 
 def test_server_generation_shares_one_registry_and_recorder(net):

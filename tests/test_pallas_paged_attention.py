@@ -34,6 +34,7 @@ from deeplearning4j_tpu.ops.attention import (  # noqa: E402
     paged_attention_step_auto,
     paged_attention_step,
     paged_gather,
+    paged_gather_quant,
 )
 from deeplearning4j_tpu.ops.pallas_paged_attention import (  # noqa: E402
     paged_attention,
@@ -268,3 +269,147 @@ def test_vmem_estimate_scales_and_gates():
     huge = vmem_bytes_estimate(C=4096, H=64, Hkv=64, hd=256, page=512,
                                itemsize=4)
     assert huge > 112 * 1024 * 1024
+    # int8 pools halve the KV tile bytes at the same shape
+    assert vmem_bytes_estimate(1, 8, 8, 128, 128, 4, kv_itemsize=1) \
+        < vmem_bytes_estimate(1, 8, 8, 128, 128, 4)
+
+
+# ------------------------------------------------ int8-KV variant
+
+
+def _rand_quant_pools(rng, P, Hkv, hd, page):
+    """int8 payload pages + per-(head, position) f32 scale pages — the
+    engine's quantized-pool layout (`serving/quantize.py`)."""
+    k_pool = rng.integers(-127, 128, (P + 1, Hkv, hd, page)).astype(np.int8)
+    v_pool = rng.integers(-127, 128, (P + 1, Hkv, page, hd)).astype(np.int8)
+    k_scale = rng.uniform(0.005, 0.05, (P + 1, Hkv, page)).astype(np.float32)
+    v_scale = rng.uniform(0.005, 0.05, (P + 1, Hkv, page)).astype(np.float32)
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def _gather_quant_chunk_ref(q, k_pool, v_pool, ks, vs, pt, p0):
+    kd, vd = paged_gather_quant(jnp.asarray(k_pool), jnp.asarray(v_pool),
+                                jnp.asarray(ks), jnp.asarray(vs),
+                                jnp.asarray(pt), jnp.float32)
+    C = q.shape[1]
+    qpos = jnp.asarray(p0)[:, None] + jnp.arange(C)[None, :]
+    out = jax.vmap(cached_attention_chunk)(jnp.asarray(q), kd, vd, qpos)
+    return np.asarray(out).reshape(q.shape)
+
+
+@pytest.mark.parametrize("H,Hkv,C", [(2, 2, 1), (4, 2, 1), (4, 1, 3),
+                                     (4, 2, 4)])
+def test_int8_kernel_matches_gather_quant_reference_fuzz(H, Hkv, C):
+    """The quantized kernel variant (dequant inside the page loop) is
+    pinned against the `paged_gather_quant` + dense oracle over the
+    same fuzz surface as the dense kernel: scrambled page tables,
+    cross-slot page reuse, holes to the trash page, GQA groupings,
+    decode and chunk widths."""
+    rng = np.random.default_rng(300 + 100 * H + 10 * Hkv + C)
+    S, hd, page, n_pages = 3, 8, 4, 4
+    P = S * n_pages
+    for trial in range(3):
+        k_pool, v_pool, ks, vs = _rand_quant_pools(rng, P, Hkv, hd, page)
+        perm = rng.permutation(np.arange(1, P + 1))
+        pt = perm.reshape(S, n_pages).astype(np.int32)
+        pt[1, 0] = pt[0, 0]   # shared prefix page
+        pt[2, 2:] = 0         # holes -> trash page
+        p0 = np.array([int(rng.integers(0, n_pages * page - C)),
+                       int(rng.integers(0, n_pages * page - C)),
+                       int(rng.integers(0, 2 * page - C))], np.int32)
+        q = rng.standard_normal((S, C, H, hd)).astype(np.float32)
+        ref = _gather_quant_chunk_ref(q, k_pool, v_pool, ks, vs, pt, p0)
+        got = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(pt), jnp.asarray(p0),
+            k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs),
+            interpret=True))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_int8_kernel_trash_and_stale_pages_masked():
+    """Poisoned int8 pages AND poisoned scale pages past each slot's
+    position (plus the trash page itself) must never move the output —
+    the same reallocation-safety convention as the dense kernel, now
+    covering the scale sidecar too."""
+    rng = np.random.default_rng(23)
+    S, H, Hkv, hd, page, n_pages = 2, 2, 2, 4, 4, 4
+    P = S * n_pages
+    k_pool, v_pool, ks, vs = _rand_quant_pools(rng, P, Hkv, hd, page)
+    pt = (1 + np.arange(P)).reshape(S, n_pages).astype(np.int32)
+    pos = np.array([2, 5], np.int32)
+    q = rng.standard_normal((S, 1, H, hd)).astype(np.float32)
+
+    def run(kp, vp, kss, vss, table):
+        return np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(pos),
+            k_scale=jnp.asarray(kss), v_scale=jnp.asarray(vss),
+            interpret=True))
+
+    base = run(k_pool, v_pool, ks, vs, pt)
+    k2, v2 = k_pool.copy(), v_pool.copy()
+    ks2, vs2 = ks.copy(), vs.copy()
+    for pid in (0, 2, 3, 4, 7, 8):  # trash page + pages past positions
+        k2[pid], v2[pid] = 127, -127
+        ks2[pid], vs2[pid] = 1e6, 1e6
+    pt2 = pt.copy()
+    pt2[0, 2:] = 0
+    np.testing.assert_array_equal(run(k2, v2, ks2, vs2, pt2), base)
+
+
+def test_int8_dispatch_declines_on_cpu_and_auto_matches_oracle():
+    """Tier-1 contract for the int8 tier: on CPU
+    `paged_attention_or_none` declines quantized calls, and the
+    `*_auto` wrappers with scales are BIT-IDENTICAL to the
+    `paged_gather_quant` + dense oracle the engine's numerics are
+    certified against."""
+    rng = np.random.default_rng(29)
+    S, H, Hkv, hd, page, n_pages = 2, 4, 2, 8, 4, 2
+    P = S * n_pages
+    k_pool, v_pool, ks, vs = _rand_quant_pools(rng, P, Hkv, hd, page)
+    pt = (1 + np.arange(P)).reshape(S, n_pages).astype(np.int32)
+    pos = np.array([3, 7], np.int32)
+    q1 = rng.standard_normal((S, H, hd)).astype(np.float32)
+    assert paged_attention_or_none(
+        jnp.asarray(q1[:, None]), jnp.asarray(k_pool),
+        jnp.asarray(v_pool), jnp.asarray(pt), jnp.asarray(pos),
+        k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs)) is None
+    auto = np.asarray(paged_attention_step_auto(
+        jnp.asarray(q1), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(pt), jnp.asarray(pos),
+        k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs)))
+    kd, vd = paged_gather_quant(jnp.asarray(k_pool), jnp.asarray(v_pool),
+                                jnp.asarray(ks), jnp.asarray(vs),
+                                jnp.asarray(pt), jnp.float32)
+    ref = np.asarray(cached_attention_step(jnp.asarray(q1), kd, vd,
+                                           jnp.asarray(pos)))
+    np.testing.assert_array_equal(auto, ref)
+    qc = rng.standard_normal((S, 3, H, hd)).astype(np.float32)
+    auto_c = np.asarray(paged_attention_chunk_auto(
+        jnp.asarray(qc), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(pt), jnp.asarray(pos),
+        k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs)))
+    ref_c = _gather_quant_chunk_ref(qc, k_pool, v_pool, ks, vs, pt, pos)
+    np.testing.assert_array_equal(auto_c, ref_c.reshape(S, 3, H * hd))
+
+
+def test_int8_kill_switch_gates_dispatch_before_probing(monkeypatch):
+    """`DL4J_TPU_NO_INT8_KV=1` must decline QUANTIZED dispatch even on
+    a platform where the dense kernel would run — the scales-present
+    path has its own gate ahead of any probe."""
+    import deeplearning4j_tpu.ops.pallas_paged_attention as pk
+
+    monkeypatch.setenv("DL4J_TPU_NO_INT8_KV", "1")
+    monkeypatch.setattr(pk, "_platform_supported", lambda: True)
+    rng = np.random.default_rng(31)
+    S, H, Hkv, hd, page, n_pages = 2, 2, 2, 4, 4, 2
+    P = S * n_pages
+    k_pool, v_pool, ks, vs = _rand_quant_pools(rng, P, Hkv, hd, page)
+    pt = (1 + np.arange(P)).reshape(S, n_pages).astype(np.int32)
+    q = rng.standard_normal((S, 1, H, hd)).astype(np.float32)
+    assert pk._int8_kv_allowed() is False
+    assert pk.paged_attention_or_none(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(pt), jnp.asarray([1, 3], np.int32),
+        k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs)) is None

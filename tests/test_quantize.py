@@ -1,0 +1,514 @@
+"""Quantized serving (ISSUE 13): int8 paged KV cache + int8/bf16
+weight quantization through the canary ladder.
+
+The load-bearing contracts:
+
+- int8 KV quantization is per-head symmetric with an explicit
+  error bound (|dequant - x| <= scale/2 elementwise) and an
+  all-zero-span identity (scale 1.0, dequant exactly 0.0 — the trash
+  page reads as true zeros);
+- an int8-KV `DecodeEngine` serves greedy tokens with bounded drift
+  vs the f32 whole-batch oracle across the FULL serving surface —
+  plain decode, chunked prefill, speculative draft/verify and
+  prefix-cache reuse — and `DL4J_TPU_NO_INT8_KV=1` (the kill switch)
+  restores bit-exact f32 parity without touching caller code;
+- weight quantization (`quantize_net_weights`) touches ONLY the
+  transformer matmul weights, never embeddings/LayerNorm/biases, and
+  the drift gates (argmax disagreement + perplexity delta on a pinned
+  eval set) accept a sane quantizer and reject a deliberately clipped
+  one — at construction AND through `ReplicaPool.rolling_reload`
+  under live traffic with ZERO failed requests (the ISSUE 13
+  acceptance drill);
+- a same-net `restore_model` rollback PRESERVES the engine's paged
+  pools and prefix-cache pages (ROADMAP item 5) instead of the old
+  unconditional rebuild-and-clear;
+- the generate-latency histogram's p99-excursion hook pins the tail
+  request's trace in the flight recorder's failures ring (ROADMAP
+  item 6).
+
+Everything runs on CPU in the quick tier; shapes stay tiny so the
+jitted prefill/decode pairs compile in seconds.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (
+    GPTPlan,
+    generate,
+    gpt_configuration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    ModelServer,
+    ModelValidationError,
+    ReplicaPool,
+    drift_report,
+    maybe_trace,
+    quantize_net_weights,
+)
+from deeplearning4j_tpu.serving import quantize as qz
+from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+from deeplearning4j_tpu.util.serialization import write_model
+
+VOCAB = 48
+
+
+def _gpt_net(seed: int = 12345, **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("max_length", 64)
+    net = MultiLayerNetwork(gpt_configuration(seed=seed, **kw))
+    net.init()
+    return net
+
+
+def _prompts(n, t0, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, (n, t0)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt_net()
+
+
+# ------------------------------------------------- quantizer numerics
+
+
+def test_quantize_heads_roundtrip_error_bound_per_head():
+    """Symmetric int8 round-trip error is bounded by scale/2 PER
+    ELEMENT, with each head's scale set by its own amax — one hot head
+    must not crush another head's resolution."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 4, 16)).astype(np.float32)
+    x[:, 1] *= 100.0  # one hot head: per-head scales or bust
+    q, scale = qz.quantize_heads(jnp.asarray(x), axis=-1)
+    assert np.asarray(q).dtype == np.int8
+    deq = np.asarray(qz.dequantize_heads(q, scale, axis=-1))
+    bound = np.expand_dims(np.asarray(scale), -1) / 2.0 + 1e-6
+    assert np.all(np.abs(deq - x) <= bound)
+    # the cold heads kept fine resolution despite the hot one
+    cold = np.abs(deq[:, 0] - x[:, 0]).max()
+    assert cold <= np.abs(x[:, 0]).max() / 127.0 * 0.51 + 1e-6
+
+
+def test_quantize_heads_zero_span_is_exact_zero():
+    """An all-zero span quantizes with scale 1.0 and dequantizes to
+    exactly 0.0 — the trash page (pools zero, scale pools ones) reads
+    back as true zeros in one multiply."""
+    import jax.numpy as jnp
+
+    q, scale = qz.quantize_heads(jnp.zeros((2, 3, 8), jnp.float32))
+    assert np.all(np.asarray(scale) == 1.0)
+    deq = np.asarray(qz.dequantize_heads(q, scale))
+    assert np.all(deq == 0.0)
+
+
+def test_quantize_heads_prefill_axis_layouts():
+    """The prefill column/row layouts quantize over their own position
+    axes: (1, Hkv, W, hd) K-columns over axis=2's paired hd... i.e. the
+    reduction runs over the HEAD dim for each position, matching the
+    engine's per-position scale pools (P+1, Hkv, page)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    kcol = jnp.asarray(rng.normal(size=(1, 2, 8, 16)).astype(np.float32))
+    q, s = qz.quantize_heads(kcol, axis=-1)
+    assert q.shape == kcol.shape and s.shape == (1, 2, 8)
+    deq = np.asarray(qz.dequantize_heads(q, s, axis=-1))
+    assert np.all(np.abs(deq - np.asarray(kcol))
+                  <= np.asarray(s)[..., None] / 2.0 + 1e-6)
+
+
+def test_kv_bytes_per_token_accounting(net):
+    """int8 pools pay 1 byte/element + an f32 per-position scale
+    sidecar; full-precision pools pay itemsize per element. Pinned
+    against `GPTPlan.kv_geometry()` so a GQA/head-width change reprices
+    the stat, the bench satellite and this test together."""
+    geom = GPTPlan(net).kv_geometry()
+    assert geom == [(2, 16), (2, 16)]
+    int8 = qz.kv_bytes_per_token(geom, "int8", 4)
+    full = qz.kv_bytes_per_token(geom, None, 4)
+    assert int8 == sum(2 * h * d + 8 * h for h, d in geom) == 160
+    assert full == sum(8 * h * d for h, d in geom) == 512
+    assert int8 * 2 < full  # the >=2x slots-per-chip headroom
+
+
+# --------------------------------------------------- weight quantizer
+
+
+def test_quantize_net_weights_touches_only_matmul_weights(net):
+    """int8 mode rewrites the block projections + output head W (to
+    bf16-stored fake-quant values CLOSE to the originals) and leaves
+    embeddings, positional tables, biases and LayerNorm params
+    bitwise alone. bf16 mode is a plain cast of the same key set."""
+    for mode in ("int8", "bf16"):
+        clone = quantize_net_weights(net, mode)
+        plan = GPTPlan(net)
+        touched = 0
+        for i, (orig, new) in enumerate(zip(net._params, clone._params)):
+            for key, w in orig.items():
+                nw = new[key]
+                is_target = (
+                    (i in plan.block_is and key in qz.BLOCK_MATMUL_KEYS)
+                    or (i == plan.out_i and key == "W")
+                ) and getattr(w, "ndim", 0) >= 2
+                if is_target:
+                    touched += 1
+                    assert str(nw.dtype) == "bfloat16", (mode, i, key)
+                    err = np.abs(np.asarray(nw, np.float32)
+                                 - np.asarray(w, np.float32))
+                    amax = np.abs(np.asarray(w, np.float32)).max()
+                    assert err.max() <= amax / 127.0 + 1e-3, (mode, key)
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(w), np.asarray(nw),
+                        err_msg=f"{mode} touched non-matmul {i}/{key}")
+        # 2 blocks x (Wqkv, Wo, W1, W2) + the output head W (no W3 —
+        # the default FFN is not SwiGLU)
+        assert touched == 2 * 4 + 1
+        # the original is untouched and the clone still runs
+        ids = _prompts(2, 8, seed=5)
+        assert np.isfinite(np.asarray(clone.output(ids))).all()
+
+
+def test_quantize_net_weights_rejects_unknown_mode(net):
+    with pytest.raises(ValueError, match="unknown weight quantization"):
+        quantize_net_weights(net, "int4")
+
+
+def test_drift_report_identity_and_direction():
+    """Identical outputs report zero drift / zero ppl delta; a
+    candidate that flips argmaxes reports a positive rate."""
+    rng = np.random.default_rng(3)
+    out = rng.normal(size=(2, 6, VOCAB)).astype(np.float32)
+    ids = _prompts(2, 6, seed=3)
+    rep = drift_report(out, out.copy(), ids)
+    assert rep["argmax_drift"] == 0.0 and rep["ppl_delta"] == 0.0
+    rep2 = drift_report(out, -out, ids)
+    assert rep2["argmax_drift"] > 0.5
+    assert rep2["ppl_cand"] != rep2["ppl_ref"]
+
+
+# ----------------------------------------------- int8 KV decode engine
+
+
+def test_int8_kv_engine_decode_bounded_drift(net):
+    """The tentpole parity pin: int8 paged KV decode serves the same
+    greedy tokens as the f32 whole-batch oracle on this eval set (per
+    the drift gate the bench and canary enforce — the bound here is
+    tight because the tiny net's logit margins dwarf int8 noise), and
+    the stats surface the quantization facts the bench satellites
+    report."""
+    prompts = _prompts(4, 5)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                       quantize={"kv": "int8"})
+    try:
+        outs = [eng.submit(prompts[i], 6).result(timeout=120.0)
+                for i in range(4)]
+        drift = np.mean([np.mean(o != e)
+                         for o, e in zip(outs, expected)])
+        assert drift <= 0.2, f"int8 KV argmax drift {drift} vs f32"
+        st = eng.stats()
+        assert st["kv_quant_bits"] == 8
+        assert st["kv_bytes_per_token"] == qz.kv_bytes_per_token(
+            GPTPlan(net).kv_geometry(), "int8", 4)
+        # int8 pools really are int8 on device, scales ride f32 ones
+        kp, vp, ks, vs = eng._caches[0]
+        assert str(kp.dtype) == "int8" and str(vp.dtype) == "int8"
+        assert str(ks.dtype) == "float32" and str(vs.dtype) == "float32"
+    finally:
+        eng.shutdown()
+
+
+def test_int8_kv_chunked_prefill_speculative_and_prefix_reuse(net):
+    """int8 KV through the whole latency tier at once: chunked prefill
+    writes quantized spans page-at-a-time, the speculative draft pools
+    quantize independently, verify reads dequantized chunks, and a
+    prefix-cache hit re-serves pages quantized by an earlier request."""
+    rng = np.random.default_rng(1)
+    long_prompt = rng.integers(0, VOCAB, (1, 24)).astype(np.int32)
+    exp = generate(net, long_prompt, 6, temperature=0.0)[0]
+    eng = DecodeEngine(net, n_slots=2, max_len=48, prompt_buckets=(8,),
+                       page_size=8, prefill_chunk=8,
+                       prefix_cache=True,
+                       speculative={"draft": "self", "k": 3},
+                       quantize={"kv": "int8"})
+    try:
+        r1 = eng.submit(long_prompt[0], 6).result(timeout=180.0)
+        r2 = eng.submit(long_prompt[0], 6).result(timeout=180.0)
+        assert np.mean(r1 != exp) <= 0.2
+        np.testing.assert_array_equal(r1, r2)  # hit path == miss path
+        st = eng.stats()
+        assert st["speculative"]["verify_steps"] >= 1
+        assert st["prefix_cache"]["hits"] >= 1
+        assert st["kv_quant_bits"] == 8
+    finally:
+        eng.shutdown()
+
+
+def test_int8_kv_kill_switch_restores_exact_parity(net, monkeypatch):
+    """`DL4J_TPU_NO_INT8_KV=1` downgrades an int8-KV engine to
+    full-precision pools — bit-exact f32 parity, 32-bit stats — with
+    ZERO caller-side changes: the operator lever behind the bench's
+    int8-vs-bf16 A/B and the numerics escape hatch."""
+    monkeypatch.setenv("DL4J_TPU_NO_INT8_KV", "1")
+    prompts = _prompts(2, 5, seed=2)
+    expected = generate(net, prompts, 5, temperature=0.0)
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                       quantize={"kv": "int8"})
+    try:
+        for i in range(2):
+            np.testing.assert_array_equal(
+                eng.submit(prompts[i], 5).result(timeout=120.0),
+                expected[i])
+        st = eng.stats()
+        assert st["kv_quant_bits"] == 32
+        assert st["kv_bytes_per_token"] == qz.kv_bytes_per_token(
+            GPTPlan(net).kv_geometry(), None, 4)
+        assert len(eng._caches[0]) == 2  # plain pools, no scale sidecar
+    finally:
+        eng.shutdown()
+
+
+def test_engine_rejects_unknown_quantize_keys(net):
+    with pytest.raises(ValueError):
+        DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                     quantize={"kv": "int4"})
+    with pytest.raises(ValueError):
+        DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                     quantize={"weights": "int8"})  # engine does KV only
+
+
+# ------------------------------- preserved pools on same-net rollback
+
+
+def test_same_net_swap_preserves_pools_and_prefix_pages(net):
+    """ROADMAP item 5: rolling back to the weights the pools were
+    built under (`restore_model` hands back the SAME net object) must
+    NOT rebuild the paged pools or clear the prefix cache — the pages
+    were built under these exact weights. A swap to DIFFERENT weights
+    still rebuilds and clears."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, VOCAB, (9,)).astype(np.int32)
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(16,),
+                       page_size=4, prefix_cache=True)
+    try:
+        eng.submit(prompt, 5).result(timeout=120.0)
+        pc_before = eng.stats()["prefix_cache"]["cached_pages"]
+        assert pc_before > 0, "test is vacuous: nothing cached"
+        swaps_before = eng.stats()["swaps"]
+        eng.drain_and_swap(net)  # SAME net object: a rollback
+        assert eng.stats()["swaps"] == swaps_before + 1
+        assert eng.stats()["prefix_cache"]["cached_pages"] == pc_before
+        evs = [e for e in eng.recorder.dump()["events"]
+               if e.get("kind") == "swap"]
+        assert evs[-1]["decision"] == "preserved-pools"
+        # different weights: pages are stale, rebuild-and-clear stands
+        eng.drain_and_swap(_gpt_net(seed=777))
+        assert eng.stats()["prefix_cache"]["cached_pages"] == 0
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------- drift gates + the ladder
+
+
+def _clipped_quantizer(w):
+    """A deliberately broken int8 quantizer: clips the grid to ±4
+    levels, crushing every weight's dynamic range — the fault the
+    drift gate exists to catch before it takes traffic."""
+    import jax.numpy as jnp
+
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -4.0, 4.0)
+    return (q * scale).astype(jnp.bfloat16)
+
+
+def test_drift_gate_accepts_sane_quantizer_and_surfaces_stats(net):
+    """Construction-time gate: int8 weights + int8 KV under a sane
+    quantizer pass the gate; stats() carries the schema-contract keys
+    plus the last drift report."""
+    eval_ids = _prompts(2, 12, seed=9)
+    srv = ModelServer(net, quantize={"weights": "int8", "kv": "int8"},
+                      drift_gate={"eval_set": eval_ids,
+                                  "max_argmax_drift": 0.5,
+                                  "max_ppl_delta": 1.0},
+                      generation={"n_slots": 2, "max_len": 32,
+                                  "prompt_buckets": (8,)})
+    try:
+        out = srv.generate(eval_ids[0][:5], 5)
+        assert out.shape[-1] == 5  # the generated tokens
+        s = srv.stats()
+        assert s["weight_bits"] == 8
+        assert s["drift_gate_checks"] == 1
+        assert s["drift_gate_failures"] == 0
+        assert set(s["drift"]) == {"argmax_drift", "ppl_ref",
+                                   "ppl_cand", "ppl_delta"}
+        assert s["drift"]["argmax_drift"] <= 0.5
+        assert s["generation"]["kv_quant_bits"] == 8
+    finally:
+        srv.shutdown()
+
+
+def test_drift_gate_rejects_clipped_quantizer_at_construction(
+        net, monkeypatch):
+    """The gate's reject arm: a clipped quantizer breaches the argmax
+    gate before the server ever takes traffic."""
+    monkeypatch.setattr(qz, "quantize_weight_int8", _clipped_quantizer)
+    eval_ids = _prompts(2, 12, seed=9)
+    with pytest.raises(ModelValidationError, match="drift gate"):
+        ModelServer(net, quantize={"weights": "int8"},
+                    drift_gate={"eval_set": eval_ids,
+                                "max_argmax_drift": 0.05,
+                                "max_ppl_delta": 0.5})
+
+
+def test_server_rejects_bad_quantize_config(net):
+    with pytest.raises(ValueError):
+        ModelServer(net, quantize={"weights": "int4"})
+    with pytest.raises(ValueError):
+        ModelServer(net, quantize={"kv": "fp8"})
+    with pytest.raises(ValueError):
+        ModelServer(net, quantize={"weights": "int8"},
+                    drift_gate={"max_argmax_drift": 0.1})  # no eval_set
+
+
+@pytest.mark.chaos
+def test_clipped_quantizer_rolling_reload_rolls_back_zero_failures(
+        net, monkeypatch, tmp_path):
+    """ISSUE 13 acceptance drill: deploy a checkpoint through
+    `rolling_reload` while the int8 weight quantizer is DELIBERATELY
+    clipped — the drift gate rejects the quantized candidate at
+    replica 0, the pool rolls back pool-wide, live traffic sees ZERO
+    failed requests, and the same deploy succeeds once the quantizer
+    is fixed (the canary ladder charges nothing for the bad try)."""
+    eval_ids = _prompts(2, 12, seed=9)
+    candidate = _gpt_net(seed=777)
+    store = CheckpointStore(tmp_path)
+    store.save(1, lambda tmp: write_model(candidate, tmp, atomic=False))
+    gate = {"eval_set": eval_ids, "max_argmax_drift": 0.05,
+            "max_ppl_delta": 0.5}
+    servers = [ModelServer(net if i == 0 else net.clone(),
+                           canary=eval_ids,
+                           quantize={"weights": "int8"},
+                           drift_gate=gate)
+               for i in range(2)]
+    pool = ReplicaPool(servers, probe_batch=eval_ids,
+                       probe_interval=0.2, probe_timeout=5.0,
+                       watchdog_timeout=10.0)
+    try:
+        before = pool.predict(eval_ids, timeout=30.0)
+        stop = threading.Event()
+        failures, lock = [], threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    pool.predict(eval_ids, timeout=30.0)
+                except Exception as e:  # noqa: BLE001 — zero-failure bar
+                    with lock:
+                        failures.append(e)
+                time.sleep(float(rng.exponential(0.01)))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        try:
+            monkeypatch.setattr(qz, "quantize_weight_int8",
+                                _clipped_quantizer)
+            with pytest.raises(ModelValidationError, match="drift gate"):
+                pool.rolling_reload(store, step=1, drain_timeout=10.0)
+        finally:
+            monkeypatch.undo()
+        s = pool.stats()
+        assert s["rollbacks"] == 1 and s["rolling_reloads"] == 0
+        # old (quantized) weights still answering, pool never split
+        np.testing.assert_allclose(pool.predict(eval_ids, timeout=30.0),
+                                   before, atol=1e-5)
+        # the SAME checkpoint deploys clean with the quantizer fixed
+        versions = pool.rolling_reload(store, step=1, drain_timeout=10.0)
+        assert len(versions) == 2
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not failures, \
+            f"reload drill failed {len(failures)} live requests: " \
+            f"{failures[:3]}"
+        s = pool.stats()
+        assert s["rolling_reloads"] == 1 and s["rollbacks"] == 1
+        expected = quantize_net_weights(candidate, "int8").output(eval_ids)
+        np.testing.assert_allclose(pool.predict(eval_ids, timeout=30.0),
+                                   np.asarray(expected, np.float32),
+                                   atol=1e-2)
+    finally:
+        pool.shutdown(drain_timeout=5.0)
+
+
+# ------------------------------------------- p99-excursion auto-dump
+
+
+def test_excursion_hook_pins_tail_trace_in_failures_ring(net):
+    """ROADMAP item 6: an observation past the live latency quantile
+    bound pins the request's trace in the flight recorder's FAILURES
+    ring (success traffic cannot push the postmortem out) and rings a
+    matching control-plane event."""
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                       excursion={"quantile": 0.5, "min_count": 2})
+    try:
+        h = eng._gen_latency_hist
+        tr = maybe_trace()
+        h.observe(1.0)
+        h.observe(5000.0, trace=tr)  # count=1 < min_count: must NOT fire
+        assert not [f for f in eng.recorder.dump()["failures"]
+                    if f.get("kind") == "excursion"]
+        h.observe(1.0)
+        assert h.quantile_bound(0.5) == 2.0
+        h.observe(5000.0, trace=tr)  # past the bound, armed: fires
+        dump = eng.recorder.dump()
+        pins = [f for f in dump["failures"]
+                if f.get("kind") == "excursion"]
+        assert len(pins) == 1
+        assert pins[0]["attrs"]["latency_ms"] == 5000.0
+        assert pins[0]["attrs"]["bound_ms"] == 2.0
+        assert [e for e in dump["events"]
+                if e.get("kind") == "excursion"]
+    finally:
+        eng.shutdown()
+
+
+def test_excursion_false_disarms_hook(net):
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                       excursion=False)
+    try:
+        assert eng._gen_latency_hist._exc_hook is None
+    finally:
+        eng.shutdown()
+
+
+def test_excursion_default_armed_at_p99(net):
+    """Engines arm the excursion hook by default at the ISSUE 13
+    numbers (p99, 50-observation warmup) — the auto-dump needs no
+    opt-in to exist in production."""
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,))
+    try:
+        h = eng._gen_latency_hist
+        assert h._exc_hook is not None
+        assert h._exc_quantile == 0.99 and h._exc_min_count == 50
+    finally:
+        eng.shutdown()

@@ -611,3 +611,21 @@ def test_bench_serve_generate_smoke(monkeypatch):
         "self-draft speculation must accept proposals"
     assert fn.spec_tokens_per_step > 1, \
         "speculative decode must emit more than one token per step"
+    # quantized KV tier (ISSUE 13 acceptance): the int8-vs-bf16 A/B is
+    # committed (both sides re-measured under the same differencing
+    # rule; on CPU the ratio is a sanity number, on TPU the real win)
+    # and the halved KV budget admits ~2x the slots on the identical
+    # pool-byte budget with zero OutOfPagesError sheds
+    assert fn.int8_kv_device_ms_per_token > 0
+    assert fn.bf16_kv_device_ms_per_token > 0
+    assert fn.int8_kv_vs_bf16_device_ms_per_token == pytest.approx(
+        fn.bf16_kv_device_ms_per_token / fn.int8_kv_device_ms_per_token,
+        abs=1e-3)
+    assert fn.int8_kv_out_of_pages_sheds == 0
+    assert fn.int8_kv_slots_per_chip >= 1.8, \
+        "halved KV bytes must admit ~2x slots on the same pool bytes"
+    assert fn.int8_kv_goodput_tokens_per_sec > 0
+    assert fn.kv_bytes_per_token["int8"] < \
+        0.75 * fn.kv_bytes_per_token["bf16"], \
+        "int8 payload + f32 scale sidecar must genuinely halve-ish the " \
+        "bf16 KV bytes (exactly 1/2 payload + 4/hd scale overhead)"
