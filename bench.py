@@ -898,7 +898,15 @@ def bench_serve_pool():
     (`ReplicaCrashInjector`), reporting `availability_pct` (fraction of
     offered requests answered) and the failover count — the number that
     should read 100.0 / >0 when failover works and <100 when it
-    doesn't."""
+    doesn't.
+
+    Cross-process lines (`serving/remote_replica`): the same 3-replica
+    load against supervised replica SUBPROCESSES over the gateway wire
+    protocol — `remote_rows_per_sec` / `remote_latency_ms` plus
+    `wire_overhead_pct` (the serialization + TCP tax vs in-process),
+    and a kill -9 drill (`remote_availability_pct`, `remote_respawns`):
+    one replica process SIGKILLed mid-bench, failover + supervisor
+    respawn keeping availability at 100."""
     from deeplearning4j_tpu.nn.conf import (
         DenseLayer,
         InputType,
@@ -1032,6 +1040,87 @@ def bench_serve_pool():
         bench_serve_pool.failovers = chaos_pool.stats()["failovers"]
     finally:
         chaos_pool.shutdown(drain_timeout=10.0)
+
+    # cross-process line: the SAME 3-replica topology, but each replica
+    # is a separate supervised PROCESS reached over the gateway wire
+    # protocol — `wire_overhead_pct` is the serialization + TCP tax on
+    # the identical offered load (3 remote vs 3 in-process)
+    from deeplearning4j_tpu.serving import spawn_replica_pool
+    import tempfile
+
+    remote = spawn_replica_pool(
+        net, 3,
+        scratch_dir=tempfile.mkdtemp(prefix="bench-remote-pool-"),
+        server_kwargs=server_kw,
+        pool_kwargs=dict(probe_batch=x, probe_interval=1.0,
+                         watchdog_timeout=10.0),
+        supervisor_kwargs=dict(poll_interval=0.1))
+    remote_lats = []
+    try:
+        for _ in range(6):  # compile each process + warm pooled conns
+            remote.predict(x, timeout=60.0)
+        remote_dts = [drive(remote.predict, remote_lats)
+                      for _ in range(_REPEATS)]
+        remote_dt, _ = _median_spread(remote_dts)
+        rlat = np.asarray(remote_lats)
+        bench_serve_pool.remote_rows_per_sec = round(
+            total_rows / remote_dt, 1)
+        bench_serve_pool.remote_latency_ms = {
+            "p50": round(1e3 * float(np.percentile(rlat, 50)), 2),
+            "p99": round(1e3 * float(np.percentile(rlat, 99)), 2)}
+        bench_serve_pool.wire_overhead_pct = round(
+            100.0 * (remote_dt / dt - 1.0), 1)
+        assert remote.stats()["failovers"] == 0, \
+            "healthy remote pool bench must not fail over"
+    finally:
+        remote.shutdown(drain_timeout=10.0)
+
+    # remote chaos line: one replica PROCESS killed -9 mid-bench —
+    # failover absorbs the in-flight loss and the supervisor respawns
+    # the process; availability should read 100.0 with respawns > 0
+    remote_chaos = spawn_replica_pool(
+        net, 3,
+        scratch_dir=tempfile.mkdtemp(prefix="bench-remote-chaos-"),
+        server_kwargs=server_kw,
+        pool_kwargs=dict(probe_batch=x, probe_interval=0.25,
+                         watchdog_timeout=5.0, evict_threshold=2,
+                         readmit_successes=2, max_failovers=3),
+        supervisor_kwargs=dict(restart_backoff=0.25, poll_interval=0.1))
+    ok_remote = [0]
+    killed = threading.Event()
+
+    def remote_chaos_client():
+        for i in range(reqs_per_thread):
+            try:
+                remote_chaos.predict(x, timeout=60.0)
+                with lock:
+                    ok_remote[0] += 1
+            except Exception:  # noqa: BLE001 — availability accounting
+                pass
+            if i == 2 and not killed.is_set():
+                killed.set()
+                remote_chaos.supervisor.kill(1)  # SIGKILL mid-flight
+
+    try:
+        for _ in range(3):
+            remote_chaos.predict(x, timeout=60.0)
+        threads = [threading.Thread(target=remote_chaos_client)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        bench_serve_pool.remote_availability_pct = round(
+            100.0 * ok_remote[0] / offered, 2)
+        # the respawn lands after the supervisor's restart backoff —
+        # give it a moment so the line reports the recovery, not a race
+        respawn_deadline = time.perf_counter() + 15.0
+        while (remote_chaos.supervisor.respawns < 1
+               and time.perf_counter() < respawn_deadline):
+            time.sleep(0.1)
+        bench_serve_pool.remote_respawns = remote_chaos.supervisor.respawns
+    finally:
+        remote_chaos.shutdown(drain_timeout=10.0)
     return ("serve_pool_predict_rows_per_sec", rows_per_sec, None, spread)
 
 
@@ -1717,6 +1806,11 @@ def main() -> None:
                 ("pool_vs_single", "pool_vs_single"),
                 ("availability_pct", "availability_pct"),
                 ("failovers", "failovers"),
+                ("remote_rows_per_sec", "remote_rows_per_sec"),
+                ("remote_latency_ms", "remote_latency_ms"),
+                ("wire_overhead_pct", "wire_overhead_pct"),
+                ("remote_availability_pct", "remote_availability_pct"),
+                ("remote_respawns", "remote_respawns"),
                 ("slot_occupancy_pct", "slot_occupancy_pct"),
                 ("pages_in_use_peak", "pages_in_use_peak"),
                 ("pool_pages", "pool_pages"),

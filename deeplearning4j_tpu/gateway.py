@@ -102,6 +102,17 @@ class RequestTooLargeError(RuntimeError):
     """The request line exceeded the server's `max_request_bytes`."""
 
 
+class GatewayProtocolError(RuntimeError):
+    """The peer answered with bytes that do not parse as one gateway
+    response line: garbage, a line truncated by a mid-response
+    disconnect, an oversize line past `max_response_bytes`, or a
+    response id that does not match the request's. The stream cannot be
+    resynced mid-line, so the connection is discarded; idempotent calls
+    may retry over a fresh one (`serving.remote_replica` maps this to
+    the typed `InferenceFailedError` so a garbage-spewing replica feeds
+    the pool's passive eviction, not a crash)."""
+
+
 def encode_array(a: np.ndarray) -> Dict[str, str]:
     buf = io.BytesIO()
     np.save(buf, np.asarray(a), allow_pickle=False)
@@ -200,7 +211,11 @@ class EntryPoint:
         """One ModelServer — or, with `"replicas": N` in the serving
         config, a ReplicaPool cloning the net across N servers
         (`"pool"` sub-dict carries ReplicaPool kwargs; everything else
-        is ModelServer kwargs)."""
+        is ModelServer kwargs). With `"remote": true` (or a dict of
+        `spawn_replica_pool` kwargs) the N replicas are SEPARATE
+        PROCESSES spawned and supervised on this host, reached over the
+        gateway wire protocol — a replica crash costs a failover plus a
+        supervised respawn, not the service."""
         cfg = dict(self._serving)
         raw_replicas = cfg.pop("replicas", 1)
         n_replicas = 1 if raw_replicas is None else int(raw_replicas)
@@ -209,6 +224,7 @@ class EntryPoint:
                 "serving config 'replicas' must be >= 1, got "
                 f"{raw_replicas!r}")
         pool_cfg = cfg.pop("pool", {}) or {}
+        remote_cfg = cfg.pop("remote", None)
         if pool_cfg and n_replicas == 1:
             # fail at construction, not silently un-replicated: pool
             # kwargs without replicas almost certainly means a typo'd
@@ -216,6 +232,20 @@ class EntryPoint:
             raise ValueError(
                 "serving config has 'pool' kwargs but 'replicas' is "
                 f"{raw_replicas!r} — a ReplicaPool needs 'replicas' > 1")
+        if remote_cfg:
+            from deeplearning4j_tpu.serving.remote_replica import (
+                spawn_replica_pool,
+            )
+
+            remote_kw = {} if remote_cfg is True else dict(remote_cfg)
+            # the serving config's own sections and any explicit
+            # spawn_replica_pool kwargs inside "remote" must merge, not
+            # collide (either shape is documented; remote's win)
+            remote_kw["server_kwargs"] = {
+                **cfg, **(remote_kw.get("server_kwargs") or {})}
+            remote_kw["pool_kwargs"] = {
+                **pool_cfg, **(remote_kw.get("pool_kwargs") or {})}
+            return spawn_replica_pool(net, n_replicas, **remote_kw)
         if n_replicas > 1:
             from deeplearning4j_tpu.serving import ReplicaPool
 
@@ -469,6 +499,10 @@ class GatewayServer:
                             "gateway: closing connection idle past "
                             "recv_timeout=%.1fs", recv_timeout)
                         return
+                    # graftlint: disable=typed-error  mid-request
+                    # disconnect: the peer is gone, so there is nobody
+                    # to answer typed — ending the handler IS the
+                    # handling
                     except (ConnectionResetError, BrokenPipeError, OSError):
                         return  # mid-request disconnect
                     if not raw:
@@ -487,19 +521,34 @@ class GatewayServer:
                     trace = None  # minted per data-path request below
                     try:
                         req = json.loads(raw)
+                        ctx = None
                         if isinstance(req, dict):
                             req_id = req.get("id")
+                            # caller-propagated trace context: a remote
+                            # pool's request arrives carrying the
+                            # trace_id minted at ITS outermost hop
+                            raw_ctx = req.get("trace")
+                            if isinstance(raw_ctx, dict):
+                                ctx = raw_ctx
                         if req["method"].startswith("_") or req["method"] \
                                 in getattr(entry, "_RPC_EXCLUDED", ()):
                             raise AttributeError(req["method"])
                         method = getattr(entry, req["method"])
                         params = decode_value(req.get("params", {}))
-                        if req["method"] in _TRACED_METHODS \
+                        if (req["method"] in _TRACED_METHODS
+                                or ctx is not None) \
                                 and observability.tracing_enabled():
                             # the gateway is the outermost hop: mint the
                             # trace here and bind it thread-locally so
-                            # pool/server/engine spans join this id
-                            trace = observability.Trace()
+                            # pool/server/engine spans join this id —
+                            # unless the request CARRIES a context, in
+                            # which case this process is an inner hop
+                            # and must join the caller's trace_id (the
+                            # response's timeline then grafts into the
+                            # caller's via the wall-clock anchors)
+                            trace = observability.Trace(
+                                trace_id=ctx.get("trace_id")
+                                if ctx else None)
                             with observability.use_trace(trace), \
                                     trace.span("gateway",
                                                method=req["method"]):
@@ -569,75 +618,219 @@ class GatewayServer:
             shutdown(drain_timeout=drain_timeout)
 
 
+class _PooledConn:
+    """One keep-alive TCP connection in a `GatewayClient`'s pool."""
+
+    __slots__ = ("sock", "file", "last_used")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.file = sock.makefile("rwb")
+        self.last_used = time.monotonic()
+
+    def close(self) -> None:
+        # best-effort: closing a connection the peer already dropped
+        # must not raise out of cleanup (the buffered writer flushes on
+        # close)
+        with contextlib.suppress(OSError):
+            self.file.close()
+        with contextlib.suppress(OSError):
+            self.sock.close()
+
+
 class GatewayClient:
     """Line-JSON client for GatewayServer (usable as a reference for
-    non-Python clients).
+    non-Python clients). Thread-safe: concurrent `call`s each borrow a
+    connection from a keep-alive pool (up to `pool_size` idle
+    connections are kept; excess ones close on release) instead of
+    serializing on one socket or paying a TCP connect per call.
 
-    Connection-level failures (`ConnectionResetError`/`BrokenPipeError`,
-    or the server closing mid-call) on IDEMPOTENT methods are retried
-    once after `retry_backoff` seconds over a fresh connection — a
-    server restart or LB connection recycle costs one backoff, not a
-    failed call. Non-idempotent methods (`fit`, `create_model`, ...)
-    never auto-retry: the server may have applied the side effect before
-    the connection died. Server-side errors raise the typed
-    `GatewayError`."""
+    Fault discipline on every wire edge:
+
+    - **stale-connection detection** — an idle connection older than
+      `max_idle` seconds is proactively replaced before it is used (the
+      server's `recv_timeout` or an LB may have torn it down; a
+      NON-idempotent call cannot discover that mid-send and retry).
+    - **bounded retries, idempotent only** — connection-level failures
+      (`ConnectionResetError`/`BrokenPipeError`, the server closing
+      mid-call) and protocol-level desyncs (`GatewayProtocolError`:
+      garbage, truncated or oversize response lines) on IDEMPOTENT
+      methods are retried up to `max_retries` times with exponential
+      backoff (`retry_backoff * 2**attempt`) over a fresh connection.
+      Non-idempotent methods (`fit`, `create_model`, ...) never
+      auto-retry: the server may have applied the side effect before
+      the connection died.
+    - **deadline pass-through** — a per-call `_timeout` overrides the
+      connect-time socket timeout, so a caller holding a request
+      deadline (e.g. a remote replica adapter) can bound the read
+      instead of pinning a thread on a wedged peer. A fired socket
+      timeout is NOT retried — the time is gone.
+    - **response bounds** — a response line longer than
+      `max_response_bytes` or one that stops mid-line raises
+      `GatewayProtocolError` and discards the (unresyncable)
+      connection.
+
+    Server-side errors raise the typed `GatewayError`."""
 
     # safe to re-send after an ambiguous connection failure: read-only or
     # naturally deduplicated on the server side (generate is seeded, so a
     # re-send recomputes the identical tokens)
     _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
                              "server_stats", "pool_stats", "generate",
-                             "metrics", "flight_record"})
+                             "metrics", "flight_record", "health",
+                             "snapshot_model", "replica_metrics"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
-                 timeout: float = 60.0, retry_backoff: float = 0.05):
+                 timeout: float = 60.0, retry_backoff: float = 0.05,
+                 max_retries: int = 1, pool_size: int = 2,
+                 max_idle: float = 30.0,
+                 max_response_bytes: int = 64 << 20,
+                 eager_connect: bool = True):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
         self._host, self._port, self._timeout = host, port, timeout
         self.retry_backoff = retry_backoff
-        self._next_id = 0
+        self.max_retries = max_retries
+        self.pool_size = pool_size
+        self.max_idle = max_idle
+        self.max_response_bytes = max_response_bytes
+        self._lock = threading.Lock()
+        self._idle: list = []  # guarded by: _lock
+        self._closed = False  # guarded by: _lock
+        self._next_id = 0  # guarded by: _lock
         # the most recent response's trace (None when tracing is off or
         # the method is not a traced data-path RPC) — lets callers
         # correlate a result with the server-side span timeline without
-        # widening every return type
+        # widening every return type. Benign write race between
+        # concurrent calls: each caller reads SOME recent response's
+        # trace, which is all the attribute promises
         self.last_trace_id: Optional[str] = None
         self.last_trace: Optional[dict] = None
-        self._connect()
+        if eager_connect:
+            # prove the endpoint at construction (historical behavior:
+            # a bad host/port fails here, not on the first call)
+            self._release(self._open())
 
-    def _connect(self) -> None:
-        self._sock = socket.create_connection((self._host, self._port),
-                                              timeout=self._timeout)
-        self._file = self._sock.makefile("rwb")
+    @property
+    def _sock(self) -> socket.socket:
+        """The most recently pooled idle connection's socket — the
+        historical single-connection attribute, kept as a diagnostic /
+        test seam (half-closing it exercises the retry path)."""
+        with self._lock:
+            if not self._idle:
+                raise ConnectionError("gateway client has no idle "
+                                      "pooled connection")
+            return self._idle[-1].sock
 
+    # -- connection pool ---------------------------------------------------
+    def _open(self) -> _PooledConn:
+        return _PooledConn(socket.create_connection(
+            (self._host, self._port), timeout=self._timeout))
+
+    def _borrow(self) -> _PooledConn:
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise ConnectionError("gateway client is closed")
+                conn = self._idle.pop() if self._idle else None
+            if conn is None:
+                return self._open()
+            if time.monotonic() - conn.last_used > self.max_idle:
+                # stale keep-alive: the server's recv_timeout (or an
+                # LB) may have torn it down — replace it here rather
+                # than discover mid-send on a call that cannot retry
+                conn.close()
+                continue
+            return conn
+
+    def _release(self, conn: _PooledConn) -> None:
+        conn.last_used = time.monotonic()
+        with self._lock:
+            if not self._closed and len(self._idle) < self.pool_size:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    # -- calls -------------------------------------------------------------
     def call(self, method: str, _idempotent: Optional[bool] = None,
-             **params):
+             _timeout: Optional[float] = None,
+             _trace: Optional[dict] = None, **params):
         """Invoke `method` on the server's entry point. `_idempotent`
         overrides the built-in retry whitelist for custom entry-point
-        methods."""
+        methods; `_timeout` bounds this call's socket reads (seconds —
+        derive it from the request deadline plus a margin); `_trace` is
+        an optional wire trace context
+        (`observability.wire_trace_context`) the server joins instead
+        of minting its own trace."""
         idempotent = (method in self._IDEMPOTENT if _idempotent is None
                       else _idempotent)
-        try:
-            return self._call_once(method, params)
-        except ConnectionError as e:  # incl. reset/broken-pipe subclasses
-            if not idempotent:
-                raise
-            logger.warning("gateway client: %s during idempotent %r; "
-                           "reconnecting after %.3fs backoff",
-                           type(e).__name__, method, self.retry_backoff)
-            time.sleep(self.retry_backoff)
-            with contextlib.suppress(Exception):
-                self.close()
-            self._connect()
-            return self._call_once(method, params)
+        attempts = 1 + (self.max_retries if idempotent else 0)
+        for attempt in range(attempts):
+            try:
+                return self._call_once(method, params, timeout=_timeout,
+                                       trace_ctx=_trace)
+            except (ConnectionError, GatewayProtocolError) as e:
+                if attempt + 1 >= attempts:
+                    raise
+                backoff = self.retry_backoff * (2 ** attempt)
+                logger.warning(
+                    "gateway client: %s during idempotent %r; retry "
+                    "%d/%d over a fresh connection after %.3fs backoff",
+                    type(e).__name__, method, attempt + 1,
+                    self.max_retries, backoff)
+                time.sleep(backoff)
 
-    def _call_once(self, method: str, params: dict):
-        self._next_id += 1
-        req = {"id": self._next_id, "method": method,
-               "params": encode_value(params)}
-        self._file.write((json.dumps(req) + "\n").encode())
-        self._file.flush()
-        line = self._file.readline()
-        if not line:
-            raise ConnectionError("gateway closed the connection")
-        resp = json.loads(line)
+    def _call_once(self, method: str, params: dict,
+                   timeout: Optional[float] = None,
+                   trace_ctx: Optional[dict] = None):
+        conn = self._borrow()
+        try:
+            with self._lock:
+                self._next_id += 1
+                req_id = self._next_id
+            req = {"id": req_id, "method": method,
+                   "params": encode_value(params)}
+            if trace_ctx:
+                req["trace"] = trace_ctx
+            conn.sock.settimeout(self._timeout if timeout is None
+                                 else timeout)
+            conn.file.write((json.dumps(req) + "\n").encode())
+            conn.file.flush()
+            line = conn.file.readline(self.max_response_bytes + 1)
+            if not line:
+                raise ConnectionError("gateway closed the connection")
+            if len(line) > self.max_response_bytes:
+                raise GatewayProtocolError(
+                    f"response line exceeds max_response_bytes="
+                    f"{self.max_response_bytes}")
+            if not line.endswith(b"\n"):
+                raise GatewayProtocolError(
+                    "response truncated mid-line (peer died while "
+                    "writing)")
+            try:
+                resp = json.loads(line)
+            except ValueError as e:
+                raise GatewayProtocolError(
+                    f"unparseable response line: {e}") from e
+            if not isinstance(resp, dict) \
+                    or ("result" not in resp and "error" not in resp):
+                raise GatewayProtocolError(
+                    "malformed response object (no result/error)")
+            # id None is legal on pre-dispatch errors (oversize
+            # request); anything else must echo OUR id or the stream
+            # is carrying someone else's response
+            if resp.get("id") not in (req_id, None):
+                raise GatewayProtocolError(
+                    f"response id {resp.get('id')!r} does not match "
+                    f"request id {req_id} (stream desynced)")
+        except BaseException:
+            # the connection's framing state is unknowable after ANY
+            # failure mid-call — never return it to the pool
+            conn.close()
+            raise
+        self._release(conn)
         self.last_trace_id = resp.get("trace_id")
         self.last_trace = resp.get("trace")
         if "error" in resp:
@@ -650,9 +843,8 @@ class GatewayClient:
         return decode_value(resp["result"])
 
     def close(self):
-        # best-effort: closing a connection the peer already dropped must
-        # not raise out of cleanup (the buffered writer flushes on close)
-        with contextlib.suppress(OSError):
-            self._file.close()
-        with contextlib.suppress(OSError):
-            self._sock.close()
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()

@@ -12,11 +12,17 @@ semantics and tuning knobs.
 """
 from deeplearning4j_tpu.serving.chaos import (
     BrokenModelInjector,
+    ChaosProxy,
+    ConnectionResetInjector,
+    GarbageResponseInjector,
     InjectedServingFault,
+    NetworkLatencyInjector,
+    PartitionInjector,
     ReloadCorruptionInjector,
     ReplicaCrashInjector,
     ReplicaHangInjector,
     SlowInferenceInjector,
+    SlowLorisInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
 from deeplearning4j_tpu.serving.observability import (
@@ -54,19 +60,53 @@ from deeplearning4j_tpu.serving.replica_pool import (
     ReplicaPool,
 )
 
+# the cross-process tier resolves lazily (PEP 562): remote_replica
+# imports gateway, and gateway imports THIS package for observability —
+# an eager import here would close that cycle while gateway is still
+# half-executed. By the time anyone touches these names, gateway is
+# fully loaded.
+_REMOTE_NAMES = frozenset({
+    "RemoteReplica",
+    "RemoteReplicaPool",
+    "ReplicaEntryPoint",
+    "ReplicaSpawnError",
+    "ReplicaSupervisor",
+    "spawn_replica_pool",
+})
+
+
+def __getattr__(name):
+    if name in _REMOTE_NAMES:
+        from deeplearning4j_tpu.serving import remote_replica
+
+        return getattr(remote_replica, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "BrokenModelInjector",
+    "ChaosProxy",
     "CircuitBreaker",
+    "ConnectionResetInjector",
     "DeadlineExceededError",
     "DecodeEngine",
     "FlightRecorder",
+    "GarbageResponseInjector",
     "InferenceFailedError",
     "InjectedServingFault",
     "MetricsRegistry",
     "ModelServer",
     "ModelValidationError",
+    "NetworkLatencyInjector",
     "OutOfPagesError",
+    "PartitionInjector",
     "PrefixCache",
+    "RemoteReplica",
+    "RemoteReplicaPool",
+    "ReplicaEntryPoint",
+    "ReplicaSpawnError",
+    "ReplicaSupervisor",
     "SpeculativeDecoder",
     "ReloadCorruptionInjector",
     "ReplicaCrashInjector",
@@ -78,7 +118,9 @@ __all__ = [
     "ServiceUnavailableError",
     "ServingError",
     "SlowInferenceInjector",
+    "SlowLorisInjector",
     "Trace",
+    "spawn_replica_pool",
     "argmax_drift_rate",
     "attach_trace",
     "current_trace",

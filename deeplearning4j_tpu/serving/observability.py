@@ -66,7 +66,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 __all__ = [
     "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
     "NULL_TRACE", "Span", "Trace", "attach_trace", "current_trace",
-    "maybe_trace", "new_trace_id", "tracing_enabled", "use_trace",
+    "graft_remote_trace", "maybe_trace", "new_trace_id", "tracing_enabled",
+    "use_trace", "wire_trace_context",
 ]
 
 _KILL_ENV = "DL4J_TPU_NO_TRACING"
@@ -141,12 +142,19 @@ class Trace:
     MAX_SPANS = 512
 
     __slots__ = ("trace_id", "decision", "_spans", "_lock", "_dropped",
-                 "created_at")
+                 "created_at", "created_mono")
 
     def __init__(self, trace_id: Optional[str] = None):
         self.trace_id = trace_id or new_trace_id()
         self.decision: Optional[str] = None
+        # the trace's WALL-CLOCK ANCHOR: the same instant read on both
+        # clocks. Span timestamps stay monotonic (immune to NTP steps),
+        # and the (mono, wall) pair lets another process convert them —
+        # remote spans are grafted into a local timeline by going
+        # remote-monotonic → wall → local-monotonic through the two
+        # anchors (`graft_remote_trace`)
         self.created_at = time.time()
+        self.created_mono = time.monotonic()
         self._spans: List[Span] = []
         self._lock = threading.Lock()
         self._dropped = 0
@@ -202,6 +210,8 @@ class Trace:
         with self._lock:
             spans = sorted(self._spans, key=lambda s: s.t0)
             out = {"trace_id": self.trace_id,
+                   "anchor": {"mono": self.created_mono,
+                              "wall": self.created_at},
                    "spans": [s.to_dict() for s in spans]}
             if self.decision is not None:
                 out["decision"] = self.decision
@@ -304,6 +314,71 @@ def attach_trace(err: BaseException, trace) -> None:
     # error delivery itself into a second failure
     except Exception:
         pass
+
+
+# -- cross-process trace propagation ---------------------------------------
+
+def wire_trace_context(trace) -> Optional[dict]:
+    """The trace context a gateway client sends alongside a request so
+    the remote server JOINS the caller's trace instead of minting its
+    own: the trace_id plus the LOCAL wall-clock anchor (informational —
+    the remote side answers with its own anchor, which is what the
+    caller grafts by). None for no/null traces: the request travels
+    context-free and the remote side keeps its historical minting."""
+    if not trace or getattr(trace, "trace_id", None) is None:
+        return None
+    ctx = {"trace_id": trace.trace_id}
+    mono = getattr(trace, "created_mono", None)
+    wall = getattr(trace, "created_at", None)
+    if mono is not None and wall is not None:
+        ctx["anchor"] = {"mono": mono, "wall": wall}
+    return ctx
+
+
+def graft_remote_trace(trace, remote: Optional[dict], **attrs) -> int:
+    """Splice a REMOTE process's serialized trace (`Trace.to_dict()`
+    shipped over the gateway wire) into the local `trace` as spans on
+    the local monotonic clock, so a cross-process request still reads
+    as ONE causally-ordered timeline in the flight recorder.
+
+    Clock conversion rides the wall-clock anchors both traces carry:
+    ``local_t = remote_t + ((r_wall - r_mono) - (l_wall - l_mono))`` —
+    remote-monotonic → shared wall time → local-monotonic. Accurate to
+    the hosts' wall-clock skew (NTP-level; fine for ms-scale serving
+    spans — docs/observability.md states the caveat). Every grafted
+    span carries ``remote=True`` plus caller `attrs` (e.g. the replica
+    endpoint), and the remote decision lands as a zero-width
+    ``remote-decision`` event. Returns the number of spans grafted;
+    anchorless remote payloads graft 0 spans but still record one
+    ``remote-trace`` marker naming the remote trace_id."""
+    if not trace or not isinstance(remote, dict):
+        return 0
+    r_anchor = remote.get("anchor") or {}
+    l_mono = getattr(trace, "created_mono", None)
+    l_wall = getattr(trace, "created_at", None)
+    r_mono, r_wall = r_anchor.get("mono"), r_anchor.get("wall")
+    if None in (l_mono, l_wall, r_mono, r_wall):
+        trace.event("remote-trace", remote_trace_id=remote.get("trace_id"),
+                    anchorless=True, **attrs)
+        return 0
+    offset = (r_wall - r_mono) - (l_wall - l_mono)
+    grafted = 0
+    for sp in remote.get("spans", ()):
+        if not isinstance(sp, dict) or "t0" not in sp:
+            continue
+        sp_attrs = dict(sp.get("attrs") or {})
+        sp_attrs.update(attrs)
+        sp_attrs["remote"] = True
+        trace.add_timed(sp.get("name", "remote"),
+                        sp["t0"] + offset,
+                        sp.get("t1", sp["t0"]) + offset,
+                        sp.get("decision"), **sp_attrs)
+        grafted += 1
+    decision = remote.get("decision")
+    if decision is not None:
+        trace.event("remote-decision", decision=decision,
+                    remote_trace_id=remote.get("trace_id"), **attrs)
+    return grafted
 
 
 # -- metrics registry ------------------------------------------------------

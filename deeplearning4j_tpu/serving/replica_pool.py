@@ -399,6 +399,41 @@ class ReplicaPool:
         logger.warning("replica pool: evicted replica %d (%s)",
                        rep.id, reason)
 
+    def _restore_or_evict(self, rep: _Replica, old_net) -> bool:
+        """Restore `rep` to `old_net`, treating a failed restore as a
+        dead replica rather than a failed deploy: evict it (whatever
+        state it is in — rollback reaches replicas mid-"draining") and
+        mark it stale, because its weights are now UNKNOWN (the swap
+        may have landed while the restore did not) and re-admitting it
+        unreloaded could split the pool between versions. Remote
+        replicas make this path real — a peer process can die between
+        its reload and the pool-wide unwind. Returns True when the
+        restore landed."""
+        try:
+            rep.server.restore_model(old_net)
+            return True
+        # graftlint: disable=typed-error  rollback edge: the restore's
+        # own failure has no caller to type for — the recovery IS
+        # evict+stale, and the deploy error already propagating must
+        # not be displaced by this secondary one
+        except BaseException as e:
+            with self._lock:
+                if rep.state != "evicted":
+                    rep.state = "evicted"
+                    rep.probe_successes = 0
+                    rep.evictions += 1
+                    self.evictions += 1
+                    self.recorder.event(
+                        "evict", replica=rep.id,
+                        reason=f"rollback restore failed: "
+                               f"{type(e).__name__}")
+                rep.stale = True
+            logger.warning(
+                "replica pool: rollback restore on replica %d failed "
+                "(%s) — evicted + stale until a later reload lands",
+                rep.id, type(e).__name__)
+            return False
+
     # -- admission ---------------------------------------------------------
     def _admit(self):
         with self._lock:
@@ -902,8 +937,12 @@ class ReplicaPool:
                         evicted = rep.state == "evicted"
                         was_stale = rep.stale
                     if evicted:
-                        old_net = rep.server.net
                         try:
+                            # .net inside the try: on a REMOTE replica it
+                            # is a snapshot RPC, and a dead evicted
+                            # replica failing to answer must stay
+                            # best-effort, not abort the deploy
+                            old_net = rep.server.net
                             rep.server.reload(source, step=step)
                         # graftlint: disable=typed-error  best-effort
                         # catch-up reload of an evicted replica: failure
@@ -925,9 +964,14 @@ class ReplicaPool:
                         done.append((rep, old_net, was_stale))
                         continue
                     self._drain_replica(rep, drain_timeout)
-                    old_net = rep.server.net
                     swapped = False
                     try:
+                        # .net inside the try: a remote replica answers
+                        # the pre-deploy snapshot over the wire, and a
+                        # wire failure here must release the drain (the
+                        # finally below) instead of wedging the replica
+                        # in "draining" forever
+                        old_net = rep.server.net
                         versions.append(rep.server.reload(source,
                                                           step=step))
                         swapped = True
@@ -942,7 +986,7 @@ class ReplicaPool:
                                 "post-reload probe on the candidate")
                     except BaseException as e:
                         if swapped:
-                            rep.server.restore_model(old_net)
+                            self._restore_or_evict(rep, old_net)
                         raise _tag(e, rep.id)
                     finally:
                         # back on known weights either way: old on
@@ -958,7 +1002,14 @@ class ReplicaPool:
                     done.append((rep, old_net, False))
             except BaseException:
                 for rep, old_net, was_stale in reversed(done):
-                    rep.server.restore_model(old_net)
+                    # per-replica: one replica dying mid-rollback (a
+                    # remote peer can vanish between its reload and the
+                    # pool-wide unwind) must not strand the OTHER
+                    # already-reloaded replicas on the new weights —
+                    # that would be the exact version split the
+                    # rollback exists to prevent
+                    if not self._restore_or_evict(rep, old_net):
+                        continue
                     with self._lock:
                         # back on its PRE-deploy weights: for a replica
                         # that was already stale coming in, those are

@@ -15,8 +15,24 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The axon sitecustomize registers the TPU backend at interpreter start, so
 # the env var alone is not enough — force the platform via config too.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(autouse=True)
+def _reap_replica_orphans():
+    """Orphan-process hygiene for `multiprocess` drills: any replica
+    subprocess a test (or its crashed supervisor) left behind is
+    SIGKILLed after the test, so one failing chaos drill cannot leak
+    interpreter processes into the rest of the tier-1 run. Free when
+    the remote-replica module was never imported."""
+    yield
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.serving.remote_replica")
+    if mod is not None:
+        mod.reap_orphans()

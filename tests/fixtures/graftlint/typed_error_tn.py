@@ -1,6 +1,11 @@
 """True negatives for the typed-error rule: typed raises, narrow
-catches, firewall handlers that convert and re-raise, and
-programmer-contract ValueErrors."""
+catches, firewall handlers that convert and re-raise,
+programmer-contract ValueErrors, and wire/transport catches that
+answer with a typed error, an explicit verdict, or a log line."""
+
+import logging
+
+logger = logging.getLogger("fixture")
 
 
 class ServingError(RuntimeError):
@@ -30,3 +35,26 @@ def firewall(fn):
         return fn()
     except Exception as e:  # broad but converts + re-raises: a firewall
         raise ServingError(f"device step failed: {e}")
+
+
+def wire_call(sock):
+    try:
+        return sock.recv(4096)
+    except ConnectionError as e:  # mapped to a typed error: legal
+        raise ServingError(f"replica unreachable: {e}")
+
+
+def wire_probe(sock):
+    try:
+        sock.sendall(b"ping\n")
+    except (TimeoutError, OSError):  # explicit verdict: legal
+        return False
+    return True
+
+
+def wire_cleanup(conns):
+    for c in conns:
+        try:
+            c.close()
+        except OSError as e:  # logged absorb: legal (cleanup path)
+            logger.warning("close failed: %s", e)

@@ -1,5 +1,5 @@
-"""True positives for the typed-error rule: generic raises and silent
-broad catches in a serving path."""
+"""True positives for the typed-error rule: generic raises, silent
+broad catches, and silent wire/transport absorbs in a serving path."""
 
 
 class ServingError(RuntimeError):
@@ -23,3 +23,25 @@ def probe(fn):
         return fn()
     except BaseException:  # TP: swallows even KeyboardInterrupt
         pass
+
+
+def wire_call(sock):
+    try:
+        return sock.recv(4096)
+    except ConnectionError:  # TP: a dead peer silently vanishes
+        pass
+
+
+def wire_read(conn):
+    try:
+        return conn.readline()
+    except OSError:  # TP: bare return is not a verdict
+        return
+
+
+def pump(conns):
+    for c in conns:
+        try:
+            c.flush()
+        except (TimeoutError, BrokenPipeError):  # TP: silent skip
+            continue

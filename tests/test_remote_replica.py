@@ -1,0 +1,653 @@
+"""Remote replicas over the gateway wire protocol — the in-process
+ladders (ISSUE 14). A `ReplicaEntryPoint` + `GatewayServer` live in the
+test process and a `RemoteReplica` talks to them over real loopback
+sockets, with `ChaosProxy` interposed for the network-fault drills, so
+every wire edge is exercised without subprocess spawn cost:
+
+1. the replica seam over the wire: predict parity, three-valued probes,
+   pending/stats/flight_record, snapshot/restore, sync_net;
+2. satellite 1 — `GatewayClient` keep-alive pooling: connection reuse,
+   transparent reconnect after a dropped pooled connection, stale-idle
+   replacement, pool-size bounding;
+3. the wire→typed error-mapping ladder (`_wire_error` unit cases plus
+   live garbage / partition / slow-loris / mid-response-reset drills);
+4. satellite 2 — failover exhaustion carries `.replica_id` +
+   `retry_after` for REMOTE hops exactly as for in-process replicas;
+5. partition → evict → heal → re-admit through a `RemoteReplicaPool`;
+6. `rolling_reload` across the process boundary, including pool-wide
+   rollback on a poisoned candidate;
+7. satellite 3 — the gateway `metrics` / `flight_record` RPCs against a
+   pool of remote replicas (per-replica labels, pinned failure
+   timelines with remote spans under one trace_id);
+8. the wall-clock anchor graft math (`observability`).
+
+The separate-process chaos drills (kill -9, supervisor respawn,
+crash-mid-deploy) live in tests/test_remote_replica_mp.py.
+"""
+import signal
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gateway import (
+    EntryPoint,
+    GatewayClient,
+    GatewayError,
+    GatewayProtocolError,
+    GatewayServer,
+)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel.multiprocess import free_port
+from deeplearning4j_tpu.serving import (
+    ChaosProxy,
+    ConnectionResetInjector,
+    DeadlineExceededError,
+    GarbageResponseInjector,
+    InferenceFailedError,
+    ModelServer,
+    ModelValidationError,
+    NetworkLatencyInjector,
+    PartitionInjector,
+    ReloadCorruptionInjector,
+    RemoteReplica,
+    RemoteReplicaPool,
+    ReplicaCrashInjector,
+    ReplicaEntryPoint,
+    ReplicaPool,
+    ServerOverloadedError,
+    ServiceUnavailableError,
+    SlowLorisInjector,
+    observability,
+)
+from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+from deeplearning4j_tpu.util.serialization import write_model
+
+WEDGE_GUARD_S = 120  # hard per-test bound, far inside the tier-1 budget
+
+
+@pytest.fixture(autouse=True)
+def _wedge_guard():
+    """Tier-1 safety net: a wire test that wedges (a proxy mode or a
+    drain path stuck) is killed by SIGALRM instead of eating the
+    suite's budget."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"remote-replica test exceeded the {WEDGE_GUARD_S} s wedge "
+            "guard — a wire/drain path is stuck")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WEDGE_GUARD_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _conf(n_out=3, seed=7):
+    return (dl4j.NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.3)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=n_out,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, 3, n)
+    x = (rng.normal(size=(n, 4)) + c[:, None]).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[c]
+
+
+def _fitted_clone(seed=1, epochs=3):
+    net = dl4j.MultiLayerNetwork(_conf(seed=seed))
+    net.init()
+    x, y = _data(48, seed=seed)
+    net.fit(DataSet(x, y), epochs=epochs)
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    n = dl4j.MultiLayerNetwork(_conf())
+    n.init()
+    return n
+
+
+@pytest.fixture()
+def x():
+    return _data()[0]
+
+
+@pytest.fixture()
+def wire(net, tmp_path):
+    """Factory for in-process wire topologies — gateway-served replica
+    endpoints, `RemoteReplica` adapters, chaos proxies, and
+    `RemoteReplicaPool`s — with guaranteed teardown in dependency
+    order (pools → replicas → proxies → servers)."""
+    servers, reps, proxies, pools = [], [], [], []
+
+    def make_server(the_net=None, serving=None):
+        ep = ReplicaEntryPoint(
+            serving={} if serving is None else serving,
+            scratch_dir=tmp_path)
+        ep.serve_net((the_net if the_net is not None else net).clone())
+        srv = GatewayServer(entry_point=ep).start()
+        servers.append(srv)
+        return srv
+
+    def make_replica(port, **kw):
+        kw.setdefault("scratch_dir", tmp_path)
+        kw.setdefault("rpc_timeout", 15.0)
+        r = RemoteReplica("127.0.0.1", port, **kw)
+        reps.append(r)
+        return r
+
+    def make_proxy(port):
+        p = ChaosProxy("127.0.0.1", port)
+        proxies.append(p)
+        return p
+
+    def make_pool(replicas, **kw):
+        kw.setdefault("probe_batch", _data()[0][:2])
+        kw.setdefault("probe_interval", 0.1)
+        kw.setdefault("probe_timeout", 3.0)
+        kw.setdefault("watchdog_timeout", 5.0)
+        kw.setdefault("template_net", net)
+        kw.setdefault("scratch_dir", tmp_path)
+        p = RemoteReplicaPool(replicas, **kw)
+        pools.append(p)
+        return p
+
+    yield SimpleNamespace(server=make_server, replica=make_replica,
+                          proxy=make_proxy, pool=make_pool)
+    for p in pools:
+        p.shutdown(drain_timeout=3.0)
+    for r in reps:
+        r.shutdown()
+    for pr in proxies:
+        pr.close()
+    for s in servers:
+        s.stop(drain_timeout=3.0)
+
+
+# ------------------------------------------------- the replica seam
+def test_remote_predict_matches_local(wire, net, x):
+    srv = wire.server()
+    rep = wire.replica(srv.port)
+    np.testing.assert_allclose(rep.predict(x, timeout=10.0),
+                               net.output(x), atol=1e-6)
+    assert rep.probe(x[:2], timeout=5.0) is True
+    # batchless probe: reachable + breaker closed == inconclusive
+    assert rep.probe() is None
+    assert rep.pending() == 0
+    st = rep.stats()
+    assert st["unreachable"] is False
+    assert st["endpoint"] == rep.endpoint
+    assert st["served"] >= 1 and st["breaker_state"] == "closed"
+    rec = rep.flight_record()
+    assert rec["endpoint"] == rep.endpoint and "requests" in rec
+
+
+def test_remote_stats_survive_a_dead_endpoint(wire, x):
+    # nothing listens on this port: stats/flight_record must degrade to
+    # schema-complete fallbacks, never raise (pool_stats aggregation)
+    rep = wire.replica(free_port(), rpc_timeout=2.0)
+    st = rep.stats()
+    assert st["unreachable"] is True
+    assert st["breaker_state"] == "closed"  # last observed
+    assert st["served"] == 0
+    rec = rep.flight_record()
+    assert rec == {"endpoint": rep.endpoint, "unreachable": True}
+    assert rep.probe() is False and rep.probe(x[:2]) is False
+    # the metrics seam answers a comment line, not an exception
+    text = rep.metrics.exposition(labels={"replica": "0"})
+    assert text.startswith("#") and "unreachable" in text
+
+
+def test_remote_snapshot_restore_roundtrip(wire, x):
+    srv = wire.server()
+    rep = wire.replica(srv.port)
+    before = rep.predict(x, timeout=10.0)
+    snap = rep.net  # remote weights snapshot: the rollback currency
+    assert snap.path and snap.version == 0
+    fitted = _fitted_clone(seed=5)
+    rep.restore_model(fitted)  # live net: serialized + shipped by path
+    after = rep.predict(x, timeout=10.0)
+    assert not np.allclose(before, after, atol=1e-3), \
+        "test is vacuous: fitted clone agrees with the base net"
+    rep.restore_model(snap)  # snapshot: the path ships back
+    np.testing.assert_allclose(rep.predict(x, timeout=10.0), before,
+                               atol=1e-6)
+
+
+def test_remote_sync_net_pushes_weights(wire, x):
+    srv = wire.server()
+    rep = wire.replica(srv.port)
+    pool = wire.pool([rep], probe_interval=1.0)
+    fitted = _fitted_clone(seed=3)
+    pool.sync_net(fitted)
+    np.testing.assert_allclose(pool.predict(x, timeout=10.0),
+                               fitted.output(x), atol=1e-5)
+    assert pool.net is fitted  # the template follows the sync
+
+
+# ------------------------------------------------- traces over the wire
+def test_remote_trace_joins_and_grafts_one_timeline(wire, x):
+    srv = wire.server()
+    rep = wire.replica(srv.port)
+    trace = observability.Trace()
+    with observability.use_trace(trace):
+        rep.predict(x[:4], timeout=10.0)
+    # the remote gateway JOINED the caller's trace_id instead of minting
+    assert rep._client.last_trace_id == trace.trace_id
+    spans = trace.to_dict()["spans"]
+    remote_spans = [s for s in spans
+                    if (s.get("attrs") or {}).get("remote")]
+    assert remote_spans, f"no remote spans grafted: {spans}"
+    assert all(s["attrs"]["endpoint"] == rep.endpoint
+               for s in remote_spans)
+
+
+def test_wire_trace_context_carries_anchor():
+    trace = observability.Trace()
+    ctx = observability.wire_trace_context(trace)
+    assert ctx["trace_id"] == trace.trace_id
+    assert ctx["anchor"] == {"mono": trace.created_mono,
+                             "wall": trace.created_at}
+    assert observability.wire_trace_context(observability.NULL_TRACE) \
+        is None
+    assert observability.wire_trace_context(None) is None
+
+
+def test_graft_remote_trace_anchor_math():
+    trace = observability.Trace()
+    # a remote process with an arbitrary monotonic epoch and 123.456 s
+    # of wall-clock skew: the graft must land spans on the LOCAL
+    # monotonic clock via the anchor pair
+    r_mono, r_wall = 5000.0, trace.created_at + 123.456
+    remote = {"trace_id": trace.trace_id,
+              "anchor": {"mono": r_mono, "wall": r_wall},
+              "decision": "served",
+              "spans": [{"name": "execute", "t0": r_mono + 1.0,
+                         "t1": r_mono + 1.5, "decision": "ok"}]}
+    assert observability.graft_remote_trace(trace, remote,
+                                            endpoint="a:1") == 1
+    span = [s for s in trace.to_dict()["spans"]
+            if s["name"] == "execute"][0]
+    offset = (r_wall - r_mono) - (trace.created_at - trace.created_mono)
+    assert span["t0"] == pytest.approx(r_mono + 1.0 + offset)
+    assert span["t1"] - span["t0"] == pytest.approx(0.5)
+    assert span["attrs"]["remote"] is True
+    assert span["attrs"]["endpoint"] == "a:1"
+    names = [s["name"] for s in trace.to_dict()["spans"]]
+    assert "remote-decision" in names
+    # anchorless payloads graft nothing but leave a marker
+    t2 = observability.Trace()
+    assert observability.graft_remote_trace(
+        t2, {"trace_id": "x", "spans": [{"name": "e", "t0": 1.0}]}) == 0
+    assert [s["name"] for s in t2.to_dict()["spans"]] == ["remote-trace"]
+
+
+# ------------------------------------- satellite 1: keep-alive pooling
+def test_pooled_connection_reused_across_calls(wire):
+    srv = wire.server()
+    client = GatewayClient(port=srv.port)
+    try:
+        client.call("health")
+        first = client._sock
+        assert client.call("health")["ok"] is True
+        assert client._sock is first, \
+            "keep-alive pooling did not reuse the idle connection"
+    finally:
+        client.close()
+
+
+def test_dropped_pooled_connection_transparently_reconnects(wire):
+    srv = wire.server()
+    client = GatewayClient(port=srv.port)
+    try:
+        client.call("health")
+        stale = client._sock
+        # the server side of the pooled connection goes away between
+        # calls (replica restart on the same port, idle reap, ...)
+        stale.shutdown(socket.SHUT_WR)
+        out = client.call("health")  # idempotent: retried over a fresh conn
+        assert out["ok"] is True
+        assert client._sock is not stale
+    finally:
+        client.close()
+
+
+def test_stale_idle_connection_replaced_not_reused(wire):
+    srv = wire.server()
+    client = GatewayClient(port=srv.port, max_idle=0.05)
+    try:
+        client.call("health")
+        old = client._sock
+        time.sleep(0.15)  # idle past max_idle: proactively discarded
+        assert client.call("health")["ok"] is True
+        assert client._sock is not old
+    finally:
+        client.close()
+
+
+def test_connection_pool_bounded_by_pool_size(wire):
+    srv = wire.server()
+    client = GatewayClient(port=srv.port, pool_size=2)
+    try:
+        barrier = threading.Barrier(4)
+        errors = []
+
+        def hit():
+            try:
+                barrier.wait(timeout=10)
+                client.call("health")
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=hit) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(client._idle) <= 2, \
+            "released connections past pool_size must be closed, not kept"
+    finally:
+        client.close()
+
+
+# ------------------------------------------- wire → typed error mapping
+def test_wire_error_mapping_ladder():
+    rep = RemoteReplica("127.0.0.1", free_port())  # mapping only: no I/O
+    try:
+        m = rep._wire_error(
+            GatewayError("queue full", error_type="ServerOverloadedError",
+                         retry_after=0.7),
+            deadline_bound=True, what="predict")
+        assert isinstance(m, ServerOverloadedError)
+        assert m.retry_after == 0.7  # the hint survives the hop
+
+        # the REMOTE server closing means THIS replica went away: fail
+        # over, do not treat the pool as closed
+        m = rep._wire_error(
+            GatewayError("closing", error_type="ServerClosedError"),
+            deadline_bound=False, what="predict")
+        assert isinstance(m, ServiceUnavailableError)
+
+        # unmapped error types pass through unchanged
+        e = GatewayError("no model 'replica'", error_type="KeyError")
+        assert rep._wire_error(e, deadline_bound=False,
+                               what="predict") is e
+
+        m = rep._wire_error(GatewayProtocolError("trash"),
+                            deadline_bound=False, what="predict")
+        assert isinstance(m, InferenceFailedError)
+
+        m = rep._wire_error(TimeoutError(), deadline_bound=True,
+                            what="predict")
+        assert isinstance(m, DeadlineExceededError)
+        m = rep._wire_error(TimeoutError(), deadline_bound=False,
+                            what="predict")
+        assert isinstance(m, ServiceUnavailableError)
+        assert m.retry_after == 0.05
+
+        m = rep._wire_error(ConnectionRefusedError("refused"),
+                            deadline_bound=True, what="predict")
+        assert isinstance(m, ServiceUnavailableError)
+        assert m.retry_after == 0.05
+    finally:
+        rep.shutdown()
+
+
+@pytest.mark.chaos
+def test_partition_maps_to_service_unavailable(wire, net, x):
+    srv = wire.server()
+    proxy = wire.proxy(srv.port)
+    rep = wire.replica(proxy.port)
+    np.testing.assert_allclose(rep.predict(x[:4], timeout=10.0),
+                               net.output(x[:4]), atol=1e-6)
+    part = PartitionInjector(proxy)
+    part.partition()
+    with pytest.raises(ServiceUnavailableError) as ei:
+        rep.predict(x[:4], timeout=5.0)
+    assert ei.value.retry_after == 0.05
+    assert rep.probe(x[:2], timeout=2.0) is False
+    part.heal()
+    # transparent reconnect once the network heals
+    np.testing.assert_allclose(rep.predict(x[:4], timeout=10.0),
+                               net.output(x[:4]), atol=1e-6)
+    assert part.partitions == 1
+
+
+@pytest.mark.chaos
+def test_garbage_response_maps_to_inference_failed(wire, x):
+    srv = wire.server()
+    proxy = wire.proxy(srv.port)
+    rep = wire.replica(proxy.port)
+    garbage = GarbageResponseInjector(proxy)
+    garbage.inject()
+    with pytest.raises(InferenceFailedError, match="undecodable"):
+        rep.predict(x[:4], timeout=5.0)
+    assert rep.probe(x[:2], timeout=2.0) is False
+    garbage.release()
+    assert rep.predict(x[:4], timeout=10.0).shape == (4, 3)
+
+
+@pytest.mark.chaos
+def test_slowloris_with_deadline_maps_to_deadline_exceeded(wire, x):
+    srv = wire.server()
+    proxy = wire.proxy(srv.port)
+    rep = wire.replica(proxy.port, deadline_margin=0.2)
+    # interval deliberately past the derived read deadline (0.3 + 0.2):
+    # the response never completes inside the request's time budget
+    SlowLorisInjector(proxy, interval=1.5).inject()
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError):
+        rep.predict(x[:2], timeout=0.3)
+    # the read deadline derives from the request deadline — the caller
+    # is answered promptly, not after rpc_timeout
+    assert time.monotonic() - t0 < 5.0
+
+
+@pytest.mark.chaos
+def test_mid_response_reset_maps_to_service_unavailable(wire, x):
+    srv = wire.server()
+    proxy = wire.proxy(srv.port)
+    rep = wire.replica(proxy.port)
+    ConnectionResetInjector(proxy).inject()
+    with pytest.raises(ServiceUnavailableError):
+        rep.predict(x[:4], timeout=5.0)
+
+
+@pytest.mark.chaos
+def test_latency_injection_slows_but_serves(wire, net, x):
+    srv = wire.server()
+    proxy = wire.proxy(srv.port)
+    rep = wire.replica(proxy.port)
+    lat = NetworkLatencyInjector(proxy, delay=0.15)
+    lat.inject()
+    t0 = time.monotonic()
+    out = rep.predict(x[:4], timeout=10.0)
+    assert time.monotonic() - t0 >= 0.1
+    np.testing.assert_allclose(out, net.output(x[:4]), atol=1e-6)
+    lat.release()
+
+
+# --------------------------- satellite 2: failover-exhaustion hints
+def test_remote_failover_exhaustion_carries_hints(wire, x):
+    # two endpoints with NOTHING listening: every remote hop refuses
+    reps = [wire.replica(free_port(), rpc_timeout=5.0)
+            for _ in range(2)]
+    pool = wire.pool(reps, probe_batch=None, probe_interval=30.0,
+                     max_failovers=1)
+    with pytest.raises(ServiceUnavailableError) as ei:
+        pool.predict(x[:4], timeout=5.0)
+    # the ORIGINAL typed error propagates after exhaustion, with the
+    # same hint fields an in-process pool attaches
+    assert ei.value.retry_after == 0.05
+    assert getattr(ei.value, "replica_id", None) in (0, 1)
+    assert pool.stats()["failovers"] == 1
+
+
+def test_inprocess_failover_exhaustion_carries_hints(net, x):
+    # the in-process parity case: same terminal-error contract
+    crashed = [ReplicaCrashInjector(crashed=True) for _ in range(2)]
+    servers = [ModelServer(net.clone(), infer_hooks=[c])
+               for c in crashed]
+    pool = ReplicaPool(servers, probe_interval=30.0, max_failovers=1)
+    try:
+        with pytest.raises(InferenceFailedError) as ei:
+            pool.predict(x[:4], timeout=5.0)
+        assert getattr(ei.value, "replica_id", None) in (0, 1)
+        assert pool.stats()["failovers"] == 1
+    finally:
+        pool.shutdown(drain_timeout=3.0)
+
+
+# ------------------------- partition → evict → heal → re-admit
+@pytest.mark.chaos
+def test_remote_pool_partition_evict_heal_readmit(wire, net, x):
+    srv_a, srv_b = wire.server(), wire.server()
+    proxy = wire.proxy(srv_b.port)
+    rep_a = wire.replica(srv_a.port)
+    rep_b = wire.replica(proxy.port, rpc_timeout=2.0)
+    pool = wire.pool([rep_a, rep_b], probe_interval=0.05,
+                     probe_timeout=2.0, watchdog_timeout=3.0,
+                     evict_threshold=2, readmit_successes=2)
+    np.testing.assert_allclose(pool.predict(x, timeout=10.0),
+                               net.output(x), atol=1e-6)
+
+    part = PartitionInjector(proxy)
+    part.partition()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if pool.stats()["replicas"]["1"]["state"] == "evicted":
+            break
+        time.sleep(0.05)
+    assert pool.stats()["replicas"]["1"]["state"] == "evicted", \
+        "partitioned replica was not evicted by failing probes"
+    # service continues on the surviving replica during the partition
+    for _ in range(3):
+        assert pool.predict(x[:4], timeout=10.0).shape == (4, 3)
+    events = pool.flight_record()["pool"]["events"]
+    assert any(e["kind"] == "evict" and e.get("replica") == 1
+               for e in events), \
+        "the flight recorder does not name the partitioned replica"
+
+    part.heal()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        s = pool.stats()
+        if s["replicas"]["1"]["state"] == "healthy":
+            break
+        time.sleep(0.05)
+    s = pool.stats()
+    assert s["replicas"]["1"]["state"] == "healthy", \
+        "healed replica was not re-admitted by consecutive probe passes"
+    assert s["readmissions"] >= 1
+    np.testing.assert_allclose(pool.predict(x, timeout=10.0),
+                               net.output(x), atol=1e-6)
+
+
+# -------------------------------- rolling reload across the boundary
+@pytest.mark.chaos
+def test_remote_rolling_reload_and_poisoned_rollback(wire, net, x,
+                                                     tmp_path):
+    canary = x[:2]
+    srv_a = wire.server(serving=dict(canary=canary))
+    srv_b = wire.server(serving=dict(canary=canary))
+    reps = [wire.replica(s.port) for s in (srv_a, srv_b)]
+    pool = wire.pool(reps, probe_interval=0.2)
+
+    store_dir = tmp_path / "store"
+    store_dir.mkdir()
+    store = CheckpointStore(store_dir)
+    candidate = _fitted_clone()
+    store.save(1, lambda p: write_model(candidate, p, atomic=False))
+
+    versions = pool.rolling_reload(store, step=1, drain_timeout=10.0)
+    assert versions == [1, 1]
+    np.testing.assert_allclose(pool.predict(x, timeout=10.0),
+                               candidate.output(x), atol=1e-5)
+    s = pool.stats()
+    assert s["rolling_reloads"] == 1 and s["rollbacks"] == 0
+
+    # a poisoned candidate is rejected by the REMOTE canary ladder and
+    # the whole pool rolls back over the wire — typed, with replica_id
+    ReloadCorruptionInjector().poison_params(store, 2, net)
+    with pytest.raises(ModelValidationError, match="non-finite") as ei:
+        pool.rolling_reload(store, step=2, drain_timeout=10.0)
+    assert getattr(ei.value, "replica_id", None) == 0
+    s = pool.stats()
+    assert s["rollbacks"] == 1 and s["rolling_reloads"] == 1
+    assert s["healthy_replicas"] == 2
+    np.testing.assert_allclose(pool.predict(x, timeout=10.0),
+                               candidate.output(x), atol=1e-5)
+
+
+# ---------------- satellite 3: gateway RPCs over a remote-backed pool
+def test_gateway_metrics_and_flight_record_over_remote_pool(wire, net,
+                                                            x):
+    srv_a, srv_b = wire.server(), wire.server()
+    reps = [wire.replica(s.port) for s in (srv_a, srv_b)]
+    pool = wire.pool(reps, probe_interval=0.5)
+    front = EntryPoint(serving={})
+    front._models["m"] = net
+    front._servers["m"] = pool
+    gw = GatewayServer(entry_point=front).start()
+    client = GatewayClient(port=gw.port)
+    try:
+        out = client.call("predict", name="m", features=x[:4])
+        np.testing.assert_allclose(out, net.output(x[:4]), atol=1e-6)
+
+        # per-replica labels cross the process boundary into one page
+        text = client.call("metrics", name="m")
+        assert 'model="m"' in text
+        assert 'replica="0"' in text and 'replica="1"' in text
+
+        # a replica-originated failure: bad feature width fails the
+        # device step on BOTH remote replicas → terminal typed error
+        with pytest.raises(GatewayError) as ei:
+            client.call("predict", name="m",
+                        features=np.ones((2, 9), np.float32),
+                        _idempotent=False)
+        assert ei.value.error_type == "InferenceFailedError"
+        assert ei.value.replica_id in (0, 1)
+        assert ei.value.trace_id
+
+        rec = client.call("flight_record", name="m")
+        assert set(rec["replicas"]) == {"0", "1"}
+        # the REMOTE processes pinned their own failure timelines and
+        # they crossed the boundary through the pool's dump
+        assert any(r.get("failures") for r in rec["replicas"].values())
+        pool_failures = rec["pool"]["failures"]
+        assert pool_failures, "pool did not pin the terminal failure"
+        pinned = pool_failures[-1]["trace"]
+        # one trace_id end to end: wire error ↔ pinned pool timeline
+        assert pinned["trace_id"] == ei.value.trace_id
+        assert any((sp.get("attrs") or {}).get("remote")
+                   for sp in pinned["spans"]), \
+            "the pinned timeline carries no remote spans"
+        endpoints = {rep.endpoint for rep in reps}
+        assert any((sp.get("attrs") or {}).get("endpoint") in endpoints
+                   for sp in pinned["spans"])
+    finally:
+        client.close()
+        gw.stop(drain_timeout=3.0)

@@ -1,0 +1,302 @@
+"""Cross-process pool chaos drills (ISSUE 14 acceptance): a pool of
+real replica SUBPROCESSES under live Poisson traffic must survive
+
+1. kill -9 of a replica — failover absorbs the in-flight loss, the
+   supervisor respawns the process, the pool re-admits it, and NO
+   request fails;
+2. a network partition of one replica (ChaosProxy in front of its
+   port) — eviction on failed probes, service from the survivor,
+   re-admission after heal;
+3. kill -9 **mid-rolling_reload** (`chaos_die_on_reload`) — the deploy
+   fails typed with the dying replica named, already-deployed replicas
+   roll back pool-wide, and traffic never sees a failed request;
+
+with the flight recorder naming the failing replica and the request
+timeline crossing the process boundary under one trace_id.
+
+Everything here spawns real interpreters (`ReplicaSupervisor`), so the
+file is marked `multiprocess` + `chaos`: tier-1-safe via tight drill
+timeouts, a SIGALRM wedge guard, and the conftest orphan reaper.
+tests/test_remote_replica.py covers the same seams in-process.
+"""
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.serving import (
+    ChaosProxy,
+    InferenceFailedError,
+    PartitionInjector,
+    RemoteReplica,
+    RemoteReplicaPool,
+    ReplicaSupervisor,
+    ServiceUnavailableError,
+    observability,
+    spawn_replica_pool,
+)
+from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+from deeplearning4j_tpu.util.serialization import write_model
+
+pytestmark = [pytest.mark.multiprocess, pytest.mark.chaos]
+
+WEDGE_GUARD_S = 240  # replica processes pay a jax-import startup cost
+
+
+@pytest.fixture(autouse=True)
+def _wedge_guard():
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"multiprocess drill exceeded the {WEDGE_GUARD_S} s wedge "
+            "guard — a spawn/drill path is stuck")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WEDGE_GUARD_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _conf(seed=7):
+    return (dl4j.NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.3)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3,
+                               activation=Activation.SOFTMAX,
+                               loss=LossFunction.MCXENT))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, 3, n)
+    x = (rng.normal(size=(n, 4)) + c[:, None]).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[c]
+
+
+def _fitted_clone(seed=1, epochs=3):
+    net = dl4j.MultiLayerNetwork(_conf(seed=seed))
+    net.init()
+    x, y = _data(48, seed=seed)
+    net.fit(DataSet(x, y), epochs=epochs)
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    n = dl4j.MultiLayerNetwork(_conf())
+    n.init()
+    return n
+
+
+class _PoissonTraffic:
+    """Live load for the drills: threads issuing `pool.predict` with
+    exponential inter-arrival times. Every exception is a failed
+    request — the drills assert this list stays EMPTY while replicas
+    are killed, partitioned, and rolled back under the traffic."""
+
+    def __init__(self, pool, x, rate_hz=20.0, n_threads=2):
+        self._pool, self._x = pool, x
+        self._rate = rate_hz / n_threads
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._loop, args=(i,),
+                                          daemon=True)
+                         for i in range(n_threads)]
+        self.served = 0
+        self.failures = []
+
+    def _loop(self, seed):
+        rng = np.random.default_rng(seed)
+        while not self._stop.is_set():
+            try:
+                self._pool.predict(self._x, timeout=15.0)
+                with self._lock:
+                    self.served += 1
+            except Exception as e:  # noqa: BLE001 — the drill's metric
+                with self._lock:
+                    self.failures.append(e)
+            time.sleep(float(rng.exponential(1.0 / self._rate)))
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30.0)
+        return False
+
+
+def _await(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+_POOL_KW = dict(probe_interval=0.25, probe_timeout=5.0,
+                watchdog_timeout=5.0, evict_threshold=2,
+                readmit_successes=2, max_failovers=3)
+
+
+def test_kill9_respawn_readmit_zero_failed_requests(net, tmp_path):
+    x = _data()[0]
+    pool = spawn_replica_pool(
+        net, 2, scratch_dir=tmp_path,
+        pool_kwargs=dict(probe_batch=x[:2], **_POOL_KW),
+        supervisor_kwargs=dict(restart_backoff=0.25, poll_interval=0.1))
+    sup = pool.supervisor
+    try:
+        np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                                   net.output(x), atol=1e-5)
+
+        # the request timeline crosses the process boundary under the
+        # caller's trace_id: spans executed in the replica process are
+        # grafted back, tagged with the endpoint they ran on
+        trace = observability.Trace()
+        with observability.use_trace(trace):
+            pool.predict(x[:2], timeout=30.0)
+        remote_spans = [s for s in trace.to_dict()["spans"]
+                        if (s.get("attrs") or {}).get("remote")]
+        assert remote_spans, "no subprocess spans crossed the boundary"
+        endpoints = {f"{h}:{p}" for h, p in sup.endpoints()}
+        assert {s["attrs"]["endpoint"] for s in remote_spans} <= endpoints
+
+        with _PoissonTraffic(pool, x[:8]) as traffic:
+            _await(lambda: traffic.served >= 5, 30.0, "traffic warmup")
+            sup.kill(1)  # SIGKILL: the hard-crash drill
+            _await(lambda: sup.respawns >= 1 and sup.is_alive(1),
+                   60.0, "supervisor respawn of replica 1")
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            == "healthy"),
+                   60.0, "re-admission of the respawned replica")
+            _await(lambda: traffic.served >= 20, 30.0, "post-drill traffic")
+        assert traffic.failures == [], \
+            f"requests failed during the kill -9 drill: {traffic.failures}"
+
+        s = pool.stats()
+        assert s["healthy_replicas"] == 2
+        assert s["evictions"] >= 1 and s["readmissions"] >= 1
+        events = pool.flight_record()["pool"]["events"]
+        assert any(e["kind"] == "evict" and e.get("replica") == 1
+                   for e in events), \
+            "the flight recorder does not name the killed replica"
+        assert any(e["kind"] == "readmit" and e.get("replica") == 1
+                   for e in events)
+        # the respawned process serves the same weights
+        np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                                   net.output(x), atol=1e-5)
+    finally:
+        pool.shutdown(drain_timeout=5.0)
+
+
+def test_partition_evict_heal_readmit_cross_process(net, tmp_path):
+    x = _data()[0]
+    model_path = tmp_path / "model.zip"
+    write_model(net, model_path, atomic=False)
+    sup = ReplicaSupervisor(model_path, 2, scratch_dir=tmp_path,
+                            poll_interval=0.1).start()
+    proxy = ChaosProxy("127.0.0.1", sup.ports[1])
+    # replica 1 is reached THROUGH the proxy: its network can be cut
+    # without touching the (healthy, running) process behind it
+    reps = [RemoteReplica("127.0.0.1", sup.ports[0],
+                          scratch_dir=tmp_path),
+            RemoteReplica("127.0.0.1", proxy.port,
+                          scratch_dir=tmp_path, rpc_timeout=5.0)]
+    pool = RemoteReplicaPool(reps, supervisor=sup, template_net=net,
+                             scratch_dir=tmp_path,
+                             probe_batch=x[:2], **_POOL_KW)
+    part = PartitionInjector(proxy)
+    try:
+        np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                                   net.output(x), atol=1e-5)
+        with _PoissonTraffic(pool, x[:8]) as traffic:
+            _await(lambda: traffic.served >= 5, 30.0, "traffic warmup")
+            part.partition()
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            == "evicted"),
+                   30.0, "eviction of the partitioned replica")
+            before = traffic.served
+            _await(lambda: traffic.served >= before + 5, 30.0,
+                   "service from the surviving replica mid-partition")
+            part.heal()
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            == "healthy"),
+                   30.0, "re-admission after the partition healed")
+        assert traffic.failures == [], \
+            f"requests failed during the partition: {traffic.failures}"
+        assert sup.is_alive(1), \
+            "the partition drill must not restart the process: only " \
+            "its network was cut"
+        events = pool.flight_record()["pool"]["events"]
+        assert any(e["kind"] == "evict" and e.get("replica") == 1
+                   for e in events)
+        assert pool.stats()["readmissions"] >= 1
+    finally:
+        pool.shutdown(drain_timeout=5.0)
+        proxy.close()
+
+
+def test_rolling_reload_crash_mid_deploy_rolls_back_pool_wide(net,
+                                                              tmp_path):
+    x = _data()[0]
+    pool = spawn_replica_pool(
+        net, 2, scratch_dir=tmp_path,
+        pool_kwargs=dict(probe_batch=x[:2], **_POOL_KW),
+        supervisor_kwargs=dict(restart_backoff=0.25, poll_interval=0.1,
+                               chaos_die_on_reload=[1]))
+    sup = pool.supervisor
+    store_dir = tmp_path / "store"
+    store_dir.mkdir()
+    store = CheckpointStore(store_dir)
+    candidate = _fitted_clone()
+    store.save(1, lambda p: write_model(candidate, p, atomic=False))
+    baseline = net.output(x)
+    try:
+        with _PoissonTraffic(pool, x[:8]) as traffic:
+            _await(lambda: traffic.served >= 5, 30.0, "traffic warmup")
+            # replica 0 deploys the candidate; replica 1 SIGKILLs
+            # itself mid-reload → the deploy fails typed, naming the
+            # dying replica, and replica 0 must roll back
+            with pytest.raises((ServiceUnavailableError,
+                                InferenceFailedError)) as ei:
+                pool.rolling_reload(store, step=1, drain_timeout=30.0)
+            assert getattr(ei.value, "replica_id", None) == 1
+            # the supervisor respawns the crashed replica on the
+            # PRE-DEPLOY weights (the deploy never succeeded)
+            _await(lambda: sup.respawns >= 1 and sup.is_alive(1),
+                   60.0, "respawn of the replica that died mid-deploy")
+            _await(lambda: pool.stats()["healthy_replicas"] == 2,
+                   60.0, "full pool recovery after the failed deploy")
+            _await(lambda: traffic.served >= 15, 30.0,
+                   "post-rollback traffic")
+        assert traffic.failures == [], \
+            f"requests failed during the aborted deploy: {traffic.failures}"
+
+        s = pool.stats()
+        assert s["rollbacks"] == 1 and s["rolling_reloads"] == 0
+        # every replica serves the PRE-deploy weights again
+        np.testing.assert_allclose(pool.predict(x, timeout=30.0),
+                                   baseline, atol=1e-5)
+        assert not np.allclose(baseline, candidate.output(x), atol=1e-3)
+    finally:
+        pool.shutdown(drain_timeout=5.0)

@@ -38,7 +38,7 @@ RULE_FIXTURES = {
     "host-sync": ("host_sync", 5),
     "lock-order": ("lock_order", 1),
     "guarded-by": ("guarded_by", 2),
-    "typed-error": ("typed_error", 3),
+    "typed-error": ("typed_error", 6),
     "rng-reuse": ("rng", 3),
 }
 

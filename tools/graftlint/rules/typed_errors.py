@@ -2,7 +2,7 @@
 (`retry_after`, `error_type` on the wire) — generic raises and silent
 broad catches break that contract.
 
-Scope: ``serving/`` and ``gateway.py`` (plus the fixture corpus).  Two
+Scope: ``serving/`` and ``gateway.py`` (plus the fixture corpus).  Three
 sub-checks:
 
 * ``raise RuntimeError(...)`` / ``raise Exception(...)`` — generic
@@ -14,6 +14,16 @@ sub-checks:
   bugs from callers.  Handlers that re-raise (converting to a typed
   error) pass; deliberate absorb-and-count sites carry a suppression
   with a reason.
+* wire/transport catches (``ConnectionError`` and subclasses,
+  ``TimeoutError``/``socket.timeout``, ``OSError``,
+  ``GatewayProtocolError``) whose handler is a SILENT absorb — no
+  re-raise, no explicit verdict (``return <value>``; a bare ``return``
+  or ``return None`` does not count), and no logging call.  The
+  cross-process pool maps every wire failure to a typed error or an
+  explicit probe verdict; a transport error that simply vanishes is how
+  partitions and dead peers become invisible hangs.  ``GatewayError``
+  (the remote's own typed answer) is deliberately NOT in the set —
+  turning it into a verdict is normal.
 """
 from __future__ import annotations
 
@@ -26,12 +36,44 @@ from tools.graftlint.rules.base import Rule
 
 _GENERIC_RAISES = {"RuntimeError", "Exception", "BaseException"}
 _BROAD_CATCHES = {"Exception", "BaseException"}
+# transport failures a serving path must never silently absorb
+_WIRE_CATCHES = {
+    "ConnectionError", "ConnectionResetError", "BrokenPipeError",
+    "ConnectionRefusedError", "ConnectionAbortedError",
+    "TimeoutError", "socket.timeout", "OSError", "GatewayProtocolError",
+}
+_LOG_BASES = {"logger", "logging", "log"}
 
 
 def _in_scope(path: str) -> bool:
     p = "/" + path
     return "/serving/" in p or p.endswith("/gateway.py") or \
         "/fixtures/graftlint/" in p
+
+
+def _caught_names(t) -> set:
+    if t is None:
+        return set()
+    if isinstance(t, ast.Tuple):
+        return {dotted(e) for e in t.elts}
+    return {dotted(t)}
+
+
+def _is_silent_absorb(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises, nor returns an explicit
+    verdict value, nor logs: the failure simply vanishes."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return False
+        if isinstance(n, ast.Return) and n.value is not None and not (
+                isinstance(n.value, ast.Constant)
+                and n.value.value is None):
+            return False
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            base = n.func.value
+            if isinstance(base, ast.Name) and base.id in _LOG_BASES:
+                return False
+    return True
 
 
 class TypedErrorRule(Rule):
@@ -57,21 +99,31 @@ class TypedErrorRule(Rule):
                         f"failure to a wire error with a retry hint"))
             elif isinstance(node, ast.ExceptHandler):
                 t = node.type
-                broad = t is None or dotted(t) in _BROAD_CATCHES or (
-                    isinstance(t, ast.Tuple) and any(
-                        dotted(e) in _BROAD_CATCHES for e in t.elts))
-                if not broad:
+                names = _caught_names(t)
+                broad = t is None or (names & _BROAD_CATCHES)
+                if broad:
+                    reraises = any(isinstance(n, ast.Raise)
+                                   for n in ast.walk(node))
+                    if not reraises:
+                        label = "bare `except:`" if t is None else \
+                            f"`except {dotted(t) or '...'}`"
+                        out.append(ctx.finding(
+                            self.name, node,
+                            f"{label} absorbs unknown failures without "
+                            f"re-raising in a serving path: catch the "
+                            f"typed ServingError hierarchy, or re-raise "
+                            f"as a typed error (suppress with a reason "
+                            f"if the absorb is deliberate)"))
                     continue
-                reraises = any(isinstance(n, ast.Raise)
-                               for n in ast.walk(node))
-                if not reraises:
-                    label = "bare `except:`" if t is None else \
-                        f"`except {dotted(t) or '...'}`"
+                wire = names & _WIRE_CATCHES
+                if wire and _is_silent_absorb(node):
+                    caught = ", ".join(sorted(n for n in wire if n))
                     out.append(ctx.finding(
                         self.name, node,
-                        f"{label} absorbs unknown failures without "
-                        f"re-raising in a serving path: catch the typed "
-                        f"ServingError hierarchy, or re-raise as a typed "
-                        f"error (suppress with a reason if the absorb is "
-                        f"deliberate)"))
+                        f"`except {caught}` silently absorbs a "
+                        f"wire/transport failure in a serving path: map "
+                        f"it to a typed ServingError, return an explicit "
+                        f"verdict, or log it — a partition that vanishes "
+                        f"here becomes an invisible hang (suppress with "
+                        f"a reason if the absorb is deliberate)"))
         return out
