@@ -1707,6 +1707,64 @@ def bench_serve_generate():
     bench_serve_generate.kv_bytes_per_token = {
         "int8": int8_stats["kv_bytes_per_token"],
         "bf16": stats["kv_bytes_per_token"]}
+
+    # -- tensor-parallel tier (ISSUE 15): the IDENTICAL paged config
+    # sharded Megatron-style over a tp mesh vs the single-device runs
+    # above, priced with the shared differencing rule. The tier's
+    # headline is `tp_max_model_bytes_per_chip` — per-chip weight + KV
+    # residency under tp vs one chip holding everything (the capacity
+    # claim: the sharded portion divides by the degree, so models too
+    # big for a chip fit a mesh). `tp_vs_single_goodput` < 1 on CPU
+    # smoke is expected — two virtual host devices share one core and
+    # psum is pure overhead there; on a real mesh the same line prices
+    # the all-reduce tax against the memory win. Guarded on device
+    # count: a 1-device run commits no tp lines (tier-1's CPU smoke
+    # forces 8 host devices, so the lines commit there).
+    import jax
+
+    tp_degree = shp.get("tp_degree", 2)
+    if len(jax.devices()) >= tp_degree:
+        tp_kw = dict(parallel={"tp": tp_degree})
+        # --trace wraps the tp passes like the step benches' first timed
+        # pass: the capture shows per-shard dispatch and the psum pair
+        # per block (named `tp-allreduce`), the profile that decides
+        # whether the all-reduce tax or the serving host loop bounds a
+        # tp deployment
+        with _maybe_trace_capture():
+            (tp_goodput, _, _, _, tp_stats) = engine_goodput(
+                net, n_slots, pool_pages=kv_budget_pages,
+                prompt_buckets=(short_t0,), **tp_kw)
+        bench_serve_generate.tp_degree = tp_stats["tp_degree"]
+        bench_serve_generate.tp_goodput_tokens_per_sec = round(
+            tp_goodput, 1)
+        bench_serve_generate.tp_vs_single_goodput = round(
+            tp_goodput / goodput, 3)
+        bench_serve_generate.tp_device_ms_per_token = paged_dms(
+            g_full=tp_goodput, **tp_kw)
+        bench_serve_generate.tp_kv_bytes_per_token_per_shard = \
+            tp_stats["tp_kv_bytes_per_token_per_shard"]
+
+        def bytes_per_chip(**kw):
+            # construction only: params are placed (and under tp,
+            # permuted + sharded) at build time, but nothing compiles
+            # until a request arrives — cheap enough to price residency
+            eng = DecodeEngine(
+                net, n_slots=n_slots, max_len=max_len,
+                page_size=shp["page_size"],
+                prompt_buckets=(short_t0,),
+                pool_pages=kv_budget_pages, **kw)
+            try:
+                return eng.model_bytes_per_chip()
+            finally:
+                eng.shutdown()
+
+        single_bytes = bytes_per_chip()
+        tp_bytes = bytes_per_chip(**tp_kw)
+        bench_serve_generate.single_model_bytes_per_chip = single_bytes
+        bench_serve_generate.tp_max_model_bytes_per_chip = tp_bytes
+        bench_serve_generate.tp_bytes_per_chip_vs_single = round(
+            tp_bytes / single_bytes, 3)
+
     return ("serve_generate_paged_goodput_tokens_per_sec", goodput, None,
             spread)
 
@@ -1842,7 +1900,19 @@ def main() -> None:
                  "int8_kv_out_of_pages_sheds"),
                 ("int8_kv_goodput_tokens_per_sec",
                  "int8_kv_goodput_tokens_per_sec"),
-                ("kv_bytes_per_token", "kv_bytes_per_token")):
+                ("kv_bytes_per_token", "kv_bytes_per_token"),
+                ("tp_degree", "tp_degree"),
+                ("tp_goodput_tokens_per_sec", "tp_goodput_tokens_per_sec"),
+                ("tp_vs_single_goodput", "tp_vs_single_goodput"),
+                ("tp_device_ms_per_token", "tp_device_ms_per_token"),
+                ("tp_kv_bytes_per_token_per_shard",
+                 "tp_kv_bytes_per_token_per_shard"),
+                ("single_model_bytes_per_chip",
+                 "single_model_bytes_per_chip"),
+                ("tp_max_model_bytes_per_chip",
+                 "tp_max_model_bytes_per_chip"),
+                ("tp_bytes_per_chip_vs_single",
+                 "tp_bytes_per_chip_vs_single")):
             extra = getattr(_CONFIGS[name], attr, None)
             if extra is not None:
                 entries[name][key] = extra

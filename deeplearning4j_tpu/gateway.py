@@ -37,7 +37,11 @@ Robustness (the serving-tier hardening pass):
   tier rides the same dict — `"generation": {"prefix_cache": true,
   "speculative": {"draft": "self" | <config json>, "k": 4}}` is fully
   JSON-expressible, so a wire client can enable shared-prefix KV reuse
-  and speculative decoding without shipping a net object
+  and speculative decoding without shipping a net object.
+  `serving={"parallel": {"tp": N}}` flows to each ModelServer the same
+  way and shards its decode engine over an N-device tensor-parallel
+  mesh (`serving.tp_engine`) — combined with `"replicas"`/`"remote"`
+  that is pools of tp-sharded replica processes behind one endpoint
   (`server_stats` then carries `prefix_hit_tokens_pct` /
   `spec_accept_rate` / `spec_tokens_per_step` top-level).
 - **client retries** — `GatewayClient` retries idempotent methods once

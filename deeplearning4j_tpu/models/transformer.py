@@ -162,24 +162,34 @@ class GPTPlan:
         return x @ params[self.out_i]["W"] + params[self.out_i]["b"]
 
 
-def _block_heads(layer, p, x, positions=None):
+def _block_heads(layer, p, x, positions=None, shard=None):
     """(..., d) -> q (..., H, hd) and k/v (..., Hkv, hd) for one block —
     K/V stay at the layer's (possibly grouped) head count, so GQA caches
     carry only Hkv heads. `positions`: RoPE rotation positions (prefill:
     arange(T); whole-batch decode: the current scalar pos; slotted
     decode: a per-slot vector) — keys enter the cache already rotated at
-    their absolute position."""
+    their absolute position.
+
+    `shard`: tensor-parallel degree when running inside a `shard_map`
+    body over head-sharded `Wqkv`/`bqkv` (columns permuted so each
+    device's slice is [Q_t | K_t | V_t] — `serving/tp_engine.py`): the
+    local projection yields H/shard query and Hkv/shard KV heads. RoPE
+    rotates per head, so local slices rotate identically to their
+    global positions. `shard=None` is byte-identical to the
+    single-device path (qw == d)."""
     from deeplearning4j_tpu.nn.conf.layers import layer_norm
 
     d = x.shape[-1]
     hd = d // layer.n_heads
-    Hkv = layer._kv_heads
+    H = layer.n_heads // shard if shard else layer.n_heads
+    Hkv = layer._kv_heads // shard if shard else layer._kv_heads
+    qw = H * hd
     kvw = Hkv * hd
     h1 = layer_norm(x, p["ln1_g"], p["ln1_b"], layer.eps)
     qkv = h1 @ p["Wqkv"] + p["bqkv"]
-    q = qkv[..., :d].reshape(*x.shape[:-1], layer.n_heads, hd)
-    k = qkv[..., d:d + kvw].reshape(*x.shape[:-1], Hkv, hd)
-    v = qkv[..., d + kvw:].reshape(*x.shape[:-1], Hkv, hd)
+    q = qkv[..., :qw].reshape(*x.shape[:-1], H, hd)
+    k = qkv[..., qw:qw + kvw].reshape(*x.shape[:-1], Hkv, hd)
+    v = qkv[..., qw + kvw:].reshape(*x.shape[:-1], Hkv, hd)
     if layer.rope:
         from deeplearning4j_tpu.ops.rope import rope_angles, rope_rotate
 
@@ -189,8 +199,35 @@ def _block_heads(layer, p, x, positions=None):
     return q, k, v
 
 
-def _block_ffn(layer, p, x):
-    """Post-attention half of the block on (B, T, d) or (B, d)."""
+def _psum_partial(y, axis_name):
+    """Sum a row-parallel matmul's partial products over the named
+    tensor-parallel mesh axis — the ONE all-reduce each Megatron-sharded
+    half-block performs. Identity when `axis_name` is None (single
+    device), so callers thread it unconditionally."""
+    if axis_name is None:
+        return y
+    import jax
+
+    with jax.named_scope("tp-allreduce"):
+        return jax.lax.psum(y, axis_name)
+
+
+def _block_out_proj(p, att, axis_name=None):
+    """Attention output projection on flattened head outputs
+    (..., H·hd). Under tensor parallelism `att` carries the local
+    H/tp head slice and `Wo` the matching row slice; the replicated
+    bias is added AFTER the all-reduce so it lands exactly once."""
+    return _psum_partial(att @ p["Wo"], axis_name) + p["bo"]
+
+
+def _block_ffn(layer, p, x, axis_name=None):
+    """Post-attention half of the block on (B, T, d) or (B, d).
+
+    `axis_name`: tensor-parallel mesh axis when `W1`/`W3` are
+    column-sharded and `W2` row-sharded (Megatron FFN) — the partial
+    W2 product is all-reduced before the replicated `b2` is added.
+    MoE blocks don't compose with serving TP (rejected at
+    `TPPlan` construction)."""
     import jax
 
     from deeplearning4j_tpu.nn.conf.layers import layer_norm
@@ -207,10 +244,12 @@ def _block_ffn(layer, p, x):
                          train=False,
                          passthrough="zero").reshape(*lead, -1)
     elif layer.ffn_activation == "swiglu":
-        ffn = (jax.nn.silu(h2 @ p["W1"])
-               * (h2 @ p["W3"])) @ p["W2"] + p["b2"]
+        ffn = _psum_partial((jax.nn.silu(h2 @ p["W1"])
+                             * (h2 @ p["W3"])) @ p["W2"],
+                            axis_name) + p["b2"]
     else:
-        ffn = jax.nn.gelu(h2 @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+        ffn = _psum_partial(jax.nn.gelu(h2 @ p["W1"] + p["b1"])
+                            @ p["W2"], axis_name) + p["b2"]
     return x + ffn
 
 

@@ -289,7 +289,14 @@ def paged_attention_step(q: jnp.ndarray, k_pool: jnp.ndarray,
     step. This XLA form is the portable reference semantics AND the
     dispatch fallback: `paged_attention_step_auto` runs the fused Pallas
     kernel that walks the page table in-place (vLLM's PagedAttention,
-    `ops/pallas_paged_attention.py`) when the platform supports it."""
+    `ops/pallas_paged_attention.py`) when the platform supports it.
+
+    Head-count contract: Hkv here is whatever the POOLS carry — under
+    tensor-parallel serving (`serving.tp_engine`) this runs per shard
+    inside `shard_map` with the LOCAL head count Hkv/tp (pools are
+    sharded on the head axis), and neither this step nor the kernel can
+    tell: heads never mix in attention, so the per-shard computation is
+    the single-device one at a smaller Hkv."""
     k, v = paged_gather(k_pool, v_pool, page_table)
     return cached_attention_step(q, k, v, pos)
 

@@ -3,6 +3,11 @@
 TPU equivalent of the reference's device enumeration in `ParallelWrapper`
 (one CUDA device per worker thread). Here: an N-d logical mesh over the
 chips; shardings name mesh axes and XLA routes the collectives over ICI.
+
+The serving tier builds its tensor-parallel decode mesh separately
+(`serving.tp_engine.tp_mesh`: a 1-d `("tp",)` mesh over the FIRST N
+devices, cached per degree) because a serving process typically owns a
+sub-slice, not the whole topology these training helpers assume.
 """
 from __future__ import annotations
 

@@ -224,7 +224,7 @@ def _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page):
     return kp_, vp_
 
 
-def _dispatched(thunk):
+def _dispatched(thunk, span=None):
     """Run one compiled dispatch INCLUDING its host materialization,
     tagging any exception raised so the caller can tell a FAILED
     DISPATCH (which, under buffer donation, may have invalidated the
@@ -232,8 +232,15 @@ def _dispatched(thunk):
     landed (non-finite screens, hooks) — only the former justifies
     failing other slots. The device_get must live inside the thunk: on
     asynchronous backends a device-side error surfaces at
-    materialization, not at the dispatch call."""
+    materialization, not at the dispatch call.
+
+    `span` (tensor-parallel engines pass "tp-dispatch") wraps the
+    dispatch in a trace annotation so `--trace` captures show which
+    wall-time went to sharded dispatches + their collectives."""
     try:
+        if span is not None:
+            with observability.annotation(span):
+                return thunk()
         return thunk()
     except BaseException as e:
         e._dispatch_failure = True
@@ -340,6 +347,18 @@ class DecodeEngine:
         generate-latency observation past the histogram's live
         quantile bound pins that request's timeline in the flight
         recorder's failures ring with an ``excursion`` event.
+    parallel : None or ``{"tp": N}`` — tensor-parallel decode
+        (`serving/tp_engine.py`): shard THIS engine Megatron-style over
+        a named `tp` mesh axis — attention heads and FFN width
+        partitioned, head-sharded paged K/V pools (each device owns
+        Hkv/N heads of every page), two all-reduces per block. The
+        page table, free list, refcounts, prefix cache, speculative
+        verify and int8 KV tier all ride unchanged; per-device
+        weight+KV residency drops ~1/N so a model too big for one
+        chip's HBM can serve. Geometry is validated at construction
+        (N must divide every block's head counts and FFN width; MoE
+        rejected) — a bad config is a typed ValueError, never a trace
+        error. ``{"tp": 1}``/None is the single-device engine.
     """
 
     def __init__(self, net, *, n_slots: int = 4,
@@ -362,7 +381,8 @@ class DecodeEngine:
                  recorder=None,
                  metrics=None,
                  quantize: Optional[dict] = None,
-                 excursion=None):
+                 excursion=None,
+                 parallel: Optional[dict] = None):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -390,6 +410,20 @@ class DecodeEngine:
         self._quantize_cfg = dict(quantize) if quantize else None
         if excursion not in (None, False) and not isinstance(excursion, dict):
             raise ValueError("excursion must be None, False, or a dict")
+        tp_degree = 1
+        if parallel is not None:
+            if not isinstance(parallel, dict):
+                raise ValueError('parallel must be a dict like {"tp": N}')
+            unknown = set(parallel) - {"tp"}
+            if unknown:
+                raise ValueError("unknown parallel keys: %s"
+                                 % sorted(unknown))
+            tp_degree = parallel.get("tp", 1)
+            if not isinstance(tp_degree, int) or tp_degree < 1:
+                raise ValueError("parallel['tp'] must be a positive int, "
+                                 "got %r" % (tp_degree,))
+        self._tp_degree = tp_degree
+        self._tp = None  # TPPlan, built per (re)build when tp_degree > 1
         self.n_slots = n_slots
         self.max_queue = max_queue
         self.default_timeout = default_timeout
@@ -468,6 +502,17 @@ class DecodeEngine:
         self.metrics.gauge(
             "decode_engine_pages_in_use",
             lambda: self.pool_pages - len(self._free_pages))
+        if self._tp_degree > 1:
+            # per-shard gauges carry a {tp_rank} label (parsed out of
+            # the series name by MetricsRegistry.exposition — one
+            # metric name, degree series on the gateway scrape page);
+            # shards are symmetric by construction, so every rank
+            # reports the same per-shard KV residency
+            for _r in range(self._tp_degree):
+                self.metrics.gauge(
+                    'decode_engine_tp_shard_kv_bytes_per_token'
+                    '{tp_rank="%d"}' % _r,
+                    lambda: self._kv_bytes_per_token // self._tp_degree)
         if self.breaker is not None \
                 and getattr(self.breaker, "on_event", None) is None:
             # standalone engines wire breaker transitions themselves; a
@@ -487,12 +532,12 @@ class DecodeEngine:
         swap to a differently-shaped net recompiles cleanly."""
         import jax
         import jax.numpy as jnp
-        from functools import partial
 
         from deeplearning4j_tpu.models.transformer import (
             GPTPlan,
             _block_ffn,
             _block_heads,
+            _block_out_proj,
             _prefill_block_attention,
             _sample_logits,
         )
@@ -502,6 +547,17 @@ class DecodeEngine:
         )
 
         plan = GPTPlan(net)
+        # tensor-parallel plan: geometry validated HERE (construction /
+        # weight swap), so a bad tp config is a typed ValueError before
+        # any device work; None means the single-device engine
+        tp = None
+        if self._tp_degree > 1:
+            from deeplearning4j_tpu.serving.tp_engine import TPPlan
+
+            tp = TPPlan(net, plan, self._tp_degree)
+        self._tp = tp
+        tp_axis = tp.axis if tp is not None else None
+        tp_shard = tp.degree if tp is not None else None
         L = self._requested_max_len or plan.emb.max_length
         if plan.emb.positional:
             L = min(L, plan.emb.max_length)
@@ -590,6 +646,15 @@ class DecodeEngine:
         def write_pages(kp_, vp_, kcol, vrow, wpids, woff):
             return _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page)
 
+        def _shard(fn, n_in, n_out):
+            """Identity on one device; under TP the body becomes the
+            per-shard program of a `shard_map` over the tp mesh
+            (serving/tp_engine.py) — params head/width-sharded, pools
+            head-sharded, page table and slot state replicated."""
+            if tp is None:
+                return fn
+            return tp.shard(fn, n_in=n_in, n_out=n_out)
+
         def step_math(bp, params, caches, page_table, tok, pos, keys,
                       temps, active):
             """Advance ALL slots one token: inactive slots are masked
@@ -616,7 +681,7 @@ class DecodeEngine:
                 # argmax parity is a numerics property, not just a logic
                 # one. positions: a per-slot column vector
                 q, k, v = _block_heads(layer, p, x[:, None, :],
-                                       pos[:, None])
+                                       pos[:, None], shard=tp_shard)
                 q, k, v = q[:, 0], k[:, 0], v[:, 0]
                 if kv_quant:
                     # quantize the single-position (S, Hkv, hd) write
@@ -643,8 +708,8 @@ class DecodeEngine:
                 att = paged_attention_step_auto(q, kp_, vp_, page_table,
                                                 pos, active,
                                                 k_scale=ks_, v_scale=vs_)
-                att = att @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att, tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
                                   else (kp_, vp_))
             logits = plan.final_logits(bp, params, x)
@@ -654,14 +719,12 @@ class DecodeEngine:
             return new_caches, nxt, new_pos, new_keys, \
                 logits_ok(logits, active)
 
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def decode_step(params, caches, page_table, tok, pos, keys, temps,
                         active):
             bp = plan.cast_blocks(params)
             return step_math(bp, params, caches, page_table, tok, pos,
                              keys, temps, active)
 
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def decode_chunked(params, caches, page_table, tok, pos, keys,
                            temps, active):
             """`decode_chunk` iterations of the SAME step body fused into
@@ -686,7 +749,6 @@ class DecodeEngine:
             # via EOS before the bad step still succeeds
             return caches, tok, pos, keys, toks, oks
 
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def prefill(params, caches, ids, t0, slot, wpids, tok, pos, keys,
                     temps, kp, kdec, temp):
             """One-shot prefill: write one prompt's KV into the slot's
@@ -707,11 +769,11 @@ class DecodeEngine:
             for bi, i in enumerate(block_is):
                 p = bp[i]
                 layer = layers[i]
-                q, k, v = _block_heads(layer, p, x, jnp.arange(P))
+                q, k, v = _block_heads(layer, p, x, jnp.arange(P),
+                                       shard=tp_shard)
                 att = _prefill_block_attention(layer, q, k, v)
-                d = x.shape[-1]
-                att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att.reshape(1, P, -1), tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, P)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, P, hd)
                 z0 = jnp.zeros((), jnp.int32)
@@ -746,7 +808,6 @@ class DecodeEngine:
             return new_caches, tok, pos, keys, temps, tok0, \
                 jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
 
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def prefill_chunk_fn(params, caches, page_row, ids, off, woff,
                              t0, slot, wpids, tok, pos, keys, temps, kp,
                              kdec, temp):
@@ -775,7 +836,7 @@ class DecodeEngine:
             for bi, i in enumerate(block_is):
                 p = bp[i]
                 layer = layers[i]
-                q, k, v = _block_heads(layer, p, x, qpos)
+                q, k, v = _block_heads(layer, p, x, qpos, shard=tp_shard)
                 kcol = jnp.transpose(k, (0, 2, 3, 1))   # (1, Hkv, hd, C)
                 vrow = jnp.transpose(v, (0, 2, 1, 3))   # (1, Hkv, C, hd)
                 if kv_quant:
@@ -797,9 +858,8 @@ class DecodeEngine:
                                                  page_row[None],
                                                  off[None],
                                                  k_scale=ks_, v_scale=vs_)
-                d = x.shape[-1]
-                att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att.reshape(1, Cw, -1), tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
                                   else (kp_, vp_))
             r = jnp.clip(t0 - 1 - off, 0, Cw - 1)
@@ -820,6 +880,25 @@ class DecodeEngine:
                 & jnp.all(jnp.isfinite(x.astype(jnp.float32)))
             return new_caches, tok, pos, keys, temps, tok0, ok
 
+        # jit OUTSIDE the shard_map (donation must alias the sharded
+        # pool buffers, and an inner jit would be inlined by the
+        # per-shard trace) — the literal jax.jit assign keeps
+        # graftlint's donation rule pointed at these call sites
+        decode_step = jax.jit(_shard(decode_step, 8, 5),
+                              donate_argnums=(1,) if donate else ())
+        decode_chunked = jax.jit(_shard(decode_chunked, 8, 6),
+                                 donate_argnums=(1,) if donate else ())
+        prefill = jax.jit(_shard(prefill, 13, 7),
+                          donate_argnums=(1,) if donate else ())
+        prefill_chunk_fn = jax.jit(_shard(prefill_chunk_fn, 16, 7),
+                                   donate_argnums=(1,) if donate else ())
+        # params placed once per (re)build: permuted + head/width-
+        # sharded over the mesh under TP (a weight swap reshards from
+        # the swapped net's clean host copy), the net's own tree
+        # otherwise
+        self._dparams = tp.shard_params(net._params) if tp is not None \
+            else net._params
+        self._tp_span = "tp-dispatch" if tp is not None else None
         self._plan = plan
         self._net = net
         self.max_len = L
@@ -874,7 +953,8 @@ class DecodeEngine:
                 target_plan=plan, target_net=net,
                 draft_net=self._draft_net, k=k, n_slots=S, page=page,
                 L_logical=L_logical, pool_pages=pool_pages,
-                top_k=self.top_k, donate=donate, kv_quant=kv_quant)
+                top_k=self.top_k, donate=donate, kv_quant=kv_quant,
+                tp=tp, tp_params=self._dparams if tp is not None else None)
         self._reset_device_state()
 
     def _reset_device_state(self) -> None:
@@ -910,6 +990,13 @@ class DecodeEngine:
                 caches.append(
                     (jnp.zeros((P + 1, Hkv, hd, page), plan.cdt),
                      jnp.zeros((P + 1, Hkv, page, hd), plan.cdt)))
+        if self._tp is not None:
+            # head axis (axis 1 in every pool + scale-sidecar layout)
+            # over `tp`: each device owns Hkv/N heads of EVERY page, so
+            # the page table / free list / refcounts below stay
+            # host-global and byte-identical to the single-device engine
+            caches = [tuple(self._tp.shard_pool(x) for x in c)
+                      for c in caches]
         self._caches = caches
         self._page_table = jnp.zeros((S, self._n_pages_max), jnp.int32)
         self._tok = jnp.zeros((S,), jnp.int32)
@@ -1206,6 +1293,12 @@ class DecodeEngine:
                # bits reflect the BUILT pools (kill switch included)
                "kv_quant_bits": self._kv_quant_bits,
                "kv_bytes_per_token": self._kv_bytes_per_token,
+               # tensor-parallel tier: degree 1 when off, so dashboards
+               # can chart capacity without branching on key presence;
+               # per-shard KV bytes is the per-chip residency claim
+               "tp_degree": self._tp_degree,
+               "tp_kv_bytes_per_token_per_shard":
+                   self._kv_bytes_per_token // self._tp_degree,
                "prompt_buckets": list(self.prompt_buckets)}
         if self._prefix_cache is not None:
             hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
@@ -1228,6 +1321,28 @@ class DecodeEngine:
                 proposed=self.spec_proposed, accepted=self.spec_accepted,
                 emitted=self.spec_emitted)
         return out
+
+    def model_bytes_per_chip(self) -> int:
+        """Per-chip residency (weights + KV pools + scale sidecars), the
+        bench's `tp_max_model_bytes_per_chip` capacity claim: under
+        parallel={"tp": N} the sharded matmul slices and the pools' head
+        axis each divide by N (replicated tensors — embeddings, LNs,
+        biases, logits head — don't), so the largest servable model
+        grows ~N× per chip. Array `.nbytes` is the GLOBAL size, hence
+        the explicit division."""
+        import jax
+
+        pool_bytes = sum(x.nbytes
+                         for c in self._caches
+                         for x in c) // self._tp_degree
+        if self._tp is not None:
+            return self._tp.weight_bytes_per_chip(self._net._params) \
+                + pool_bytes
+        weight_bytes = sum(
+            x.nbytes
+            for p in self._net._params
+            for x in jax.tree_util.tree_leaves(p))
+        return weight_bytes + pool_bytes
 
     def drain_and_swap(self, net, timeout: Optional[float] = None) -> None:
         """Hot-reload seam: pause admission, let every in-flight request
@@ -1522,14 +1637,14 @@ class DecodeEngine:
         def run():
             (self._caches, self._tok, self._pos, self._keys, self._temps,
              tok0, ok) = self._prefill(
-                self._net._params, self._caches, jnp.asarray(ids),
+                self._dparams, self._caches, jnp.asarray(ids),
                 jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
                 wpids, self._tok, self._pos, self._keys, self._temps,
                 kp, kdec, jnp.asarray(req.temperature, jnp.float32))
             return jax.device_get((tok0, ok))
 
         tp0 = time.monotonic()
-        first, ok = _dispatched(run)
+        first, ok = _dispatched(run, span=self._tp_span)
         # host clock around the dispatch+materialization — already
         # synced, so the span costs no extra device round-trip
         req.trace.add_timed("prefill", tp0, time.monotonic(),
@@ -1612,7 +1727,7 @@ class DecodeEngine:
         def run():
             (self._caches, self._tok, self._pos, self._keys, self._temps,
              tok0, ok) = self._prefill_chunk_fn(
-                self._net._params, self._caches, self._page_table[slot],
+                self._dparams, self._caches, self._page_table[slot],
                 jnp.asarray(ids), jnp.asarray(off, jnp.int32),
                 jnp.asarray(woff, jnp.int32), jnp.asarray(t0, jnp.int32),
                 jnp.asarray(slot, jnp.int32),
@@ -1623,7 +1738,7 @@ class DecodeEngine:
 
         tp0 = time.monotonic()
         try:
-            first, ok = _dispatched(run)
+            first, ok = _dispatched(run, span=self._tp_span)
             req.trace.add_timed("prefill-chunk", tp0, time.monotonic(),
                                 chunk_off=off, width=W, final=final)
             if not bool(ok):
@@ -1906,12 +2021,12 @@ class DecodeEngine:
                     active, wlimit)
                 (self._caches, self._tok, self._pos, self._keys, out,
                  n_emit, oks) = spec._verify(
-                    self._net._params, self._caches, self._page_table,
+                    self._dparams, self._caches, self._page_table,
                     self._tok, self._pos, self._keys, self._temps,
                     active, wlimit, props, qd)
                 return jax.device_get((out, n_emit, oks))
 
-            out, n_emit, oks = _dispatched(run)
+            out, n_emit, oks = _dispatched(run, span=self._tp_span)
             self._hook("post_decode", info)
             t1c = time.monotonic()
         # graftlint: disable=typed-error  converts to a typed failure:
@@ -1976,14 +2091,14 @@ class DecodeEngine:
                 if chunked:
                     (self._caches, self._tok, self._pos, self._keys,
                      toks_d, oks_d) = self._decode_chunked(
-                        self._net._params, self._caches, self._page_table,
+                        self._dparams, self._caches, self._page_table,
                         self._tok, self._pos, self._keys, self._temps,
                         jnp.asarray(self._active))
                     # (chunk, S) tokens + per-step flags, ONE host sync
                     return jax.device_get((toks_d, oks_d))
                 (self._caches, self._tok, self._pos, self._keys,
                  ok_d) = self._decode_step(
-                    self._net._params, self._caches, self._page_table,
+                    self._dparams, self._caches, self._page_table,
                     self._tok, self._pos, self._keys, self._temps,
                     jnp.asarray(self._active))
                 # THE per-iteration host sync — the price of
@@ -1991,7 +2106,7 @@ class DecodeEngine:
                 t, o = jax.device_get((self._tok, ok_d))
                 return t[None], o[None]
 
-            toks, oks = _dispatched(run)
+            toks, oks = _dispatched(run, span=self._tp_span)
             self._hook("post_decode", info)
         # graftlint: disable=typed-error  converts to a typed failure:
         # _decode_failure wraps the cause in InferenceFailedError for the

@@ -415,7 +415,8 @@ class ModelServer:
                  pad_batches: bool = True,
                  generation: Optional[dict] = None,
                  quantize: Optional[dict] = None,
-                 drift_gate: Optional[dict] = None):
+                 drift_gate: Optional[dict] = None,
+                 parallel: Optional[dict] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if max_concurrent < 1:
@@ -438,6 +439,13 @@ class ModelServer:
                 raise ValueError("quantize['kv'] must be 'int8', got "
                                  f"{quantize.get('kv')!r}")
         self._quantize_cfg = dict(quantize) if quantize else None
+        # tensor-parallel serving (serving/tp_engine.py): validated and
+        # applied by the DecodeEngine at construction; the server only
+        # routes the config, so the batch-predict path stays
+        # single-device (generation is where HBM capacity binds)
+        if parallel is not None and not isinstance(parallel, dict):
+            raise ValueError('parallel must be a dict like {"tp": N}')
+        self._parallel_cfg = dict(parallel) if parallel else None
         if drift_gate is not None:
             unknown = set(drift_gate) - {"eval_set", "max_argmax_drift",
                                          "max_ppl_delta"}
@@ -809,6 +817,8 @@ class ModelServer:
                 if self._quantize_cfg and self._quantize_cfg.get("kv"):
                     cfg.setdefault(
                         "quantize", {"kv": self._quantize_cfg["kv"]})
+                if self._parallel_cfg:
+                    cfg.setdefault("parallel", self._parallel_cfg)
                 self._engine = DecodeEngine(self._net, **cfg)
             return self._engine
 

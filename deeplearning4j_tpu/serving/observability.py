@@ -633,9 +633,22 @@ class MetricsRegistry:
         lines: List[str] = []
 
         def emit(name, kind, value):
+            # a series may embed its OWN labels in the registered name
+            # (e.g. 'x{tp_rank="0"}' — per-shard gauges register one
+            # series per rank); split them off before sanitizing and
+            # merge with the call-level labels so the exposition stays
+            # one metric name with several labelled series
+            own = ""
+            if "{" in name:
+                name, own = name.split("{", 1)
+                own = own.rstrip("}")
             full = f"{namespace}_{_sanitize(name)}"
+            merged = lab
+            if own:
+                merged = lab[:-1] + "," + own + "}" if lab \
+                    else "{" + own + "}"
             lines.append(f"# TYPE {full} {kind}")
-            lines.append(f"{full}{lab} {value}")
+            lines.append(f"{full}{merged} {value}")
 
         for name, v in sorted(snap["counters"].items()):
             emit(name, "counter", v)
@@ -793,6 +806,11 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
     # (8 = int8 pools, else the compute dtype's width) and the
     # per-generated-token KV byte cost including the scale sidecar
     "kv_quant_bits", "kv_bytes_per_token",
+    # tensor-parallel tier: mesh degree (1 = single-device engine, so
+    # capacity dashboards never branch on key presence) and the
+    # per-shard slice of kv_bytes_per_token — each device's actual
+    # per-token KV residency under head sharding
+    "tp_degree", "tp_kv_bytes_per_token_per_shard",
 })
 
 REPLICA_POOL_STATS_KEYS = frozenset({

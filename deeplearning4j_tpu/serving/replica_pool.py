@@ -12,7 +12,10 @@ robustness ladders of PRs 1–4 built in:
   with the smallest queued+in-flight load (`ModelServer.pending()`),
   ties broken round-robin so equal replicas share evenly.
 - **health probing + passive eviction** — a daemon probe loop serves a
-  canary batch through every replica each `probe_interval`. A replica
+  canary batch through every replica each `probe_interval` (a
+  generation-only pool auto-arms a one-token generation canary from
+  its first served `generate` instead — see `_probe_generate`). A
+  replica
   is EVICTED (no new traffic) when its probe fails, its breaker is
   open, it hangs past `watchdog_timeout` (the probe runs under a
   watchdog — a wedged device step cannot wedge the probe loop), or
@@ -175,6 +178,7 @@ class ReplicaPool:
             _Replica(i, srv) for i, srv in enumerate(replicas)]
         self._probe_batch = None if probe_batch is None \
             else np.asarray(probe_batch)  # guarded by: _lock
+        self._probe_gen = None  # generation canary prompt; guarded by: _lock
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.watchdog_timeout = watchdog_timeout
@@ -765,6 +769,18 @@ class ReplicaPool:
             self._release()
         trace.finish("served")
         self.recorder.record(trace, "served", kind="generate")
+        # auto-arm the generation probe from the first served generate
+        # (the generation mirror of predict's probe_batch auto-arm): a
+        # generation-ONLY pool never arms a predict canary at any layer,
+        # so without this an evicted replica — e.g. one respawned by the
+        # supervisor after a crash — could never prove recovery; probes
+        # would stay inconclusive forever and the pool would sit in
+        # degraded mode until an operator intervened
+        if self._probe_gen is None:
+            armed = np.array(np.asarray(prompt_ids))
+            with self._lock:
+                if self._probe_gen is None:
+                    self._probe_gen = armed
         return out
 
     # -- health probing ----------------------------------------------------
@@ -782,6 +798,25 @@ class ReplicaPool:
                 return canary
         return None
 
+    def _probe_generate(self, rep: _Replica, prompt: np.ndarray,
+                        timeout: Optional[float]) -> Optional[bool]:
+        """Generation-canary probe: serve ONE greedy token through the
+        replica's full generate path (admission, engine, non-finite
+        screen — and, for a remote replica, the wire). Same
+        three-valued contract as `ModelServer.probe`: a load/time shed
+        is inconclusive, typed sickness is False, a served token is
+        True. Used when no predict canary exists anywhere — a
+        generation-only pool's replicas serve no predict traffic to
+        arm one."""
+        try:
+            rep.server.generate(prompt, 1, temperature=0.0, seed=0,
+                                timeout=timeout)
+        except (ServerOverloadedError, DeadlineExceededError):
+            return None  # load/time shed: not evidence of sickness
+        except ServingError:
+            return False
+        return True
+
     def _probe_async(self, rep: _Replica):
         """Start one probe on a helper thread; returns (event, verdict)
         where verdict[0] lands as True (healthy), False (sick — incl.
@@ -790,6 +825,8 @@ class ReplicaPool:
         verdict: List[Optional[bool]] = [False]
         done = threading.Event()
         batch = self._probe_input()
+        with self._lock:
+            gen_prompt = self._probe_gen if batch is None else None
 
         # a probe must ALWAYS carry a deadline: with timeout=None a
         # probe of a wedged replica would block its helper thread (and
@@ -801,8 +838,12 @@ class ReplicaPool:
 
         def run():
             try:
-                verdict[0] = rep.server.probe(batch,
-                                              timeout=probe_timeout)
+                if batch is None and gen_prompt is not None:
+                    verdict[0] = self._probe_generate(rep, gen_prompt,
+                                                      probe_timeout)
+                else:
+                    verdict[0] = rep.server.probe(batch,
+                                                  timeout=probe_timeout)
             # graftlint: disable=typed-error  probe worker: any failure
             # (hang, crash, typed shed) means one thing — unhealthy; the
             # verdict is the only channel out of this watchdog thread

@@ -89,17 +89,18 @@ class SpeculativeDecoder:
     def __init__(self, *, target_plan, target_net, draft_net, k: int,
                  n_slots: int, page: int, L_logical: int,
                  pool_pages: int, top_k: int, donate: bool,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 tp=None, tp_params=None):
         if k < 1:
             raise ValueError("speculative k must be >= 1")
         import jax
         import jax.numpy as jnp
-        from functools import partial
 
         from deeplearning4j_tpu.models.transformer import (
             GPTPlan,
             _block_ffn,
             _block_heads,
+            _block_out_proj,
             _prefill_block_attention,
             _top_k_filter,
         )
@@ -148,6 +149,33 @@ class SpeculativeDecoder:
                 f"draft max_length {dplan.emb.max_length} is shorter than "
                 f"the engine's logical cache ({L_logical}) — the draft "
                 "could not embed positions the target serves")
+        # tensor parallelism: the engine's TPPlan (target geometry) is
+        # shared for the verify step; the draft gets its OWN plan unless
+        # self-drafting (same net → reuse the engine's already-placed
+        # sharded params instead of device_put-ing them twice). A draft
+        # whose heads/FFN don't divide the degree fails HERE with the
+        # same typed ValueError the engine raises for the target.
+        self._tp = tp
+        if tp is not None:
+            if self.self_draft:
+                dtp = tp
+                self._dparams_sharded = tp_params
+            else:
+                from deeplearning4j_tpu.serving.tp_engine import TPPlan
+
+                dtp = TPPlan(draft_net, dplan, tp.degree)
+                self._dparams_sharded = dtp.shard_params(draft_net._params)
+        else:
+            dtp = None
+            self._dparams_sharded = None
+        self._dtp = dtp
+        tp_axis = tp.axis if tp is not None else None
+        tp_shard = tp.degree if tp is not None else None
+
+        def _shard_d(fn, n_in, n_out):
+            return fn if dtp is None else dtp.shard(
+                fn, n_in=n_in, n_out=n_out, caches_out_at=0)
+
         S, kk = n_slots, self.k
         C = kk + 1
 
@@ -159,7 +187,6 @@ class SpeculativeDecoder:
             return _top_k_filter(logits / safe_t, top_k)
 
         # -- draft prefill (one-shot + chunk): KV writes only, no head --
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def draft_prefill(dparams, dcaches, ids, wpids):
             bp = dplan.cast_blocks(dparams)
             P = ids.shape[1]
@@ -172,11 +199,11 @@ class SpeculativeDecoder:
             for bi, i in enumerate(dplan.block_is):
                 p = bp[i]
                 layer = dplan.layers[i]
-                q, kh, vh = _block_heads(layer, p, x, jnp.arange(P))
+                q, kh, vh = _block_heads(layer, p, x, jnp.arange(P),
+                                         shard=tp_shard)
                 att = _prefill_block_attention(layer, q, kh, vh)
-                d = x.shape[-1]
-                att = att.reshape(1, P, d) @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att.reshape(1, P, -1), tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 kcol = jnp.transpose(kh, (0, 2, 3, 1))
                 vrow = jnp.transpose(vh, (0, 2, 1, 3))
                 z0 = jnp.zeros((), jnp.int32)
@@ -196,7 +223,6 @@ class SpeculativeDecoder:
                     new_caches.append((kp_, vp_))
             return new_caches
 
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def draft_prefill_chunk(dparams, dcaches, page_row, ids, off, woff,
                                 wpids):
             bp = dplan.cast_blocks(dparams)
@@ -211,7 +237,7 @@ class SpeculativeDecoder:
             for bi, i in enumerate(dplan.block_is):
                 p = bp[i]
                 layer = dplan.layers[i]
-                q, kh, vh = _block_heads(layer, p, x, qpos)
+                q, kh, vh = _block_heads(layer, p, x, qpos, shard=tp_shard)
                 kcol = jnp.transpose(kh, (0, 2, 3, 1))
                 vrow = jnp.transpose(vh, (0, 2, 1, 3))
                 if kv_quant:
@@ -228,9 +254,8 @@ class SpeculativeDecoder:
                 att = paged_attention_chunk_auto(q, kp_, vp_,
                                                  page_row[None], off[None],
                                                  k_scale=ks_, v_scale=vs_)
-                d = x.shape[-1]
-                att = att.reshape(1, Cw, d) @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att.reshape(1, Cw, -1), tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
                                   else (kp_, vp_))
             return new_caches
@@ -239,7 +264,6 @@ class SpeculativeDecoder:
         # k proposals plus one cache-completion step, so the draft's KV
         # covers every position the NEXT round may start from (an
         # all-accepted verify advances the slot past the k-th write)
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def draft_propose(dparams, dcaches, page_table, tok, pos, dkeys,
                           temps, active, wlimit):
             bp = dplan.cast_blocks(dparams)
@@ -265,7 +289,7 @@ class SpeculativeDecoder:
                     p = bp[i]
                     layer = dplan.layers[i]
                     q, kh, vh = _block_heads(layer, p, x[:, None, :],
-                                             p_j[:, None])
+                                             p_j[:, None], shard=tp_shard)
                     q, kh, vh = q[:, 0], kh[:, 0], vh[:, 0]
                     if kv_quant:
                         kp_, vp_, ks_, vs_ = caches[bi]
@@ -285,8 +309,8 @@ class SpeculativeDecoder:
                                                     active,
                                                     k_scale=ks_,
                                                     v_scale=vs_)
-                    att = att @ p["Wo"] + p["bo"]
-                    x = _block_ffn(layer, p, x + att)
+                    att = _block_out_proj(p, att, tp_axis)
+                    x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                     new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
                                       else (kp_, vp_))
                 logits = dplan.final_logits(bp, dparams, x)
@@ -309,7 +333,6 @@ class SpeculativeDecoder:
             return caches, keys, props, qd
 
         # -- target verify: one (k+1)-wide chunk per slot -------------------
-        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def verify(params, caches, page_table, tok, pos, keys, temps,
                    active, wlimit, props, qdists):
             bp = tplan.cast_blocks(params)
@@ -325,7 +348,7 @@ class SpeculativeDecoder:
             for bi, i in enumerate(tplan.block_is):
                 p = bp[i]
                 layer = tplan.layers[i]
-                q, kh, vh = _block_heads(layer, p, x, qpos)
+                q, kh, vh = _block_heads(layer, p, x, qpos, shard=tp_shard)
                 if kv_quant:
                     kp_, vp_, ks_, vs_ = caches[bi]
                 else:
@@ -354,8 +377,8 @@ class SpeculativeDecoder:
                 att = paged_attention_chunk_auto(q, kp_, vp_, page_table,
                                                  pos, active,
                                                  k_scale=ks_, v_scale=vs_)
-                att = att @ p["Wo"] + p["bo"]
-                x = _block_ffn(layer, p, x + att)
+                att = _block_out_proj(p, att, tp_axis)
+                x = _block_ffn(layer, p, x + att, axis_name=tp_axis)
                 new_caches.append((kp_, vp_, ks_, vs_) if kv_quant
                                   else (kp_, vp_))
             logits = tplan.final_logits(bp, params, x)       # (S, C, V)
@@ -428,6 +451,18 @@ class SpeculativeDecoder:
             oks = jnp.where(active, row_ok, True)
             return new_caches, new_tok, new_pos, new_keys, out, n_emit, oks
 
+        # jit OUTSIDE shard_map (identity when tp is off) so pool
+        # donation aliases the sharded buffers; draft closures shard
+        # with the DRAFT plan's specs, verify with the target's
+        draft_prefill = jax.jit(_shard_d(draft_prefill, 4, 1),
+                                donate_argnums=(1,) if donate else ())
+        draft_prefill_chunk = jax.jit(_shard_d(draft_prefill_chunk, 7, 1),
+                                      donate_argnums=(1,) if donate else ())
+        draft_propose = jax.jit(_shard_d(draft_propose, 9, 4),
+                                donate_argnums=(1,) if donate else ())
+        verify = jax.jit(
+            verify if tp is None else tp.shard(verify, n_in=11, n_out=7),
+            donate_argnums=(1,) if donate else ())
         self._draft_prefill = draft_prefill
         self._draft_prefill_chunk = draft_prefill_chunk
         self._propose = draft_propose
@@ -461,11 +496,21 @@ class SpeculativeDecoder:
                 caches.append(
                     (jnp.zeros((P + 1, Hkv, hd, page), dplan.cdt),
                      jnp.zeros((P + 1, Hkv, page, hd), dplan.cdt)))
+        if self._dtp is not None:
+            # head axis over tp, mirroring the engine's pools — the
+            # shared page table addresses the same per-device head slice
+            # in both models' pools
+            caches = [tuple(self._dtp.shard_pool(x) for x in c)
+                      for c in caches]
         self._caches = caches
         self._keys = jnp.stack(
             [jax.random.PRNGKey(1000 + i) for i in range(S)])
 
     def _draft_params(self):
+        """The params list the compiled draft closures consume: the
+        permuted+placed shards under TP, the net's own list otherwise."""
+        if self._dparams_sharded is not None:
+            return self._dparams_sharded
         return self.draft_net._params
 
     def seed_slot(self, slot: int, seed: int) -> None:
@@ -487,7 +532,7 @@ class SpeculativeDecoder:
 
         with observability.annotation("draft-prefill"):
             self._caches = self._draft_prefill(
-                self.draft_net._params, self._caches, jnp.asarray(ids),
+                self._draft_params(), self._caches, jnp.asarray(ids),
                 wpids)
             jax.device_get(self._caches[0][0][0, 0, 0, 0])
         self.draft_prefills += 1
@@ -499,7 +544,7 @@ class SpeculativeDecoder:
 
         with observability.annotation("draft-prefill-chunk"):
             self._caches = self._draft_prefill_chunk(
-                self.draft_net._params, self._caches, page_row,
+                self._draft_params(), self._caches, page_row,
                 jnp.asarray(ids), jnp.asarray(off, jnp.int32),
                 jnp.asarray(woff, jnp.int32),
                 jnp.asarray(np.asarray(pids, np.int32)))

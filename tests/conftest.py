@@ -23,6 +23,19 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+@pytest.fixture(scope="session")
+def tp_mesh2():
+    """The serving tensor-parallel tp=2 mesh over the forced host
+    devices, built ONCE per session: `serving.tp_engine.tp_mesh` caches
+    per process, so every `tp`-marked test (and any engine built with
+    parallel={"tp": 2}) shares one mesh instead of re-paying mesh
+    construction + XLA device queries per test — the tier-1 wall-time
+    bound for the TP matrix."""
+    from deeplearning4j_tpu.serving.tp_engine import tp_mesh
+
+    return tp_mesh(2)
+
+
 @pytest.fixture(autouse=True)
 def _reap_replica_orphans():
     """Orphan-process hygiene for `multiprocess` drills: any replica

@@ -34,3 +34,30 @@ def guarded_branches(params, batch, fast):
 def undonated_arg(params, batch):
     _ = step(params, batch)
     return batch  # only argument 0 is donated
+
+
+# -- shard_map-wrapped jitted calls --------------------------------------
+from jax.experimental.shard_map import shard_map  # noqa: E402
+
+_MESH = None  # stand-in; the rule is static, nothing here runs
+
+sharded_step = shard_map(
+    jax.jit(lambda pools, x: (pools, x), donate_argnums=(0,)),
+    mesh=_MESH, in_specs=None, out_specs=None)
+
+
+def sharded_rebind_same_statement(pools, x):
+    # the TP engine idiom: donate the sharded pools and reassign them
+    # in the same tuple assignment
+    pools, y = sharded_step(pools, x)
+    return pools + y
+
+
+undonated_sharded = shard_map(
+    jax.jit(lambda pools, x: (pools, x)),
+    mesh=_MESH, in_specs=None, out_specs=None)
+
+
+def sharded_without_donation(pools, x):
+    _ = undonated_sharded(pools, x)
+    return pools  # nothing was donated — reading back is fine

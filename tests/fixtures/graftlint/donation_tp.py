@@ -31,3 +31,39 @@ class Engine:
     def tick(self, x):
         new_caches, y = self._step(self._caches, x)
         return jnp.sum(self._caches) + y  # TP: self._caches donated, not rebound
+
+
+# -- shard_map-wrapped jitted calls: donation must survive the wrap ----
+from jax.experimental.shard_map import shard_map  # noqa: E402
+
+_MESH = None  # stand-in; the rule is static, nothing here runs
+
+sharded_step = shard_map(
+    jax.jit(lambda pools, x: (pools, x), donate_argnums=(0,)),
+    mesh=_MESH, in_specs=None, out_specs=None)
+
+
+def read_after_sharded_donation(pools, x):
+    new_pools, y = sharded_step(pools, x)
+    return pools  # TP: donated through the shard_map-wrapped jit
+
+
+sharded_alias = shard_map(step, mesh=_MESH, in_specs=None, out_specs=None)
+
+
+def read_after_aliased_donation(params, batch):
+    out = sharded_alias(params, batch)
+    return params  # TP: `step`'s donation travels through shard_map
+
+
+class ShardedEngine:
+    def __init__(self):
+        self._step = jax.jit(
+            shard_map(lambda c, x: (c, x), mesh=_MESH,
+                      in_specs=None, out_specs=None),
+            donate_argnums=(0,))
+        self._caches = jnp.zeros((4,))
+
+    def tick(self, x):
+        new_caches, y = self._step(self._caches, x)
+        return jnp.sum(self._caches)  # TP: sharded pools donated, not rebound

@@ -33,7 +33,9 @@ FIXTURES = os.path.join("tests", "fixtures", "graftlint")
 
 # rule name -> (fixture stem, minimum TP findings the rule must produce)
 RULE_FIXTURES = {
-    "donation": ("donation", 3),
+    # 6: three plain forms + three shard_map-wrapped forms (the TP
+    # serving engine's jit(shard_map(...)) / shard_map(jit(...)) idioms)
+    "donation": ("donation", 6),
     "recompile": ("recompile", 6),
     "host-sync": ("host_sync", 5),
     "lock-order": ("lock_order", 1),

@@ -1,0 +1,489 @@
+"""Tensor-parallel decode (ISSUE 15 acceptance): ONE decode engine
+sharded Megatron-style over a tp=2 mesh of forced host devices must be
+**argmax-exact** against the single-device engine / whole-batch
+`generate` across the serving feature matrix — chunked prefill × prefix
+hits × speculative × GQA × int8 KV — because the sharded computation is
+the same math with one changed reduction (row-parallel partials summed
+by psum instead of one contraction).
+
+Also pinned here: typed ValueErrors at CONSTRUCTION for invalid tp
+configs (never a trace error), the stats/metrics schema (tp_degree +
+per-shard KV bytes, `{tp_rank}`-labelled gauges through the gateway
+metrics surface), the per-chip byte reduction behind the capacity
+claim, and a cross-process `RemoteReplicaPool` drill where each replica
+serves a tp=2 mesh (kill -9 + rolling reload, zero request loss).
+
+The conftest's session-scoped `tp_mesh2` fixture warms the cached mesh
+once; every engine here reuses it.
+"""
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (
+    GPTPlan,
+    generate,
+    gpt_configuration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    ModelServer,
+    observability,
+)
+from deeplearning4j_tpu.serving.tp_engine import TPPlan, tp_mesh
+
+VOCAB = 48
+
+pytestmark = pytest.mark.tp
+
+
+def _gpt_net(seed=12345, **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("max_length", 64)
+    net = MultiLayerNetwork(gpt_configuration(seed=seed, **kw))
+    net.init()
+    return net
+
+
+def _prompts(n, t0, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, (n, t0)).astype(np.int32)
+
+
+def _engine(net, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prompt_buckets", (8,))
+    kw.setdefault("parallel", {"tp": 2})
+    return DecodeEngine(net, **kw)
+
+
+def _run(eng, prompts, n):
+    reqs = [eng.submit(p, n) for p in prompts]
+    return [r.result(timeout=120.0) for r in reqs]
+
+
+@pytest.fixture(scope="module")
+def net(tp_mesh2):
+    return _gpt_net()
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_tp_parity_and_prefix_hits(net):
+    """Plain decode parity AND prefix-cache composition on one engine:
+    page management is head-agnostic under TP (the page table and
+    free list are host-global), so cached-page promotion and the
+    hit-path suffix prefill must reuse head-sharded pages exactly."""
+    prompts = _prompts(4, 20)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    eng = _engine(net, max_len=48, prefix_cache={}, page_size=8)
+    try:
+        for p, e in zip(prompts, expected):
+            np.testing.assert_array_equal(
+                eng.submit(p, 6).result(timeout=120.0), e)
+        # identical prompt again, sequentially: the promoted pages hit
+        np.testing.assert_array_equal(
+            eng.submit(prompts[0], 6).result(timeout=120.0), expected[0])
+        st = eng.stats()
+        assert st["tp_degree"] == 2
+        assert st["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_tp_parity_chunked_prefill_gqa_rope_swiglu(tp_mesh2):
+    """Chunked prefill × GQA × RoPE × swiglu in one cell: tp=2 divides
+    Hkv=2, per-shard GQA grouping is (H/2)/(Hkv/2) == H/Hkv, and the
+    chunk closure walks head-sharded pages."""
+    gnet = _gpt_net(n_heads=4, n_kv_heads=2, rope=True,
+                    ffn_activation="swiglu")
+    prompts = _prompts(2, 20, seed=1)
+    expected = generate(gnet, prompts, 6, temperature=0.0)
+    eng = _engine(gnet, max_len=48, prompt_buckets=(4,),
+                  prefill_chunk=8, page_size=8)
+    try:
+        outs = _run(eng, prompts, 6)
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+    for e, o in zip(expected, outs):
+        np.testing.assert_array_equal(e, o)
+    assert st["prefill_chunks"] > 0
+
+
+def test_tp_parity_int8_kv_matches_single_device_int8(net):
+    """int8 KV quantization is per-(head, position) — head-local — so
+    the sharded engine must be argmax-exact against the SINGLE-DEVICE
+    int8 engine (not the f32 oracle: int8 changes numerics)."""
+    prompts = _prompts(4, 5, seed=2)
+    ref_eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                           quantize={"kv": "int8"})
+    try:
+        ref = _run(ref_eng, prompts, 6)
+    finally:
+        ref_eng.shutdown()
+    eng = _engine(net, quantize={"kv": "int8"})
+    try:
+        outs = _run(eng, prompts, 6)
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+    for r, o in zip(ref, outs):
+        np.testing.assert_array_equal(r, o)
+    assert st["kv_quant_bits"] == 8
+    assert st["tp_kv_bytes_per_token_per_shard"] * 2 \
+        == st["kv_bytes_per_token"]
+
+
+def test_tp_parity_speculative_self_draft(net):
+    """Speculative verify under TP: the draft shares the engine's
+    sharded params (self-draft), both models' head-sharded pools ride
+    one page table, and greedy emission stays argmax-exact."""
+    prompts = _prompts(4, 5, seed=3)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    eng = _engine(net, speculative={"draft": "self", "k": 2})
+    try:
+        outs = _run(eng, prompts, 6)
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+    for e, o in zip(expected, outs):
+        np.testing.assert_array_equal(e, o)
+    assert st["spec_accept_rate"] > 0
+
+
+def test_tp_parity_speculative_separate_draft(net):
+    """A separate draft net gets its OWN TPPlan (draft geometry
+    validated independently, draft params permuted+placed once) — and a
+    garbage draft only costs acceptance rate, never parity."""
+    draft = _gpt_net(seed=777, n_layers=1)
+    prompts = _prompts(4, 5, seed=4)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    eng = _engine(net, speculative={"draft": draft, "k": 2})
+    try:
+        outs = _run(eng, prompts, 6)
+    finally:
+        eng.shutdown()
+    for e, o in zip(expected, outs):
+        np.testing.assert_array_equal(e, o)
+
+
+# ------------------------------------------- construction-time errors
+
+
+def test_invalid_tp_configs_raise_typed_errors_at_construction(net):
+    """Every bad parallel= config must fail as a ValueError naming the
+    problem BEFORE any tracing starts — a trace-time shape error names
+    an einsum, not the user's mistake."""
+    cases = [
+        ({"tp": 4}, "must divide the head counts"),   # n_heads=2
+        ({"pp": 2}, "unknown parallel keys"),
+        ({"tp": "2"}, "must be a positive int"),
+        ({"tp": 0}, "must be a positive int"),
+        ({"tp": 16}, "needs 16 devices"),
+    ]
+    for cfg, frag in cases:
+        with pytest.raises(ValueError, match=frag):
+            DecodeEngine(net, n_slots=2, max_len=32,
+                         prompt_buckets=(8,), parallel=cfg)
+    with pytest.raises(ValueError, match="must be a dict"):
+        DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,),
+                     parallel=7)
+
+
+def test_tp_rejects_gqa_heads_moe_and_ffn_width(tp_mesh2):
+    # GQA: tp must divide Hkv, not just H
+    g = _gpt_net(n_heads=4, n_kv_heads=2)
+    with pytest.raises(ValueError, match="must divide the head counts"):
+        DecodeEngine(g, n_slots=2, max_len=32, prompt_buckets=(8,),
+                     parallel={"tp": 4})
+    # MoE: expert parallelism is its own axis
+    moe = _gpt_net(moe_experts=4)
+    with pytest.raises(ValueError, match="does not compose with MoE"):
+        DecodeEngine(moe, n_slots=2, max_len=32, prompt_buckets=(8,),
+                     parallel={"tp": 2})
+    # FFN width: unreachable via gpt_configuration (width is a
+    # head-count multiple there) but TPPlan must still catch a custom
+    # net whose FFN width doesn't divide
+    import jax.numpy as jnp
+
+    odd = _gpt_net()
+    plan = GPTPlan(odd)
+    i = plan.block_is[0]
+    odd._params[i]["W1"] = jnp.zeros((32, 97), jnp.float32)
+    with pytest.raises(ValueError, match="must divide the FFN width"):
+        TPPlan(odd, plan, 2)
+
+
+# ------------------------------------------------ stats/metrics schema
+
+
+def test_tp_stats_keys_are_schema_pinned(net):
+    """The tp keys are part of the DECODE_ENGINE contract frozenset and
+    land UNCONDITIONALLY (degree 1 when off) so capacity dashboards
+    never branch on key presence."""
+    assert {"tp_degree", "tp_kv_bytes_per_token_per_shard"} \
+        <= observability.DECODE_ENGINE_STATS_KEYS
+    eng = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,))
+    try:
+        st = eng.stats()
+        assert st["tp_degree"] == 1
+        assert st["tp_kv_bytes_per_token_per_shard"] \
+            == st["kv_bytes_per_token"]
+        assert observability.DECODE_ENGINE_STATS_KEYS <= set(st)
+    finally:
+        eng.shutdown()
+
+
+def test_tp_rank_labelled_gauges_through_gateway_metrics(net):
+    """Per-shard gauges carry a `{tp_rank}` label merged with the
+    gateway's `{model}` label on the one scrape surface — exercised
+    through the real gateway `metrics` RPC path (EntryPoint in-process;
+    the multiprocess drill covers the wire)."""
+    from deeplearning4j_tpu.gateway import EntryPoint
+
+    conf = gpt_configuration(seed=12345, vocab_size=VOCAB, d_model=32,
+                             n_heads=2, n_layers=2, max_length=64)
+    ep = EntryPoint(serving={
+        "generation": {"n_slots": 2, "max_len": 32,
+                       "prompt_buckets": (8,)},
+        "parallel": {"tp": 2}})
+    try:
+        ep.create_model("m", conf.to_json())
+        prompt = _prompts(1, 5)[0]
+        out = np.asarray(ep.generate("m", prompt, 6, temperature=0.0,
+                                     seed=0))
+        expected = generate(net, prompt[None], 6, temperature=0.0)
+        np.testing.assert_array_equal(out, expected[0])
+        text = ep.metrics("m")
+    finally:
+        ep.shutdown()
+    lines = [ln for ln in text.splitlines()
+             if "tp_shard_kv_bytes_per_token" in ln
+             and not ln.startswith("#")]
+    assert len(lines) == 2, text
+    for rank, ln in enumerate(sorted(lines)):
+        assert f'tp_rank="{rank}"' in ln
+        assert 'model="m"' in ln
+    assert "stats_decode_engine_tp_degree" in text
+
+
+# -------------------------------------------------- capacity accounting
+
+
+def test_tp_halves_sharded_bytes_per_chip(net):
+    """The capacity claim behind `tp_max_model_bytes_per_chip`: block
+    matmuls and the pools' head axis divide by the degree exactly;
+    only replicated tensors (embeddings, LNs, biases, logits head)
+    don't. Per-chip total must drop strictly, and the SHARDED portion
+    by exactly 1/2."""
+    single = DecodeEngine(net, n_slots=2, max_len=32, prompt_buckets=(8,))
+    try:
+        b1 = single.model_bytes_per_chip()
+        pool1 = sum(x.nbytes for c in single._caches for x in c)
+    finally:
+        single.shutdown()
+    eng = _engine(net)
+    try:
+        b2 = eng.model_bytes_per_chip()
+        tp = eng._tp
+        wpc = tp.weight_bytes_per_chip(net._params)
+    finally:
+        eng.shutdown()
+    assert b2 < b1
+    # pool bytes divide exactly: the head axis is even by validation
+    assert b2 - wpc == pool1 // 2
+    # every sharded block key contributes exactly nbytes/2
+    from jax.sharding import PartitionSpec as P
+
+    plan = GPTPlan(net)
+    full = sharded = 0
+    for i in plan.block_is:
+        for k, v in net._params[i].items():
+            full += v.nbytes
+            if tp.param_specs[i][k] != P():
+                sharded += v.nbytes
+    assert sharded > 0
+    repl_blocks = full - sharded
+    total_repl = b1 - pool1 - sharded  # embeddings/LNs/head + repl keys
+    assert wpc == total_repl + sharded // 2, \
+        (wpc, total_repl, sharded, repl_blocks)
+
+
+# ----------------------------------------------- server/pool composition
+
+
+def test_model_server_routes_parallel_to_engine(net):
+    srv = ModelServer(net, generation={"n_slots": 2, "max_len": 32,
+                                       "prompt_buckets": (8,)},
+                      parallel={"tp": 2})
+    try:
+        prompt = _prompts(1, 5)[0]
+        expected = generate(net, prompt[None], 6, temperature=0.0)
+        np.testing.assert_array_equal(
+            srv.generate(prompt, 6, temperature=0.0, seed=0), expected[0])
+        assert srv._engine.stats()["tp_degree"] == 2
+    finally:
+        srv.shutdown()
+    with pytest.raises(ValueError, match="must be a dict"):
+        ModelServer(net, parallel=[2])
+
+
+# ------------------------------------------------- cross-process drill
+
+
+WEDGE_GUARD_S = 480  # replica processes pay jax import + TP compile
+
+
+@pytest.fixture
+def _wedge_guard():
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"tp multiprocess drill exceeded the {WEDGE_GUARD_S} s "
+            "wedge guard — a spawn/drill path is stuck")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WEDGE_GUARD_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+class _GenTraffic:
+    """Live generate() load: every exception is a failed request — the
+    drill asserts the list stays EMPTY through kill -9 and the rolling
+    reload. Seeded greedy decode makes failover re-sends idempotent."""
+
+    def __init__(self, pool, prompt, n_tokens=4, period=0.2):
+        self._pool, self._prompt, self._n = pool, prompt, n_tokens
+        self._period = period
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self.served = 0
+        self.failures = []
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self._pool.generate(self._prompt, self._n,
+                                    temperature=0.0, seed=0,
+                                    timeout=60.0)
+                self.served += 1
+            except Exception as e:  # noqa: BLE001 - drill bookkeeping
+                self.failures.append(repr(e))
+            self._stop.wait(self._period)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+
+
+def _await(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+def test_remote_pool_of_tp2_replicas_kill9_and_rolling_reload(
+        tmp_path, _wedge_guard, tp_mesh2):
+    """ISSUE 15 composition bar: a cross-process pool where EACH
+    replica is a tp=2 sharded engine (the replica subprocesses inherit
+    the forced-host-device XLA flags from this process's environment)
+    survives kill -9 failover AND a rolling reload under live generate
+    traffic with zero failed requests — PR 14's pool drills, now with
+    tensor-parallel replicas."""
+    from deeplearning4j_tpu.serving import spawn_replica_pool
+    from deeplearning4j_tpu.util.checkpoint_store import CheckpointStore
+    from deeplearning4j_tpu.util.serialization import write_model
+
+    gnet = _gpt_net(n_layers=1, max_length=24)
+    prompt = _prompts(1, 5)[0]
+    expected = generate(gnet, prompt[None], 4, temperature=0.0)[0]
+    pool = spawn_replica_pool(
+        gnet, 2, scratch_dir=tmp_path,
+        server_kwargs={"generation": {"n_slots": 2, "max_len": 24,
+                                      "prompt_buckets": (8,),
+                                      "page_size": 8},
+                       "parallel": {"tp": 2}},
+        pool_kwargs=dict(probe_interval=0.5, probe_timeout=15.0,
+                         watchdog_timeout=15.0, evict_threshold=2,
+                         readmit_successes=2, max_failovers=3),
+        # tp=2 needs exactly 2 devices: the parent's 8-way forced mesh
+        # would give EACH replica 8 virtual devices' worth of XLA
+        # thread pools — on a 1-core CI box three such processes starve
+        # each other and a respawned replica can miss its re-admission
+        # window just paging XLA in
+        supervisor_kwargs=dict(
+            restart_backoff=0.25, poll_interval=0.1,
+            spawn_timeout=240.0,
+            env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}),
+        rpc_timeout=120.0)
+    sup = pool.supervisor
+    try:
+        np.testing.assert_array_equal(
+            pool.generate(prompt, 4, temperature=0.0, seed=0,
+                          timeout=120.0), expected)
+        with _GenTraffic(pool, prompt) as traffic:
+            _await(lambda: traffic.served >= 3, 60.0, "traffic warmup")
+            sup.kill(1)  # SIGKILL: the hard-crash drill
+            # the pool must first NOTICE the death (a probe cycle) —
+            # waiting for "healthy" straight away would pass against
+            # the stale pre-kill state and race the reload below into
+            # a dead replica
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            != "healthy"),
+                   60.0, "eviction of the killed replica")
+            _await(lambda: sup.respawns >= 1 and sup.is_alive(1),
+                   120.0, "supervisor respawn of replica 1")
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            == "healthy"),
+                   180.0, "re-admission of the respawned tp replica")
+            before = traffic.served
+            _await(lambda: traffic.served >= before + 3, 60.0,
+                   "post-kill traffic")
+            # rolling reload to swapped weights, still under traffic
+            store_dir = tmp_path / "store"
+            store_dir.mkdir()
+            store = CheckpointStore(store_dir)
+            candidate = _gpt_net(seed=999, n_layers=1, max_length=24)
+            store.save(1, lambda p: write_model(candidate, p,
+                                                atomic=False))
+            pool.rolling_reload(store, step=1, drain_timeout=60.0)
+            before = traffic.served
+            _await(lambda: traffic.served >= before + 3, 60.0,
+                   "post-reload traffic")
+        assert traffic.failures == [], \
+            f"requests failed during the tp drill: {traffic.failures}"
+        # every replica now serves the candidate, still sharded
+        got = pool.generate(prompt, 4, temperature=0.0, seed=0,
+                            timeout=120.0)
+        np.testing.assert_array_equal(
+            got, generate(candidate, prompt[None], 4,
+                          temperature=0.0)[0])
+        assert pool.stats()["rolling_reloads"] == 1
+    finally:
+        pool.shutdown(drain_timeout=5.0)

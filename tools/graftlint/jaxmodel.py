@@ -7,7 +7,14 @@ Resolves the three jit-wrapping forms this codebase uses —
   * ``name = jax.jit(fn, ...)`` local/module assignments;
   * ``self.attr = <jitted local>`` — the decode engine builds jitted
     closures in ``_build`` and stores them on the instance, then calls
-    them from the scheduler methods.
+    them from the scheduler methods;
+  * ``shard_map``-wrapped forms — ``name = shard_map(jax.jit(f,
+    donate_argnums=...), ...)`` and ``name = shard_map(<jitted
+    local>, ...)``: the tensor-parallel serving plan wraps jitted step
+    closures in ``shard_map``, and a donated sharded pool read after
+    the call is the same TPU corruption hazard, so donation info must
+    survive the wrapping. (``jax.jit(shard_map(f), donate_argnums=...)``
+    — the engine's own idiom — already parses as a plain jit assign.)
 
 Static, donated and jitted-ness travel with the name so call-site rules
 (donation, recompile) can reason about ``self._decode_step(...)``.
@@ -76,12 +83,20 @@ class JaxNames:
     def __init__(self, tree: ast.Module):
         self.jit = {"jax.jit"}
         self.partial = {"functools.partial"}
+        self.shard_map = {"jax.shard_map",
+                          "jax.experimental.shard_map.shard_map"}
         for node in ast.walk(tree):
             if isinstance(node, ast.ImportFrom):
                 if node.module == "jax":
                     for a in node.names:
                         if a.name == "jit":
                             self.jit.add(a.asname or a.name)
+                        if a.name == "shard_map":
+                            self.shard_map.add(a.asname or a.name)
+                if node.module == "jax.experimental.shard_map":
+                    for a in node.names:
+                        if a.name == "shard_map":
+                            self.shard_map.add(a.asname or a.name)
                 if node.module == "functools":
                     for a in node.names:
                         if a.name == "partial":
@@ -148,6 +163,7 @@ def collect_jits(tree: ast.Module, names: JaxNames) -> ModuleJits:
                     jits.by_name[node.name] = info
         elif isinstance(node, ast.Assign) and isinstance(node.value,
                                                          ast.Call):
+            info = None
             kws = names.jit_call_kwargs(node.value)
             if kws is not None and node.value.args:
                 info = info_from_kwargs(kws, node.value)
@@ -157,6 +173,33 @@ def collect_jits(tree: ast.Module, names: JaxNames) -> ModuleJits:
                     existing = jits.by_name.get(inner.id)
                     if existing is not None and existing.def_node is not None:
                         info.def_node = existing.def_node
+            elif dotted(node.value.func) in names.shard_map:
+                # ``x = shard_map(jax.jit(f, donate_argnums=...), ...)``
+                # and ``x = shard_map(<known jitted name>, ...)``: the
+                # wrapper changes how buffers shard, not whether they
+                # were donated — the info must survive the wrapping
+                inner = node.value.args[0] if node.value.args else next(
+                    (kw.value for kw in node.value.keywords
+                     if kw.arg == "f"), None)
+                if isinstance(inner, ast.Call):
+                    ikws = names.jit_call_kwargs(inner)
+                    if ikws is not None and inner.args:
+                        info = info_from_kwargs(ikws, node.value)
+                        wrapped = inner.args[0]
+                        if isinstance(wrapped, ast.Name):
+                            existing = jits.by_name.get(wrapped.id)
+                            if existing is not None \
+                                    and existing.def_node is not None:
+                                info.def_node = existing.def_node
+                elif isinstance(inner, ast.Name):
+                    existing = jits.by_name.get(inner.id)
+                    if existing is not None:
+                        info = JitInfo(donate=existing.donate,
+                                       static_nums=existing.static_nums,
+                                       static_names=existing.static_names,
+                                       def_node=existing.def_node,
+                                       site=node.value)
+            if info is not None:
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Name):
                         jits.by_name[tgt.id] = info
