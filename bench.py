@@ -1769,6 +1769,260 @@ def bench_serve_generate():
             spread)
 
 
+_SERVE_QOS_SHAPE = {
+    "vocab": 256, "d_model": 128, "n_heads": 4, "n_layers": 2,
+    "prompt_len": 16, "n_tokens": 8, "n_interactive": 24,
+    "mean_interarrival": 0.01, "n_slots": 4, "page_size": 16,
+    "flood_rate": 60.0, "flood_burst": 24.0, "flood_concurrency": 2,
+    # diurnal predict replay (part B): threads per phase and per-phase
+    # request budget for the closed-loop drive
+    "low_threads": 1, "peak_threads": 8, "reqs_per_thread": 40,
+    "replica_max_queue": 8, "slow_step": 0.02,
+}
+
+
+def bench_serve_qos():
+    """The adaptive control plane priced end to end (ISSUE 16), two
+    drills in one config:
+
+    **Cross-tenant isolation** — one `DecodeEngine` with a quota'd
+    batch tenant (`TenantFloodInjector` hammering it) while an
+    interactive tenant runs the same Poisson traffic it ran unloaded.
+    Committed lines: `cross_tenant_isolation` (interactive p99 flooded
+    over unloaded — the ≤ 2 acceptance ratio), the flooder's quota
+    rejections (its OWN typed wall, proving the flood never converts
+    into everyone's `ServerOverloadedError`), and
+    `batch_lane_utilization_pct` — the batch lane's share of tokens
+    while isolation holds (quota'd, not starved to zero). The headline
+    is the interactive lane's goodput UNDER flood.
+
+    **Autoscale vs static** — a diurnal closed-loop predict replay
+    (low → peak → low offered load) against a 1-replica `ReplicaPool`,
+    static vs the same pool under an `Autoscaler` (spawned replicas
+    enter through the probe ladder). Committed lines:
+    `autoscale_p99_vs_static` (static peak p99 over autoscaled —
+    > 1 = elasticity bought tail latency), `scale_up_reaction_ms`
+    (peak onset → replica added), `autoscale_events`, and
+    `autoscale_failed_requests` (the zero-failed-requests drain
+    discipline, measured not asserted)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.serving import (
+        Autoscaler,
+        ModelServer,
+        ReplicaPool,
+        ServingError,
+        SlowInferenceInjector,
+        TenantFloodInjector,
+    )
+    from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+
+    shp = _SERVE_QOS_SHAPE
+    rng = np.random.default_rng(0)
+
+    # -- part A: tenant isolation on one decode engine -------------------
+    max_len = shp["prompt_len"] + shp["n_tokens"] + 8
+    gen_net = MultiLayerNetwork(
+        gpt_configuration(vocab_size=shp["vocab"], d_model=shp["d_model"],
+                          n_heads=shp["n_heads"], n_layers=shp["n_layers"],
+                          max_length=max_len),
+        compute_dtype=jnp.bfloat16)
+    gen_net.init()
+    prompts = [rng.integers(0, shp["vocab"],
+                            shp["prompt_len"]).astype(np.int32)
+               for _ in range(shp["n_interactive"])]
+    arrivals = np.cumsum(rng.exponential(shp["mean_interarrival"],
+                                         shp["n_interactive"]))
+    engine = DecodeEngine(
+        gen_net, n_slots=shp["n_slots"], max_len=max_len,
+        page_size=shp["page_size"], prompt_buckets=(shp["prompt_len"],),
+        max_queue=256, max_queued_pages=10 ** 9,
+        qos={"tenants": {"flood": {"rate": shp["flood_rate"],
+                                   "burst": shp["flood_burst"]}},
+             "preempt": True, "slo_shed": True})
+
+    def interactive_pass():
+        t_start = time.monotonic()
+        reqs = []
+        for i, p in enumerate(prompts):
+            lag = t_start + arrivals[i] - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            reqs.append(engine.submit(p, shp["n_tokens"], timeout=120.0,
+                                      tenant="user",
+                                      priority="interactive"))
+        toks = sum(len(r.result(timeout=120.0)) for r in reqs)
+        dt = time.monotonic() - t_start
+        lats = [r.completed_at - (t_start + arrivals[i])
+                for i, r in enumerate(reqs)]
+        return toks / dt, lats
+
+    def p99(lats):
+        return float(np.percentile(np.asarray(lats), 99))
+
+    try:
+        interactive_pass()  # compile
+        _, base_lats = interactive_pass()
+        pre = engine.stats()
+        flood = TenantFloodInjector(
+            engine, tenant="flood",
+            prompt=prompts[0], n_tokens=shp["n_tokens"],
+            concurrency=shp["flood_concurrency"]).start()
+        try:
+            goodput, flood_lats = interactive_pass()
+        finally:
+            flood.release()
+        post = engine.stats()
+    finally:
+        engine.shutdown(drain_timeout=30.0)
+    fc = flood.counters()
+    flood_tokens = (post["tenants"]["flood"]["tokens_generated"]
+                    - pre["tenants"].get("flood", {}).get(
+                        "tokens_generated", 0))
+    total_tokens = post["tokens_generated"] - pre["tokens_generated"]
+    bench_serve_qos.cross_tenant_isolation = round(
+        p99(flood_lats) / max(1e-9, p99(base_lats)), 3)
+    bench_serve_qos.flood_quota_rejections = fc["quota_rejections"]
+    bench_serve_qos.flood_other_errors = fc["other_errors"]
+    bench_serve_qos.batch_lane_utilization_pct = round(
+        100.0 * flood_tokens / max(1, total_tokens), 1)
+    bench_serve_qos.interactive_flooded_latency_ms = {
+        "p50": round(1e3 * float(np.percentile(flood_lats, 50)), 2),
+        "p99": round(1e3 * p99(flood_lats), 2)}
+    bench_serve_qos.preemptions = post["preemptions"]
+
+    # -- part B: diurnal replay, static vs autoscaled pool ---------------
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01)
+            .list()
+            .layer(DenseLayer(n_out=256, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(128))
+            .build())
+    mlp = MultiLayerNetwork(conf)
+    mlp.init()
+    x = rng.standard_normal((4, 128)).astype(np.float32)
+    # the slow step makes one replica saturable on any host, so the
+    # diurnal swell is a real overload and not a CPU-speed lottery
+    server_kw = dict(max_queue=shp["replica_max_queue"],
+                     max_batch_size=8, batch_window=0.001,
+                     infer_hooks=[SlowInferenceInjector(shp["slow_step"])])
+    lock = threading.Lock()
+
+    def drive(pool, n_threads, latencies=None, failures=None):
+        def client():
+            mine = []
+            for _ in range(shp["reqs_per_thread"]):
+                t0 = time.perf_counter()
+                try:
+                    pool.predict(x, timeout=60.0)
+                    mine.append(time.perf_counter() - t0)
+                except ServingError:
+                    if failures is not None:
+                        with lock:
+                            failures.append(1)
+            if latencies is not None:
+                with lock:
+                    latencies.extend(mine)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def diurnal(pool, scaler=None):
+        """low → peak → low; returns (peak latencies, failures,
+        scale-up reaction seconds or None)."""
+        lats, fails = [], []
+        reaction = None
+        drive(pool, shp["low_threads"], failures=fails)
+        t_peak = time.perf_counter()
+        watcher_stop = threading.Event()
+
+        def watch():
+            nonlocal reaction
+            while not watcher_stop.is_set():
+                if pool.stats()["replicas_added"] > 0:
+                    reaction = time.perf_counter() - t_peak
+                    return
+                time.sleep(0.005)
+
+        w = None
+        if scaler is not None:
+            w = threading.Thread(target=watch)
+            w.start()
+        drive(pool, shp["peak_threads"], latencies=lats, failures=fails)
+        drive(pool, shp["peak_threads"], latencies=lats, failures=fails)
+        watcher_stop.set()
+        if w is not None:
+            w.join()
+        drive(pool, shp["low_threads"], failures=fails)
+        if scaler is not None:
+            # give the low phase time to register and drain a replica
+            deadline = time.perf_counter() + 10.0
+            while (time.perf_counter() < deadline
+                   and scaler.stats()["scale_downs"] == 0):
+                time.sleep(0.05)
+        return lats, fails, reaction
+
+    static = ReplicaPool.from_net(mlp, 1, server_kwargs=server_kw,
+                                  probe_batch=x, probe_interval=0.1)
+    try:
+        static.predict(x)  # compile
+        static_lats, static_fails, _ = diurnal(static)
+    finally:
+        static.shutdown(drain_timeout=10.0)
+
+    auto_pool = ReplicaPool.from_net(mlp, 1, server_kwargs=server_kw,
+                                     probe_batch=x, probe_interval=0.05)
+    scaler = Autoscaler(
+        auto_pool, min_replicas=1, max_replicas=3, interval=0.05,
+        alpha=0.5, high_watermark=0.6, low_watermark=0.2, hysteresis=2,
+        cooldown=0.5, drain_timeout=10.0,
+        spawn=lambda: ModelServer(mlp.clone(), **server_kw)).start()
+    try:
+        auto_pool.predict(x)  # compile
+        auto_lats, auto_fails, reaction = diurnal(auto_pool, scaler)
+        sc_stats = scaler.stats()
+    finally:
+        scaler.stop()
+        auto_pool.shutdown(drain_timeout=10.0)
+
+    static_p99 = p99(static_lats)
+    auto_p99 = p99(auto_lats)
+    bench_serve_qos.autoscale_p99_vs_static = round(
+        static_p99 / max(1e-9, auto_p99), 3)
+    bench_serve_qos.scale_up_reaction_ms = (
+        None if reaction is None else round(1e3 * reaction, 1))
+    bench_serve_qos.autoscale_events = sc_stats["autoscale_events"]
+    bench_serve_qos.autoscale_failed_requests = len(auto_fails)
+    bench_serve_qos.static_failed_requests = len(static_fails)
+    bench_serve_qos.static_peak_latency_ms = {
+        "p50": round(1e3 * float(np.percentile(static_lats, 50)), 2),
+        "p99": round(1e3 * static_p99, 2)}
+    bench_serve_qos.autoscaled_peak_latency_ms = {
+        "p50": round(1e3 * float(np.percentile(auto_lats, 50)), 2),
+        "p99": round(1e3 * auto_p99, 2)}
+
+    return ("serve_qos_interactive_flooded_tokens_per_sec", goodput,
+            None, 1.0)
+
+
 _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "lstm": bench_lstm, "lstm_large": bench_lstm_large,
             "gpt": bench_gpt,
@@ -1780,7 +2034,8 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "sentinel": bench_sentinel,
             "serving": bench_serving,
             "serve_pool": bench_serve_pool,
-            "serve_generate": bench_serve_generate}
+            "serve_generate": bench_serve_generate,
+            "serve_qos": bench_serve_qos}
 
 
 def _unit(metric: str) -> str:
@@ -1907,6 +2162,23 @@ def main() -> None:
                 ("tp_device_ms_per_token", "tp_device_ms_per_token"),
                 ("tp_kv_bytes_per_token_per_shard",
                  "tp_kv_bytes_per_token_per_shard"),
+                ("cross_tenant_isolation", "cross_tenant_isolation"),
+                ("flood_quota_rejections", "flood_quota_rejections"),
+                ("flood_other_errors", "flood_other_errors"),
+                ("batch_lane_utilization_pct",
+                 "batch_lane_utilization_pct"),
+                ("interactive_flooded_latency_ms",
+                 "interactive_flooded_latency_ms"),
+                ("preemptions", "preemptions"),
+                ("autoscale_p99_vs_static", "autoscale_p99_vs_static"),
+                ("scale_up_reaction_ms", "scale_up_reaction_ms"),
+                ("autoscale_events", "autoscale_events"),
+                ("autoscale_failed_requests",
+                 "autoscale_failed_requests"),
+                ("static_failed_requests", "static_failed_requests"),
+                ("static_peak_latency_ms", "static_peak_latency_ms"),
+                ("autoscaled_peak_latency_ms",
+                 "autoscaled_peak_latency_ms"),
                 ("single_model_bytes_per_chip",
                  "single_model_bytes_per_chip"),
                 ("tp_max_model_bytes_per_chip",

@@ -229,6 +229,7 @@ class EntryPoint:
                 f"{raw_replicas!r}")
         pool_cfg = cfg.pop("pool", {}) or {}
         remote_cfg = cfg.pop("remote", None)
+        autoscale_cfg = cfg.pop("autoscale", None)
         if pool_cfg and n_replicas == 1:
             # fail at construction, not silently un-replicated: pool
             # kwargs without replicas almost certainly means a typo'd
@@ -249,15 +250,43 @@ class EntryPoint:
                 **cfg, **(remote_kw.get("server_kwargs") or {})}
             remote_kw["pool_kwargs"] = {
                 **pool_cfg, **(remote_kw.get("pool_kwargs") or {})}
-            return spawn_replica_pool(net, n_replicas, **remote_kw)
+            pool = spawn_replica_pool(net, n_replicas, **remote_kw)
+            return self._maybe_autoscale(pool, autoscale_cfg)
         if n_replicas > 1:
-            from deeplearning4j_tpu.serving import ReplicaPool
+            from deeplearning4j_tpu.serving import ReplicaPool, ModelServer
 
-            return ReplicaPool.from_net(net, n_replicas,
+            pool = ReplicaPool.from_net(net, n_replicas,
                                         server_kwargs=cfg, **pool_cfg)
+            # scale-up on the in-process path clones the served net into
+            # a fresh ModelServer (the same recipe from_net used)
+            spawn = lambda: ModelServer(net.clone(), **cfg)  # noqa: E731
+            return self._maybe_autoscale(pool, autoscale_cfg, spawn=spawn)
+        if autoscale_cfg:
+            raise ValueError(
+                "serving config has 'autoscale' but 'replicas' is "
+                f"{raw_replicas!r} — the autoscaler drives a ReplicaPool; "
+                "set 'replicas' > 1 (or 'remote')")
         from deeplearning4j_tpu.serving import ModelServer
 
         return ModelServer(net, **cfg)
+
+    @staticmethod
+    def _maybe_autoscale(pool, autoscale_cfg, spawn=None):
+        """Attach a started `Autoscaler` to `pool` when the serving
+        config carries `"autoscale"` (True for defaults, or a dict of
+        Autoscaler kwargs). The scaler rides on the pool as
+        `pool.autoscaler` so `shutdown`/stats RPCs can find it."""
+        if not autoscale_cfg:
+            return pool
+        from deeplearning4j_tpu.serving.autoscaler import Autoscaler
+
+        scale_kw = {} if autoscale_cfg is True else dict(autoscale_cfg)
+        if spawn is not None and "spawn" not in scale_kw:
+            scale_kw["spawn"] = spawn
+        scaler = Autoscaler(pool, **scale_kw)
+        scaler.start()
+        pool.autoscaler = scaler
+        return pool
 
     def _model(self, name: str):
         if name not in self._models:
@@ -333,18 +362,23 @@ class EntryPoint:
 
     def generate(self, name: str, prompt_ids, n_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive") -> np.ndarray:
         """Autoregressive generation for a `gpt_configuration` model
         through the serving tier's continuous-batching decode engine —
         concurrent gateway callers share the slot pool, so no request
         waits on another's tail. Requires `serving={..., "generation":
         {...}}` (DecodeEngine kwargs, or True for defaults). Typed shed
         errors (`ServerOverloadedError` + retry_after, ...) surface in
-        the error payload like `predict`'s."""
+        the error payload like `predict`'s. `tenant` and `priority`
+        ("interactive" | "batch") feed the engine's multi-tenant QoS
+        doors when a `"qos"` generation config is present."""
         srv = self._server(name)
         return srv.generate(np.asarray(prompt_ids), int(n_tokens),
                             temperature=float(temperature),
-                            seed=int(seed), timeout=timeout)
+                            seed=int(seed), timeout=timeout,
+                            tenant=tenant, priority=priority)
 
     # -- serving management ----------------------------------------------
     @staticmethod
@@ -414,6 +448,29 @@ class EntryPoint:
                 "server_stats instead")
         return srv.stats()
 
+    def set_tenant_quota(self, name: str, tenant: str,
+                         rate: Optional[float] = None,
+                         burst: Optional[float] = None) -> bool:
+        """Install (or update) tenant `tenant`'s token-rate quota on
+        model `name`'s decode engine — `rate` tokens/second refill,
+        `burst` bucket depth. On a pool this fans out to every replica
+        so failover cannot launder a flooding tenant past its quota."""
+        self._server(name).set_tenant_quota(tenant, rate=rate, burst=burst)
+        return True
+
+    def autoscaler_stats(self, name: str) -> dict:
+        """The autoscaler's decision counters and live pressure signal
+        for model `name` (requires serving={'replicas': N, 'autoscale':
+        ...})."""
+        srv = self._server(name)
+        scaler = getattr(srv, "autoscaler", None)
+        if scaler is None:
+            from deeplearning4j_tpu.serving import ServingError
+            raise ServingError(
+                f"model {name!r} has no autoscaler — enable it with "
+                "serving={'replicas': N, 'autoscale': {...}}")
+        return scaler.stats()
+
     def metrics(self, name: Optional[str] = None) -> str:
         """Prometheus-style text exposition of the serving tier's
         metrics registry — one model's (by `name`) or every served
@@ -439,6 +496,11 @@ class EntryPoint:
         """Drain and stop every ModelServer (called by
         `GatewayServer.stop`)."""
         for srv in self._servers.values():
+            # stop the control loop first or it may race the drain with
+            # a concurrent scale decision against a closing pool
+            scaler = getattr(srv, "autoscaler", None)
+            if scaler is not None:
+                scaler.stop()
             srv.shutdown(drain_timeout=drain_timeout)
         self._servers.clear()
 
@@ -682,7 +744,8 @@ class GatewayClient:
     _IDEMPOTENT = frozenset({"predict", "evaluate", "score", "save_model",
                              "server_stats", "pool_stats", "generate",
                              "metrics", "flight_record", "health",
-                             "snapshot_model", "replica_metrics"})
+                             "snapshot_model", "replica_metrics",
+                             "autoscaler_stats", "set_tenant_quota"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05,

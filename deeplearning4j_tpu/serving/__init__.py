@@ -10,12 +10,14 @@ counterpart of the training robustness tier (elastic workers / durable
 checkpoints / health sentinel). See `docs/serving.md` for the ladder
 semantics and tuning knobs.
 """
+from deeplearning4j_tpu.serving.autoscaler import Autoscaler
 from deeplearning4j_tpu.serving.chaos import (
     BrokenModelInjector,
     ChaosProxy,
     ConnectionResetInjector,
     GarbageResponseInjector,
     InjectedServingFault,
+    LoadSpikeInjector,
     NetworkLatencyInjector,
     PartitionInjector,
     ReloadCorruptionInjector,
@@ -23,6 +25,7 @@ from deeplearning4j_tpu.serving.chaos import (
     ReplicaHangInjector,
     SlowInferenceInjector,
     SlowLorisInjector,
+    TenantFloodInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
 from deeplearning4j_tpu.serving.observability import (
@@ -44,6 +47,7 @@ from deeplearning4j_tpu.serving.quantize import (
 )
 from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
 from deeplearning4j_tpu.serving.model_server import (
+    AutoscaleError,
     CircuitBreaker,
     DeadlineExceededError,
     InferenceFailedError,
@@ -54,6 +58,7 @@ from deeplearning4j_tpu.serving.model_server import (
     ServerOverloadedError,
     ServiceUnavailableError,
     ServingError,
+    TenantQuotaExceededError,
 )
 from deeplearning4j_tpu.serving.replica_pool import (
     ReplicaEvictedError,
@@ -85,6 +90,8 @@ def __getattr__(name):
 
 
 __all__ = [
+    "AutoscaleError",
+    "Autoscaler",
     "BrokenModelInjector",
     "ChaosProxy",
     "CircuitBreaker",
@@ -95,6 +102,7 @@ __all__ = [
     "GarbageResponseInjector",
     "InferenceFailedError",
     "InjectedServingFault",
+    "LoadSpikeInjector",
     "MetricsRegistry",
     "ModelServer",
     "ModelValidationError",
@@ -119,6 +127,8 @@ __all__ = [
     "ServingError",
     "SlowInferenceInjector",
     "SlowLorisInjector",
+    "TenantFloodInjector",
+    "TenantQuotaExceededError",
     "Trace",
     "spawn_replica_pool",
     "argmax_drift_rate",

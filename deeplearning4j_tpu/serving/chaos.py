@@ -475,3 +475,164 @@ class ConnectionResetInjector:
 
     def release(self) -> None:
         self.proxy.heal()
+
+
+class TenantFloodInjector:
+    """One tenant floods the serving tier with batch-priority generate
+    traffic — the multi-tenant isolation drill. `concurrency` threads
+    hammer `target.generate(...)` under the flooding tenant's identity
+    until `release()`; per-outcome counters record what the flooder got
+    back. The QoS contract under drill: the flooder's rejections are its
+    OWN `TenantQuotaExceededError` (with retry_after), never anyone
+    else's `ServerOverloadedError`, and other tenants' interactive p99
+    stays within 2x unloaded. `target` is anything with the generate
+    signature (DecodeEngine, ModelServer, ReplicaPool, RemoteReplica)."""
+
+    def __init__(self, target, tenant: str = "flooder",
+                 prompt=None, n_tokens: int = 8,
+                 concurrency: int = 4, timeout: float = 5.0):
+        self.target = target
+        self.tenant = tenant
+        self.prompt = (np.arange(8, dtype=np.int32)
+                       if prompt is None else np.asarray(prompt, np.int32))
+        self.n_tokens = int(n_tokens)
+        self.concurrency = int(concurrency)
+        self.timeout = float(timeout)
+        self.active = True
+        self.served = 0           # guarded by: _lock
+        self.quota_rejections = 0  # guarded by: _lock
+        self.sheds = 0            # guarded by: _lock
+        self.other_errors = 0     # guarded by: _lock
+        self._lock = threading.Lock()
+        self._threads: list = []
+
+    def start(self) -> "TenantFloodInjector":
+        for i in range(self.concurrency):
+            t = threading.Thread(target=self._flood,
+                                 name=f"tenant-flood-{self.tenant}-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _flood(self) -> None:
+        from .model_server import ServingError, TenantQuotaExceededError
+
+        while self.active:
+            try:
+                self.target.generate(self.prompt, self.n_tokens,
+                                     timeout=self.timeout,
+                                     tenant=self.tenant, priority="batch")
+                with self._lock:
+                    self.served += 1
+            except TenantQuotaExceededError as err:
+                with self._lock:
+                    self.quota_rejections += 1
+                # back off as told — a well-behaved flooder; the drill
+                # for a non-compliant one just shrinks this sleep
+                time.sleep(min(getattr(err, "retry_after", 0.01) or 0.01,
+                               0.05))
+            except ServingError:
+                with self._lock:
+                    self.sheds += 1
+            # graftlint: disable=typed-error  deliberate: a chaos driver
+            # counts whatever the target throws; killing the flood
+            # thread on a surprise would end the drill early
+            except Exception:
+                with self._lock:
+                    self.other_errors += 1
+
+    def release(self) -> None:
+        self.active = False
+        for t in self._threads:
+            t.join(timeout=self.timeout + 5.0)
+        self._threads = []
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"served": self.served,
+                    "quota_rejections": self.quota_rejections,
+                    "sheds": self.sheds,
+                    "other_errors": self.other_errors}
+
+
+class LoadSpikeInjector:
+    """A sudden sustained jump in interactive arrivals — the autoscale
+    drill's stimulus. `concurrency` closed-loop threads submit
+    interactive generate traffic under distinct tenants until
+    `release()`, recording each request's latency so the drill can
+    check p99 against the unloaded baseline while the autoscaler reacts
+    (scale-up on pressure, scale-down after the spike, zero failed
+    requests through both transitions)."""
+
+    def __init__(self, target, prompt=None, n_tokens: int = 8,
+                 concurrency: int = 8, tenant: str = "spike",
+                 timeout: float = 10.0):
+        self.target = target
+        self.prompt = (np.arange(8, dtype=np.int32)
+                       if prompt is None else np.asarray(prompt, np.int32))
+        self.n_tokens = int(n_tokens)
+        self.concurrency = int(concurrency)
+        self.tenant = tenant
+        self.timeout = float(timeout)
+        self.active = True
+        self.served = 0       # guarded by: _lock
+        self.failures = 0     # guarded by: _lock
+        self.sheds = 0        # guarded by: _lock
+        self.latencies: list = []  # guarded by: _lock
+        self._lock = threading.Lock()
+        self._threads: list = []
+
+    def start(self) -> "LoadSpikeInjector":
+        for i in range(self.concurrency):
+            t = threading.Thread(target=self._drive, args=(i,),
+                                 name=f"load-spike-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _drive(self, i: int) -> None:
+        from .model_server import ServerOverloadedError, ServingError
+
+        while self.active:
+            t0 = time.monotonic()
+            try:
+                self.target.generate(self.prompt, self.n_tokens,
+                                     timeout=self.timeout,
+                                     tenant=f"{self.tenant}-{i}",
+                                     priority="interactive")
+                with self._lock:
+                    self.served += 1
+                    self.latencies.append(time.monotonic() - t0)
+            except ServerOverloadedError as err:
+                with self._lock:
+                    self.sheds += 1
+                time.sleep(min(getattr(err, "retry_after", 0.01) or 0.01,
+                               0.05))
+            except ServingError:
+                with self._lock:
+                    self.failures += 1
+            # graftlint: disable=typed-error  deliberate: the spike must
+            # keep driving through any surprise — an uncounted crash of
+            # a driver thread would silently thin the load
+            except Exception:
+                with self._lock:
+                    self.failures += 1
+
+    def release(self) -> None:
+        self.active = False
+        for t in self._threads:
+            t.join(timeout=self.timeout + 5.0)
+        self._threads = []
+
+    def p99(self) -> float:
+        with self._lock:
+            lats = sorted(self.latencies)
+        if not lats:
+            return 0.0
+        return lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"served": self.served, "failures": self.failures,
+                    "sheds": self.sheds, "n_latencies": len(self.latencies)}

@@ -119,6 +119,7 @@ from deeplearning4j_tpu.serving.model_server import (
     ServerOverloadedError,
     ServiceUnavailableError,
     ServingError,
+    TenantQuotaExceededError,
 )
 from deeplearning4j_tpu.util.concurrency import assert_owned
 
@@ -139,16 +140,29 @@ class _GenRequest:
                  "event", "tokens", "error", "enqueued_at", "probe",
                  "slot", "completed_at", "n_pages", "pages",
                  "prefill_pos", "hit_len", "n_shared", "nodes", "digests",
-                 "trace")
+                 "trace", "tenant", "priority", "resumed_at",
+                 "preempted")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float],
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive"):
         self.prompt = prompt
         self.n_tokens = n_tokens
         self.temperature = temperature
         self.seed = seed
         self.deadline = deadline
+        self.tenant = tenant
+        self.priority = priority
+        # preemption bookkeeping: a preempted batch request folds its
+        # emitted tokens into the prompt for re-prefill (prefix-cached,
+        # so the re-prefill mostly re-binds resident pages).
+        # `resumed_at` = len(tokens) at the moment the current prompt
+        # was formed (0 for a fresh request), so logical span math
+        # stays exact: span = len(prompt) - resumed_at + n_tokens
+        self.resumed_at = 0
+        self.preempted = 0
         self.event = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
@@ -194,6 +208,54 @@ class _GenRequest:
         if self.error is not None:
             raise self.error
         return np.asarray(self.tokens, np.int32)
+
+
+class _TenantState:
+    """One tenant's QoS ledger: a token bucket over REQUESTED tokens
+    (charged at submit, so a flood hits its own wall before consuming
+    queue capacity) plus the per-tenant counters `stats()["tenants"]`
+    publishes. Every field is synchronized by the owning engine's
+    `_cond` — the ledger is only ever touched inside the engine's
+    locked admission/retire sections."""
+
+    __slots__ = ("rate", "burst", "tokens", "last_refill", "submitted",
+                 "served", "shed_quota", "tokens_generated",
+                 "preemptions")
+
+    def __init__(self, rate: Optional[float] = None,
+                 burst: Optional[float] = None):
+        self.rate = None if rate is None else float(rate)
+        self.burst = float(burst) if burst is not None \
+            else (self.rate if self.rate else 0.0)
+        self.tokens = self.burst
+        self.last_refill = time.monotonic()
+        self.submitted = 0
+        self.served = 0
+        self.shed_quota = 0
+        self.tokens_generated = 0
+        self.preemptions = 0
+
+    def refill(self, now: float) -> None:
+        # elapsed clamps at 0: a ledger created mid-admission carries a
+        # `last_refill` stamped AFTER the door's `now`, and a negative
+        # elapsed would start the bucket fractionally below burst —
+        # spuriously rejecting a first-sight tenant's full-burst request
+        if self.rate:
+            self.tokens = min(
+                self.burst,
+                self.tokens + max(0.0, now - self.last_refill)
+                * self.rate)
+        self.last_refill = now
+
+    def counters(self) -> dict:
+        # rate/burst stay None (JSON null) for unquota'd tenants — a
+        # 0.0 sentinel would read as "zero allowance"
+        return {"submitted": self.submitted, "served": self.served,
+                "shed_quota": self.shed_quota,
+                "tokens_generated": self.tokens_generated,
+                "preemptions": self.preemptions,
+                "rate": self.rate, "burst": self.burst or None,
+                "tokens": round(self.tokens, 3)}
 
 
 def _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page):
@@ -382,7 +444,8 @@ class DecodeEngine:
                  metrics=None,
                  quantize: Optional[dict] = None,
                  excursion=None,
-                 parallel: Optional[dict] = None):
+                 parallel: Optional[dict] = None,
+                 qos: Optional[dict] = None):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -410,6 +473,27 @@ class DecodeEngine:
         self._quantize_cfg = dict(quantize) if quantize else None
         if excursion not in (None, False) and not isinstance(excursion, dict):
             raise ValueError("excursion must be None, False, or a dict")
+        if qos is not None:
+            if not isinstance(qos, dict):
+                raise ValueError(
+                    'qos must be a dict like {"tenants": {...}, '
+                    '"default": {...}, "preempt": bool, "slo_shed": bool}')
+            unknown = set(qos) - {"tenants", "default", "preempt",
+                                  "slo_shed"}
+            if unknown:
+                raise ValueError("unknown qos keys: %s" % sorted(unknown))
+            for name, spec in {**(qos.get("tenants") or {}),
+                               "default": qos.get("default") or {}}.items():
+                bad = set(spec) - {"rate", "burst"}
+                if bad:
+                    raise ValueError(
+                        "unknown qos tenant keys for %r: %s"
+                        % (name, sorted(bad)))
+                if "rate" in spec and spec["rate"] is not None \
+                        and float(spec["rate"]) <= 0:
+                    raise ValueError(
+                        "qos tenant %r rate must be > 0" % (name,))
+        self._qos_cfg = dict(qos) if qos else None
         tp_degree = 1
         if parallel is not None:
             if not isinstance(parallel, dict):
@@ -455,6 +539,22 @@ class DecodeEngine:
         self._swap_done = threading.Event()
         self._step_ewma = 0.01  # guarded by: _cond
         self._pages_demand_queued = 0  # guarded by: _cond
+        # QoS control plane (armed by `qos={...}`): per-tenant token
+        # buckets, the batch→interactive preemption switch, and the
+        # SLO-shed estimators (queue-wait + prefill-chunk EWMAs; the
+        # decode-step EWMA above is shared with retry_after estimates)
+        _q = self._qos_cfg or {}
+        self._preempt_enabled = self._qos_cfg is not None \
+            and _q.get("preempt", True) is not False
+        self._slo_shed_enabled = self._qos_cfg is not None \
+            and _q.get("slo_shed", True) is not False
+        self._default_quota = dict(_q.get("default") or {}) or None
+        self._tenants: dict = {}  # guarded by: _cond
+        for _name, _spec in (_q.get("tenants") or {}).items():
+            self._tenants[_name] = _TenantState(
+                rate=_spec.get("rate"), burst=_spec.get("burst"))
+        self._queue_wait_ewma = 0.0  # guarded by: _cond
+        self._chunk_ewma = 0.0  # guarded by: _cond
         # counters (observable state for tests/telemetry)
         self.submitted = 0  # guarded by: _cond
         self.served = 0  # guarded by: _cond
@@ -470,6 +570,11 @@ class DecodeEngine:
         self.tokens_generated = 0  # guarded by: _cond
         self.pages_in_use_peak = 0  # guarded by: _cond
         self.swaps = 0  # guarded by: _cond
+        # QoS counters: batch-lane slots yielded to interactive
+        # pressure, SLO-estimator door sheds, per-tenant quota sheds
+        self.preemptions = 0  # guarded by: _cond
+        self.slo_sheds = 0  # guarded by: _cond
+        self.shed_quota = 0  # guarded by: _cond
         # latency-tier counters (prefix cache + speculative decoding)
         self.prompt_tokens = 0  # guarded by: _cond
         self.prefix_hits = 0  # guarded by: _cond
@@ -1120,13 +1225,25 @@ class DecodeEngine:
     # -- public surface ----------------------------------------------------
     def submit(self, prompt_ids, n_tokens: int, *,
                temperature: float = 0.0, seed: int = 0,
-               timeout: Optional[float] = None) -> _GenRequest:
+               timeout: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: str = "interactive") -> _GenRequest:
         """Admit one generation request (non-blocking). Typed give-ups:
         `ServerOverloadedError` (queue full), `OutOfPagesError` (the
         paged KV pool cannot reserve this request's pages right now),
-        `ServiceUnavailableError` (breaker open), `ServerClosedError`.
-        Returns the request handle; `request.result()` blocks for the
-        tokens."""
+        `TenantQuotaExceededError` (THIS tenant's token-rate budget is
+        spent — never another tenant's overload), `DeadlineExceededError`
+        (already expired, or the SLO estimator proves the deadline
+        cannot be met), `ServiceUnavailableError` (breaker open),
+        `ServerClosedError`. `priority` is `"interactive"` (default) or
+        `"batch"` — the batch lane fills otherwise-idle slots and
+        yields them (preemption, `qos={...}`) under interactive
+        pressure. Returns the request handle; `request.result()` blocks
+        for the tokens."""
+        if priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"priority must be 'interactive' or 'batch', got "
+                f"{priority!r}")
         prompt = np.asarray(prompt_ids)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -1166,7 +1283,8 @@ class DecodeEngine:
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
         req = _GenRequest(prompt.astype(np.int32), int(n_tokens),
-                          float(temperature), int(seed), deadline)
+                          float(temperature), int(seed), deadline,
+                          tenant=tenant, priority=priority)
         req.n_pages = need
         req.trace = trace
         with self._cond:
@@ -1174,6 +1292,83 @@ class DecodeEngine:
                 err = ServerClosedError("decode engine is shut down")
                 self._shed_obs(trace, err)
                 raise err
+            now = time.monotonic()
+            # door-order contract (pinned by tests): expired corpses are
+            # swept and the incoming request's own deadline is judged
+            # BEFORE any capacity verdict — a dead request must hear
+            # DeadlineExceededError, and a queue padded with dead
+            # entries is not real backpressure. Then the tenant's OWN
+            # quota, then the SLO estimate, and only then the shared
+            # queue/page limits.
+            if len(self._queue) >= self.max_queue \
+                    or (self._pages_demand_queued
+                        and self._pages_demand_queued + need
+                        > self.max_queued_pages):
+                self._sweep_expired_locked(now)
+            if deadline is not None and deadline <= now:
+                self.shed_deadline += 1
+                err = DeadlineExceededError(
+                    "deadline expired before admission; request shed at "
+                    "the door")
+                self._shed_obs(trace, err)
+                raise err
+            tstate = self._tenant_locked(tenant)
+            if tstate is not None and tstate.rate:
+                tstate.refill(now)
+                if tstate.tokens < n_tokens:
+                    tstate.shed_quota += 1
+                    self.shed_quota += 1
+                    retry = max(0.001,
+                                (n_tokens - tstate.tokens) / tstate.rate)
+                    err = TenantQuotaExceededError(
+                        f"tenant {tenant!r} token-rate quota exhausted "
+                        f"({tstate.tokens:.0f} of {n_tokens} tokens "
+                        f"available at {tstate.rate:.0f} tok/s); retry "
+                        f"in {retry:.3f}s", retry_after=retry)
+                    self._shed_obs(trace, err, tenant=tenant,
+                                   bucket_tokens=round(tstate.tokens, 1),
+                                   rate=tstate.rate, n_tokens=int(n_tokens))
+                    self.recorder.event(
+                        "quota-shed", tenant=tenant,
+                        bucket_tokens=round(tstate.tokens, 1),
+                        rate=tstate.rate, n_tokens=int(n_tokens))
+                    raise err
+            if self._slo_shed_enabled and deadline is not None \
+                    and self.decode_steps:
+                # can this request provably not meet its deadline? The
+                # estimate is grounded in OBSERVED EWMAs (hence the
+                # decode_steps gate): expected queue wait + its prefill
+                # chunks at the chunk EWMA + its tokens at the decode-
+                # step EWMA. Shedding here costs nothing; admitting it
+                # costs prefill the deadline then throws away.
+                n_chunks = -(-T0 // self.prefill_chunk) \
+                    if self._is_chunked(T0) else 1
+                est = self._queue_wait_ewma \
+                    + n_chunks * self._chunk_ewma \
+                    + n_tokens * self._step_ewma
+                if now + est > deadline:
+                    self.slo_sheds += 1
+                    err = DeadlineExceededError(
+                        f"deadline unmeetable: needs ~{est:.3f}s "
+                        f"(queue {self._queue_wait_ewma:.3f}s + "
+                        f"{n_chunks} prefill chunks + {n_tokens} decode "
+                        f"steps) but only "
+                        f"{max(0.0, deadline - now):.3f}s remain; shed "
+                        "before prefill")
+                    self._shed_obs(trace, err,
+                                   estimate_s=round(est, 4),
+                                   queue_wait_ewma_s=round(
+                                       self._queue_wait_ewma, 4),
+                                   prefill_chunks=n_chunks,
+                                   step_ewma_s=round(self._step_ewma, 5))
+                    self.recorder.event(
+                        "slo-shed", tenant=tenant,
+                        estimate_s=round(est, 4),
+                        queue_wait_ewma_s=round(self._queue_wait_ewma, 4),
+                        prefill_chunks=n_chunks,
+                        step_ewma_s=round(self._step_ewma, 5),
+                        budget_s=round(max(0.0, deadline - now), 4))
+                    raise err
             if len(self._queue) >= self.max_queue:
                 self.shed_overload += 1
                 retry = max(0.001, self._step_ewma
@@ -1220,6 +1415,13 @@ class DecodeEngine:
                     pages_in_use=held, queued_page_demand=demand,
                     max_queued_pages=self.max_queued_pages)
                 raise err
+            # debit the tenant's bucket only once EVERY door has passed:
+            # a request shed by the shared queue/page limits above must
+            # not also burn its tenant's budget
+            if tstate is not None:
+                if tstate.rate:
+                    tstate.tokens -= n_tokens
+                tstate.submitted += 1
             self._pages_demand_queued += need
             self.submitted += 1
             self._queue.append(req)
@@ -1229,13 +1431,69 @@ class DecodeEngine:
             self._cond.notify_all()
         return req
 
+    def _tenant_locked(self, tenant: Optional[str]):
+        """This tenant's ledger (created on first sight, `default` quota
+        applied), or None for untenanted traffic — which is untracked
+        and unlimited, so pre-QoS callers see zero behavior change."""
+        assert_owned(self._cond, "DecodeEngine._tenant_locked")
+        if tenant is None:
+            return None
+        state = self._tenants.get(tenant)
+        if state is None:
+            spec = self._default_quota or {}
+            state = _TenantState(rate=spec.get("rate"),
+                                 burst=spec.get("burst"))
+            self._tenants[tenant] = state
+        return state
+
+    def _sweep_expired_locked(self, now: float) -> None:
+        """Shed every already-expired QUEUED request with ITS truth
+        (`DeadlineExceededError`), releasing its page reservation — so
+        a queue padded with dead entries can never be the reason a live
+        request hears `ServerOverloadedError`/`OutOfPagesError`."""
+        assert_owned(self._cond, "DecodeEngine._sweep_expired_locked")
+        if not any(r.expired(now) for r in self._queue):
+            return
+        keep: collections.deque = collections.deque()
+        for req in self._queue:
+            if req.expired(now):
+                self._pages_demand_queued -= req.n_pages
+                self.shed_deadline += 1
+                req.trace.add_timed("queue-wait", req.enqueued_at, now,
+                                    decision="expired")
+                self._finish_obs(req, DeadlineExceededError(
+                    "deadline expired while queued; request shed before "
+                    "prefill"))
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
+                         burst: Optional[float] = None) -> None:
+        """Install (or with `rate=None` clear) tenant `tenant`'s
+        token-rate quota at runtime — the seam the gateway's
+        `set_tenant_quota` RPC lands on. The bucket restarts full at
+        the new burst; counters survive the change."""
+        with self._cond:
+            state = self._tenant_locked(tenant)
+            state.rate = None if rate is None else float(rate)
+            state.burst = float(burst) if burst is not None \
+                else (state.rate if state.rate else 0.0)
+            state.tokens = state.burst
+            state.last_refill = time.monotonic()
+        self.recorder.event("quota-set", tenant=tenant, rate=rate,
+                            burst=burst)
+
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive") -> np.ndarray:
         """Blocking convenience: submit + wait. Returns the generated
         tokens (1-D int32; shorter than `n_tokens` only on EOS)."""
         return self.submit(prompt_ids, n_tokens, temperature=temperature,
-                           seed=seed, timeout=timeout).result()
+                           seed=seed, timeout=timeout, tenant=tenant,
+                           priority=priority).result()
 
     def pending(self) -> int:
         """Queued + in-slot generation requests — the engine's share of
@@ -1257,7 +1515,10 @@ class DecodeEngine:
                     continue
                 t0 = r.prompt.shape[0]
                 used_positions += min(r.prefill_pos, t0) \
-                    if r.prefill_pos is not None else t0 + len(r.tokens)
+                    if r.prefill_pos is not None \
+                    else t0 + len(r.tokens) - r.resumed_at
+            tenants = {name: state.counters()
+                       for name, state in sorted(self._tenants.items())}
         occupancy = (100.0 * self.active_slot_steps
                      / (self.decode_steps * self.n_slots)
                      if self.decode_steps else 0.0)
@@ -1299,6 +1560,13 @@ class DecodeEngine:
                "tp_degree": self._tp_degree,
                "tp_kv_bytes_per_token_per_shard":
                    self._kv_bytes_per_token // self._tp_degree,
+               # QoS control plane: unconditional (zero / empty when
+               # qos is off) so dashboards and the stats-schema
+               # contract never branch on key presence
+               "preemptions": self.preemptions,
+               "slo_sheds": self.slo_sheds,
+               "shed_quota": self.shed_quota,
+               "tenants": tenants,
                "prompt_buckets": list(self.prompt_buckets)}
         if self._prefix_cache is not None:
             hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
@@ -1490,13 +1758,102 @@ class DecodeEngine:
                 self._finish_obs(req, err)
         self._cond.notify_all()
 
+    def _select_head_locked(self) -> int:
+        """Index of the next request to admit: the FIRST queued
+        interactive request when one exists, else the queue head. FIFO
+        within each priority class — an interactive request jumps a
+        page-blocked batch head, so the batch lane only consumes
+        capacity interactive traffic is not asking for. Under sustained
+        interactive saturation the batch lane starves by design (its
+        deadline sweep still fails batch requests typed)."""
+        assert_owned(self._cond, "DecodeEngine._select_head_locked")
+        for i, r in enumerate(self._queue):
+            if r.priority == "interactive":
+                return i
+        return 0
+
+    def _maybe_preempt_locked(self, head: _GenRequest, reason: str):
+        """Retire-to-queue one DECODING batch-lane slot so a blocked
+        interactive head can take its slot and pages. The victim's
+        emitted tokens fold into its prompt (`resumed_at` marks the
+        fold point, keeping the logical span constant), its prompt's
+        fully-covered pages are promoted into the prefix cache so the
+        re-prefill re-binds them instead of recomputing, and it rejoins
+        the queue FRONT with its position preserved. Mid-prefill slots
+        are never preempted: their pages hold partial KV, which must
+        not reach the prefix cache. Returns ``(victim, old_probe,
+        reason, slot)`` or None (caller releases the breaker token
+        outside the lock)."""
+        assert_owned(self._cond, "DecodeEngine._maybe_preempt_locked")
+        if not self._preempt_enabled or head.priority != "interactive" \
+                or head.expired():
+            return None
+        best = None
+        for s in range(self.n_slots):
+            v = self._slots[s]
+            if v is None or v.priority != "batch":
+                continue
+            if v.prefill_pos is not None or not self._active[s]:
+                continue  # mid-prefill KV is partial: not promotable
+            if v.n_tokens - len(v.tokens) < 1:
+                continue  # retiring on its own this iteration
+            if best is None or \
+                    len(v.tokens) < len(self._slots[best].tokens):
+                best = s  # least progress = least re-prefill to redo
+        if best is None:
+            return None
+        v = self._slots[best]
+        old_probe = v.probe
+        # promote only the CURRENT prompt's fully-covered pages: the
+        # latest decoded token's KV is not written yet, so pages
+        # touching the decoded tail are not provably complete
+        self._promote_prefix_locked(v)
+        self._free_request_pages_locked(v)
+        self._slots[best] = None
+        self._active[best] = False
+        emitted = len(v.tokens)
+        if emitted > v.resumed_at:
+            v.prompt = np.concatenate(
+                [v.prompt, np.asarray(v.tokens[v.resumed_at:], np.int32)])
+        v.resumed_at = emitted
+        v.prefill_pos = None
+        v.slot = None
+        v.hit_len = 0
+        v.n_shared = 0
+        v.nodes = None
+        v.digests = []
+        v.probe = False
+        v.preempted += 1
+        v.n_pages = self._pages_for(v.prompt.shape[0],
+                                    max(1, v.n_tokens - emitted))
+        self._pages_demand_queued += v.n_pages
+        # queue FRONT: the victim was admitted before anything queued,
+        # so it keeps seniority within the batch lane (interactive
+        # selection still jumps it)
+        self._queue.appendleft(v)
+        self.preemptions += 1
+        ts = self._tenants.get(v.tenant)
+        if ts is not None:
+            ts.preemptions += 1
+        self.recorder.event(
+            "preempt", slot=best, reason=reason, tenant=v.tenant,
+            victim_emitted=emitted, victim_remaining=v.n_tokens - emitted,
+            head_tenant=head.tenant, free_pages=len(self._free_pages),
+            head_need_pages=head.n_pages)
+        self._cond.notify_all()
+        return (v, old_probe, reason, best)
+
     # graftlint: hot-loop
     def _admit(self) -> None:
         """Move queued requests into free slots. Expired queued requests
-        are shed BEFORE any device work. The queue head waits (FIFO)
-        when the free list cannot cover its pages — a retirement frees
-        them in bounded time, and unreferenced prefix-cache pages are
-        reclaimed LRU-first before waiting (caching never shrinks
+        are shed BEFORE any device work. Head selection is
+        priority-aware: the first queued INTERACTIVE request goes
+        first (FIFO within a class), and when it is slot- or
+        page-blocked a decoding batch-lane slot is preempted
+        (retire-to-queue) to make room. The selected head otherwise
+        waits when the free list cannot cover its pages — a retirement
+        frees them in bounded time, and unreferenced prefix-cache pages
+        are reclaimed LRU-first before waiting (caching never shrinks
         effective capacity). With a prefix hit, the longest cached
         chain binds into the slot's page table (refcounts bumped), only
         the uncached tail allocates fresh pages, and prefill starts at
@@ -1507,15 +1864,24 @@ class DecodeEngine:
         import jax.numpy as jnp
 
         while True:
+            preempt = None
             with self._cond:
+                if not self._queue:
+                    return
                 free = [s for s in range(self.n_slots)
                         if self._slots[s] is None]
-                if not free or not self._queue:
-                    return
-                head = self._queue[0]
+                head_idx = self._select_head_locked()
+                head = self._queue[head_idx]
                 nodes: list = []
                 need = head.n_pages
-                if not head.expired():
+                if not free:
+                    # every slot taken, an interactive head waiting: the
+                    # batch lane yields a slot (retire-to-queue) or we
+                    # wait for a retirement like any full house
+                    preempt = self._maybe_preempt_locked(head, "slots")
+                    if preempt is None:
+                        return
+                elif not head.expired():
                     if self._prefix_cache is not None:
                         # only the scheduler thread mutates the cache,
                         # so this lookup stays valid through the bind;
@@ -1524,9 +1890,13 @@ class DecodeEngine:
                         nodes = self._prefix_cache.lookup(head.prompt,
                                                           head.digests)
                         if nodes:
+                            # resumed (preempted) requests span only
+                            # their REMAINING tokens past the extended
+                            # prompt
                             need = self._pages_for_hit(
                                 head.prompt.shape[0],
-                                head.n_tokens) - len(nodes)
+                                max(1, head.n_tokens - head.resumed_at)) \
+                                - len(nodes)
                     if need > len(self._free_pages) \
                             and self._prefix_cache is not None:
                         # pool pressure: release idle cached pages
@@ -1544,9 +1914,26 @@ class DecodeEngine:
                                 "page-reclaim", pages=len(reclaimed),
                                 free_after=len(self._free_pages))
                     if need > len(self._free_pages):
-                        return  # page-blocked: wait for a retirement
-                req = self._queue.popleft()
-                self._pages_demand_queued -= req.n_pages
+                        # page-blocked: a batch slot's pages can cover
+                        # an interactive head (preemption), else wait
+                        # for a retirement to free pages
+                        preempt = self._maybe_preempt_locked(head,
+                                                             "pages")
+                        if preempt is None:
+                            return
+                if preempt is None:
+                    req = head
+                    del self._queue[head_idx]
+                    self._pages_demand_queued -= req.n_pages
+            if preempt is not None:
+                victim, old_probe, reason, vslot = preempt
+                if self.breaker is not None:
+                    # the victim's device work so far was healthy —
+                    # preemption is a scheduling decision, not sickness
+                    self.breaker.record_success(old_probe)
+                victim.trace.event("preempt", reason=reason, slot=vslot,
+                                   emitted=len(victim.tokens))
+                continue
             now = time.monotonic()
             if req.expired(now):
                 with self._cond:
@@ -1558,6 +1945,12 @@ class DecodeEngine:
                     "prefill"))
                 continue
             req.trace.add_timed("queue-wait", req.enqueued_at, now)
+            with self._cond:
+                # ground the SLO estimator's queue-wait term on every
+                # admission (preempted re-admissions fold in too: their
+                # requeue wait is real interactive-pressure wait)
+                self._queue_wait_ewma = 0.8 * self._queue_wait_ewma \
+                    + 0.2 * (now - req.enqueued_at)
             probe = False
             if self.breaker is not None:
                 try:
@@ -1645,9 +2038,10 @@ class DecodeEngine:
 
         tp0 = time.monotonic()
         first, ok = _dispatched(run, span=self._tp_span)
+        tp1 = time.monotonic()
         # host clock around the dispatch+materialization — already
         # synced, so the span costs no extra device round-trip
-        req.trace.add_timed("prefill", tp0, time.monotonic(),
+        req.trace.add_timed("prefill", tp0, tp1,
                             bucket=bucket, prompt_len=t0)
         first = int(first[0])
         if not bool(ok):
@@ -1662,11 +2056,17 @@ class DecodeEngine:
         with self._cond:
             self.prefills += 1
             self.tokens_generated += 1
+            # a one-shot prefill grounds the SLO estimator as a single
+            # chunk observation (same dispatch scale as a chunk)
+            self._chunk_ewma = 0.8 * self._chunk_ewma + 0.2 * (tp1 - tp0)
             self._promote_prefix_locked(req)
         if self._spec is not None:
             self._spec.seed_slot(slot, req.seed)
         req.tokens.append(first)
-        if req.n_tokens == 1 or first == self.eos_token:
+        # >= len comparison, not n_tokens == 1: a preempted request
+        # re-prefills with its emitted tokens folded into the prompt,
+        # so this "first" token may already be its last
+        if len(req.tokens) >= req.n_tokens or first == self.eos_token:
             self._retire(slot, req, attached=False)
             return
         with self._cond:
@@ -1739,7 +2139,8 @@ class DecodeEngine:
         tp0 = time.monotonic()
         try:
             first, ok = _dispatched(run, span=self._tp_span)
-            req.trace.add_timed("prefill-chunk", tp0, time.monotonic(),
+            tp1 = time.monotonic()
+            req.trace.add_timed("prefill-chunk", tp0, tp1,
                                 chunk_off=off, width=W, final=final)
             if not bool(ok):
                 raise InferenceFailedError(
@@ -1758,6 +2159,7 @@ class DecodeEngine:
         self._hook("post_prefill", info)
         with self._cond:
             self.prefill_chunks += 1
+            self._chunk_ewma = 0.8 * self._chunk_ewma + 0.2 * (tp1 - tp0)
         if not final:
             req.prefill_pos = off + C
             return
@@ -1770,7 +2172,9 @@ class DecodeEngine:
             self._spec.seed_slot(slot, req.seed)
         first = int(first[0])
         req.tokens.append(first)
-        if req.n_tokens == 1 or first == self.eos_token:
+        # >= len, not n_tokens == 1: a resumed (preempted) request may
+        # complete on its re-prefill token
+        if len(req.tokens) >= req.n_tokens or first == self.eos_token:
             self._retire(slot, req)
             return
         with self._cond:
@@ -1835,6 +2239,10 @@ class DecodeEngine:
                 self._active[slot] = False
             self._free_request_pages_locked(req)
             self.served += 1
+            ts = self._tenants.get(req.tenant)
+            if ts is not None:
+                ts.served += 1
+                ts.tokens_generated += len(req.tokens)
             self._cond.notify_all()
         if self.breaker is not None:
             self.breaker.record_success(req.probe)
@@ -2005,7 +2413,10 @@ class DecodeEngine:
             return False
         wl = np.zeros((self.n_slots,), np.int32)
         for s, r in live:
-            wl[s] = r.prompt.shape[0] + r.n_tokens - 2
+            # resumed_at keeps the write limit at the ORIGINAL logical
+            # span: a preempted request's prompt absorbed its emitted
+            # tokens, which its n_tokens budget already spans
+            wl[s] = r.prompt.shape[0] - r.resumed_at + r.n_tokens - 2
         info = {"active": len(live), "step": self.decode_steps,
                 "spec": True, "k": k}
         t0c = time.monotonic()
@@ -2174,11 +2585,13 @@ class DecodeEngine:
                 reserved = 0
                 while self._queue:
                     r = self._queue.popleft()
-                    if r.prompt.shape[0] + r.n_tokens > self.max_len:
+                    if r.prompt.shape[0] - r.resumed_at + r.n_tokens \
+                            > self.max_len:
                         misfit.append(r)
                         continue
-                    r.n_pages = self._pages_for(r.prompt.shape[0],
-                                                r.n_tokens)
+                    r.n_pages = self._pages_for(
+                        r.prompt.shape[0],
+                        max(1, r.n_tokens - r.resumed_at))
                     if r.n_pages > self.pool_pages or \
                             reserved + r.n_pages > self.max_queued_pages:
                         misfit.append(r)  # incl. pool shrunk below the

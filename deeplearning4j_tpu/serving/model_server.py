@@ -157,6 +157,25 @@ class ServerClosedError(ServingError):
     admitted and unfinished queued requests fail with this."""
 
 
+class TenantQuotaExceededError(ServingError):
+    """This tenant's own token-rate quota is exhausted — deliberately
+    NOT a `ServerOverloadedError` subclass: a flooding tenant must hear
+    about ITS budget, and well-behaved co-tenants must never see this
+    error for someone else's flood. `retry_after` (seconds) is when the
+    tenant's token bucket refills enough to admit this request."""
+
+    def __init__(self, msg: str, retry_after: float = 0.1):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class AutoscaleError(ServingError):
+    """The autoscaler could not complete a scale action: the supervisor
+    exhausted its spawn budget, the pool refused the mutation, or the
+    new replica never passed the probe ladder. The pool keeps serving
+    at its previous size."""
+
+
 # ---------------------------------------------------------------------------
 # read-write lock (hot reload swaps under the write side; every device
 # step holds the read side, so in-flight requests finish on the old model)
@@ -637,6 +656,13 @@ class ModelServer:
                         "spec_tokens_per_step"):
                 if key in gen:
                     out[key] = gen[key]
+            # QoS control-plane counters, top-level next to the shed
+            # family: how often the batch lane yielded to interactive
+            # pressure, and how many requests the SLO estimator turned
+            # away before prefill
+            out["preemptions"] = gen["preemptions"]
+            out["slo_sheds"] = gen["slo_sheds"]
+            out["shed_quota"] = gen["shed_quota"]
             out["generation"] = gen
         return out
 
@@ -672,8 +698,26 @@ class ModelServer:
         req.trace = trace
         err: Optional[ServingError] = None
         with self._cond:
+            # a FULL queue must be swept of already-dead entries BEFORE
+            # the queue-full verdict: expired requests padding the
+            # queue are not real backpressure, and each swept entry
+            # fails with ITS truth (DeadlineExceededError) instead of
+            # being the reason a live request hears
+            # ServerOverloadedError
+            now = time.monotonic()
+            if len(self._queue) >= self.max_queue:
+                live = [r for r in self._queue
+                        if not self._pop_expired(r, now)]
+                if len(live) != len(self._queue):
+                    self._queue.clear()
+                    self._queue.extend(live)
             if self._closed:
                 err = ServerClosedError("model server is shut down")
+            elif deadline is not None and deadline <= now:
+                self.shed_deadline += 1
+                err = DeadlineExceededError(
+                    "deadline expired before admission; request shed at "
+                    "the door")
             elif len(self._queue) >= self.max_queue:
                 self.shed_overload += 1
                 # backlog ÷ capacity × EWMA step latency: how long until
@@ -824,19 +868,33 @@ class ModelServer:
 
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive") -> np.ndarray:
         """Serve one generation request through the continuous-batching
         decode engine (`serving.decode_engine.DecodeEngine`): admitted
         into a decode slot as soon as one frees, decoded alongside every
         other in-flight request, returned the moment ITS tokens are done
         — never waiting on another request's tail. Shares the server's
         circuit breaker and admission discipline; typed give-ups match
-        `predict`'s. Returns the generated token ids (1-D int32)."""
+        `predict`'s. `tenant`/`priority` feed the engine's QoS admission
+        path (per-tenant token-rate quotas; `"interactive"` preempts
+        the `"batch"` lane under pressure). Returns the generated token
+        ids (1-D int32)."""
         engine = self._ensure_engine()
         timeout = self.default_timeout if timeout is None else timeout
         return engine.generate(prompt_ids, n_tokens,
                                temperature=temperature, seed=seed,
-                               timeout=timeout)
+                               timeout=timeout, tenant=tenant,
+                               priority=priority)
+
+    def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
+                         burst: Optional[float] = None) -> None:
+        """Set (or clear, with `rate=None`) tenant `tenant`'s token-rate
+        quota on the decode engine — the admin seam the gateway's quota
+        RPC lands on. Requires generation serving."""
+        self._ensure_engine().set_tenant_quota(tenant, rate=rate,
+                                               burst=burst)
 
     # -- batch assembly ----------------------------------------------------
     def _pop_expired(self, req: _Request, now: float) -> bool:  # graftlint: holds _cond

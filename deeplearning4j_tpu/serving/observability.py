@@ -811,6 +811,17 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
     # per-shard slice of kv_bytes_per_token — each device's actual
     # per-token KV residency under head sharding
     "tp_degree", "tp_kv_bytes_per_token_per_shard",
+    # multi-tenant QoS tier: batch-lane preemptions, SLO-infeasible
+    # sheds, quota rejections, and the per-tenant sub-dicts (keyed by
+    # tenant name; each value pins TENANT_STATS_KEYS)
+    "preemptions", "slo_sheds", "shed_quota", "tenants",
+})
+
+# Per-tenant counters nested under DecodeEngine ``stats()["tenants"]``
+# — one dict per tenant name the engine has seen (quota'd or not).
+TENANT_STATS_KEYS = frozenset({
+    "submitted", "served", "shed_quota", "tokens_generated",
+    "preemptions", "rate", "burst", "tokens",
 })
 
 REPLICA_POOL_STATS_KEYS = frozenset({
@@ -819,6 +830,19 @@ REPLICA_POOL_STATS_KEYS = frozenset({
     "hedge_wins", "evictions", "readmissions", "rolling_reloads",
     "rollbacks", "shed_overload", "shed_unavailable", "ewma_latency_ms",
     "replicas",
+    # elasticity tier: replicas added/drained-out by the autoscaler (or
+    # an operator) since construction
+    "replicas_added", "replicas_removed",
+})
+
+# `Autoscaler.stats()` — registered under the pool's metrics registry
+# as component "autoscaler", so the gateway `metrics` exposition and
+# `autoscaler_stats` RPC both carry it.
+AUTOSCALER_STATS_KEYS = frozenset({
+    "autoscale_events", "scale_ups", "scale_downs",
+    "autoscale_failures", "samples", "pressure", "pressure_ewma",
+    "min_replicas", "max_replicas", "cooldown_remaining",
+    "last_decision",
 })
 
 POOL_REPLICA_STATS_KEYS = frozenset({

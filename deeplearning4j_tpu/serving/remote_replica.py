@@ -89,6 +89,7 @@ from deeplearning4j_tpu.serving.model_server import (
     ServerOverloadedError,
     ServiceUnavailableError,
     ServingError,
+    TenantQuotaExceededError,
 )
 from deeplearning4j_tpu.serving.replica_pool import (
     ReplicaEvictedError,
@@ -150,6 +151,7 @@ _WIRE_ERRORS: Dict[str, type] = {
     "InferenceFailedError": InferenceFailedError,
     "ModelValidationError": ModelValidationError,
     "ReplicaEvictedError": ReplicaEvictedError,
+    "TenantQuotaExceededError": TenantQuotaExceededError,
     "ServerClosedError": ServiceUnavailableError,
 }
 
@@ -372,11 +374,20 @@ class RemoteReplica:
 
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive") -> np.ndarray:
         return np.asarray(self._data_call(
             "generate", timeout, prompt_ids=np.asarray(prompt_ids),
             n_tokens=int(n_tokens), temperature=float(temperature),
-            seed=int(seed)))
+            seed=int(seed), tenant=tenant, priority=priority))
+
+    def set_tenant_quota(self, tenant: str, rate=None, burst=None) -> None:
+        """Push one tenant's token-rate quota to the remote engine (the
+        wire mirror of `ModelServer.set_tenant_quota`)."""
+        self._client.call("set_tenant_quota", name=self.MODEL,
+                          tenant=tenant, rate=rate, burst=burst,
+                          _timeout=self.rpc_timeout)
 
     # -- health ------------------------------------------------------------
     def probe(self, x=None, timeout: Optional[float] = None
@@ -677,6 +688,7 @@ class ReplicaSupervisor:
         self._last_spawn = [0.0] * n_replicas
         self._restarts_in_window = [0] * n_replicas
         self._backoffs = [restart_backoff] * n_replicas
+        self._retired: set = set()  # guarded by: _lock
         self.respawns = 0  # guarded by: _lock
         self._monitor: Optional[threading.Thread] = None
         _LIVE_SUPERVISORS.add(self)
@@ -761,7 +773,10 @@ class ReplicaSupervisor:
             with self._lock:
                 if self._closed:
                     return
+                retired = set(self._retired)
             for i in range(self.n_replicas):
+                if i in retired:
+                    continue  # scale-down: never respawn a retired slot
                 proc = self._procs[i]
                 if proc is None or proc.poll() is None:
                     continue
@@ -796,6 +811,82 @@ class ReplicaSupervisor:
                 self._spawn(i)
                 with self._lock:
                     self.respawns += 1
+
+    # -- elasticity (the autoscaler's seam) --------------------------------
+    def grow_slot(self) -> int:
+        """Scale-up: allocate a NEW slot (fresh port), spawn its replica
+        process, and wait for readiness. Returns the slot index. On any
+        failure the half-born slot is retired (the monitor must never
+        respawn it) and `ReplicaSpawnError` propagates — the autoscaler
+        wraps it in `AutoscaleError`."""
+        from deeplearning4j_tpu.parallel.multiprocess import free_port
+        with self._lock:
+            if self._closed:
+                raise ReplicaSpawnError("supervisor is stopped")
+            i = len(self.ports)
+            self.ports.append(free_port())
+            self._procs.append(None)
+            self._last_spawn.append(0.0)
+            self._restarts_in_window.append(0)
+            self._backoffs.append(self.restart_backoff)
+            # n_replicas grows LAST: the monitor iterates
+            # range(n_replicas) without the lock, so every parallel
+            # array must already cover the new slot when it does
+            self.n_replicas += 1
+        try:
+            self._spawn(i)
+            self._await_ready(i, time.monotonic() + self.spawn_timeout)
+        except BaseException:
+            self.retire_slot(i)
+            raise
+        logger.info("replica supervisor: grew slot %d (port %d)", i,
+                    self.ports[i])
+        return i
+
+    def retire_slot(self, i: int) -> None:
+        """Scale-down: permanently stop slot `i`. The slot is marked
+        retired BEFORE its process is signalled — otherwise the monitor
+        could observe the death and respawn it in the gap. Slot indices
+        and ports are never reused, so surviving `RemoteReplica`
+        endpoints stay stable. Idempotent."""
+        if not 0 <= i < self.n_replicas:
+            raise ValueError(f"no supervisor slot {i}")
+        with self._lock:
+            self._retired.add(i)
+        proc = self._procs[i]
+        self._procs[i] = None
+        if proc is not None:
+            _ORPHAN_PIDS.discard(proc.pid)
+        if proc is not None and proc.poll() is None:
+            with contextlib.suppress(OSError):
+                proc.terminate()
+            with contextlib.suppress(Exception):
+                proc.wait(timeout=5.0)
+            if proc.poll() is None:
+                with contextlib.suppress(OSError):
+                    proc.kill()
+                with contextlib.suppress(Exception):
+                    proc.wait(timeout=5.0)
+        logger.info("replica supervisor: retired slot %d (port %d)", i,
+                    self.ports[i])
+
+    def slot_for_port(self, port: int) -> int:
+        """Map a replica endpoint's port back to its supervisor slot
+        (the autoscaler removes a pool replica first, then retires the
+        slot that served it)."""
+        with self._lock:
+            retired = set(self._retired)
+        for i, p in enumerate(self.ports):
+            if p == port and i not in retired:
+                return i
+        raise ValueError(f"no live supervisor slot serving port {port}")
+
+    def live_slots(self) -> int:
+        """Slots that can currently hold a process (not retired, not
+        given up) — the autoscaler's view of supervisor capacity."""
+        with self._lock:
+            retired = set(self._retired)
+        return sum(1 for i in range(self.n_replicas) if i not in retired)
 
     # -- drills / introspection --------------------------------------------
     def kill(self, i: int, sig: int = signal.SIGKILL) -> int:
@@ -868,6 +959,42 @@ class RemoteReplicaPool(ReplicaPool):
     @property
     def supervisor(self):
         return self._supervisor
+
+    # -- elasticity (the autoscaler's seam) --------------------------------
+    def grow_replica(self) -> int:
+        """Scale-up across the process boundary: grow a supervisor slot
+        (fresh process, fresh port, readiness-gated) and attach it to
+        the pool EVICTED — the probe ladder owns re-admission, exactly
+        like a respawned crashed replica. Returns the pool replica id.
+        `ReplicaSpawnError` propagates on supervisor exhaustion."""
+        sup = self._supervisor
+        if sup is None:
+            raise ReplicaSpawnError(
+                "pool has no supervisor to spawn replicas with")
+        slot = sup.grow_slot()
+        rep = RemoteReplica(
+            sup._host, sup.ports[slot], scratch_dir=self._scratch,
+            max_queue=sup._serving.get("max_queue", 64))
+        return self.add_replica(rep)
+
+    def shrink_replica(self, replica_id: int, *,
+                       drain_timeout: float = 30.0) -> None:
+        """Scale-down across the process boundary: drain + detach the
+        pool replica (zero-failed-requests discipline — aborts typed if
+        the drain cannot finish), then retire the supervisor slot that
+        served it so the process is stopped and never respawned."""
+        server = self.remove_replica(replica_id,
+                                     drain_timeout=drain_timeout)
+        if self._supervisor is not None:
+            port = int(server.endpoint.rsplit(":", 1)[1])
+            try:
+                self._supervisor.retire_slot(
+                    self._supervisor.slot_for_port(port))
+            except ValueError:
+                logger.warning(
+                    "remote pool: no live supervisor slot for removed "
+                    "replica %d (port %d) — already retired?",
+                    replica_id, port)
 
     @property
     def net(self):

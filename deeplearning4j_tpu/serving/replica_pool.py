@@ -93,6 +93,7 @@ import numpy as np
 
 from deeplearning4j_tpu.serving import observability
 from deeplearning4j_tpu.serving.model_server import (
+    AutoscaleError,
     DeadlineExceededError,
     InferenceFailedError,
     ModelServer,
@@ -219,6 +220,8 @@ class ReplicaPool:
         self.rollbacks = 0  # guarded by: _lock
         self.shed_overload = 0  # guarded by: _lock
         self.shed_unavailable = 0  # guarded by: _lock
+        self.replicas_added = 0  # guarded by: _lock
+        self.replicas_removed = 0  # guarded by: _lock
         # observability: the pool keeps its own registry + recorder for
         # routing-layer views (failovers, hedges, probe verdicts,
         # evictions, reloads); each replica's ModelServer keeps its own
@@ -300,6 +303,8 @@ class ReplicaPool:
                 "rollbacks": self.rollbacks,
                 "shed_overload": self.shed_overload,
                 "shed_unavailable": self.shed_unavailable,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
                 "ewma_latency_ms": round(1e3 * self._lat_ewma, 3),
                 "replicas": per_replica,
             }
@@ -737,12 +742,15 @@ class ReplicaPool:
     # -- generation --------------------------------------------------------
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: str = "interactive") -> np.ndarray:
         """Route one generation request (each replica's lazily-built
         `DecodeEngine`) with least-loaded routing + failover. Safe to
         re-route: generation is seeded, so a failover re-send
         recomputes identical tokens. Shares the pool admission budget
-        with `predict`."""
+        with `predict`. `tenant`/`priority` ride through to the chosen
+        replica's engine-level QoS doors."""
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
         trace = observability.maybe_trace()
@@ -757,7 +765,8 @@ class ReplicaPool:
                 return self._call_replica(
                     rep, lambda: rep.server.generate(
                         prompt_ids, n_tokens, temperature=temperature,
-                        seed=seed, timeout=rem),
+                        seed=seed, timeout=rem, tenant=tenant,
+                        priority=priority),
                     track_latency=False)
 
             with observability.use_trace(trace):
@@ -1096,7 +1105,8 @@ class ReplicaPool:
                 with self._lock:
                     rep.stale = False
 
-    def _drain_replica(self, rep: _Replica, drain_timeout: float) -> None:
+    def _drain_replica(self, rep: _Replica, drain_timeout: float,
+                       reason: str = "rolling-reload") -> None:
         """Stop routing to `rep` and wait (bounded) for its pending
         work to finish so the reload's canary/swap does not contend
         with live traffic. A drain timeout is not fatal — `reload`'s
@@ -1105,11 +1115,90 @@ class ReplicaPool:
         with self._lock:
             if rep.state == "healthy":
                 rep.state = "draining"
-        self.recorder.event("drain", replica=rep.id,
-                            reason="rolling-reload")
+        self.recorder.event("drain", replica=rep.id, reason=reason)
         deadline = time.monotonic() + drain_timeout
         while rep.server.pending() and time.monotonic() < deadline:
             time.sleep(0.005)
+
+    # -- elasticity (the autoscaler's seam) --------------------------------
+    def add_replica(self, server, *, healthy: bool = False) -> int:
+        """Attach one more replica to the live pool and return its id.
+
+        The new replica enters EVICTED by default: it serves no traffic
+        until the probe ladder re-admits it (`readmit_successes`
+        consecutive probe passes) — scale-up never routes requests to a
+        replica that has not proven itself. `healthy=True` skips the
+        ladder for callers that already validated the server (tests,
+        pre-warmed spares). The admission budget grows by the new
+        replica's queue capacity, and the replica list is replaced
+        copy-on-write so unlocked snapshot readers never see a
+        half-mutated list."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("replica pool is shut down")
+            new_id = max((r.id for r in self._replicas), default=-1) + 1
+            rep = _Replica(new_id, server)
+            if not healthy:
+                rep.state = "evicted"
+            self._replicas = self._replicas + [rep]
+            self.admission_budget += getattr(server, "max_queue", 64)
+            self.replicas_added += 1
+            self.recorder.event("add-replica", replica=new_id,
+                                state=rep.state,
+                                n_replicas=len(self._replicas))
+        logger.info("replica pool: added replica %d (%s)", new_id,
+                    rep.state)
+        self._probe_wake.set()  # start the ladder immediately
+        return new_id
+
+    def remove_replica(self, replica_id: int, *,
+                       drain_timeout: float = 30.0):
+        """Detach one replica with the zero-failed-requests drain
+        discipline and return its (still running) server: routing stops
+        first, in-flight work on the victim finishes, THEN the replica
+        leaves the pool. If the drain does not complete inside
+        `drain_timeout` the removal is aborted — the replica is
+        restored to rotation and `AutoscaleError` raised, because
+        completing the removal would fail its in-flight requests. The
+        caller owns the returned server's shutdown."""
+        with self._lock:
+            rep = next((r for r in self._replicas if r.id == replica_id),
+                       None)
+            if rep is None:
+                raise ValueError(f"no replica with id {replica_id}")
+            if len(self._replicas) <= 1:
+                raise ValueError("cannot remove the last replica")
+            prior_state = rep.state
+        self._drain_replica(rep, drain_timeout, reason="scale-down")
+        if rep.server.pending():
+            with self._lock:
+                if rep.state == "draining":
+                    rep.state = prior_state
+            raise AutoscaleError(
+                f"replica {replica_id} still has {rep.server.pending()} "
+                f"in-flight requests after a {drain_timeout:.1f}s drain; "
+                "removal aborted (completing it would fail them)")
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r.id != replica_id]
+            self.admission_budget = max(
+                1, self.admission_budget
+                - getattr(rep.server, "max_queue", 64))
+            self.replicas_removed += 1
+            self.recorder.event("remove-replica", replica=replica_id,
+                                n_replicas=len(self._replicas))
+        logger.info("replica pool: removed replica %d (drained clean)",
+                    replica_id)
+        return rep.server
+
+    def set_tenant_quota(self, tenant: str, rate=None, burst=None) -> None:
+        """Fan one tenant's token-rate quota out to every replica (the
+        quota is enforced per decode engine; a pool-level budget would
+        need cross-replica accounting the wire does not carry)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        for rep in replicas:
+            rep.server.set_tenant_quota(tenant, rate=rate, burst=burst)
 
     # -- shutdown ----------------------------------------------------------
     def shutdown(self, drain_timeout: float = 10.0) -> bool:

@@ -22,6 +22,23 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache, FRESH per session (tempdir): the suite
+# compiles the same HLO over and over — every test that builds its own
+# engine/net at the same shapes repays an identical XLA compile. Keyed
+# by HLO hash, so a hit can never change numerics; a fresh dir per run
+# means no cross-run staleness to reason about. Subprocess drills spawn
+# their own interpreters and are unaffected. Best-effort: older jax
+# without these knobs just runs uncached.
+try:
+    import tempfile
+
+    jax.config.update("jax_compilation_cache_dir",
+                      tempfile.mkdtemp(prefix="t1-xla-cache-"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:  # pragma: no cover - jax without the cache knobs
+    pass
+
 
 @pytest.fixture(scope="session")
 def tp_mesh2():

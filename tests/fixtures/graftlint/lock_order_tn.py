@@ -25,3 +25,23 @@ class Pool:
             pass
         with self._cond:
             pass
+
+
+class Scaler:
+    """Leaf-lock discipline: the control plane's own lock is never held
+    across a reach into the pool's — sample under the collaborator's
+    lock, account under its own, sequentially. No ordering edge."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self.pool._lock:
+            pass
+        with self._lock:
+            pass
+
+    def account(self):
+        with self._lock:
+            pass

@@ -17,3 +17,23 @@ class Pool:
         with self._engine_lock:
             with self._lock:  # TP: order: _engine_lock -> _lock
                 pass
+
+
+class Scaler:
+    """Cross-object inversion: the control plane takes its own lock
+    around a reach into the pool's lock in one method, and the reverse
+    in another — the autoscaler↔pool deadlock shape."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def decide(self):
+        with self._lock:
+            with self.pool._lock:  # order: _lock -> pool._lock
+                pass
+
+    def account(self):
+        with self.pool._lock:
+            with self._lock:  # TP: order: pool._lock -> _lock
+                pass

@@ -38,7 +38,8 @@ RULE_FIXTURES = {
     "donation": ("donation", 6),
     "recompile": ("recompile", 6),
     "host-sync": ("host_sync", 5),
-    "lock-order": ("lock_order", 1),
+    # 2: same-class inversion + cross-object (self.pool._lock) inversion
+    "lock-order": ("lock_order", 2),
     "guarded-by": ("guarded_by", 2),
     "typed-error": ("typed_error", 6),
     "rng-reuse": ("rng", 3),

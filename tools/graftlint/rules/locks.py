@@ -6,7 +6,10 @@ blocks plus one hop of same-class method calls made while a lock is
 held, and flags pairs of locks acquired in both orders (the classic
 deadlock shape).  Lock-looking attributes are those matching
 ``lock|cond|mutex|sem``; ``.read()``/``.write()`` rwlock handles map to
-their base lock.
+their base lock.  One dotted collaborator hop is recognized too
+(``with self.pool._lock:``), so a control-plane object that reaches
+into an owned object's lock (autoscaler → pool → supervisor) still
+contributes ordering edges.
 
 **guarded-by** consumes ``# guarded by: <lock>`` comments on ``self``
 field assignments (conventionally in ``__init__``) and flags any rebind
@@ -34,19 +37,23 @@ _LOCKISH = re.compile(r"lock|cond|mutex|sem", re.IGNORECASE)
 
 
 def _with_item_lock(expr: ast.AST) -> Optional[str]:
-    """`with self._cond:` / `with self._rwlock.write():` -> base attr
-    name of the lock, else None."""
+    """`with self._cond:` / `with self._rwlock.write():` /
+    `with self.pool._lock:` -> attr path of the lock (one dotted hop of
+    collaborator allowed, so a control plane reaching into its pool's
+    lock participates in the ordering graph), else None."""
     if isinstance(expr, ast.Call):
         d = dotted(expr.func)
         if d and d.startswith("self.") and \
                 d.split(".")[-1] in ("read", "write", "acquire"):
-            base = d.split(".")[1]
-            return base if _LOCKISH.search(base) else None
+            base = ".".join(d.split(".")[1:-1])
+            leaf = base.split(".")[-1] if base else ""
+            return base if leaf and _LOCKISH.search(leaf) else None
         return None
     d = dotted(expr)
-    if d and d.startswith("self.") and d.count(".") == 1:
+    if d and d.startswith("self.") and 1 <= d.count(".") <= 2:
         base = d[5:]
-        return base if _LOCKISH.search(base) else None
+        leaf = base.split(".")[-1]
+        return base if _LOCKISH.search(leaf) else None
     return None
 
 
