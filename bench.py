@@ -2023,6 +2023,195 @@ def bench_serve_qos():
             None, 1.0)
 
 
+_SERVE_DISAGG_SHAPE = {
+    "vocab": 256, "d_model": 128, "n_heads": 4, "n_layers": 2,
+    # the two Poisson mixes: prefill-heavy amortises long prompts,
+    # decode-heavy amortises long emission — disaggregation trades
+    # a KV wire hop for not letting one phase starve the other's slots
+    "prefill_heavy": {"prompt_len": 48, "n_tokens": 4},
+    "decode_heavy": {"prompt_len": 8, "n_tokens": 24},
+    "n_requests": 16, "mean_interarrival": 0.01,
+    "n_slots": 4, "page_size": 16,
+}
+
+
+def bench_serve_disagg():
+    """Disaggregated prefill/decode + KV shipping priced end to end
+    (ISSUE 17), three numbers in one config:
+
+    **disagg_vs_colocated_goodput** — the same Poisson request mix
+    driven through a `DisaggCoordinator` (prefill-role replica ships
+    leased KV pages to a decode-role replica) and through one
+    colocated engine, on a prefill-heavy and a decode-heavy mix. The
+    ratio prices what the wire hop costs (or buys) per mix; the
+    headline is the disaggregated decode-heavy goodput.
+
+    **kv_transfer_mbytes_per_sec** — handoff wire throughput from the
+    coordinator's own transfer ledger, bf16 KV vs int8 KV (int8 ships
+    ~half the bytes per page plus f32 scale sidecars, so the SAME link
+    moves ~2x the sequence-state per second).
+
+    **migration_resume_ms** — one mid-sequence decode-state migration,
+    warm (pages ride the lease, receiver re-binds) vs the degradation
+    ladder's cold fallback (receiver re-prefills prompt + emitted
+    tokens): the gap is what fault-tolerant page shipping saves on
+    every live migration."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DisaggCoordinator,
+        ModelServer,
+        SlotMigratedError,
+    )
+    from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+
+    shp = _SERVE_DISAGG_SHAPE
+    rng = np.random.default_rng(0)
+    max_len = max(m["prompt_len"] + m["n_tokens"]
+                  for m in (shp["prefill_heavy"], shp["decode_heavy"])) + 8
+    buckets = tuple(sorted({m["prompt_len"]
+                            for m in (shp["prefill_heavy"],
+                                      shp["decode_heavy"])}))
+
+    def _net():
+        net = MultiLayerNetwork(
+            gpt_configuration(vocab_size=shp["vocab"],
+                              d_model=shp["d_model"],
+                              n_heads=shp["n_heads"],
+                              n_layers=shp["n_layers"],
+                              max_length=max_len),
+            compute_dtype=jnp.bfloat16)
+        net.init()
+        return net
+
+    def _gen_kw(**extra):
+        return dict(n_slots=shp["n_slots"], max_len=max_len,
+                    page_size=shp["page_size"], prompt_buckets=buckets,
+                    max_queue=256, **extra)
+
+    def _drive(generate_fn, mix):
+        """Poisson-arrival closed set: N threads, one request each,
+        arrivals drawn once (shared across all servers under test)."""
+        n = shp["n_requests"]
+        arrivals = np.cumsum(rng.exponential(shp["mean_interarrival"], n))
+        prompts = [rng.integers(0, shp["vocab"],
+                                mix["prompt_len"]).astype(np.int32)
+                   for _ in range(n)]
+        toks = [0] * n
+        errs = []
+
+        def one(i):
+            try:
+                toks[i] = len(generate_fn(prompts[i], mix["n_tokens"]))
+            except Exception as e:  # noqa: BLE001 — bench counts, not hides
+                errs.append(e)
+
+        t0 = time.monotonic()
+        threads = []
+        for i in range(n):
+            lag = t0 + arrivals[i] - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            th = threading.Thread(target=one, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        dt = time.monotonic() - t0
+        if errs:
+            raise errs[0]
+        return sum(toks) / dt
+
+    net = _net()
+    mixes = ("prefill_heavy", "decode_heavy")
+
+    # -- colocated baseline: one engine does both phases ------------------
+    colocated = {}
+    server = ModelServer(net, generation=_gen_kw())
+    try:
+        for mix in mixes:
+            _drive(lambda p, n: server.generate(p, n, timeout=120.0),
+                   shp[mix])  # compile
+            colocated[mix] = _drive(
+                lambda p, n: server.generate(p, n, timeout=120.0),
+                shp[mix])
+    finally:
+        server.shutdown(drain_timeout=30.0)
+
+    # -- disaggregated: prefill replica ships KV to a decode replica ------
+    disagg = {}
+    wire = {}
+    for tier, quant in (("bf16", None), ("int8", {"kv": "int8"})):
+        co = DisaggCoordinator(
+            net, server_kwargs={"generation": _gen_kw(
+                **({} if quant is None else {"quantize": quant}))})
+        try:
+            for mix in mixes if tier == "bf16" else ("decode_heavy",):
+                _drive(lambda p, n: co.generate(p, n, timeout=120.0),
+                       shp[mix])  # compile
+                g = _drive(lambda p, n: co.generate(p, n, timeout=120.0),
+                           shp[mix])
+                if tier == "bf16":
+                    disagg[mix] = g
+            st = co.stats()
+            wire[tier] = round(st["kv_transfer_mbytes_per_sec"], 4)
+            if tier == "bf16":
+                bench_serve_disagg.disagg_handoffs = st["handoffs"]
+                bench_serve_disagg.disagg_fallbacks = st["fallbacks"]
+        finally:
+            co.shutdown(drain_timeout=30.0)
+    bench_serve_disagg.disagg_vs_colocated_goodput = {
+        mix: round(disagg[mix] / max(1e-9, colocated[mix]), 3)
+        for mix in mixes}
+    bench_serve_disagg.kv_transfer_mbytes_per_sec = wire
+
+    # -- migration resume: warm re-bind vs cold re-prefill ----------------
+    mix = shp["prefill_heavy"]  # long prompt: the cold path repays it
+
+    def hold(phase, info):  # keep the source sequence in flight long
+        if phase == "pre_decode":  # enough to export it mid-decode
+            time.sleep(0.02)
+
+    src = DecodeEngine(net, **_gen_kw(step_hooks=[hold]))
+    resume_ms = {}
+    try:
+        prompt = rng.integers(0, shp["vocab"],
+                              mix["prompt_len"]).astype(np.int32)
+        req = src.submit(prompt, mix["n_tokens"] + 4, timeout=120.0)
+        while len(req.tokens) < 2:
+            time.sleep(0.005)
+        src.migrate_slots(wait=10.0)
+        try:
+            req.result(timeout=60.0)
+            raise RuntimeError("bench expected the export redirect")
+        except SlotMigratedError as redirect:
+            warm = src.fetch_handoff(redirect.handoff_id)
+        warm = dict(warm)
+        warm["deadline_remaining"] = None
+        cold = dict(warm, kind="cold", blocks=[], sums=[],
+                    pages_shipped=0)
+        for label, payload in (("warm", warm),
+                               ("cold_reprefill", cold)):
+            dst = DecodeEngine(net, **_gen_kw())
+            try:
+                dst.resume_generate(payload, timeout=120.0)  # compile
+                t0 = time.monotonic()
+                dst.resume_generate(payload, timeout=120.0)
+                resume_ms[label] = round(
+                    1e3 * (time.monotonic() - t0), 2)
+            finally:
+                dst.shutdown(drain_timeout=30.0)
+    finally:
+        src.shutdown(drain_timeout=30.0)
+    bench_serve_disagg.migration_resume_ms = resume_ms
+
+    return ("serve_disagg_decode_heavy_tokens_per_sec",
+            disagg["decode_heavy"], None, 1.0)
+
 _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "lstm": bench_lstm, "lstm_large": bench_lstm_large,
             "gpt": bench_gpt,
@@ -2035,7 +2224,8 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "serving": bench_serving,
             "serve_pool": bench_serve_pool,
             "serve_generate": bench_serve_generate,
-            "serve_qos": bench_serve_qos}
+            "serve_qos": bench_serve_qos,
+            "serve_disagg": bench_serve_disagg}
 
 
 def _unit(metric: str) -> str:
@@ -2184,7 +2374,14 @@ def main() -> None:
                 ("tp_max_model_bytes_per_chip",
                  "tp_max_model_bytes_per_chip"),
                 ("tp_bytes_per_chip_vs_single",
-                 "tp_bytes_per_chip_vs_single")):
+                 "tp_bytes_per_chip_vs_single"),
+                ("disagg_vs_colocated_goodput",
+                 "disagg_vs_colocated_goodput"),
+                ("kv_transfer_mbytes_per_sec",
+                 "kv_transfer_mbytes_per_sec"),
+                ("migration_resume_ms", "migration_resume_ms"),
+                ("disagg_handoffs", "disagg_handoffs"),
+                ("disagg_fallbacks", "disagg_fallbacks")):
             extra = getattr(_CONFIGS[name], attr, None)
             if extra is not None:
                 entries[name][key] = extra

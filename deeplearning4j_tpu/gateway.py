@@ -74,7 +74,8 @@ logger = logging.getLogger("deeplearning4j_tpu")
 # outermost hop, so these requests' span timelines start here and every
 # layer below (pool routing, server admission, engine scheduling) joins
 # the same trace_id via the thread-local binding.
-_TRACED_METHODS = frozenset({"predict", "evaluate", "generate"})
+_TRACED_METHODS = frozenset({"predict", "evaluate", "generate",
+                             "resume_generate"})
 
 
 class GatewayError(RuntimeError):
@@ -87,10 +88,15 @@ class GatewayError(RuntimeError):
                  retry_after: Optional[float] = None,
                  replica_id: Optional[int] = None,
                  trace_id: Optional[str] = None,
-                 trace: Optional[dict] = None):
+                 trace: Optional[dict] = None,
+                 payload: Optional[dict] = None):
         super().__init__(msg)
         self.error_type = error_type
         self.retry_after = retry_after
+        # structured error payload for errors that carry data, not just
+        # a message — e.g. `SlotMigratedError`'s handoff_id + tokens, so
+        # a remote pool can resume a migrated request on a peer
+        self.payload = payload
         # present when a replicated pool produced the error: which
         # replica it originated on
         self.replica_id = replica_id
@@ -221,6 +227,7 @@ class EntryPoint:
         gateway wire protocol — a replica crash costs a failover plus a
         supervised respawn, not the service."""
         cfg = dict(self._serving)
+        disagg_cfg = cfg.pop("disagg", None)
         raw_replicas = cfg.pop("replicas", 1)
         n_replicas = 1 if raw_replicas is None else int(raw_replicas)
         if n_replicas < 1:
@@ -230,6 +237,18 @@ class EntryPoint:
         pool_cfg = cfg.pop("pool", {}) or {}
         remote_cfg = cfg.pop("remote", None)
         autoscale_cfg = cfg.pop("autoscale", None)
+        if disagg_cfg:
+            if n_replicas > 1 or remote_cfg or autoscale_cfg:
+                raise ValueError(
+                    "serving config 'disagg' builds its own prefill + "
+                    "decode replica set and cannot combine with "
+                    "'replicas' > 1, 'remote', or 'autoscale'")
+            from deeplearning4j_tpu.serving.kv_transfer import (
+                DisaggCoordinator,
+            )
+
+            disagg_kw = {} if disagg_cfg is True else dict(disagg_cfg)
+            return DisaggCoordinator(net, server_kwargs=cfg, **disagg_kw)
         if pool_cfg and n_replicas == 1:
             # fail at construction, not silently un-replicated: pool
             # kwargs without replicas almost certainly means a typo'd
@@ -450,13 +469,46 @@ class EntryPoint:
 
     def set_tenant_quota(self, name: str, tenant: str,
                          rate: Optional[float] = None,
-                         burst: Optional[float] = None) -> bool:
-        """Install (or update) tenant `tenant`'s token-rate quota on
-        model `name`'s decode engine — `rate` tokens/second refill,
-        `burst` bucket depth. On a pool this fans out to every replica
-        so failover cannot launder a flooding tenant past its quota."""
-        self._server(name).set_tenant_quota(tenant, rate=rate, burst=burst)
+                         burst: Optional[float] = None,
+                         max_pages: Optional[int] = None) -> bool:
+        """Install (or update) tenant `tenant`'s token-rate quota and KV
+        page ceiling on model `name`'s decode engine — `rate`
+        tokens/second refill, `burst` bucket depth, `max_pages` the most
+        KV pages the tenant may hold at once. On a pool this fans out to
+        every replica so failover cannot launder a flooding tenant past
+        its quota."""
+        self._server(name).set_tenant_quota(tenant, rate=rate, burst=burst,
+                                            max_pages=max_pages)
         return True
+
+    # -- KV handoff / live migration --------------------------------------
+    def migrate_slots(self, name: str, wait: Optional[float] = 5.0) -> int:
+        """Export model `name`'s in-flight generations as leased KV
+        handoffs (live decode-state migration; see
+        `serving.kv_transfer`). Returns the number migrated."""
+        return int(self._server(name).migrate_slots(wait=wait))
+
+    def resume_generate(self, name: str, payload: dict,
+                        timeout: Optional[float] = None) -> np.ndarray:
+        """Admit a fetched KV handoff payload on model `name`'s engine
+        and return the TAIL tokens it generates (the sender already
+        emitted `payload['tokens']`)."""
+        return self._server(name).resume_generate(payload, timeout=timeout)
+
+    def fetch_handoff(self, name: str, handoff_id: str) -> dict:
+        """Fetch a leased handoff payload by id (extends its TTL)."""
+        return self._server(name).fetch_handoff(handoff_id)
+
+    def commit_handoff(self, name: str, handoff_id: str) -> bool:
+        """Resolve a handoff lease after a successful resume: the sender
+        frees the shipped pages. Idempotent; False when already gone."""
+        return bool(self._server(name).commit_handoff(handoff_id))
+
+    def abort_handoff(self, name: str, handoff_id: str) -> bool:
+        """Resolve a handoff lease after a FAILED resume: the sender
+        reclaims the shipped pages immediately instead of waiting for
+        the TTL sweep. Idempotent; False when already gone."""
+        return bool(self._server(name).abort_handoff(handoff_id))
 
     def autoscaler_stats(self, name: str) -> dict:
         """The autoscaler's decision counters and live pressure signal
@@ -643,6 +695,14 @@ class GatewayServer:
                         replica_id = getattr(e, "replica_id", None)
                         if replica_id is not None:
                             resp["replica_id"] = int(replica_id)
+                        # errors that carry structured data (e.g. a
+                        # SlotMigratedError's handoff_id + emitted
+                        # tokens) ship it alongside the message so the
+                        # caller can act on it, not just read it
+                        wire_payload = getattr(e, "wire_payload", None)
+                        if callable(wire_payload):
+                            resp["error_payload"] = encode_value(
+                                wire_payload())
                         # the postmortem travels on the wire: the
                         # gateway-minted timeline when one exists, else
                         # whatever the typed error carried up
@@ -745,7 +805,15 @@ class GatewayClient:
                              "server_stats", "pool_stats", "generate",
                              "metrics", "flight_record", "health",
                              "snapshot_model", "replica_metrics",
-                             "autoscaler_stats", "set_tenant_quota"})
+                             "autoscaler_stats", "set_tenant_quota",
+                             # KV handoff edges: fetch is a read,
+                             # commit/abort resolve-by-id (re-resolving
+                             # returns False), migrate_slots re-runs as
+                             # a no-op on an already-drained engine.
+                             # resume_generate is NOT here: a re-send
+                             # could double-admit the same handoff.
+                             "fetch_handoff", "commit_handoff",
+                             "abort_handoff", "migrate_slots"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05,
@@ -901,12 +969,15 @@ class GatewayClient:
         self.last_trace_id = resp.get("trace_id")
         self.last_trace = resp.get("trace")
         if "error" in resp:
+            err_payload = resp.get("error_payload")
             raise GatewayError(resp["error"],
                                error_type=resp.get("error_type"),
                                retry_after=resp.get("retry_after"),
                                replica_id=resp.get("replica_id"),
                                trace_id=resp.get("trace_id"),
-                               trace=resp.get("trace"))
+                               trace=resp.get("trace"),
+                               payload=decode_value(err_payload)
+                               if err_payload is not None else None)
         return decode_value(resp["result"])
 
     def close(self):

@@ -17,6 +17,7 @@ from deeplearning4j_tpu.serving.chaos import (
     ConnectionResetInjector,
     GarbageResponseInjector,
     InjectedServingFault,
+    KVTransferCorruptionInjector,
     LoadSpikeInjector,
     NetworkLatencyInjector,
     PartitionInjector,
@@ -28,6 +29,12 @@ from deeplearning4j_tpu.serving.chaos import (
     TenantFloodInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+from deeplearning4j_tpu.serving.kv_transfer import (
+    DisaggCoordinator,
+    KVTransferError,
+    LeaseTable,
+    SlotMigratedError,
+)
 from deeplearning4j_tpu.serving.observability import (
     FlightRecorder,
     MetricsRegistry,
@@ -98,10 +105,14 @@ __all__ = [
     "ConnectionResetInjector",
     "DeadlineExceededError",
     "DecodeEngine",
+    "DisaggCoordinator",
     "FlightRecorder",
     "GarbageResponseInjector",
     "InferenceFailedError",
     "InjectedServingFault",
+    "KVTransferCorruptionInjector",
+    "KVTransferError",
+    "LeaseTable",
     "LoadSpikeInjector",
     "MetricsRegistry",
     "ModelServer",
@@ -125,6 +136,7 @@ __all__ = [
     "ServerOverloadedError",
     "ServiceUnavailableError",
     "ServingError",
+    "SlotMigratedError",
     "SlowInferenceInjector",
     "SlowLorisInjector",
     "TenantFloodInjector",

@@ -310,8 +310,12 @@ class Autoscaler:
 
     def scale_down(self) -> int:
         """Drain + remove one replica (zero failed requests — aborts
-        typed if the victim cannot drain). Returns the removed replica
-        id."""
+        typed if the victim cannot drain). The pool's drain is
+        migrate-then-drain: the victim's in-flight generations export
+        as leased KV handoffs and resume mid-sequence on surviving
+        replicas (`serving.kv_transfer`), so scale-down no longer waits
+        on — or re-computes — long decode tails. Returns the removed
+        replica id."""
         if self.pool.n_replicas <= self.min_replicas:
             raise AutoscaleError(
                 f"already at min_replicas={self.min_replicas}")

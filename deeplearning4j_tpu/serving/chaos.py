@@ -209,6 +209,66 @@ class ReloadCorruptionInjector:
         return path
 
 
+class KVTransferCorruptionInjector:
+    """Damage a KV handoff payload between `fetch_handoff` and
+    `resume_generate` — the wire hazards a migrated slot must survive
+    typed (`KVTransferError` → re-prefill fallback), never as wrong
+    tokens.
+
+    Three corruption families, matching how real transfers go bad:
+
+    - `flip_page(payload)` — XOR bytes inside one shipped KV page
+      (bit-rot / a bad NIC): the per-page checksum must refuse it.
+    - `truncate(payload)` — drop the tail pages of every block (a
+      transfer killed mid-flight): the span/shape validation must
+      refuse it.
+    - `expire_lease(server, handoff_id)` — resolve the lease out from
+      under the receiver (the TTL sweep racing a slow resume): the
+      NEXT fetch must answer the typed unknown-lease error.
+
+    Every method works on a COPY of the payload dicts it mutates, so
+    the sender's leased original stays intact — exactly like a wire
+    that corrupts in transit without touching the source buffers.
+    `corruptions` counts injected damages."""
+
+    def __init__(self):
+        self.corruptions = 0
+
+    @staticmethod
+    def _copy(payload: dict) -> dict:
+        out = dict(payload)
+        out["blocks"] = [dict(b) for b in payload.get("blocks", [])]
+        return out
+
+    def flip_page(self, payload: dict, block: int = 0,
+                  tensor: str = "k", page: int = 0) -> dict:
+        """One shipped page's bytes flipped; checksums untouched."""
+        out = self._copy(payload)
+        arr = np.array(out["blocks"][block][tensor])  # private copy
+        flat = arr.view(np.uint8).reshape(-1)
+        flat[: min(16, flat.size)] ^= 0xFF
+        out["blocks"][block][tensor] = arr
+        self.corruptions += 1
+        return out
+
+    def truncate(self, payload: dict, keep: int = 0) -> dict:
+        """Every block's page arrays cut to `keep` pages — but
+        `pages_shipped` still claims the original count, like a frame
+        that stopped arriving mid-transfer."""
+        out = self._copy(payload)
+        for blk in out["blocks"]:
+            for name, arr in blk.items():
+                blk[name] = np.array(arr[:keep])
+        self.corruptions += 1
+        return out
+
+    def expire_lease(self, server, handoff_id: str) -> None:
+        """Kill the lease mid-flight (the receiver already fetched; the
+        sender reclaims as if the TTL swept it)."""
+        server.abort_handoff(handoff_id)
+        self.corruptions += 1
+
+
 # -- network chaos (cross-process replica pool) ---------------------------
 
 class ChaosProxy:

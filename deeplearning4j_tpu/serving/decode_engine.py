@@ -103,6 +103,7 @@ the kernel).
 from __future__ import annotations
 
 import collections
+import hashlib
 import logging
 import threading
 import time
@@ -141,7 +142,7 @@ class _GenRequest:
                  "slot", "completed_at", "n_pages", "pages",
                  "prefill_pos", "hit_len", "n_shared", "nodes", "digests",
                  "trace", "tenant", "priority", "resumed_at",
-                 "preempted")
+                 "preempted", "handoff", "import_state")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -180,6 +181,12 @@ class _GenRequest:
         self.n_shared = 0
         self.nodes: Optional[list] = None
         self.digests: list = []  # memoized per-chunk prompt digests
+        # KV handoff (kv_transfer): `handoff` requests export their
+        # slot state under a lease instead of entering/continuing the
+        # decode loop; `import_state` carries a validated inbound
+        # payload whose shipped pages re-bind at admission
+        self.handoff = False
+        self.import_state: Optional[dict] = None
         # the request timeline, carried across the caller-thread →
         # scheduler-thread hop (thread-locals do not cross it)
         self.trace = observability.NULL_TRACE
@@ -219,19 +226,26 @@ class _TenantState:
     locked admission/retire sections."""
 
     __slots__ = ("rate", "burst", "tokens", "last_refill", "submitted",
-                 "served", "shed_quota", "tokens_generated",
-                 "preemptions")
+                 "served", "shed_quota", "shed_page_quota",
+                 "tokens_generated", "preemptions", "max_pages")
 
     def __init__(self, rate: Optional[float] = None,
-                 burst: Optional[float] = None):
+                 burst: Optional[float] = None,
+                 max_pages: Optional[int] = None):
         self.rate = None if rate is None else float(rate)
         self.burst = float(burst) if burst is not None \
             else (self.rate if self.rate else 0.0)
         self.tokens = self.burst
+        # page-pool ceiling: the sum of this tenant's page RESERVATIONS
+        # (queued + resident) may not exceed max_pages — a tenant
+        # inside its token-rate budget can still hoard the shared page
+        # pool with a few huge-prompt requests; this caps that
+        self.max_pages = None if max_pages is None else int(max_pages)
         self.last_refill = time.monotonic()
         self.submitted = 0
         self.served = 0
         self.shed_quota = 0
+        self.shed_page_quota = 0
         self.tokens_generated = 0
         self.preemptions = 0
 
@@ -252,10 +266,12 @@ class _TenantState:
         # 0.0 sentinel would read as "zero allowance"
         return {"submitted": self.submitted, "served": self.served,
                 "shed_quota": self.shed_quota,
+                "shed_page_quota": self.shed_page_quota,
                 "tokens_generated": self.tokens_generated,
                 "preemptions": self.preemptions,
                 "rate": self.rate, "burst": self.burst or None,
-                "tokens": round(self.tokens, 3)}
+                "tokens": round(self.tokens, 3),
+                "max_pages": self.max_pages}
 
 
 def _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page):
@@ -445,7 +461,15 @@ class DecodeEngine:
                  quantize: Optional[dict] = None,
                  excursion=None,
                  parallel: Optional[dict] = None,
-                 qos: Optional[dict] = None):
+                 qos: Optional[dict] = None,
+                 role: str = "both",
+                 handoff_ttl: float = 30.0):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                'role must be "both", "prefill" or "decode", got %r'
+                % (role,))
+        if handoff_ttl <= 0:
+            raise ValueError("handoff_ttl must be > 0")
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if max_queue < 1:
@@ -484,7 +508,7 @@ class DecodeEngine:
                 raise ValueError("unknown qos keys: %s" % sorted(unknown))
             for name, spec in {**(qos.get("tenants") or {}),
                                "default": qos.get("default") or {}}.items():
-                bad = set(spec) - {"rate", "burst"}
+                bad = set(spec) - {"rate", "burst", "max_pages"}
                 if bad:
                     raise ValueError(
                         "unknown qos tenant keys for %r: %s"
@@ -493,6 +517,10 @@ class DecodeEngine:
                         and float(spec["rate"]) <= 0:
                     raise ValueError(
                         "qos tenant %r rate must be > 0" % (name,))
+                if "max_pages" in spec and spec["max_pages"] is not None \
+                        and int(spec["max_pages"]) < 1:
+                    raise ValueError(
+                        "qos tenant %r max_pages must be >= 1" % (name,))
         self._qos_cfg = dict(qos) if qos else None
         tp_degree = 1
         if parallel is not None:
@@ -552,9 +580,16 @@ class DecodeEngine:
         self._tenants: dict = {}  # guarded by: _cond
         for _name, _spec in (_q.get("tenants") or {}).items():
             self._tenants[_name] = _TenantState(
-                rate=_spec.get("rate"), burst=_spec.get("burst"))
+                rate=_spec.get("rate"), burst=_spec.get("burst"),
+                max_pages=_spec.get("max_pages"))
         self._queue_wait_ewma = 0.0  # guarded by: _cond
         self._chunk_ewma = 0.0  # guarded by: _cond
+        # KV handoff plane (kv_transfer): disagg role, the sender-side
+        # lease ledger, and the scheduler's migrate-everything switch
+        from deeplearning4j_tpu.serving.kv_transfer import LeaseTable
+        self._role = role
+        self._leases = LeaseTable(ttl=handoff_ttl)  # guarded by: _cond
+        self._migrate_all = False  # guarded by: _cond
         # counters (observable state for tests/telemetry)
         self.submitted = 0  # guarded by: _cond
         self.served = 0  # guarded by: _cond
@@ -575,6 +610,15 @@ class DecodeEngine:
         self.preemptions = 0  # guarded by: _cond
         self.slo_sheds = 0  # guarded by: _cond
         self.shed_quota = 0  # guarded by: _cond
+        self.shed_page_quota = 0  # guarded by: _cond
+        # KV migration counters: slots exported under lease / imported
+        # and resumed, lease resolutions, and outbound KV wire bytes
+        self.migrations_out = 0  # guarded by: _cond
+        self.migrations_in = 0  # guarded by: _cond
+        self.handoffs_committed = 0  # guarded by: _cond
+        self.handoffs_aborted = 0  # guarded by: _cond
+        self.handoffs_expired = 0  # guarded by: _cond
+        self.kv_transfer_bytes = 0  # guarded by: _cond
         # latency-tier counters (prefix cache + speculative decoding)
         self.prompt_tokens = 0  # guarded by: _cond
         self.prefix_hits = 0  # guarded by: _cond
@@ -1024,6 +1068,17 @@ class DecodeEngine:
             else 8 * jnp.dtype(cdt).itemsize
         self._kv_bytes_per_token = _qz.kv_bytes_per_token(
             plan.kv_geometry(), kv_quant, jnp.dtype(cdt).itemsize)
+        # content digest of the served weights: KV handoffs are stamped
+        # with the sender's digest and refused typed on mismatch — a
+        # page of KV computed under other weights must never re-bind
+        # here (and never seed this engine's prefix cache)
+        _wh = hashlib.blake2b(digest_size=8)
+        for _leaf in jax.tree_util.tree_leaves(net._params):
+            _arr = np.ascontiguousarray(np.asarray(_leaf))
+            _wh.update(str(_arr.dtype).encode())
+            _wh.update(str(_arr.shape).encode())
+            _wh.update(_arr.tobytes())
+        self._weight_version = _wh.hexdigest()
         # latency tier: prefix cache + speculative decoder are rebuilt
         # with the geometry on every (re)build, so a weight swap always
         # starts them cold — stale pages can never serve new weights
@@ -1035,7 +1090,8 @@ class DecodeEngine:
             pc_kw = {} if self._prefix_cache_cfg is True \
                 else dict(self._prefix_cache_cfg)
             self._prefix_cache = PrefixCache(page, **pc_kw) \
-                .bind_guard(self._cond).bind_recorder(self.recorder)
+                .bind_guard(self._cond).bind_recorder(self.recorder) \
+                .bind_version(self._weight_version)
         self._spec = None
         if self._speculative_cfg is not None:
             from deeplearning4j_tpu.serving.speculative import (
@@ -1117,6 +1173,11 @@ class DecodeEngine:
             if self._prefix_cache is not None:
                 # the pools just rebuilt: every cached page id is stale
                 self._prefix_cache.clear()
+            # leased page ids index into the pools that just vanished:
+            # void the ownership (the free list above is already whole)
+            # but keep payloads fetchable — a receiver mid-resume holds
+            # host copies and must still be able to finish
+            self._leases.invalidate_pages()
         if self._spec is not None:
             self._spec.reset_state()
 
@@ -1265,6 +1326,15 @@ class DecodeEngine:
                 f"request needs {need} KV pages of {self.page_size} "
                 f"tokens but the pool holds only {self.pool_pages} — "
                 "raise pool_pages or shorten the request")
+        if self._role == "decode":
+            from deeplearning4j_tpu.serving.kv_transfer import (
+                KVTransferError,
+            )
+
+            raise KVTransferError(
+                "decode-role engine accepts only resume_generate "
+                "handoffs, not fresh prompts — route prefills to a "
+                "prefill-role replica")
         trace = observability.maybe_trace()
         with self._cond:
             if self._closed:  # before the breaker door check: a closed
@@ -1287,6 +1357,9 @@ class DecodeEngine:
                           tenant=tenant, priority=priority)
         req.n_pages = need
         req.trace = trace
+        # a prefill-role engine never decodes: the finished prefill is
+        # exported under a lease and the caller redirected
+        req.handoff = self._role == "prefill"
         with self._cond:
             if self._closed:
                 err = ServerClosedError("decode engine is shut down")
@@ -1332,6 +1405,32 @@ class DecodeEngine:
                         "quota-shed", tenant=tenant,
                         bucket_tokens=round(tstate.tokens, 1),
                         rate=tstate.rate, n_tokens=int(n_tokens))
+                    raise err
+            if tstate is not None and tstate.max_pages is not None:
+                # page-pool ceiling: this tenant's RESERVATIONS (queued
+                # demand + resident requests) may not exceed max_pages.
+                # Reservation accounting (n_pages, the cold cost) is
+                # leak-proof by construction — it is recomputed from the
+                # live queue/slots, never an incremental ledger
+                live = self._tenant_pages_locked(tenant)
+                if live + need > tstate.max_pages:
+                    tstate.shed_page_quota += 1
+                    self.shed_page_quota += 1
+                    retry = max(0.001, self._step_ewma
+                                * (len(self._queue) + 1))
+                    err = TenantQuotaExceededError(
+                        f"tenant {tenant!r} KV page quota exhausted "
+                        f"({live} of {tstate.max_pages} pages reserved; "
+                        f"{need} more needed); retry in {retry:.3f}s",
+                        retry_after=retry)
+                    self._shed_obs(trace, err, tenant=tenant,
+                                   pages_reserved=live,
+                                   max_pages=tstate.max_pages,
+                                   pages_needed=need)
+                    self.recorder.event(
+                        "quota-shed", tenant=tenant, resource="pages",
+                        pages_reserved=live,
+                        max_pages=tstate.max_pages, pages_needed=need)
                     raise err
             if self._slo_shed_enabled and deadline is not None \
                     and self.decode_steps:
@@ -1442,9 +1541,18 @@ class DecodeEngine:
         if state is None:
             spec = self._default_quota or {}
             state = _TenantState(rate=spec.get("rate"),
-                                 burst=spec.get("burst"))
+                                 burst=spec.get("burst"),
+                                 max_pages=spec.get("max_pages"))
             self._tenants[tenant] = state
         return state
+
+    def _tenant_pages_locked(self, tenant: str) -> int:
+        """Pages currently reserved by `tenant`: queued demand plus
+        every resident request's reservation."""
+        assert_owned(self._cond, "DecodeEngine._tenant_pages_locked")
+        return sum(r.n_pages for r in self._queue if r.tenant == tenant) \
+            + sum(r.n_pages for r in self._slots
+                  if r is not None and r.tenant == tenant)
 
     def _sweep_expired_locked(self, now: float) -> None:
         """Shed every already-expired QUEUED request with ITS truth
@@ -1469,9 +1577,11 @@ class DecodeEngine:
         self._queue = keep
 
     def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
-                         burst: Optional[float] = None) -> None:
+                         burst: Optional[float] = None,
+                         max_pages: Optional[int] = None) -> None:
         """Install (or with `rate=None` clear) tenant `tenant`'s
-        token-rate quota at runtime — the seam the gateway's
+        token-rate quota — and with `max_pages` its KV page ceiling
+        (`None` clears it) — at runtime; the seam the gateway's
         `set_tenant_quota` RPC lands on. The bucket restarts full at
         the new burst; counters survive the change."""
         with self._cond:
@@ -1480,9 +1590,203 @@ class DecodeEngine:
             state.burst = float(burst) if burst is not None \
                 else (state.rate if state.rate else 0.0)
             state.tokens = state.burst
+            state.max_pages = None if max_pages is None else int(max_pages)
             state.last_refill = time.monotonic()
         self.recorder.event("quota-set", tenant=tenant, rate=rate,
-                            burst=burst)
+                            burst=burst, max_pages=max_pages)
+
+    # -- KV handoff public surface (kv_transfer) ---------------------------
+    def migrate_slots(self, wait: Optional[float] = 5.0) -> int:
+        """Export EVERY in-flight request (queued, mid-prefill,
+        decoding) as a leased handoff: each waiter's `result()` raises
+        the `SlotMigratedError` redirect and the pool/coordinator
+        resumes it on a peer. Returns the number of requests marked.
+        Blocks up to `wait` seconds for the scheduler's migration pass
+        to drain the engine (pass `wait=None`/0 for fire-and-forget).
+        Idempotent — an empty engine migrates nothing."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("decode engine is shut down")
+            n = len(self._queue) \
+                + sum(1 for r in self._slots if r is not None)
+            if n == 0:
+                return 0
+            self._migrate_all = True
+            self._cond.notify_all()
+            if wait:
+                deadline = time.monotonic() + wait
+                while self._migrate_all or self._queue \
+                        or any(r is not None for r in self._slots):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(min(remaining, 0.05))
+        return n
+
+    def fetch_handoff(self, handoff_id: str) -> dict:
+        """The leased payload for `handoff_id` (extends the lease TTL,
+        so an actively-resuming receiver cannot lose the race against
+        the orphan sweep). Typed `KVTransferError` for an unknown or
+        already-expired lease."""
+        from deeplearning4j_tpu.serving.kv_transfer import KVTransferError
+
+        with self._cond:
+            lease = self._leases.touch(handoff_id)
+            if lease is None:
+                raise KVTransferError(
+                    f"unknown or expired handoff lease {handoff_id!r}; "
+                    "fall back to re-prefill from the prompt")
+            return lease.payload
+
+    def commit_handoff(self, handoff_id: str) -> bool:
+        """The receiver resumed successfully: release the lease and
+        free the shipped pages on this side. Idempotent (False when the
+        lease is already resolved or expired)."""
+        with self._cond:
+            lease = self._leases.resolve(handoff_id)
+            if lease is None:
+                return False
+            self._release_lease_locked(lease)
+            self.handoffs_committed += 1
+            self._cond.notify_all()
+        self.recorder.event("handoff-commit", handoff_id=handoff_id)
+        return True
+
+    def abort_handoff(self, handoff_id: str) -> bool:
+        """The transfer failed downstream: reclaim the leased pages now
+        instead of waiting out the TTL. Idempotent."""
+        with self._cond:
+            lease = self._leases.resolve(handoff_id)
+            if lease is None:
+                return False
+            self._release_lease_locked(lease)
+            self.handoffs_aborted += 1
+            self._cond.notify_all()
+        self.recorder.event("handoff-abort", handoff_id=handoff_id)
+        return True
+
+    def resume_submit(self, payload: dict,
+                      timeout: Optional[float] = None) -> _GenRequest:
+        """Admit a fetched handoff payload: validate it against this
+        engine's weights/geometry (typed `KVTransferError` on ANY
+        mismatch or corruption — nothing is touched), then enqueue a
+        request whose shipped pages re-bind at admission (warm) or that
+        re-prefills from the prompt (cold). The deadline is the
+        SMALLER of the sender's remaining budget and `timeout`."""
+        from deeplearning4j_tpu.serving.kv_transfer import (
+            KVTransferError,
+            verify_payload,
+        )
+
+        if self._role == "prefill":
+            raise KVTransferError(
+                "prefill-role engine does not accept KV handoffs — "
+                "route resumes to a decode-capable replica")
+        payload = verify_payload(
+            payload, weight_version=self._weight_version,
+            kv_quant=self._kv_quant, page_size=self.page_size,
+            n_blocks=len(self._caches), max_len=self.max_len)
+        prompt = np.asarray(payload["prompt"], np.int32)
+        n_tokens = int(payload["n_tokens"])
+        rems = [t for t in (payload.get("deadline_remaining"), timeout)
+                if t is not None]
+        if not rems and self.default_timeout is not None:
+            rems = [self.default_timeout]
+        deadline = time.monotonic() + min(rems) if rems else None
+        req = _GenRequest(prompt, n_tokens,
+                          float(payload["temperature"]),
+                          int(payload["seed"]), deadline,
+                          tenant=payload.get("tenant"),
+                          priority=payload.get("priority") or "interactive")
+        req.trace = observability.maybe_trace()
+        req.tokens = [int(t) for t in payload["tokens"]]
+        req.resumed_at = int(payload["resumed_at"])
+        req.preempted = int(payload["preempted"])
+        if payload["kind"] == "cold":
+            # fold emitted tokens into the prompt exactly like a
+            # preemption resume: re-prefill reproduces the sequence
+            if len(req.tokens) > req.resumed_at:
+                req.prompt = np.concatenate(
+                    [req.prompt, np.asarray(req.tokens[req.resumed_at:],
+                                            np.int32)])
+                req.resumed_at = len(req.tokens)
+            t0 = req.prompt.shape[0]
+            req.n_pages = self._pages_for(
+                t0, max(1, n_tokens - req.resumed_at))
+        else:
+            req.import_state = payload
+            t0 = prompt.shape[0]
+            span = t0 + max(1, n_tokens - req.resumed_at) - 1
+            req.n_pages = max(-(-span // self.page_size),
+                              int(payload["pages_shipped"]))
+        if req.n_pages > self.pool_pages:
+            raise KVTransferError(
+                f"handoff needs {req.n_pages} KV pages but the "
+                f"receiving pool holds only {self.pool_pages}")
+        with self._cond:
+            if self._closed:
+                err = ServerClosedError("decode engine is shut down")
+                self._shed_obs(req.trace, err)
+                raise err
+            now = time.monotonic()
+            if deadline is not None and deadline <= now:
+                self.shed_deadline += 1
+                err = DeadlineExceededError(
+                    "deadline expired before handoff admission")
+                self._shed_obs(req.trace, err)
+                raise err
+            if len(self._queue) >= self.max_queue:
+                self.shed_overload += 1
+                retry = max(0.001, self._step_ewma
+                            * (len(self._queue) / self.n_slots + 1))
+                err = ServerOverloadedError(
+                    f"generation queue full ({self.max_queue} pending); "
+                    f"retry in {retry:.3f}s", retry_after=retry)
+                self._shed_obs(req.trace, err)
+                raise err
+            tstate = self._tenant_locked(req.tenant)
+            if tstate is not None:
+                # no token-rate debit: the sender already charged this
+                # request's tokens at original submission — migrating
+                # must not bill a tenant twice. The page ceiling still
+                # applies: resident pages are resident pages
+                if tstate.max_pages is not None:
+                    live = self._tenant_pages_locked(req.tenant)
+                    if live + req.n_pages > tstate.max_pages:
+                        tstate.shed_page_quota += 1
+                        self.shed_page_quota += 1
+                        err = TenantQuotaExceededError(
+                            f"tenant {req.tenant!r} KV page quota "
+                            f"exhausted ({live} of {tstate.max_pages} "
+                            f"pages reserved; {req.n_pages} more needed)",
+                            retry_after=max(0.001, self._step_ewma))
+                        self._shed_obs(req.trace, err, tenant=req.tenant)
+                        self.recorder.event(
+                            "quota-shed", tenant=req.tenant, resource="pages",
+                            pages_reserved=live,
+                            max_pages=tstate.max_pages,
+                            pages_needed=req.n_pages)
+                        raise err
+                tstate.submitted += 1
+            self.submitted += 1
+            self._pages_demand_queued += req.n_pages
+            self._queue.append(req)
+            req.trace.event("resume-enqueue", kind=payload["kind"],
+                            handoff_id=payload["handoff_id"],
+                            pages_shipped=int(payload["pages_shipped"]),
+                            emitted=len(req.tokens))
+            self._cond.notify_all()
+        return req
+
+    def resume_generate(self, payload: dict,
+                        timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking `resume_submit`: returns only the TAIL tokens this
+        engine generates — the caller splices them after the redirect's
+        already-emitted `tokens`."""
+        req = self.resume_submit(payload, timeout=timeout)
+        already = len(req.tokens)
+        out = req.result()
+        return out[already:]
 
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
@@ -1519,6 +1823,10 @@ class DecodeEngine:
                     else t0 + len(r.tokens) - r.resumed_at
             tenants = {name: state.counters()
                        for name, state in sorted(self._tenants.items())}
+            for name, counters in tenants.items():
+                counters["pages_reserved"] = self._tenant_pages_locked(name)
+            leases = len(self._leases)
+            unfetched = self._leases.unfetched()
         occupancy = (100.0 * self.active_slot_steps
                      / (self.decode_steps * self.n_slots)
                      if self.decode_steps else 0.0)
@@ -1566,7 +1874,18 @@ class DecodeEngine:
                "preemptions": self.preemptions,
                "slo_sheds": self.slo_sheds,
                "shed_quota": self.shed_quota,
+               "shed_page_quota": self.shed_page_quota,
                "tenants": tenants,
+               # KV handoff plane: slots exported under lease /
+               # imported, lease resolutions, live leases, wire bytes
+               "migrations_out": self.migrations_out,
+               "migrations_in": self.migrations_in,
+               "handoffs_committed": self.handoffs_committed,
+               "handoffs_aborted": self.handoffs_aborted,
+               "handoffs_expired": self.handoffs_expired,
+               "handoff_leases": leases,
+               "handoffs_unfetched": unfetched,
+               "kv_transfer_bytes": self.kv_transfer_bytes,
                "prompt_buckets": list(self.prompt_buckets)}
         if self._prefix_cache is not None:
             hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
@@ -1706,6 +2025,8 @@ class DecodeEngine:
                 if not self._draining and not self._closed:
                     self._admit()
                 self._expire_in_flight()
+                self._step_migrations()
+                self._sweep_leases()
                 self._step_prefills()
                 self._step_active()
                 self._maybe_swap()
@@ -1737,6 +2058,8 @@ class DecodeEngine:
             return True
         if self._draining:
             return True  # reach _maybe_swap even with empty slots
+        if self._migrate_all or self._leases.expired_pending():
+            return True  # reach the migration pass / lease sweep
         return bool(self._queue) and not self._draining
 
     def _fail_all_locked(self, err: BaseException) -> None:
@@ -1882,7 +2205,8 @@ class DecodeEngine:
                     if preempt is None:
                         return
                 elif not head.expired():
-                    if self._prefix_cache is not None:
+                    if self._prefix_cache is not None \
+                            and head.import_state is None:
                         # only the scheduler thread mutates the cache,
                         # so this lookup stays valid through the bind;
                         # a page-blocked head retries every iteration —
@@ -1990,6 +2314,17 @@ class DecodeEngine:
             row[:len(req.pages)] = req.pages
             self._page_table = self._page_table.at[slot].set(
                 jnp.asarray(row))
+            if req.import_state is not None:
+                # shipped KV re-binds directly into the slot: no
+                # prefill — the pages already hold the sender's state
+                try:
+                    self._import_into(slot, req)
+                # graftlint: disable=typed-error  converts to a typed
+                # failure: _import_failure maps the cause to
+                # KVTransferError and fails only the one request
+                except BaseException as e:
+                    self._import_failure(slot, req, e)
+                continue
             t0 = req.prompt.shape[0]
             if req.hit_len or self._is_chunked(t0):
                 with self._cond:
@@ -2068,6 +2403,11 @@ class DecodeEngine:
         # so this "first" token may already be its last
         if len(req.tokens) >= req.n_tokens or first == self.eos_token:
             self._retire(slot, req, attached=False)
+            return
+        if req.handoff:
+            # prefill-role (disagg): the freshly computed KV leaves
+            # under a lease instead of entering this engine's decode loop
+            self._export_slot(slot, req, attached=False, reason="disagg")
             return
         with self._cond:
             req.slot = slot
@@ -2177,6 +2517,9 @@ class DecodeEngine:
         if len(req.tokens) >= req.n_tokens or first == self.eos_token:
             self._retire(slot, req)
             return
+        if req.handoff:
+            self._export_slot(slot, req, attached=True, reason="disagg")
+            return
         with self._cond:
             self._active[slot] = True
 
@@ -2252,6 +2595,247 @@ class DecodeEngine:
             1e3 * (time.monotonic() - req.enqueued_at), trace=req.trace)
         self.recorder.event("retire", slot=slot, tokens=len(req.tokens))
         self._finish_obs(req)
+
+    # -- KV handoff / live migration (kv_transfer) -------------------------
+    def _export_slot(self, slot: int, req: _GenRequest, *,
+                     attached: bool = True,
+                     reason: str = "migrate") -> None:
+        """Scheduler-thread export: serialize this slot's decode state
+        (used KV pages of every block + scale sidecars, page span,
+        position/last-token registers, the LIVE per-slot PRNG key, the
+        emitted transcript) into a leased handoff payload, release the
+        slot, and finish the request with the `SlotMigratedError`
+        redirect. Page ownership moves to the lease — freed exactly
+        once by commit, abort, or TTL expiry. Must run on the scheduler
+        thread: the registers it reads are replaced functionally by
+        every dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        pos_, tok_, key_, temp_ = jax.device_get(
+            (self._pos[slot], self._tok[slot], self._keys[slot],
+             self._temps[slot]))
+        pos = int(pos_)
+        page = self.page_size
+        used = min(-(-pos // page), len(req.pages))
+        jidx = jnp.asarray(np.asarray(req.pages[:used], np.int32))
+        names = ("k", "v", "ks", "vs") if self._kv_quant else ("k", "v")
+        blocks = []
+        for c in self._caches:
+            blocks.append({name: np.asarray(jax.device_get(arr[jidx]))
+                           for name, arr in zip(names, c)})
+        handoff_id = kv_transfer.LeaseTable.new_id()
+        payload = kv_transfer.build_payload(
+            handoff_id=handoff_id, kind="warm",
+            weight_version=self._weight_version,
+            kv_quant=self._kv_quant, page_size=page,
+            n_blocks=len(self._caches), prompt=req.prompt,
+            n_tokens=req.n_tokens, temperature=req.temperature,
+            seed=req.seed, resumed_at=req.resumed_at,
+            tokens=req.tokens, blocks=blocks, pages_shipped=used,
+            pos=pos, tok=int(tok_), key=np.asarray(key_, np.uint32),
+            temp=float(temp_), tenant=req.tenant, priority=req.priority,
+            preempted=req.preempted,
+            deadline_remaining=None if req.deadline is None
+            else max(0.0, req.deadline - time.monotonic()))
+        nbytes = kv_transfer.payload_nbytes(payload)
+        with self._cond:
+            self._leases.grant(payload, pages=req.pages,
+                               n_shared=req.n_shared, nodes=req.nodes)
+            req.pages = None  # ownership moved to the lease
+            req.nodes = None
+            if attached:
+                self._slots[slot] = None
+                self._active[slot] = False
+            self.migrations_out += 1
+            self.kv_transfer_bytes += nbytes
+            self._cond.notify_all()
+        if self.breaker is not None:
+            # an export is a routing decision, not sickness: the device
+            # work so far was healthy, and the token must not be dropped
+            self.breaker.record_success(req.probe)
+        req.trace.event("migrate-out", handoff_id=handoff_id, slot=slot,
+                        pos=pos, pages_shipped=used, bytes=nbytes,
+                        reason=reason)
+        self.recorder.event("migrate-out", handoff_id=handoff_id,
+                            slot=slot, pos=pos, pages_shipped=used,
+                            bytes=nbytes, reason=reason)
+        self._finish_obs(req, kv_transfer.SlotMigratedError(
+            f"slot exported under lease {handoff_id} ({reason}); fetch "
+            "the handoff and resume on a peer",
+            handoff_id=handoff_id, tokens=list(req.tokens)))
+
+    def _export_cold(self, req: _GenRequest, *, reason: str) -> None:
+        """Export a request that holds no (complete) KV — queued, or
+        parked mid-prefill — as a cold handoff: the peer re-prefills
+        from the prompt with the same seed, reproducing the exact
+        output. No pages ride the lease (there is nothing complete to
+        ship), but the payload stays fetchable until resolution."""
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        handoff_id = kv_transfer.LeaseTable.new_id()
+        payload = kv_transfer.build_payload(
+            handoff_id=handoff_id, kind="cold",
+            weight_version=self._weight_version,
+            kv_quant=self._kv_quant, page_size=self.page_size,
+            n_blocks=len(self._caches), prompt=req.prompt,
+            n_tokens=req.n_tokens, temperature=req.temperature,
+            seed=req.seed, resumed_at=req.resumed_at,
+            tokens=req.tokens, blocks=[], pages_shipped=0,
+            tenant=req.tenant, priority=req.priority,
+            preempted=req.preempted,
+            deadline_remaining=None if req.deadline is None
+            else max(0.0, req.deadline - time.monotonic()))
+        with self._cond:
+            self._leases.grant(payload)
+            self.migrations_out += 1
+            self._cond.notify_all()
+        req.trace.event("migrate-out", handoff_id=handoff_id,
+                        kind="cold", reason=reason)
+        self.recorder.event("migrate-out", handoff_id=handoff_id,
+                            handoff_kind="cold", reason=reason)
+        self._finish_obs(req, kv_transfer.SlotMigratedError(
+            f"request exported cold under lease {handoff_id} ({reason});"
+            " resume re-prefills from the prompt on a peer",
+            handoff_id=handoff_id, tokens=list(req.tokens)))
+
+    def _step_migrations(self) -> None:
+        """One-shot migrate-everything pass (armed by
+        `migrate_slots()`): decoding slots export warm (their KV pages
+        ship), queued and mid-prefill requests export cold (partial KV
+        is never shipped — it is not provably complete)."""
+        with self._cond:
+            if not self._migrate_all:
+                return
+            self._migrate_all = False
+            queued = list(self._queue)
+            self._queue.clear()
+            for r in queued:
+                self._pages_demand_queued -= r.n_pages
+            parked = []
+            decoding = []
+            for s, r in enumerate(self._slots):
+                if r is None:
+                    continue
+                if self._active[s] and r.prefill_pos is None:
+                    decoding.append((s, r))
+                else:
+                    parked.append((s, r))
+            for s, r in parked:
+                self._slots[s] = None
+                self._active[s] = False
+                self._free_request_pages_locked(r)
+            self._cond.notify_all()
+        for r in queued:
+            self._export_cold(r, reason="migrate")
+        for s, r in parked:
+            if self.breaker is not None:
+                self.breaker.record_success(r.probe)
+            self._export_cold(r, reason="migrate")
+        for s, r in decoding:
+            self._export_slot(s, r, attached=True, reason="migrate")
+
+    def _sweep_leases(self) -> None:
+        """Orphan reclamation: a receiver that died (or never
+        committed) lets its lease expire; the pages come home here, so
+        a dead receiver can never leak sender pages."""
+        now = time.monotonic()
+        with self._cond:
+            if not self._leases.expired_pending(now):
+                return
+            for lease in self._leases.sweep(now):
+                self._release_lease_locked(lease)
+                self.handoffs_expired += 1
+                self.recorder.event("lease-expired",
+                                    handoff_id=lease.handoff_id)
+            self._cond.notify_all()
+
+    def _release_lease_locked(self, lease) -> None:
+        """Return a resolved lease's page ownership to the pool —
+        mirror of `_free_request_pages_locked`, once per lease."""
+        assert_owned(self._cond, "DecodeEngine._release_lease_locked")
+        if lease.nodes:
+            self._prefix_cache.release(lease.nodes)
+            lease.nodes = None
+        if lease.pages:
+            self._free_pages.extend(lease.pages[lease.n_shared:])
+        lease.pages = None
+
+    # graftlint: hot-loop
+    def _import_into(self, slot: int, req: _GenRequest) -> None:
+        """Re-bind a validated warm handoff into a free slot: scatter
+        the shipped pages into every block's pools (+ scale sidecars),
+        restore the position/last-token/temperature registers and the
+        live PRNG key, promote the prompt-covered pages into the prefix
+        cache (weight versions already proven equal by validation), and
+        activate — the next `_step_active` continues the sequence
+        argmax-exact."""
+        import jax.numpy as jnp
+
+        payload = req.import_state
+        shipped = int(payload["pages_shipped"])
+        jidx = jnp.asarray(np.asarray(req.pages[:shipped], np.int32))
+        names = ("k", "v", "ks", "vs") if self._kv_quant else ("k", "v")
+        new_caches = []
+        for blk, c in zip(payload["blocks"], self._caches):
+            new_c = []
+            for name, arr in zip(names, c):
+                out = arr.at[jidx].set(
+                    jnp.asarray(np.asarray(blk[name])))
+                if self._tp is not None:
+                    out = self._tp.shard_pool(out)
+                new_c.append(out)
+            new_caches.append(tuple(new_c))
+        self._caches = new_caches
+        pos = int(payload["pos"])
+        self._pos = self._pos.at[slot].set(pos)
+        self._tok = self._tok.at[slot].set(int(payload["tok"]))
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(np.asarray(payload["key"], np.uint32)))
+        self._temps = self._temps.at[slot].set(float(payload["temp"]))
+        with self._cond:
+            req.slot = slot
+            req.import_state = None
+            self._slots[slot] = req
+            self._active[slot] = True
+            self.migrations_in += 1
+            self._promote_prefix_locked(req)
+            held = self.pool_pages - len(self._free_pages)
+            self.pages_in_use_peak = max(self.pages_in_use_peak, held)
+            self._cond.notify_all()
+        if self._spec is not None:
+            # cold draft mirror: proposals start from draft-side
+            # garbage and greedy verify rejects them — still
+            # target-exact, just zero speedup until the draft re-warms
+            self._spec.seed_slot(slot, req.seed)
+        req.trace.event("migrate-in", slot=slot, pages_shipped=shipped,
+                        pos=pos)
+        self.recorder.event("migrate-in", slot=slot,
+                            handoff_id=payload["handoff_id"],
+                            pages_shipped=shipped, pos=pos)
+
+    def _import_failure(self, slot: int, req: _GenRequest,
+                        e: BaseException) -> None:
+        """A failed import touches only this request: the eager pool
+        updates are not donated dispatches, so other slots' KV is
+        intact. The breaker token returns as success — a transfer
+        failure is wire trouble, not model sickness."""
+        from deeplearning4j_tpu.serving.kv_transfer import KVTransferError
+
+        if self.breaker is not None:
+            self.breaker.record_success(req.probe)
+        with self._cond:
+            self.failures += 1
+            self._slots[slot] = None
+            self._active[slot] = False
+            self._free_request_pages_locked(req)
+            self._cond.notify_all()
+        err = e if isinstance(e, ServingError) else KVTransferError(
+            f"KV import failed: {type(e).__name__}: {e}")
+        logger.warning("decode engine: KV import failure (%s)", err)
+        self._finish_obs(req, err, phase="import")
 
     # graftlint: hot-loop
     def _expire_in_flight(self) -> None:
@@ -2585,6 +3169,13 @@ class DecodeEngine:
                 reserved = 0
                 while self._queue:
                     r = self._queue.popleft()
+                    if r.import_state is not None:
+                        # queued warm handoff: its KV was computed under
+                        # the PRE-swap weights — binding it now would
+                        # decode silently-wrong tokens. Fail it typed;
+                        # the caller's fallback ladder re-prefills
+                        misfit.append(r)
+                        continue
                     if r.prompt.shape[0] - r.resumed_at + r.n_tokens \
                             > self.max_len:
                         misfit.append(r)
@@ -2600,7 +3191,17 @@ class DecodeEngine:
                     keep.append(r)
                 self._queue = keep
                 self._pages_demand_queued = reserved
+            from deeplearning4j_tpu.serving.kv_transfer import (
+                KVTransferError,
+            )
+
             for r in misfit:
+                if r.import_state is not None:
+                    self._finish_obs(r, KVTransferError(
+                        "queued KV handoff refused: the engine's "
+                        "weights swapped while it waited — stale KV "
+                        "must not bind; fall back to re-prefill"))
+                    continue
                 self._finish_obs(r, ServingError(
                     f"request (prompt {r.prompt.shape[0]} + n_tokens "
                     f"{r.n_tokens}) no longer fits the swapped engine's "

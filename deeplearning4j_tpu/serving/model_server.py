@@ -889,12 +889,45 @@ class ModelServer:
                                priority=priority)
 
     def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
-                         burst: Optional[float] = None) -> None:
-        """Set (or clear, with `rate=None`) tenant `tenant`'s token-rate
-        quota on the decode engine — the admin seam the gateway's quota
-        RPC lands on. Requires generation serving."""
+                         burst: Optional[float] = None,
+                         max_pages: Optional[int] = None) -> None:
+        """Set (or clear, with `rate=None` / `max_pages=None`) tenant
+        `tenant`'s token-rate quota and KV page ceiling on the decode
+        engine — the admin seam the gateway's quota RPC lands on.
+        Requires generation serving."""
         self._ensure_engine().set_tenant_quota(tenant, rate=rate,
-                                               burst=burst)
+                                               burst=burst,
+                                               max_pages=max_pages)
+
+    # -- KV handoff / live migration (kv_transfer) -------------------------
+    def migrate_slots(self, wait: Optional[float] = 5.0) -> int:
+        """Export every in-flight generation as a leased KV handoff
+        (waiters raise the `SlotMigratedError` redirect; the pool
+        resumes them on peers). 0 when generation was never exercised —
+        an idle engine is not built just to migrate nothing."""
+        with self._engine_lock:
+            if self._engine is None:
+                return 0
+        return self._ensure_engine().migrate_slots(wait=wait)
+
+    def resume_generate(self, payload: dict,
+                        timeout: Optional[float] = None) -> np.ndarray:
+        """Admit a fetched KV handoff payload and return the TAIL
+        tokens this server generates (typed `KVTransferError` when the
+        payload fails validation against this server's weights or
+        geometry)."""
+        timeout = self.default_timeout if timeout is None else timeout
+        return self._ensure_engine().resume_generate(payload,
+                                                     timeout=timeout)
+
+    def fetch_handoff(self, handoff_id: str) -> dict:
+        return self._ensure_engine().fetch_handoff(handoff_id)
+
+    def commit_handoff(self, handoff_id: str) -> bool:
+        return self._ensure_engine().commit_handoff(handoff_id)
+
+    def abort_handoff(self, handoff_id: str) -> bool:
+        return self._ensure_engine().abort_handoff(handoff_id)
 
     # -- batch assembly ----------------------------------------------------
     def _pop_expired(self, req: _Request, now: float) -> bool:  # graftlint: holds _cond

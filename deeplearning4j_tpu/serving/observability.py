@@ -815,6 +815,12 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
     # sheds, quota rejections, and the per-tenant sub-dicts (keyed by
     # tenant name; each value pins TENANT_STATS_KEYS)
     "preemptions", "slo_sheds", "shed_quota", "tenants",
+    # KV transfer tier (`serving.kv_transfer`): page-quota sheds, slots
+    # exported/imported as leased handoffs, lease resolutions by
+    # outcome, live leases, and total payload bytes shipped out
+    "shed_page_quota", "migrations_out", "migrations_in",
+    "handoffs_committed", "handoffs_aborted", "handoffs_expired",
+    "handoff_leases", "handoffs_unfetched", "kv_transfer_bytes",
 })
 
 # Per-tenant counters nested under DecodeEngine ``stats()["tenants"]``
@@ -822,6 +828,9 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
 TENANT_STATS_KEYS = frozenset({
     "submitted", "served", "shed_quota", "tokens_generated",
     "preemptions", "rate", "burst", "tokens",
+    # KV page quota tier: page-ceiling rejections, the configured
+    # ceiling (None = unlimited), and the tenant's live page footprint
+    "shed_page_quota", "max_pages", "pages_reserved",
 })
 
 REPLICA_POOL_STATS_KEYS = frozenset({
@@ -833,6 +842,9 @@ REPLICA_POOL_STATS_KEYS = frozenset({
     # elasticity tier: replicas added/drained-out by the autoscaler (or
     # an operator) since construction
     "replicas_added", "replicas_removed",
+    # live decode-state migration: redirects resumed on a peer vs
+    # degraded to the full re-prefill fallback
+    "migrations", "migration_fallbacks",
 })
 
 # `Autoscaler.stats()` — registered under the pool's metrics registry

@@ -118,6 +118,12 @@ class PrefixCache:
         self.insertions = 0  # guarded by: _guard [external]
         self.evictions = 0  # guarded by: _guard [external]
         self._recorder = None  # optional FlightRecorder (engine's)
+        # weight-version tag (`bind_version`): every cached page holds
+        # KV computed under exactly these weights. The engine re-binds
+        # the tag on every (re)build — shipped pages from a KV handoff
+        # may only promote here after the transfer layer proved the
+        # sender's version equal (kv_transfer.verify_payload)
+        self.weight_version: Optional[str] = None
 
     def bind_guard(self, lock) -> "PrefixCache":
         """Register the owner's lock. Every mutating method then runs
@@ -134,6 +140,12 @@ class PrefixCache:
         self._recorder = recorder
         return self
 
+    def bind_version(self, version: Optional[str]) -> "PrefixCache":
+        """Tag the cache with the serving weights' content digest (the
+        key under which cached KV is valid)."""
+        self.weight_version = version
+        return self
+
     # -- introspection -----------------------------------------------------
     @property
     def cached_pages(self) -> int:
@@ -146,7 +158,8 @@ class PrefixCache:
                 "insertions": self.insertions,
                 "evictions": self.evictions,
                 "page_size": self.page_size,
-                "max_pages": self.max_pages}
+                "max_pages": self.max_pages,
+                "weight_version": self.weight_version}
 
     # -- lookup / binding --------------------------------------------------
     def _max_hit_pages(self, t0: int) -> int:

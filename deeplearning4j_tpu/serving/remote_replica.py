@@ -80,6 +80,10 @@ from deeplearning4j_tpu.gateway import (
     GatewayServer,
 )
 from deeplearning4j_tpu.serving import observability
+from deeplearning4j_tpu.serving.kv_transfer import (
+    KVTransferError,
+    SlotMigratedError,
+)
 from deeplearning4j_tpu.serving.model_server import (
     DeadlineExceededError,
     InferenceFailedError,
@@ -153,6 +157,8 @@ _WIRE_ERRORS: Dict[str, type] = {
     "ReplicaEvictedError": ReplicaEvictedError,
     "TenantQuotaExceededError": TenantQuotaExceededError,
     "ServerClosedError": ServiceUnavailableError,
+    "KVTransferError": KVTransferError,
+    "SlotMigratedError": SlotMigratedError,
 }
 
 # the transport failures a remote call can surface (socket.timeout IS
@@ -287,6 +293,16 @@ class RemoteReplica:
             cls = _WIRE_ERRORS.get(e.error_type or "")
             if cls is None:
                 return e
+            if cls is SlotMigratedError:
+                # a redirect, not a failure: rebuild its routing fields
+                # from the structured error payload so the pool can
+                # fetch + resume the handoff on a peer
+                data = getattr(e, "payload", None) or {}
+                return SlotMigratedError(
+                    f"remote replica {self.endpoint}: {e}",
+                    handoff_id=str(data.get("handoff_id", "")),
+                    tokens=[int(t) for t in data.get("tokens", [])],
+                    source=data.get("source") or self.endpoint)
             err = cls(f"remote replica {self.endpoint}: {e}")
             retry_after = getattr(e, "retry_after", None)
             if retry_after is not None:
@@ -382,12 +398,72 @@ class RemoteReplica:
             n_tokens=int(n_tokens), temperature=float(temperature),
             seed=int(seed), tenant=tenant, priority=priority))
 
-    def set_tenant_quota(self, tenant: str, rate=None, burst=None) -> None:
-        """Push one tenant's token-rate quota to the remote engine (the
-        wire mirror of `ModelServer.set_tenant_quota`)."""
+    def set_tenant_quota(self, tenant: str, rate=None, burst=None,
+                         max_pages=None) -> None:
+        """Push one tenant's token-rate quota + page ceiling to the
+        remote engine (the wire mirror of
+        `ModelServer.set_tenant_quota`)."""
         self._client.call("set_tenant_quota", name=self.MODEL,
                           tenant=tenant, rate=rate, burst=burst,
+                          max_pages=max_pages,
                           _timeout=self.rpc_timeout)
+
+    # -- KV handoff / live migration ---------------------------------------
+    def migrate_slots(self, wait: Optional[float] = 5.0) -> int:
+        """Ask the remote engine to export its in-flight generations as
+        leased handoffs (migrate-then-drain). Idempotent: re-running on
+        an already-drained engine migrates zero slots."""
+        try:
+            return int(self._client.call(
+                "migrate_slots", name=self.MODEL, wait=wait,
+                _timeout=self._wire_deadline(wait)))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=wait is not None,
+                               what="migrate_slots")
+
+    def resume_generate(self, payload: dict,
+                        timeout: Optional[float] = None) -> np.ndarray:
+        """Admit a fetched handoff payload on the remote engine; returns
+        the TAIL tokens it generates. NOT retried on ambiguous wire
+        failures — a re-send could double-admit the same handoff (the
+        caller's fallback is re-prefill, which is always safe)."""
+        return np.asarray(self._data_call(
+            "resume_generate", timeout, payload=payload,
+            _idempotent=False))
+
+    def fetch_handoff(self, handoff_id: str,
+                      timeout: Optional[float] = None) -> dict:
+        """Fetch a leased handoff payload from the remote sender
+        (extends the lease TTL). Read-only, so retryable."""
+        try:
+            return self._client.call(
+                "fetch_handoff", name=self.MODEL, handoff_id=handoff_id,
+                _timeout=self._wire_deadline(timeout))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=timeout is not None,
+                               what="fetch_handoff")
+
+    def commit_handoff(self, handoff_id: str) -> bool:
+        """Resolve a handoff lease after a successful resume (sender
+        frees the shipped pages). Resolve-by-id, so retryable."""
+        try:
+            return bool(self._client.call(
+                "commit_handoff", name=self.MODEL, handoff_id=handoff_id,
+                _timeout=self.rpc_timeout))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="commit_handoff")
+
+    def abort_handoff(self, handoff_id: str) -> bool:
+        """Resolve a handoff lease after a FAILED resume (sender
+        reclaims the shipped pages now, not at TTL expiry)."""
+        try:
+            return bool(self._client.call(
+                "abort_handoff", name=self.MODEL, handoff_id=handoff_id,
+                _timeout=self.rpc_timeout))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="abort_handoff")
 
     # -- health ------------------------------------------------------------
     def probe(self, x=None, timeout: Optional[float] = None

@@ -92,6 +92,10 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.serving import observability
+from deeplearning4j_tpu.serving.kv_transfer import (
+    KVTransferError,
+    SlotMigratedError,
+)
 from deeplearning4j_tpu.serving.model_server import (
     AutoscaleError,
     DeadlineExceededError,
@@ -111,6 +115,13 @@ class ReplicaEvictedError(ServingError):
     """The chosen replica was evicted between routing and dispatch (or
     found evicted mid-flight). Retryable: the pool re-routes it to
     another healthy replica under the request's failover budget."""
+
+
+# transport-level faults a KV handoff edge can surface when the victim
+# is a remote replica whose adapter is gone (RemoteReplica maps live
+# wire failures into the ServingError taxonomy; these cover a torn-down
+# client): the fallback ladder treats them exactly like typed failures
+_TRANSFER_FAULTS = (ConnectionError, TimeoutError, OSError)
 
 
 def _tag(err: BaseException, replica_id: int) -> BaseException:
@@ -222,6 +233,8 @@ class ReplicaPool:
         self.shed_unavailable = 0  # guarded by: _lock
         self.replicas_added = 0  # guarded by: _lock
         self.replicas_removed = 0  # guarded by: _lock
+        self.migrations = 0  # guarded by: _lock
+        self.migration_fallbacks = 0  # guarded by: _lock
         # observability: the pool keeps its own registry + recorder for
         # routing-layer views (failovers, hedges, probe verdicts,
         # evictions, reloads); each replica's ModelServer keeps its own
@@ -305,6 +318,8 @@ class ReplicaPool:
                 "shed_unavailable": self.shed_unavailable,
                 "replicas_added": self.replicas_added,
                 "replicas_removed": self.replicas_removed,
+                "migrations": self.migrations,
+                "migration_fallbacks": self.migration_fallbacks,
                 "ewma_latency_ms": round(1e3 * self._lat_ewma, 3),
                 "replicas": per_replica,
             }
@@ -762,12 +777,22 @@ class ReplicaPool:
         try:
             def attempt(rep, tried):
                 rem = self._remaining(deadline)
-                return self._call_replica(
-                    rep, lambda: rep.server.generate(
-                        prompt_ids, n_tokens, temperature=temperature,
-                        seed=seed, timeout=rem, tenant=tenant,
-                        priority=priority),
-                    track_latency=False)
+                try:
+                    return self._call_replica(
+                        rep, lambda: rep.server.generate(
+                            prompt_ids, n_tokens, temperature=temperature,
+                            seed=seed, timeout=rem, tenant=tenant,
+                            priority=priority),
+                        track_latency=False)
+                except SlotMigratedError as e:
+                    # a redirect, not a failure: the replica exported
+                    # this request's decode state under a lease (drain,
+                    # scale-down) — fetch it and resume on a peer. A
+                    # failed resume raises the retryable
+                    # InferenceFailedError so THIS loop re-routes the
+                    # full seeded generate (identical output, just the
+                    # re-prefill cost)
+                    return self._resume_migrated(rep, e, deadline, tried)
 
             with observability.use_trace(trace):
                 out = self._route_with_failover(attempt)
@@ -791,6 +816,83 @@ class ReplicaPool:
                 if self._probe_gen is None:
                     self._probe_gen = armed
         return out
+
+    def _resume_migrated(self, victim: _Replica,
+                         redirect: SlotMigratedError, deadline,
+                         tried: set) -> np.ndarray:
+        """Finish one migrated generation: fetch the leased KV payload
+        from the exporting `victim`, resume it on a healthy peer, splice
+        the victim's already-emitted tokens in front of the peer's tail.
+        Any transfer/resume failure aborts the lease (the victim
+        reclaims its pages immediately) and raises the retryable
+        `InferenceFailedError` so the failover loop re-runs the full
+        seeded generate — the degradation ladder's last rung, which
+        reproduces the exact same output."""
+        trace = observability.current_trace()
+        handoff_id = redirect.handoff_id
+        if trace:
+            trace.event("migrate-redirect", replica=victim.id,
+                        handoff_id=handoff_id,
+                        emitted=len(redirect.tokens))
+        self.recorder.event("migrate-redirect", replica=victim.id,
+                            handoff_id=handoff_id)
+        try:
+            rem = self._remaining(deadline)
+            payload = victim.server.fetch_handoff(handoff_id)
+            peer = self._pick(exclude=tried | {victim.id})
+            if peer is None or peer.id == victim.id:
+                raise KVTransferError(
+                    "no healthy peer to resume the migrated slot on")
+            if trace:
+                trace.event("migrate-resume", replica=peer.id,
+                            handoff_id=handoff_id)
+            tail = self._call_replica(
+                peer, lambda: peer.server.resume_generate(
+                    payload, timeout=rem),
+                track_latency=False)
+        except DeadlineExceededError:
+            raise  # terminal: a peer cannot give the time back
+        except (ServingError, *_TRANSFER_FAULTS) as e:
+            # best-effort early reclaim: without it the victim's pages
+            # stay leased until the TTL sweep
+            try:
+                victim.server.abort_handoff(handoff_id)
+            except (ServingError, *_TRANSFER_FAULTS):
+                logger.info(
+                    "replica pool: abort_handoff %s unreachable after "
+                    "failed resume; victim's lease sweep reclaims it",
+                    handoff_id)
+            with self._lock:
+                self.migration_fallbacks += 1
+            if trace:
+                trace.event("migrate-fallback", replica=victim.id,
+                            error=type(e).__name__)
+            self.recorder.event("migrate-fallback", replica=victim.id,
+                                handoff_id=handoff_id,
+                                error=type(e).__name__)
+            raise _tag(InferenceFailedError(
+                f"migrated slot {handoff_id} could not be resumed "
+                f"({type(e).__name__}: {e}); falling back to a full "
+                "re-prefill on another replica"), victim.id) from e
+        # success: resolve the lease so the victim frees the shipped
+        # pages now instead of at TTL expiry (best-effort — expiry is
+        # the backstop)
+        try:
+            victim.server.commit_handoff(handoff_id)
+        except (ServingError, *_TRANSFER_FAULTS):
+            logger.info(
+                "replica pool: commit_handoff %s unreachable after "
+                "successful resume; victim's lease sweep reclaims it",
+                handoff_id)
+        with self._lock:
+            self.migrations += 1
+        if trace:
+            trace.event("migrate-done", handoff_id=handoff_id,
+                        spliced=len(redirect.tokens))
+        self.recorder.event("migrate-done", handoff_id=handoff_id)
+        return np.concatenate([
+            np.asarray(redirect.tokens, np.int32),
+            np.asarray(tail, np.int32).reshape(-1)])
 
     # -- health probing ----------------------------------------------------
     def _probe_input(self) -> Optional[np.ndarray]:
@@ -1111,14 +1213,59 @@ class ReplicaPool:
         work to finish so the reload's canary/swap does not contend
         with live traffic. A drain timeout is not fatal — `reload`'s
         write lock still guarantees in-flight work finishes on the old
-        model; the bound just caps how long a deploy can stall."""
+        model; the bound just caps how long a deploy can stall.
+
+        Migrate-then-drain: when the victim can export decode state
+        (`migrate_slots`) AND a healthy peer exists to resume on, its
+        in-flight generations are exported as leased KV handoffs first —
+        their waiters get the `SlotMigratedError` redirect and finish on
+        a peer mid-sequence instead of holding the drain for their full
+        tails. With no peer the export is skipped: a redirect nobody can
+        resume would turn a finishable request into a fallback."""
         with self._lock:
             if rep.state == "healthy":
                 rep.state = "draining"
+            peers = sum(1 for r in self._replicas
+                        if r.id != rep.id and r.state == "healthy")
         self.recorder.event("drain", replica=rep.id, reason=reason)
+        moved = 0
+        if peers >= 1 and hasattr(rep.server, "migrate_slots"):
+            try:
+                moved = rep.server.migrate_slots(wait=drain_timeout)
+            except (ServingError, *_TRANSFER_FAULTS) as e:
+                # the export is an optimization — a victim that cannot
+                # export still drains the classic way (bounded wait)
+                logger.info(
+                    "replica pool: migrate-then-drain export failed on "
+                    "replica %d (%s); draining without migration",
+                    rep.id, type(e).__name__)
+            else:
+                if moved:
+                    self.recorder.event("migrate-drain", replica=rep.id,
+                                        slots=moved, reason=reason)
+                    logger.info(
+                        "replica pool: migrated %d in-flight slot(s) "
+                        "off replica %d before drain (%s)",
+                        moved, rep.id, reason)
         deadline = time.monotonic() + drain_timeout
         while rep.server.pending() and time.monotonic() < deadline:
             time.sleep(0.005)
+        if moved:
+            # the exported payloads live ON the victim until their
+            # receivers fetch them; letting the caller dispose the
+            # victim before that would turn every migration into a
+            # fallback re-prefill. Wait (same bounded budget) until no
+            # lease is unfetched — commit/abort can land after disposal
+            # (the resume already holds the bytes; expiry is moot on a
+            # dead sender), so fetched is the bar, not resolved
+            while time.monotonic() < deadline:
+                try:
+                    gen = rep.server.stats().get("generation", {})
+                except (ServingError, *_TRANSFER_FAULTS):
+                    break  # victim unreachable: nothing left to wait on
+                if not gen.get("handoffs_unfetched", 0):
+                    break
+                time.sleep(0.01)
 
     # -- elasticity (the autoscaler's seam) --------------------------------
     def add_replica(self, server, *, healthy: bool = False) -> int:
@@ -1191,14 +1338,17 @@ class ReplicaPool:
                     replica_id)
         return rep.server
 
-    def set_tenant_quota(self, tenant: str, rate=None, burst=None) -> None:
-        """Fan one tenant's token-rate quota out to every replica (the
-        quota is enforced per decode engine; a pool-level budget would
-        need cross-replica accounting the wire does not carry)."""
+    def set_tenant_quota(self, tenant: str, rate=None, burst=None,
+                         max_pages=None) -> None:
+        """Fan one tenant's token-rate quota + KV page ceiling out to
+        every replica (the quota is enforced per decode engine; a
+        pool-level budget would need cross-replica accounting the wire
+        does not carry)."""
         with self._lock:
             replicas = list(self._replicas)
         for rep in replicas:
-            rep.server.set_tenant_quota(tenant, rate=rate, burst=burst)
+            rep.server.set_tenant_quota(tenant, rate=rate, burst=burst,
+                                        max_pages=max_pages)
 
     # -- shutdown ----------------------------------------------------------
     def shutdown(self, drain_timeout: float = 10.0) -> bool:

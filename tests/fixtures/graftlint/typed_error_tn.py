@@ -58,3 +58,24 @@ def wire_cleanup(conns):
             c.close()
         except OSError as e:  # logged absorb: legal (cleanup path)
             logger.warning("close failed: %s", e)
+
+
+def fetch_kv_pages(victim, handoff_id):
+    try:
+        return victim.fetch_handoff(handoff_id)
+    except ConnectionResetError as e:  # typed re-prefill fallback: legal
+        raise ServingError(f"KV fetch failed; falling back: {e}")
+
+
+def abort_lease_best_effort(victim, handoff_id):
+    try:
+        victim.abort_handoff(handoff_id)
+    except (ConnectionError, TimeoutError, OSError):  # logged absorb:
+        logger.info("abort unreachable; the TTL sweep reclaims it")
+
+
+def commit_lease(sender, handoff_id):
+    try:
+        return sender.commit_handoff(handoff_id)
+    except TimeoutError:  # explicit verdict: the caller sees False
+        return False

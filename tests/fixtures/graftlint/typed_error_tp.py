@@ -1,5 +1,9 @@
 """True positives for the typed-error rule: generic raises, silent
-broad catches, and silent wire/transport absorbs in a serving path."""
+broad catches, and silent wire/transport absorbs in a serving path —
+including the KV-transfer edges (page fetch, lease commit, frame
+shipping) where a vanished wire failure becomes silent corruption."""
+
+import socket
 
 
 class ServingError(RuntimeError):
@@ -45,3 +49,25 @@ def pump(conns):
             c.flush()
         except (TimeoutError, BrokenPipeError):  # TP: silent skip
             continue
+
+
+def fetch_kv_pages(victim, handoff_id):
+    try:
+        return victim.fetch_handoff(handoff_id)
+    except ConnectionResetError:  # TP: partition mid-migration vanishes
+        pass
+
+
+def commit_lease(sender, handoff_id):
+    try:
+        sender.commit_handoff(handoff_id)
+    except socket.timeout:  # TP: the lease outcome is simply dropped
+        return None
+
+
+def ship_pages(conn, frames):
+    for frame in frames:
+        try:
+            conn.sendall(frame)
+        except ConnectionAbortedError:  # TP: a dropped page frame is
+            break                       # silent corruption downstream

@@ -1,0 +1,717 @@
+"""Disaggregated prefill/decode + live decode-state migration
+(`serving/kv_transfer.py`, ISSUE 17).
+
+The load-bearing contract is the robustness ladder around KV-page
+shipping:
+
+- **bit-identical resume**: a request exported mid-sequence (leased
+  handoff of its KV pages + registers + RNG key) and resumed on a peer
+  engine emits EXACTLY the tokens an uninterrupted run would —
+  across chunked prefill, prefix-cache hits, speculative decode,
+  int8-quantized KV, and tp=2 sharding;
+- **no silent corruption**: a flipped or truncated page frame is
+  refused with a typed `KVTransferError` before anything is touched —
+  never absorbed into wrong tokens;
+- **no leaked pages**: commit, abort, and lease-TTL expiry all return
+  the shipped pages to the sender's pool, so a dead receiver cannot
+  leak sender memory; refcounts/free-lists balance on BOTH ends;
+- **degradation ladder**: when the transfer itself fails
+  (partition mid-migration), the pool falls back to a full seeded
+  re-prefill on a healthy peer and the caller still sees the exact
+  same tokens — zero failed requests;
+- **quota fencing**: per-tenant KV page ceilings shed typed
+  (`TenantQuotaExceededError`) with per-tenant counters and a
+  "quota-shed" flight event.
+
+Everything runs on CPU with tiny shapes; the cross-process kill -9
+drill (the ISSUE acceptance) is marked `multiprocess` + `chaos` and
+guarded by the same SIGALRM wedge guard as test_remote_replica_mp.py.
+"""
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (
+    generate,
+    gpt_configuration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    DisaggCoordinator,
+    KVTransferCorruptionInjector,
+    KVTransferError,
+    ModelServer,
+    ReplicaPool,
+    SlotMigratedError,
+    TenantQuotaExceededError,
+    observability,
+)
+
+VOCAB = 48
+
+
+def _gpt_net(seed: int = 12345, **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("max_length", 64)
+    net = MultiLayerNetwork(gpt_configuration(seed=seed, **kw))
+    net.init()
+    return net
+
+
+def _prompts(n, t0, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, (n, t0)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt_net()
+
+
+def _engine(net, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prompt_buckets", (8,))
+    # one token per scheduler step: migration tests poll for "a few
+    # tokens emitted" then export, and fused 4-token decode chunks
+    # would race the whole sequence past the export flag
+    kw.setdefault("decode_chunk", 1)
+    return DecodeEngine(net, **kw)
+
+
+def _await(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+def _slow(dt=0.02):
+    """A pre-decode drag hook for SENDER engines: one token per ~dt
+    keeps a tiny-model sequence in flight long enough for the export
+    flag to land mid-decode instead of racing it to completion."""
+    def hook(phase, info):
+        if phase == "pre_decode":
+            time.sleep(dt)
+    return hook
+
+
+def _migrate_one(src, dst, prompt, n_tokens, *, warm_tokens=2, seed=0):
+    """Submit on `src`, wait for `warm_tokens` emitted tokens (0 skips
+    the wait — the export may then be cold), migrate, resume on `dst`,
+    splice. Returns (payload, spliced_tokens, handoff_id)."""
+    req = src.submit(prompt, n_tokens, seed=seed, timeout=120.0)
+    if warm_tokens:
+        _await(lambda: len(req.tokens) >= warm_tokens, 60.0,
+               f"{warm_tokens} decode tokens before the migration")
+    assert src.migrate_slots(wait=10.0) >= 1
+    with pytest.raises(SlotMigratedError) as ei:
+        req.result(timeout=60.0)
+    redirect = ei.value
+    assert redirect.handoff_id
+    payload = src.fetch_handoff(redirect.handoff_id)
+    tail = dst.resume_generate(payload, timeout=120.0)
+    out = np.concatenate([np.asarray(redirect.tokens, np.int32),
+                          np.asarray(tail, np.int32).reshape(-1)])
+    return payload, out, redirect.handoff_id
+
+
+# ----------------------------------------------------- warm handoff parity
+
+
+def test_warm_migration_mid_decode_argmax_exact_and_ledger_balanced(net):
+    """The tentpole pin: a decoding slot exported after >=2 emitted
+    tokens and resumed on a peer finishes argmax-identical to
+    whole-batch `generate`, the commit frees the sender's leased
+    pages, and BOTH pools drain back to zero pages in use."""
+    prompt = _prompts(1, 5)[0]
+    expected = generate(net, prompt[None], 12, temperature=0.0)[0]
+    src = _engine(net, step_hooks=[_slow()])
+    dst = _engine(net)
+    try:
+        payload, out, hid = _migrate_one(src, dst, prompt, 12)
+        assert payload["kind"] == "warm"
+        assert int(payload["pages_shipped"]) >= 1
+        np.testing.assert_array_equal(out, expected)
+        # commit is idempotent: True resolves the lease, False repeats
+        assert src.commit_handoff(hid) is True
+        assert src.commit_handoff(hid) is False
+        s_src, s_dst = src.stats(), dst.stats()
+        assert s_src["migrations_out"] == 1
+        assert s_src["handoffs_committed"] == 1
+        assert s_src["kv_transfer_bytes"] > 0
+        assert s_src["handoff_leases"] == 0
+        assert s_src["handoffs_unfetched"] == 0
+        assert s_src["pages_in_use"] == 0, "sender leaked shipped pages"
+        assert s_dst["migrations_in"] == 1
+        assert s_dst["served"] == 1
+        assert s_dst["pages_in_use"] == 0, "receiver leaked pages"
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+_VARIANTS = {
+    "chunked-prefill": (dict(max_len=48, prompt_buckets=(4,),
+                             prefill_chunk=8, page_size=8), 20, 8),
+    "prefix-hit": (dict(max_len=40, page_size=8, prefill_chunk=8,
+                        prefix_cache=True), 16, 8),
+    "speculative": (dict(speculative={"draft": "self", "k": 3}), 5, 14),
+}
+
+
+@pytest.mark.parametrize("variant", sorted(_VARIANTS))
+def test_migration_parity_across_engine_variants(net, variant):
+    """The satellite matrix: mid-sequence migration stays argmax-exact
+    when the sequence was built by chunked prefill, admitted through a
+    prefix-cache hit, or decoded speculatively."""
+    kw, t0, n = _VARIANTS[variant]
+    prompt = _prompts(1, t0, seed=3)[0]
+    expected = generate(net, prompt[None], n, temperature=0.0)[0]
+    src = _engine(net, step_hooks=[_slow()], **kw)
+    dst = _engine(net, **kw)
+    try:
+        if variant == "prefix-hit":
+            # warm the sender's prefix cache so the migrated request
+            # was admitted THROUGH a hit (shared pages ref-counted)
+            np.testing.assert_array_equal(
+                src.generate(prompt, n, timeout=120.0), expected)
+            _await(lambda: src.pending() == 0, 30.0, "warmup drain")
+        _, out, hid = _migrate_one(src, dst, prompt, n)
+        np.testing.assert_array_equal(out, expected)
+        src.commit_handoff(hid)
+        # ledger balance: everything not deliberately resident in the
+        # prefix cache drains back to the free list on both ends
+        def _leaked(eng):
+            s = eng.stats()
+            cached = s.get("prefix_cache", {}).get("cached_pages", 0)
+            return s["pages_in_use"] - cached
+        _await(lambda: _leaked(src) == 0, 30.0,
+               "sender page ledger draining to zero")
+        assert _leaked(dst) == 0
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_int8_kv_migration_bit_identical_to_uninterrupted_run(net):
+    """int8-KV migration ships the quantized pages AND their f32 scale
+    sidecars: the resumed run must be bit-identical to an
+    uninterrupted run on an identically-configured int8 engine (f32
+    whole-batch parity would hide a scale-sidecar loss)."""
+    kw = dict(quantize={"kv": "int8"}, page_size=8)
+    prompt = _prompts(1, 5, seed=7)[0]
+    ref = _engine(net, **kw)
+    try:
+        expected = ref.generate(prompt, 10, timeout=120.0)
+    finally:
+        ref.shutdown()
+    src = _engine(net, step_hooks=[_slow()], **kw)
+    dst = _engine(net, **kw)
+    try:
+        payload, out, hid = _migrate_one(src, dst, prompt, 10)
+        assert payload["kv_quant"] == "int8"
+        np.testing.assert_array_equal(out, expected)
+        src.commit_handoff(hid)
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+@pytest.mark.tp
+def test_tp2_migration_parity(net, tp_mesh2):
+    """A tp=2-sharded engine gathers its pools for export and
+    re-shards on import: migration parity holds across the mesh."""
+    kw = dict(parallel={"tp": 2})
+    prompt = _prompts(1, 5, seed=11)[0]
+    expected = generate(net, prompt[None], 8, temperature=0.0)[0]
+    src = _engine(net, step_hooks=[_slow()], **kw)
+    dst = _engine(net, **kw)
+    try:
+        _, out, hid = _migrate_one(src, dst, prompt, 8)
+        np.testing.assert_array_equal(out, expected)
+        src.commit_handoff(hid)
+        assert src.stats()["pages_in_use"] == 0
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_cold_export_of_queued_requests_reprefills_exact(net):
+    """`migrate_slots` exports EVERYTHING in flight: decoding slots
+    ship warm, a still-queued request ships cold (prompt + emitted
+    tokens only) and re-prefills on the receiver — all three resume
+    argmax-exact."""
+    prompts = _prompts(2, 5, seed=5)
+    expected = generate(net, prompts, 16, temperature=0.0)
+    src = _engine(net, n_slots=1, step_hooks=[_slow()])
+    dst = _engine(net)
+    try:
+        reqs = [src.submit(p, 16, timeout=120.0) for p in prompts]
+        # the single slot decodes request 0; request 1 queues behind it
+        _await(lambda: len(reqs[0].tokens) >= 2, 60.0,
+               "the slot decoding")
+        assert src.migrate_slots(wait=10.0) == 2
+        kinds, outs = [], {}
+        for i, req in enumerate(reqs):
+            with pytest.raises(SlotMigratedError) as ei:
+                req.result(timeout=60.0)
+            payload = src.fetch_handoff(ei.value.handoff_id)
+            kinds.append(payload["kind"])
+            tail = dst.resume_generate(payload, timeout=120.0)
+            outs[i] = np.concatenate(
+                [np.asarray(ei.value.tokens, np.int32),
+                 np.asarray(tail, np.int32).reshape(-1)])
+            src.commit_handoff(ei.value.handoff_id)
+        assert kinds == ["warm", "cold"], kinds
+        for i in range(2):
+            np.testing.assert_array_equal(outs[i], expected[i])
+        assert src.stats()["pages_in_use"] == 0
+        assert dst.stats()["pages_in_use"] == 0
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+# ------------------------------------------------ disaggregated roles
+
+
+def test_prefill_role_exports_decode_role_resumes(net):
+    """The disaggregated pair: a prefill-role engine redirects every
+    finished prefill as a handoff (it never decodes), a decode-role
+    engine accepts ONLY handoffs — fresh prompts are refused typed in
+    both wrong directions."""
+    prompt = _prompts(1, 5, seed=9)[0]
+    expected = generate(net, prompt[None], 8, temperature=0.0)[0]
+    pre = _engine(net, role="prefill")
+    dec = _engine(net, role="decode")
+    try:
+        req = pre.submit(prompt, 8, timeout=120.0)
+        with pytest.raises(SlotMigratedError) as ei:
+            req.result(timeout=60.0)
+        payload = pre.fetch_handoff(ei.value.handoff_id)
+        out = np.concatenate(
+            [np.asarray(ei.value.tokens, np.int32),
+             np.asarray(dec.resume_generate(payload, timeout=120.0),
+                        np.int32).reshape(-1)])
+        np.testing.assert_array_equal(out, expected)
+        pre.commit_handoff(ei.value.handoff_id)
+        with pytest.raises(KVTransferError, match="decode-role"):
+            dec.submit(prompt, 4)
+        with pytest.raises(KVTransferError, match="prefill-role"):
+            pre.resume_generate(payload)
+    finally:
+        pre.shutdown()
+        dec.shutdown()
+
+
+def test_disagg_coordinator_parity_and_stats(net):
+    """`DisaggCoordinator` (the gateway's `serving={"disagg": ...}`
+    target) routes prefill->ship->decode end to end: argmax parity,
+    one handoff per request, zero fallbacks, wire throughput
+    reported."""
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": (8,)}
+    prompts = _prompts(3, 5)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    co = DisaggCoordinator(net, server_kwargs={"generation": gen})
+    try:
+        for i in range(3):
+            np.testing.assert_array_equal(
+                co.generate(prompts[i], 6, timeout=120.0), expected[i])
+        st = co.stats()
+        assert st["disagg"] is True
+        assert st["handoffs"] == 3 and st["fallbacks"] == 0
+        assert st["kv_transfer_mbytes"] > 0
+        assert st["kv_transfer_mbytes_per_sec"] > 0
+        assert len(st["prefill"]) == 1 and len(st["decode"]) == 1
+    finally:
+        co.shutdown()
+
+
+def test_gateway_disagg_config_round_trip(net):
+    """`serving={"disagg": ...}` through the gateway: generate parity
+    over the wire and `server_stats` exposing the disagg plane."""
+    from deeplearning4j_tpu.gateway import GatewayClient, GatewayServer
+
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": (8,),
+           "decode_chunk": 1, "step_hooks": [_slow()]}
+    prompt = _prompts(1, 5)[0]
+    expected = generate(net, prompt[None], 6, temperature=0.0)[0]
+    srv = GatewayServer(serving={"generation": gen,
+                                 "disagg": {"decode_replicas": 1}})
+    srv.start()
+    client = GatewayClient(port=srv.port)
+    try:
+        conf = gpt_configuration(vocab_size=VOCAB, d_model=32, n_heads=2,
+                                 n_layers=2, max_length=64, seed=12345)
+        client.call("create_model", name="m", config=conf.to_json())
+        out = client.call("generate", name="m", prompt_ids=prompt,
+                          n_tokens=6)
+        np.testing.assert_array_equal(np.asarray(out), expected)
+        st = client.call("server_stats", name="m")
+        assert st["disagg"] is True and st["handoffs"] >= 1
+    finally:
+        client.close()
+        srv.stop()
+
+
+# --------------------------------------------- corruption / lease ladder
+
+
+@pytest.mark.chaos
+def test_corrupted_frames_refused_typed_original_still_resumes(net):
+    """`KVTransferCorruptionInjector`: a bit-flipped page and a
+    truncated pool array are BOTH refused with `KVTransferError`
+    before any state is touched — and the pristine payload still
+    resumes exactly afterwards (refusal is non-destructive)."""
+    prompt = _prompts(1, 5, seed=13)[0]
+    expected = generate(net, prompt[None], 10, temperature=0.0)[0]
+    src = _engine(net, step_hooks=[_slow()])
+    dst = _engine(net)
+    inj = KVTransferCorruptionInjector()
+    try:
+        req = src.submit(prompt, 10, timeout=120.0)
+        _await(lambda: len(req.tokens) >= 2, 60.0, "warm decode tokens")
+        src.migrate_slots(wait=10.0)
+        with pytest.raises(SlotMigratedError) as ei:
+            req.result(timeout=60.0)
+        payload = src.fetch_handoff(ei.value.handoff_id)
+        with pytest.raises(KVTransferError, match="checksum"):
+            dst.resume_generate(inj.flip_page(payload), timeout=60.0)
+        with pytest.raises(KVTransferError):
+            dst.resume_generate(inj.truncate(payload), timeout=60.0)
+        assert inj.corruptions == 2
+        # nothing was absorbed: the receiver admitted no request and
+        # holds no pages, and the untouched payload still resumes
+        assert dst.stats()["submitted"] == 0
+        assert dst.stats()["pages_in_use"] == 0
+        tail = dst.resume_generate(payload, timeout=120.0)
+        out = np.concatenate([np.asarray(ei.value.tokens, np.int32),
+                              np.asarray(tail, np.int32).reshape(-1)])
+        np.testing.assert_array_equal(out, expected)
+        src.commit_handoff(ei.value.handoff_id)
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+@pytest.mark.chaos
+def test_lease_ttl_expiry_reclaims_orphaned_pages(net):
+    """Orphan reclamation: a receiver that never fetches lets the
+    lease TTL expire — the sweep returns the shipped pages to the
+    sender's free list and a late fetch is refused typed."""
+    prompt = _prompts(1, 5, seed=17)[0]
+    src = _engine(net, handoff_ttl=0.3, step_hooks=[_slow()])
+    try:
+        req = src.submit(prompt, 10, timeout=120.0)
+        _await(lambda: len(req.tokens) >= 2, 60.0, "warm decode tokens")
+        src.migrate_slots(wait=10.0)
+        with pytest.raises(SlotMigratedError) as ei:
+            req.result(timeout=60.0)
+        assert src.stats()["handoff_leases"] == 1
+        _await(lambda: src.stats()["handoffs_expired"] >= 1, 30.0,
+               "the lease TTL sweep")
+        s = src.stats()
+        assert s["handoff_leases"] == 0
+        assert s["pages_in_use"] == 0, "expired lease leaked pages"
+        with pytest.raises(KVTransferError, match="expired"):
+            src.fetch_handoff(ei.value.handoff_id)
+    finally:
+        src.shutdown()
+
+
+def test_stale_weights_refused_typed_and_abort_reclaims(net):
+    """A handoff lands on a receiver serving DIFFERENT weights: the
+    weight-version pin refuses it typed (silently resuming on new
+    weights would corrupt the sequence), and the sender-side abort
+    reclaims the leased pages immediately."""
+    prompt = _prompts(1, 5, seed=19)[0]
+    src = _engine(net, step_hooks=[_slow()])
+    other = _engine(_gpt_net(seed=999))
+    try:
+        req = src.submit(prompt, 10, timeout=120.0)
+        _await(lambda: len(req.tokens) >= 2, 60.0, "warm decode tokens")
+        src.migrate_slots(wait=10.0)
+        with pytest.raises(SlotMigratedError) as ei:
+            req.result(timeout=60.0)
+        payload = src.fetch_handoff(ei.value.handoff_id)
+        with pytest.raises(KVTransferError, match="weight"):
+            other.resume_generate(payload, timeout=60.0)
+        assert src.abort_handoff(ei.value.handoff_id) is True
+        s = src.stats()
+        assert s["handoffs_aborted"] == 1
+        assert s["pages_in_use"] == 0
+    finally:
+        src.shutdown()
+        other.shutdown()
+
+
+# --------------------------------------------------- tenant page quotas
+
+
+def test_tenant_kv_page_quota_sheds_typed_with_counters(net):
+    """Satellite 1: `qos={"tenants": {t: {"max_pages": N}}}` fences a
+    tenant's resident KV pages — an over-quota admission sheds
+    `TenantQuotaExceededError` with per-tenant counters, a
+    "quota-shed" flight event, and NO effect on other tenants;
+    clearing the ceiling at runtime re-admits."""
+    eng = _engine(net, page_size=8,
+                  qos={"tenants": {"t": {"max_pages": 1}}})
+    prompt = _prompts(1, 8, seed=23)[0]
+    expected = generate(net, prompt[None], 8, temperature=0.0)[0]
+    try:
+        # t0=8 + 8 tokens on 8-token pages needs 2 pages > the 1-page cap
+        with pytest.raises(TenantQuotaExceededError, match="page"):
+            eng.submit(prompt, 8, tenant="t")
+        s = eng.stats()
+        assert s["shed_page_quota"] == 1
+        assert s["tenants"]["t"]["shed_page_quota"] == 1
+        assert s["tenants"]["t"]["max_pages"] == 1
+        assert s["tenants"]["t"]["pages_reserved"] == 0
+        assert any(e["kind"] == "quota-shed"
+                   for e in eng.flight_record()["events"])
+        # an unfenced tenant is untouched by t's ceiling
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 8, tenant="u", timeout=120.0), expected)
+        # runtime clear through the same seam the gateway RPC lands on
+        eng.set_tenant_quota("t", max_pages=None)
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 8, tenant="t", timeout=120.0), expected)
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------- pool-level migration
+
+
+@pytest.mark.chaos
+def test_pool_scale_down_migrates_live_slot_under_one_trace(net):
+    """The live-migration acceptance (in-process): `remove_replica` of
+    a replica mid-decode exports its slot, the pool resumes it on the
+    survivor, the caller sees the exact whole-batch tokens, and the
+    flight recorder names the migration decisions under the caller's
+    one trace_id."""
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": (8,),
+           "decode_chunk": 1, "step_hooks": [_slow()]}
+    prompt = _prompts(1, 5)[0]
+    expected = generate(net, prompt[None], 24, temperature=0.0)[0]
+    pool = ReplicaPool.from_net(net, 2, server_kwargs={"generation": gen},
+                                probe_interval=30.0)
+    victim_server = None
+    try:
+        trace = observability.Trace()
+        res = {}
+
+        def run():
+            with observability.use_trace(trace):
+                res["out"] = pool.generate(prompt, 24, timeout=120.0)
+
+        t = threading.Thread(target=run)
+        t.start()
+
+        def find_victim():
+            for rid, r in pool.stats()["replicas"].items():
+                if r.get("generation", {}).get("active_slots", 0) > 0:
+                    return int(rid)
+            return None
+
+        _await(lambda: find_victim() is not None, 60.0,
+               "an active decode slot to scale away from")
+        victim_server = pool.remove_replica(find_victim(),
+                                            drain_timeout=30.0)
+        t.join(60.0)
+        assert not t.is_alive(), "migrated generate never completed"
+        np.testing.assert_array_equal(res["out"], expected)
+        s = pool.stats()
+        assert s["migrations"] == 1 and s["migration_fallbacks"] == 0
+        kinds = [sp["name"] for sp in trace.to_dict()["spans"]]
+        assert "migrate-redirect" in kinds and "migrate-done" in kinds
+        events = pool.flight_record()["pool"]["events"]
+        assert any(e["kind"] == "migrate-redirect" for e in events)
+        assert any(e["kind"] == "migrate-drain" for e in events)
+    finally:
+        if victim_server is not None:
+            victim_server.shutdown()
+        pool.shutdown()
+
+
+@pytest.mark.chaos
+def test_pool_partition_mid_migration_falls_back_to_reprefill(net):
+    """Degradation ladder's last rung: the victim exports its slot but
+    the page fetch dies (partition mid-migration) — the pool aborts
+    the lease, falls back to a full seeded re-prefill on the
+    survivor, and the caller STILL gets the exact tokens. Zero failed
+    requests; the fallback is counted and flight-recorded."""
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": (8,),
+           "decode_chunk": 1, "step_hooks": [_slow()]}
+    prompt = _prompts(1, 5)[0]
+    expected = generate(net, prompt[None], 24, temperature=0.0)[0]
+    pool = ReplicaPool.from_net(net, 2, server_kwargs={"generation": gen},
+                                probe_interval=30.0)
+    victim_server = None
+    try:
+        res = {}
+
+        def run():
+            res["out"] = pool.generate(prompt, 24, timeout=120.0)
+
+        t = threading.Thread(target=run)
+        t.start()
+
+        def find_victim():
+            for rid, r in pool.stats()["replicas"].items():
+                if r.get("generation", {}).get("active_slots", 0) > 0:
+                    return int(rid)
+            return None
+
+        _await(lambda: find_victim() is not None, 60.0,
+               "an active decode slot")
+        vid = find_victim()
+        rep = next(r for r in pool._replicas if r.id == vid)
+
+        def dead_fetch(handoff_id):
+            raise ConnectionError(
+                "injected partition: KV fetch wire cut mid-migration")
+
+        rep.server.fetch_handoff = dead_fetch
+        victim_server = pool.remove_replica(vid, drain_timeout=30.0)
+        t.join(60.0)
+        assert not t.is_alive(), "fallback generate never completed"
+        np.testing.assert_array_equal(res["out"], expected)
+        s = pool.stats()
+        assert s["migration_fallbacks"] >= 1 and s["migrations"] == 0
+        events = pool.flight_record()["pool"]["events"]
+        assert any(e["kind"] == "migrate-fallback" for e in events)
+    finally:
+        if victim_server is not None:
+            victim_server.shutdown()
+        pool.shutdown()
+
+
+# ------------------------------------------- cross-process kill -9 drill
+
+
+WEDGE_GUARD_S = 240  # replica processes pay a jax-import startup cost
+
+
+@pytest.fixture
+def _wedge_guard():
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"multiprocess drill exceeded the {WEDGE_GUARD_S} s wedge "
+            "guard — a spawn/drill path is stuck")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WEDGE_GUARD_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+class _GenTraffic:
+    """Live Poisson generate load: every exception AND every token
+    mismatch versus the precomputed whole-batch expectation is a
+    failure — the drill asserts this list stays EMPTY while a decode
+    replica is SIGKILLed under it."""
+
+    def __init__(self, pool, prompts, expected, n_tokens,
+                 rate_hz=4.0, n_threads=2):
+        self._pool, self._n = pool, n_tokens
+        self._prompts, self._expected = prompts, expected
+        self._rate = rate_hz / n_threads
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._loop, args=(i,),
+                                          daemon=True)
+                         for i in range(n_threads)]
+        self.served = 0
+        self.failures = []
+
+    def _loop(self, seed):
+        rng = np.random.default_rng(seed)
+        while not self._stop.is_set():
+            i = int(rng.integers(len(self._prompts)))
+            try:
+                out = self._pool.generate(self._prompts[i], self._n,
+                                          timeout=60.0)
+                np.testing.assert_array_equal(out, self._expected[i])
+                with self._lock:
+                    self.served += 1
+            except Exception as e:  # noqa: BLE001 — the drill's metric
+                with self._lock:
+                    self.failures.append(e)
+            time.sleep(float(rng.exponential(1.0 / self._rate)))
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=60.0)
+        return False
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+def test_kill9_decode_replica_mid_generation_zero_failed_requests(
+        net, tmp_path, _wedge_guard):
+    """The ISSUE acceptance drill: kill -9 a replica PROCESS
+    mid-generation under live Poisson generate traffic — every request
+    completes argmax-identical to whole-batch `generate` (migration
+    where the export survived, seeded re-prefill failover where the
+    process died holding it), zero failed requests, and the respawned
+    replica re-admits and serves exact tokens again."""
+    from deeplearning4j_tpu.serving import spawn_replica_pool
+
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": [8]}
+    prompts = _prompts(4, 5, seed=29)
+    expected = generate(net, prompts, 6, temperature=0.0)
+    pool = spawn_replica_pool(
+        net, 2, scratch_dir=tmp_path,
+        server_kwargs={"generation": gen},
+        pool_kwargs=dict(probe_interval=0.25, probe_timeout=10.0,
+                         watchdog_timeout=10.0, evict_threshold=2,
+                         readmit_successes=2, max_failovers=3),
+        supervisor_kwargs=dict(restart_backoff=0.25, poll_interval=0.1))
+    sup = pool.supervisor
+    try:
+        # warm both engines (and arm the generate canary probe)
+        np.testing.assert_array_equal(
+            pool.generate(prompts[0], 6, timeout=120.0), expected[0])
+        with _GenTraffic(pool, list(prompts), list(expected), 6) \
+                as traffic:
+            _await(lambda: traffic.served >= 3, 120.0, "traffic warmup")
+            sup.kill(1)  # SIGKILL mid-generation
+            _await(lambda: sup.respawns >= 1 and sup.is_alive(1),
+                   120.0, "supervisor respawn of replica 1")
+            _await(lambda: (pool.stats()["replicas"]["1"]["state"]
+                            == "healthy"),
+                   120.0, "re-admission of the respawned replica")
+            _await(lambda: traffic.served >= 10, 120.0,
+                   "post-drill traffic")
+        assert traffic.failures == [], \
+            f"requests failed during the kill -9 drill: {traffic.failures}"
+        s = pool.stats()
+        assert s["healthy_replicas"] == 2
+        np.testing.assert_array_equal(
+            pool.generate(prompts[1], 6, timeout=120.0), expected[1])
+    finally:
+        pool.shutdown(drain_timeout=5.0)
