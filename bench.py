@@ -2212,6 +2212,154 @@ def bench_serve_disagg():
     return ("serve_disagg_decode_heavy_tokens_per_sec",
             disagg["decode_heavy"], None, 1.0)
 
+def bench_serve_exactly_once():
+    """Exactly-once serving priced end to end (ISSUE 18), three numbers
+    in one config:
+
+    **dedup_overhead_pct** — steady-state gateway predict throughput
+    with every request stamped through the dedup door (idempotency key
+    + completed-result ring + in-flight registry) vs the same wire
+    path unstamped. This is the always-on tax of the at-most-once
+    promise.
+
+    **journal_append_latency_ms** — one durable WAL admit (CRC'd
+    record, flush + fsync): the at-least-once side's cost per accepted
+    generate/predict/fit, fsync included because that is the number
+    that survives kill -9.
+
+    **gateway_crash_recovery_ms** — a journal left exactly as a dead
+    gateway leaves it (accepted admits, no completes) is mounted by a
+    fresh gateway; the clock runs from server start until every
+    orphaned request has replayed through fresh prefill and its
+    outcome is claimable. `requests_lost` and `double_executions`
+    ride along and must both be ZERO — recovery speed only counts if
+    the ledger balances."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.gateway import GatewayClient, GatewayServer
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.updater import Updater
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.serving.exactly_once import RequestJournal
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01).updater(Updater.ADAM)
+            .list()
+            .layer(DenseLayer(n_out=256, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(128))
+            .build())
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 128)).astype(np.float32)
+    n_threads, reqs_per_thread = 4, 16
+
+    def _drive(client):
+        def worker():
+            for _ in range(reqs_per_thread):
+                client.call("predict", name="m", features=x,
+                            _timeout=60.0)
+
+        dts = []
+        for _ in range(_REPEATS):
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dts.append(time.perf_counter() - t0)
+        dt, spread = _median_spread(dts)
+        return n_threads * reqs_per_thread / dt, spread
+
+    # -- leg 1: the dedup door's steady-state tax --------------------------
+    server = GatewayServer(exactly_once=True).start()
+    try:
+        plain = GatewayClient(port=server.port)
+        stamped = GatewayClient(port=server.port, exactly_once=True)
+        plain.call("create_model", name="m", config=conf.to_json())
+        for _ in range(4):
+            plain.call("predict", name="m", features=x)  # compile warm
+        plain_rps, _ = _drive(plain)
+        stamped_rps, spread = _drive(stamped)
+        st = stamped.call("exactly_once_stats")
+        assert st["cache"]["double_executions"] == 0
+        plain.close()
+        stamped.close()
+    finally:
+        server.stop()
+    bench_serve_exactly_once.dedup_overhead_pct = round(
+        100.0 * (1.0 - stamped_rps / max(1e-9, plain_rps)), 1)
+
+    # -- leg 2: the durable admit (flush + fsync per record) ---------------
+    with tempfile.TemporaryDirectory() as d:
+        j = RequestJournal(d, fsync=True)
+        params = {"name": "m", "n_tokens": 8}
+        lats = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            j.admit(f"bench-{i}", "generate", params)
+            lats.append(time.perf_counter() - t0)
+        j.close()
+    bench_serve_exactly_once.journal_append_latency_ms = round(
+        1e3 * float(np.median(np.asarray(lats))), 3)
+
+    # -- leg 3: crash recovery — replay a dead gateway's journal -----------
+    from deeplearning4j_tpu.gateway import encode_value
+
+    gconf = gpt_configuration(vocab_size=48, d_model=32, n_heads=2,
+                              n_layers=2, max_length=64)
+    n_orphans = 6
+    with tempfile.TemporaryDirectory() as d:
+        j = RequestJournal(d)
+        prompts = [rng.integers(0, 48, 8).astype(np.int32)
+                   for _ in range(n_orphans)]
+        for i, p in enumerate(prompts):
+            j.admit(f"orphan-{i}", "generate",
+                    encode_value({"name": "g", "prompt_ids": p,
+                                  "n_tokens": 6}))
+        j.close()
+
+        t0 = time.perf_counter()
+        server = GatewayServer(
+            serving={"generation": {"n_slots": 2, "max_len": 32,
+                                    "prompt_buckets": (8,)}},
+            exactly_once={"journal_dir": d, "replay_timeout": 120.0})
+        server.entry.create_model("g", gconf.to_json())
+        server.start()
+        lost = 0
+        try:
+            client = GatewayClient(port=server.port, exactly_once=True)
+            for i in range(n_orphans):
+                try:
+                    client.claim(f"orphan-{i}", timeout=120.0)
+                except Exception:  # noqa: BLE001 — bench counts, not hides
+                    lost += 1
+            recovery_ms = round(1e3 * (time.perf_counter() - t0), 1)
+            st = client.call("exactly_once_stats")
+            bench_serve_exactly_once.crash_double_executions = \
+                st["cache"]["double_executions"]
+            client.close()
+        finally:
+            server.stop()
+    bench_serve_exactly_once.gateway_crash_recovery_ms = recovery_ms
+    bench_serve_exactly_once.crash_requests_lost = lost
+    assert lost == 0, "crash recovery lost accepted requests"
+    assert bench_serve_exactly_once.crash_double_executions == 0
+
+    return ("serve_exactly_once_predict_roundtrips_per_sec",
+            stamped_rps, None, spread)
+
+
 _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "lstm": bench_lstm, "lstm_large": bench_lstm_large,
             "gpt": bench_gpt,
@@ -2225,7 +2373,8 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "serve_pool": bench_serve_pool,
             "serve_generate": bench_serve_generate,
             "serve_qos": bench_serve_qos,
-            "serve_disagg": bench_serve_disagg}
+            "serve_disagg": bench_serve_disagg,
+            "serve_exactly_once": bench_serve_exactly_once}
 
 
 def _unit(metric: str) -> str:
@@ -2381,7 +2530,14 @@ def main() -> None:
                  "kv_transfer_mbytes_per_sec"),
                 ("migration_resume_ms", "migration_resume_ms"),
                 ("disagg_handoffs", "disagg_handoffs"),
-                ("disagg_fallbacks", "disagg_fallbacks")):
+                ("disagg_fallbacks", "disagg_fallbacks"),
+                ("dedup_overhead_pct", "dedup_overhead_pct"),
+                ("journal_append_latency_ms",
+                 "journal_append_latency_ms"),
+                ("gateway_crash_recovery_ms",
+                 "gateway_crash_recovery_ms"),
+                ("crash_requests_lost", "crash_requests_lost"),
+                ("crash_double_executions", "crash_double_executions")):
             extra = getattr(_CONFIGS[name], attr, None)
             if extra is not None:
                 entries[name][key] = extra

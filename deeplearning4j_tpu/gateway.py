@@ -49,6 +49,20 @@ Robustness (the serving-tier hardening pass):
   (server restart, LB connection recycle), and surfaces server-side
   `error` payloads as the typed `GatewayError` (`.error_type`,
   `.retry_after`) instead of a bare RuntimeError.
+- **exactly-once serving** — construct the server with
+  `exactly_once={...}` (or `True`) and every request carrying a
+  client-minted `request_id` (the client stamps one on every call)
+  rides `serving.exactly_once.ExactlyOnceDoor`: a wire-level retry of
+  ANY method — `fit` and `reload_model` included — returns the parked
+  original outcome instead of re-executing, a client that disconnects
+  mid-`generate` can reconnect and `claim(request_id)` the finished
+  tokens, and with `"journal_dir"` accepted generate/predict/fit
+  requests hit a durable WAL that a restarted gateway replays — a
+  kill -9 under live traffic completes every accepted request exactly
+  once. `GatewayClient(exactly_once=True)` then retries EVERY method
+  (the `_IDEMPOTENT` whitelist collapses into the server-side dedup
+  door) and polls through `ResultPendingError` while the original
+  execution finishes.
 """
 from __future__ import annotations
 
@@ -61,6 +75,7 @@ import socket
 import socketserver
 import threading
 import time
+import uuid
 from pathlib import Path
 from typing import Any, Dict, Optional
 
@@ -76,6 +91,10 @@ logger = logging.getLogger("deeplearning4j_tpu")
 # the same trace_id via the thread-local binding.
 _TRACED_METHODS = frozenset({"predict", "evaluate", "generate",
                              "resume_generate"})
+
+# Exactly-once built-ins answered by the door itself, never dispatched
+# to the entry point (and never themselves deduped: claim IS the retry).
+_DOOR_METHODS = frozenset({"claim", "exactly_once_stats"})
 
 
 class GatewayError(RuntimeError):
@@ -470,15 +489,19 @@ class EntryPoint:
     def set_tenant_quota(self, name: str, tenant: str,
                          rate: Optional[float] = None,
                          burst: Optional[float] = None,
-                         max_pages: Optional[int] = None) -> bool:
+                         max_pages: Optional[int] = None,
+                         weight: Optional[float] = None) -> bool:
         """Install (or update) tenant `tenant`'s token-rate quota and KV
         page ceiling on model `name`'s decode engine — `rate`
         tokens/second refill, `burst` bucket depth, `max_pages` the most
-        KV pages the tenant may hold at once. On a pool this fans out to
-        every replica so failover cannot launder a flooding tenant past
-        its quota."""
+        KV pages the tenant may hold at once, `weight` the batch lane's
+        weighted-fair-queueing share (default 1.0; weight 2 earns twice
+        the admitted span of weight 1 under saturation). On a pool this
+        fans out to every replica so failover cannot launder a flooding
+        tenant past its quota."""
         self._server(name).set_tenant_quota(tenant, rate=rate, burst=burst,
-                                            max_pages=max_pages)
+                                            max_pages=max_pages,
+                                            weight=weight)
         return True
 
     # -- KV handoff / live migration --------------------------------------
@@ -565,13 +588,25 @@ class GatewayServer:
     close); `recv_timeout` arms a per-connection socket timeout so a
     silent client cannot pin a handler thread forever; `serving` enables
     the ModelServer tier on the default EntryPoint (ignored when an
-    `entry_point` instance is passed — configure that one directly)."""
+    `entry_point` instance is passed — configure that one directly).
+
+    `exactly_once` (True for defaults, or a dict of
+    `serving.exactly_once.ExactlyOnceDoor` kwargs plus the gateway-level
+    `"replay"` / `"replay_timeout"` knobs) installs the dedup door:
+    every request stamped with a `request_id` is deduplicated against a
+    bounded TTL'd completed-result ring, outcomes park for
+    `claim(request_id)` after a mid-response disconnect, and with
+    `"journal_dir"` a restarted gateway replays accepted-but-unfinished
+    generate/predict/fit requests off the durable journal (the replay
+    thread waits — within `replay_timeout` — for each record's named
+    model to be re-installed)."""
 
     def __init__(self, entry_point: Optional[EntryPoint] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  max_request_bytes: int = 64 << 20,
                  recv_timeout: Optional[float] = 600.0,
-                 serving: Optional[dict] = None):
+                 serving: Optional[dict] = None,
+                 exactly_once=None):
         if max_request_bytes < 1:
             raise ValueError("max_request_bytes must be >= 1")
         self.entry = entry_point or EntryPoint(serving=serving)
@@ -580,6 +615,20 @@ class GatewayServer:
         self._host, self._requested_port = host, port
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self.door = None
+        self._replay_enabled = True
+        self._replay_timeout = 60.0
+        self._stop_replay = threading.Event()
+        self._replay_thread: Optional[threading.Thread] = None
+        if exactly_once:
+            from deeplearning4j_tpu.serving.exactly_once import (
+                ExactlyOnceDoor,
+            )
+
+            kw = {} if exactly_once is True else dict(exactly_once)
+            self._replay_enabled = bool(kw.pop("replay", True))
+            self._replay_timeout = float(kw.pop("replay_timeout", 60.0))
+            self.door = ExactlyOnceDoor(**kw)
 
     @property
     def port(self) -> int:
@@ -591,6 +640,7 @@ class GatewayServer:
         entry = self.entry
         max_bytes = self.max_request_bytes
         recv_timeout = self.recv_timeout
+        door = self.door
 
         class Handler(socketserver.StreamRequestHandler):
             # StreamRequestHandler.setup() arms this on the connection:
@@ -637,6 +687,8 @@ class GatewayServer:
                         return
                     req_id = None  # this request's id only — never stale
                     trace = None  # minted per data-path request below
+                    request_key = None  # exactly-once idempotency key
+                    owner = False  # this handler executes + parks it
                     try:
                         req = json.loads(raw)
                         ctx = None
@@ -648,36 +700,80 @@ class GatewayServer:
                             raw_ctx = req.get("trace")
                             if isinstance(raw_ctx, dict):
                                 ctx = raw_ctx
-                        if req["method"].startswith("_") or req["method"] \
-                                in getattr(entry, "_RPC_EXCLUDED", ()):
-                            raise AttributeError(req["method"])
-                        method = getattr(entry, req["method"])
-                        params = decode_value(req.get("params", {}))
-                        if (req["method"] in _TRACED_METHODS
-                                or ctx is not None) \
-                                and observability.tracing_enabled():
-                            # the gateway is the outermost hop: mint the
-                            # trace here and bind it thread-locally so
-                            # pool/server/engine spans join this id —
-                            # unless the request CARRIES a context, in
-                            # which case this process is an inner hop
-                            # and must join the caller's trace_id (the
-                            # response's timeline then grafts into the
-                            # caller's via the wall-clock anchors)
-                            trace = observability.Trace(
-                                trace_id=ctx.get("trace_id")
-                                if ctx else None)
-                            with observability.use_trace(trace), \
-                                    trace.span("gateway",
-                                               method=req["method"]):
-                                result = method(**params)
+                            if door is not None \
+                                    and req.get("request_id") is not None:
+                                request_key = str(req["request_id"])
+                        resp = None
+                        if door is not None and isinstance(req, dict) \
+                                and req.get("method") in _DOOR_METHODS:
+                            # door built-ins, answered without touching
+                            # the entry point; claim raises the typed
+                            # pending/unknown errors through the normal
+                            # wire-error path below
+                            if req["method"] == "claim":
+                                outcome = door.claim(
+                                    str(dict(req.get("params") or {})
+                                        .get("request_id")))
+                                resp = {"id": req_id, **outcome}
+                            else:
+                                resp = {"id": req_id,
+                                        "result": door.stats()}
+                        elif door is not None and request_key is not None:
+                            verdict, info = door.admit(
+                                request_key, req["method"],
+                                req.get("params") or {})
+                            if verdict == "cached":
+                                # the original outcome, re-stamped with
+                                # THIS retry's wire id — the whole
+                                # exactly-once promise in one line
+                                resp = {"id": req_id, **info}
+                            elif verdict == "pending":
+                                resp = {
+                                    "id": req_id,
+                                    "error": "ResultPendingError: request "
+                                             f"{request_key!r} is still "
+                                             "executing — claim it in "
+                                             f"{float(info):.3g}s",
+                                    "error_type": "ResultPendingError",
+                                    "retry_after": float(info)}
+                            else:
+                                owner = True
+                        if resp is not None:
+                            pass  # door short-circuit: skip dispatch
                         else:
-                            result = method(**params)
-                        resp = {"id": req_id, "result": encode_value(result)}
-                        if trace is not None:
-                            trace.finish("served")
-                            resp["trace_id"] = trace.trace_id
-                            resp["trace"] = trace.to_dict()
+                            if req["method"].startswith("_") \
+                                    or req["method"] \
+                                    in getattr(entry, "_RPC_EXCLUDED", ()):
+                                raise AttributeError(req["method"])
+                            method = getattr(entry, req["method"])
+                            params = decode_value(req.get("params", {}))
+                            if (req["method"] in _TRACED_METHODS
+                                    or ctx is not None) \
+                                    and observability.tracing_enabled():
+                                # the gateway is the outermost hop: mint
+                                # the trace here and bind it
+                                # thread-locally so pool/server/engine
+                                # spans join this id — unless the request
+                                # CARRIES a context, in which case this
+                                # process is an inner hop and must join
+                                # the caller's trace_id (the response's
+                                # timeline then grafts into the caller's
+                                # via the wall-clock anchors)
+                                trace = observability.Trace(
+                                    trace_id=ctx.get("trace_id")
+                                    if ctx else None)
+                                with observability.use_trace(trace), \
+                                        trace.span("gateway",
+                                                   method=req["method"]):
+                                    result = method(**params)
+                            else:
+                                result = method(**params)
+                            resp = {"id": req_id,
+                                    "result": encode_value(result)}
+                            if trace is not None:
+                                trace.finish("served")
+                                resp["trace_id"] = trace.trace_id
+                                resp["trace"] = trace.to_dict()
                     # graftlint: disable=typed-error  RPC boundary: any
                     # server-side failure, typed or not, must be serialized
                     # to the client as a wire error (error_type/retry_after
@@ -717,6 +813,26 @@ class GatewayServer:
                             err_trace = getattr(e, "trace", None)
                             if err_trace is not None:
                                 resp["trace"] = err_trace
+                    if owner:
+                        # park the outcome BEFORE replying: a client
+                        # that dies mid-response can still reconnect
+                        # and claim(request_id) it. Shed outcomes
+                        # (retry_after) resolve VOID — the client's
+                        # retry is a genuine new attempt, not a dup
+                        body = {k: v for k, v in resp.items()
+                                if k != "id"}
+                        retryable = "error" in resp \
+                            and "retry_after" in resp
+                        try:
+                            door.complete(request_key, body,
+                                          retryable=retryable)
+                        # graftlint: disable=typed-error  the reply must
+                        # still go out when parking/journaling fails —
+                        # logged loudly, never silent
+                        except Exception:
+                            logger.exception(
+                                "gateway: exactly-once complete failed "
+                                "for %r", request_key)
                     if not self._respond(resp):
                         return
 
@@ -732,9 +848,73 @@ class GatewayServer:
                                         daemon=True)
         self._thread.start()
         logger.info("gateway listening on %s:%d", self._host, self.port)
+        if self.door is not None and self._replay_enabled \
+                and self.door.pending_records():
+            self._stop_replay.clear()
+            self._replay_thread = threading.Thread(
+                target=self._replay_pending, daemon=True,
+                name="gateway-journal-replay")
+            self._replay_thread.start()
         return self
 
+    def _replay_pending(self) -> None:
+        """Crash recovery: re-execute journaled requests that were
+        admitted but never completed before the previous incarnation
+        died. Each record rides the SAME dedup door as live traffic
+        (a reconnecting client's retry and this loop can never both
+        execute one id), and records wait — within `replay_timeout` —
+        for their named model to be re-installed first."""
+        door, entry = self.door, self.entry
+
+        def ready(method: str, params: dict) -> bool:
+            name = params.get("name") if isinstance(params, dict) else None
+            return name is None or name in getattr(entry, "_models", {})
+
+        def execute(method_name: str, raw_params: dict) -> dict:
+            try:
+                if method_name.startswith("_") or method_name \
+                        in getattr(entry, "_RPC_EXCLUDED", ()):
+                    raise AttributeError(method_name)
+                method = getattr(entry, method_name)
+                result = method(**decode_value(raw_params or {}))
+                return {"result": encode_value(result)}
+            # graftlint: disable=typed-error  replay boundary: like the
+            # live RPC boundary, any failure becomes the request's wire
+            # outcome (error_type travels alongside), never a crash
+            except Exception as e:
+                body = {"error": f"{type(e).__name__}: {e}",
+                        "error_type": type(e).__name__}
+                retry_after = getattr(e, "retry_after", None)
+                if retry_after is not None:
+                    body["retry_after"] = float(retry_after)
+                return body
+
+        deadline = time.monotonic() + self._replay_timeout
+        replayed = 0
+        while not self._stop_replay.is_set() \
+                and time.monotonic() < deadline:
+            if not door.pending_records():
+                break
+            replayed_now = door.replay(execute, ready=ready)
+            replayed += replayed_now
+            if replayed_now == 0:
+                # every remaining record waits on a model install
+                self._stop_replay.wait(0.1)
+        left = len(door.pending_records())
+        if left:
+            logger.warning(
+                "gateway: replay window closed with %d journaled "
+                "requests still pending (model never re-installed?)",
+                left)
+        else:
+            logger.info("gateway: journal replay complete "
+                        "(%d re-executed)", replayed)
+
     def stop(self, drain_timeout: float = 10.0) -> None:
+        self._stop_replay.set()
+        if self._replay_thread is not None:
+            self._replay_thread.join(timeout=5.0)
+            self._replay_thread = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -742,6 +922,10 @@ class GatewayServer:
         shutdown = getattr(self.entry, "shutdown", None)
         if shutdown is not None:
             shutdown(drain_timeout=drain_timeout)
+        if self.door is not None:
+            # closes the journal's append handle; a later start() (or a
+            # fresh admit) reopens a new segment
+            self.door.close()
 
 
 class _PooledConn:
@@ -795,6 +979,15 @@ class GatewayClient:
       `max_response_bytes` or one that stops mid-line raises
       `GatewayProtocolError` and discards the (unresyncable)
       connection.
+    - **exactly-once mode** — every call is stamped with a client-minted
+      `request_id`; against a server built with `exactly_once={...}`,
+      `GatewayClient(exactly_once=True)` retries EVERY method (the
+      `_IDEMPOTENT` whitelist collapses into the server-side dedup
+      door: a re-send returns the parked original outcome, never
+      re-executes), polls through `ResultPendingError` while the
+      original execution finishes, and `claim(request_id)` recovers
+      the outcome of a call whose connection died mid-response
+      (`last_request_id` holds the most recent stamp).
 
     Server-side errors raise the typed `GatewayError`."""
 
@@ -820,7 +1013,9 @@ class GatewayClient:
                  max_retries: int = 1, pool_size: int = 2,
                  max_idle: float = 30.0,
                  max_response_bytes: int = 64 << 20,
-                 eager_connect: bool = True):
+                 eager_connect: bool = True,
+                 exactly_once: bool = False,
+                 client_id: Optional[str] = None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if pool_size < 1:
@@ -831,10 +1026,19 @@ class GatewayClient:
         self.pool_size = pool_size
         self.max_idle = max_idle
         self.max_response_bytes = max_response_bytes
+        self.exactly_once = bool(exactly_once)
+        # the request_id namespace: unique per client process unless the
+        # caller pins one (a RECONNECTING client must pin its old id to
+        # claim outcomes stamped by its previous incarnation)
+        self.client_id = client_id or uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
         self._idle: list = []  # guarded by: _lock
         self._closed = False  # guarded by: _lock
         self._next_id = 0  # guarded by: _lock
+        self._next_request = 0  # guarded by: _lock
+        # the most recent call()'s idempotency stamp — after a failed
+        # call, claim(last_request_id) recovers its parked outcome
+        self.last_request_id: Optional[str] = None
         # the most recent response's trace (None when tracing is off or
         # the method is not a traced data-path RPC) — lets callers
         # correlate a result with the server-side span timeline without
@@ -891,35 +1095,94 @@ class GatewayClient:
     # -- calls -------------------------------------------------------------
     def call(self, method: str, _idempotent: Optional[bool] = None,
              _timeout: Optional[float] = None,
-             _trace: Optional[dict] = None, **params):
+             _trace: Optional[dict] = None,
+             _request_id: Optional[str] = None, **params):
         """Invoke `method` on the server's entry point. `_idempotent`
         overrides the built-in retry whitelist for custom entry-point
         methods; `_timeout` bounds this call's socket reads (seconds —
         derive it from the request deadline plus a margin); `_trace` is
         an optional wire trace context
         (`observability.wire_trace_context`) the server joins instead
-        of minting its own trace."""
-        idempotent = (method in self._IDEMPOTENT if _idempotent is None
-                      else _idempotent)
+        of minting its own trace; `_request_id` pins the idempotency
+        stamp (default: a fresh `<client_id>-<n>` — re-issuing a call
+        with the OLD stamp is how a reconnecting client turns a retry
+        into a dedup hit)."""
+        with self._lock:
+            self._next_request += 1
+            request_id = _request_id \
+                or f"{self.client_id}-{self._next_request}"
+        self.last_request_id = request_id
+        if _idempotent is not None:
+            idempotent = _idempotent
+        elif self.exactly_once:
+            # the server-side dedup door makes EVERY re-send safe: it
+            # returns the parked original outcome instead of
+            # re-executing, so the whitelist no longer gates retries
+            idempotent = True
+        else:
+            idempotent = method in self._IDEMPOTENT
         attempts = 1 + (self.max_retries if idempotent else 0)
-        for attempt in range(attempts):
+        budget = self._timeout if _timeout is None else _timeout
+        pending_deadline = time.monotonic() + budget
+        attempt = 0
+        while True:
             try:
                 return self._call_once(method, params, timeout=_timeout,
-                                       trace_ctx=_trace)
+                                       trace_ctx=_trace,
+                                       request_id=request_id)
+            except GatewayError as e:
+                # exactly-once: "pending" means the ORIGINAL execution
+                # is still running server-side (this retry raced it) —
+                # poll until the parked outcome appears instead of
+                # failing a call whose work is finishing fine
+                if (self.exactly_once
+                        and e.error_type == "ResultPendingError"
+                        and time.monotonic() < pending_deadline):
+                    time.sleep(min(e.retry_after or 0.05,
+                                   max(0.0, pending_deadline
+                                       - time.monotonic())))
+                    continue
+                raise
             except (ConnectionError, GatewayProtocolError) as e:
-                if attempt + 1 >= attempts:
+                attempt += 1
+                if attempt >= attempts:
                     raise
-                backoff = self.retry_backoff * (2 ** attempt)
+                backoff = self.retry_backoff * (2 ** (attempt - 1))
                 logger.warning(
                     "gateway client: %s during idempotent %r; retry "
                     "%d/%d over a fresh connection after %.3fs backoff",
-                    type(e).__name__, method, attempt + 1,
+                    type(e).__name__, method, attempt,
                     self.max_retries, backoff)
                 time.sleep(backoff)
 
+    def claim(self, request_id: str, timeout: Optional[float] = None,
+              _timeout: Optional[float] = None):
+        """Recover the parked outcome of a detached request — one whose
+        connection died mid-response, or one submitted before a gateway
+        restart and replayed off the journal. Polls through the typed
+        `ResultPendingError` (the decode is still running) until
+        `timeout` (default: the client timeout); a cached error outcome
+        re-raises the ORIGINAL typed failure; `UnknownRequestError`
+        means the outcome aged past the server's TTL (or was never
+        admitted)."""
+        deadline = time.monotonic() + (self._timeout if timeout is None
+                                       else timeout)
+        while True:
+            try:
+                return self.call("claim", request_id=str(request_id),
+                                 _timeout=_timeout)
+            except GatewayError as e:
+                if e.error_type != "ResultPendingError":
+                    raise
+                now = time.monotonic()
+                if now >= deadline:
+                    raise
+                time.sleep(min(e.retry_after or 0.05, deadline - now))
+
     def _call_once(self, method: str, params: dict,
                    timeout: Optional[float] = None,
-                   trace_ctx: Optional[dict] = None):
+                   trace_ctx: Optional[dict] = None,
+                   request_id: Optional[str] = None):
         conn = self._borrow()
         try:
             with self._lock:
@@ -927,6 +1190,11 @@ class GatewayClient:
                 req_id = self._next_id
             req = {"id": req_id, "method": method,
                    "params": encode_value(params)}
+            if request_id is not None:
+                # the idempotency stamp rides OUTSIDE params: servers
+                # without the dedup door ignore unknown top-level keys,
+                # so stamping is backward-compatible
+                req["request_id"] = request_id
             if trace_ctx:
                 req["trace"] = trace_ctx
             conn.sock.settimeout(self._timeout if timeout is None

@@ -17,6 +17,7 @@ from deeplearning4j_tpu.serving.chaos import (
     ConnectionResetInjector,
     GarbageResponseInjector,
     InjectedServingFault,
+    JournalCorruptionInjector,
     KVTransferCorruptionInjector,
     LoadSpikeInjector,
     NetworkLatencyInjector,
@@ -29,6 +30,13 @@ from deeplearning4j_tpu.serving.chaos import (
     TenantFloodInjector,
 )
 from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+from deeplearning4j_tpu.serving.exactly_once import (
+    DedupCache,
+    ExactlyOnceDoor,
+    RequestJournal,
+    ResultPendingError,
+    UnknownRequestError,
+)
 from deeplearning4j_tpu.serving.kv_transfer import (
     DisaggCoordinator,
     KVTransferError,
@@ -105,11 +113,14 @@ __all__ = [
     "ConnectionResetInjector",
     "DeadlineExceededError",
     "DecodeEngine",
+    "DedupCache",
     "DisaggCoordinator",
+    "ExactlyOnceDoor",
     "FlightRecorder",
     "GarbageResponseInjector",
     "InferenceFailedError",
     "InjectedServingFault",
+    "JournalCorruptionInjector",
     "KVTransferCorruptionInjector",
     "KVTransferError",
     "LeaseTable",
@@ -126,6 +137,8 @@ __all__ = [
     "ReplicaEntryPoint",
     "ReplicaSpawnError",
     "ReplicaSupervisor",
+    "RequestJournal",
+    "ResultPendingError",
     "SpeculativeDecoder",
     "ReloadCorruptionInjector",
     "ReplicaCrashInjector",
@@ -142,6 +155,7 @@ __all__ = [
     "TenantFloodInjector",
     "TenantQuotaExceededError",
     "Trace",
+    "UnknownRequestError",
     "spawn_replica_pool",
     "argmax_drift_rate",
     "attach_trace",

@@ -116,6 +116,9 @@ class Autoscaler:
         self._cooldown_until = 0.0  # guarded by: _lock
         self._last_excursion_scan = time.time()  # guarded by: _lock
         self._last_decision = "none"  # guarded by: _lock
+        # how long the most recent migrate-then-drain shrink took; stays
+        # 0.0 until the first scale-down — guarded by: _lock
+        self._last_scale_down_ms = 0.0
         self.autoscale_events = 0  # guarded by: _lock
         self.scale_ups = 0  # guarded by: _lock
         self.scale_downs = 0  # guarded by: _lock
@@ -323,6 +326,7 @@ class Autoscaler:
         if victim is None:
             raise AutoscaleError(
                 "no healthy replica is removable right now")
+        t0 = time.monotonic()
         try:
             if hasattr(self.pool, "shrink_replica"):
                 self.pool.shrink_replica(
@@ -339,9 +343,16 @@ class Autoscaler:
                 self.autoscale_failures += 1
                 self._cooldown_until = time.monotonic() + self.cooldown
             raise
-        self._account("down", replica=victim)
-        logger.info("autoscaler: scaled down to %d replicas (removed %d)",
-                    self.pool.n_replicas, victim)
+        # migrate-then-drain makes this a bounded handoff, not a wait
+        # on the longest in-flight generation — the duration stat is
+        # the regression alarm for that property
+        duration_ms = round((time.monotonic() - t0) * 1000.0, 1)
+        with self._lock:
+            self._last_scale_down_ms = duration_ms
+        self._account("down", replica=victim, duration_ms=duration_ms)
+        logger.info("autoscaler: scaled down to %d replicas (removed %d "
+                    "in %.1fms)", self.pool.n_replicas, victim,
+                    duration_ms)
         return victim
 
     def _pick_victim(self) -> Optional[int]:
@@ -372,4 +383,5 @@ class Autoscaler:
                 "cooldown_remaining": round(
                     max(0.0, self._cooldown_until - time.monotonic()), 3),
                 "last_decision": self._last_decision,
+                "last_scale_down_ms": self._last_scale_down_ms,
             }

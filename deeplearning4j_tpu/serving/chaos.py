@@ -209,6 +209,67 @@ class ReloadCorruptionInjector:
         return path
 
 
+class JournalCorruptionInjector:
+    """Damage an exactly-once request journal on disk
+    (`serving/exactly_once.RequestJournal`) between gateway
+    incarnations — the disk hazards replay must survive typed, never
+    as a double execution or a crash at load:
+
+    - `torn_tail(journal_dir)` — truncate the NEWEST segment mid-way
+      through its last record: the shape of `kill -9` landing between
+      `write()` and a completed line. Replay must count it
+      `torn_skipped` and carry on; the half-written admit is a request
+      the client never got an ack for, so dropping it is correct.
+    - `corrupt_record(journal_dir, index)` — flip bytes inside a
+      COMMITTED record of the OLDEST segment (bit-rot, a bad sector):
+      the CRC must refuse it (`corrupt_skipped`), and every other
+      record in the segment must still replay.
+
+    `corruptions` counts injected damages."""
+
+    def __init__(self):
+        self.corruptions = 0
+
+    @staticmethod
+    def _segments(journal_dir) -> list:
+        segs = sorted(Path(journal_dir).glob("journal-*.wal"))
+        if not segs:
+            raise FileNotFoundError(
+                f"no journal segments under {journal_dir}")
+        return segs
+
+    def torn_tail(self, journal_dir) -> Path:
+        """Cut the newest segment's last record in half — a torn write."""
+        path = self._segments(journal_dir)[-1]
+        data = path.read_bytes()
+        lines = data.splitlines(keepends=True)
+        if not lines:
+            raise ValueError(f"segment {path} is empty — nothing to tear")
+        last = lines[-1]
+        path.write_bytes(b"".join(lines[:-1]) + last[: max(1, len(last) // 2)])
+        self.corruptions += 1
+        return path
+
+    def corrupt_record(self, journal_dir, index: int = 0) -> Path:
+        """Flip bytes inside committed record `index` of the oldest
+        segment WITHOUT touching its length — the CRC, not the line
+        framing, must catch this one."""
+        path = self._segments(journal_dir)[0]
+        lines = path.read_bytes().splitlines(keepends=True)
+        if not 0 <= index < len(lines):
+            raise IndexError(f"record {index} not in {path} "
+                             f"({len(lines)} records)")
+        rec = bytearray(lines[index])
+        # flip payload bytes mid-line; keep the trailing newline intact
+        mid = len(rec) // 2
+        for i in range(mid, min(mid + 8, len(rec) - 1)):
+            rec[i] ^= 0x5A
+        lines[index] = bytes(rec)
+        path.write_bytes(b"".join(lines))
+        self.corruptions += 1
+        return path
+
+
 class KVTransferCorruptionInjector:
     """Damage a KV handoff payload between `fetch_handoff` and
     `resume_generate` — the wire hazards a migrated slot must survive

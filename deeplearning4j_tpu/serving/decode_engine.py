@@ -227,11 +227,12 @@ class _TenantState:
 
     __slots__ = ("rate", "burst", "tokens", "last_refill", "submitted",
                  "served", "shed_quota", "shed_page_quota",
-                 "tokens_generated", "preemptions", "max_pages")
+                 "tokens_generated", "preemptions", "max_pages", "weight")
 
     def __init__(self, rate: Optional[float] = None,
                  burst: Optional[float] = None,
-                 max_pages: Optional[int] = None):
+                 max_pages: Optional[int] = None,
+                 weight: Optional[float] = None):
         self.rate = None if rate is None else float(rate)
         self.burst = float(burst) if burst is not None \
             else (self.rate if self.rate else 0.0)
@@ -241,6 +242,10 @@ class _TenantState:
         # inside its token-rate budget can still hoard the shared page
         # pool with a few huge-prompt requests; this caps that
         self.max_pages = None if max_pages is None else int(max_pages)
+        # batch-lane stride-scheduling share: admissions charge
+        # span/weight, so weight 2 gets twice the admitted work of
+        # weight 1 under saturation (interactive traffic is unaffected)
+        self.weight = 1.0 if weight is None else float(weight)
         self.last_refill = time.monotonic()
         self.submitted = 0
         self.served = 0
@@ -271,7 +276,8 @@ class _TenantState:
                 "preemptions": self.preemptions,
                 "rate": self.rate, "burst": self.burst or None,
                 "tokens": round(self.tokens, 3),
-                "max_pages": self.max_pages}
+                "max_pages": self.max_pages,
+                "weight": self.weight}
 
 
 def _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page):
@@ -508,7 +514,7 @@ class DecodeEngine:
                 raise ValueError("unknown qos keys: %s" % sorted(unknown))
             for name, spec in {**(qos.get("tenants") or {}),
                                "default": qos.get("default") or {}}.items():
-                bad = set(spec) - {"rate", "burst", "max_pages"}
+                bad = set(spec) - {"rate", "burst", "max_pages", "weight"}
                 if bad:
                     raise ValueError(
                         "unknown qos tenant keys for %r: %s"
@@ -521,6 +527,10 @@ class DecodeEngine:
                         and int(spec["max_pages"]) < 1:
                     raise ValueError(
                         "qos tenant %r max_pages must be >= 1" % (name,))
+                if "weight" in spec and spec["weight"] is not None \
+                        and float(spec["weight"]) <= 0:
+                    raise ValueError(
+                        "qos tenant %r weight must be > 0" % (name,))
         self._qos_cfg = dict(qos) if qos else None
         tp_degree = 1
         if parallel is not None:
@@ -581,7 +591,14 @@ class DecodeEngine:
         for _name, _spec in (_q.get("tenants") or {}).items():
             self._tenants[_name] = _TenantState(
                 rate=_spec.get("rate"), burst=_spec.get("burst"),
-                max_pages=_spec.get("max_pages"))
+                max_pages=_spec.get("max_pages"),
+                weight=_spec.get("weight"))
+        # batch-lane weighted-fair queueing (stride scheduling): each
+        # tenant's pass value advances by admitted-span/weight; the
+        # floor tracks the last admitted tenant's pre-charge pass so an
+        # idle tenant rejoins AT the floor instead of banking credit
+        self._wfq_pass: dict = {}  # guarded by: _cond
+        self._wfq_floor = 0.0  # guarded by: _cond
         self._queue_wait_ewma = 0.0  # guarded by: _cond
         self._chunk_ewma = 0.0  # guarded by: _cond
         # KV handoff plane (kv_transfer): disagg role, the sender-side
@@ -1542,7 +1559,8 @@ class DecodeEngine:
             spec = self._default_quota or {}
             state = _TenantState(rate=spec.get("rate"),
                                  burst=spec.get("burst"),
-                                 max_pages=spec.get("max_pages"))
+                                 max_pages=spec.get("max_pages"),
+                                 weight=spec.get("weight"))
             self._tenants[tenant] = state
         return state
 
@@ -1578,12 +1596,17 @@ class DecodeEngine:
 
     def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
                          burst: Optional[float] = None,
-                         max_pages: Optional[int] = None) -> None:
+                         max_pages: Optional[int] = None,
+                         weight: Optional[float] = None) -> None:
         """Install (or with `rate=None` clear) tenant `tenant`'s
         token-rate quota — and with `max_pages` its KV page ceiling
-        (`None` clears it) — at runtime; the seam the gateway's
-        `set_tenant_quota` RPC lands on. The bucket restarts full at
-        the new burst; counters survive the change."""
+        (`None` clears it), with `weight` its batch-lane fair-queueing
+        share (`None` keeps the current weight; default 1.0) — at
+        runtime; the seam the gateway's `set_tenant_quota` RPC lands
+        on. The bucket restarts full at the new burst; counters survive
+        the change."""
+        if weight is not None and float(weight) <= 0:
+            raise ValueError("tenant weight must be > 0")
         with self._cond:
             state = self._tenant_locked(tenant)
             state.rate = None if rate is None else float(rate)
@@ -1591,9 +1614,12 @@ class DecodeEngine:
                 else (state.rate if state.rate else 0.0)
             state.tokens = state.burst
             state.max_pages = None if max_pages is None else int(max_pages)
+            if weight is not None:
+                state.weight = float(weight)
             state.last_refill = time.monotonic()
         self.recorder.event("quota-set", tenant=tenant, rate=rate,
-                            burst=burst, max_pages=max_pages)
+                            burst=burst, max_pages=max_pages,
+                            weight=weight)
 
     # -- KV handoff public surface (kv_transfer) ---------------------------
     def migrate_slots(self, wait: Optional[float] = 5.0) -> int:
@@ -2083,17 +2109,45 @@ class DecodeEngine:
 
     def _select_head_locked(self) -> int:
         """Index of the next request to admit: the FIRST queued
-        interactive request when one exists, else the queue head. FIFO
-        within each priority class — an interactive request jumps a
-        page-blocked batch head, so the batch lane only consumes
-        capacity interactive traffic is not asking for. Under sustained
-        interactive saturation the batch lane starves by design (its
-        deadline sweep still fails batch requests typed)."""
+        interactive request when one exists (an interactive request
+        jumps a page-blocked batch head, so the batch lane only
+        consumes capacity interactive traffic is not asking for; under
+        sustained interactive saturation the batch lane starves by
+        design, its deadline sweep still failing batch requests typed).
+        The batch lane itself is weighted-fair, not FIFO: the queued
+        batch request whose tenant holds the LOWEST stride-scheduling
+        pass value wins, so two equal-weight tenants split admitted
+        work ~50/50 under saturation instead of one backlog serializing
+        in front of the other — and a weight-2 tenant gets twice the
+        admitted span of a weight-1 peer. FIFO within one tenant
+        (earliest queued wins the tie on equal pass values);
+        untenanted batch traffic rides one shared implicit ledger."""
         assert_owned(self._cond, "DecodeEngine._select_head_locked")
+        best = 0
+        best_pass = None
         for i, r in enumerate(self._queue):
             if r.priority == "interactive":
                 return i
-        return 0
+            p = self._wfq_pass.get(r.tenant, self._wfq_floor)
+            if best_pass is None or p < best_pass:
+                best, best_pass = i, p
+        return best
+
+    def _wfq_charge_locked(self, req: "_GenRequest") -> None:
+        """Advance the admitted batch request's tenant pass: virtual
+        start = max(own pass, floor) — an idle tenant rejoins AT the
+        floor, never banking credit — charged by the request's logical
+        decode span over the tenant's weight. The floor then advances
+        to the winner's pre-charge pass, keeping every ledger within
+        one span of each other (bounded unfairness, O(1) state)."""
+        assert_owned(self._cond, "DecodeEngine._wfq_charge_locked")
+        state = self._tenant_locked(req.tenant)
+        weight = state.weight if state is not None else 1.0
+        start = max(self._wfq_pass.get(req.tenant, self._wfq_floor),
+                    self._wfq_floor)
+        span = float(max(1, int(req.n_tokens)))
+        self._wfq_pass[req.tenant] = start + span / max(weight, 1e-9)
+        self._wfq_floor = start
 
     def _maybe_preempt_locked(self, head: _GenRequest, reason: str):
         """Retire-to-queue one DECODING batch-lane slot so a blocked
@@ -2249,6 +2303,12 @@ class DecodeEngine:
                     req = head
                     del self._queue[head_idx]
                     self._pages_demand_queued -= req.n_pages
+                    if req.priority != "interactive":
+                        # charge the batch lane's fair-queueing ledger
+                        # at the admission that actually consumed
+                        # capacity (preempted re-admissions re-charge:
+                        # they consume capacity again)
+                        self._wfq_charge_locked(req)
             if preempt is not None:
                 victim, old_probe, reason, vslot = preempt
                 if self.breaker is not None:
@@ -2309,7 +2369,8 @@ class DecodeEngine:
                             pages_in_use=held)
             self.recorder.event("admit", slot=slot, pages=len(req.pages),
                                 hit_tokens=req.hit_len,
-                                pages_in_use=held)
+                                pages_in_use=held, tenant=req.tenant,
+                                priority=req.priority)
             row = np.zeros((self._n_pages_max,), np.int32)
             row[:len(req.pages)] = req.pages
             self._page_table = self._page_table.at[slot].set(

@@ -547,10 +547,10 @@ class DisaggCoordinator:
         return out
 
     def set_tenant_quota(self, tenant: str, rate=None, burst=None,
-                         max_pages=None) -> None:
+                         max_pages=None, weight=None) -> None:
         for s in self._servers:
             s.set_tenant_quota(tenant, rate=rate, burst=burst,
-                               max_pages=max_pages)
+                               max_pages=max_pages, weight=weight)
 
     def flight_record(self) -> dict:
         return self.prefill[0].flight_record()

@@ -890,14 +890,17 @@ class ModelServer:
 
     def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
                          burst: Optional[float] = None,
-                         max_pages: Optional[int] = None) -> None:
+                         max_pages: Optional[int] = None,
+                         weight: Optional[float] = None) -> None:
         """Set (or clear, with `rate=None` / `max_pages=None`) tenant
-        `tenant`'s token-rate quota and KV page ceiling on the decode
-        engine — the admin seam the gateway's quota RPC lands on.
-        Requires generation serving."""
+        `tenant`'s token-rate quota, KV page ceiling, and batch-lane
+        fair-queueing `weight` on the decode engine — the admin seam
+        the gateway's quota RPC lands on. Requires generation
+        serving."""
         self._ensure_engine().set_tenant_quota(tenant, rate=rate,
                                                burst=burst,
-                                               max_pages=max_pages)
+                                               max_pages=max_pages,
+                                               weight=weight)
 
     # -- KV handoff / live migration (kv_transfer) -------------------------
     def migrate_slots(self, wait: Optional[float] = 5.0) -> int:

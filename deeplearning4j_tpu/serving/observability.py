@@ -831,6 +831,9 @@ TENANT_STATS_KEYS = frozenset({
     # KV page quota tier: page-ceiling rejections, the configured
     # ceiling (None = unlimited), and the tenant's live page footprint
     "shed_page_quota", "max_pages", "pages_reserved",
+    # batch-lane weighted-fair queueing: this tenant's stride share
+    # (1.0 default; weight 2 earns twice the admitted span of weight 1)
+    "weight",
 })
 
 REPLICA_POOL_STATS_KEYS = frozenset({
@@ -855,6 +858,19 @@ AUTOSCALER_STATS_KEYS = frozenset({
     "autoscale_failures", "samples", "pressure", "pressure_ewma",
     "min_replicas", "max_replicas", "cooldown_remaining",
     "last_decision",
+    # migrate-then-drain shrink: wall time of the most recent
+    # scale-down — the regression alarm for "scale_down no longer
+    # blocks on the longest in-flight generation"
+    "last_scale_down_ms",
+})
+
+# `ExactlyOnceDoor.stats()["cache"]` (`serving.exactly_once`) — the
+# gateway `exactly_once_stats` RPC returns the enclosing dict verbatim;
+# the ledger counters the crash/reclaim drills and the bench assert on.
+EXACTLY_ONCE_STATS_KEYS = frozenset({
+    "completed", "inflight", "capacity", "ttl_s", "dedup_hits",
+    "executions", "expired", "evicted", "double_executions",
+    "durable_loaded",
 })
 
 POOL_REPLICA_STATS_KEYS = frozenset({

@@ -399,13 +399,13 @@ class RemoteReplica:
             seed=int(seed), tenant=tenant, priority=priority))
 
     def set_tenant_quota(self, tenant: str, rate=None, burst=None,
-                         max_pages=None) -> None:
-        """Push one tenant's token-rate quota + page ceiling to the
-        remote engine (the wire mirror of
-        `ModelServer.set_tenant_quota`)."""
+                         max_pages=None, weight=None) -> None:
+        """Push one tenant's token-rate quota, page ceiling, and
+        batch-lane fair-queueing weight to the remote engine (the wire
+        mirror of `ModelServer.set_tenant_quota`)."""
         self._client.call("set_tenant_quota", name=self.MODEL,
                           tenant=tenant, rate=rate, burst=burst,
-                          max_pages=max_pages,
+                          max_pages=max_pages, weight=weight,
                           _timeout=self.rpc_timeout)
 
     # -- KV handoff / live migration ---------------------------------------

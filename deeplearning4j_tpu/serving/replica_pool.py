@@ -1339,16 +1339,17 @@ class ReplicaPool:
         return rep.server
 
     def set_tenant_quota(self, tenant: str, rate=None, burst=None,
-                         max_pages=None) -> None:
-        """Fan one tenant's token-rate quota + KV page ceiling out to
-        every replica (the quota is enforced per decode engine; a
-        pool-level budget would need cross-replica accounting the wire
-        does not carry)."""
+                         max_pages=None, weight=None) -> None:
+        """Fan one tenant's token-rate quota, KV page ceiling, and
+        batch-lane fair-queueing weight out to every replica (the quota
+        is enforced per decode engine; a pool-level budget would need
+        cross-replica accounting the wire does not carry)."""
         with self._lock:
             replicas = list(self._replicas)
         for rep in replicas:
             rep.server.set_tenant_quota(tenant, rate=rate, burst=burst,
-                                        max_pages=max_pages)
+                                        max_pages=max_pages,
+                                        weight=weight)
 
     # -- shutdown ----------------------------------------------------------
     def shutdown(self, drain_timeout: float = 10.0) -> bool:

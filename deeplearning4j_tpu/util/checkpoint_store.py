@@ -95,6 +95,20 @@ def fsync_file(path) -> None:
         os.fsync(f.fileno())
 
 
+def fsync_dir(directory) -> None:
+    """Public seam over `_fsync_dir`: fsync a directory so a just-created
+    file (e.g. a fresh WAL segment in `serving.exactly_once`) survives
+    power loss. Best-effort with the same caveats."""
+    _fsync_dir(directory)
+
+
+def crc32_hex(data: bytes) -> str:
+    """CRC32 of `data` as 8 lowercase hex chars — the per-record checksum
+    primitive shared by checkpoint manifests and the exactly-once request
+    journal (`serving.exactly_once.RequestJournal`)."""
+    return format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+
+
 def _tmp_name(path: Path) -> Path:
     # same directory as the destination: os.replace must not cross a
     # filesystem boundary, and the unique suffix keeps concurrent savers

@@ -79,3 +79,30 @@ def commit_lease(sender, handoff_id):
         return sender.commit_handoff(handoff_id)
     except TimeoutError:  # explicit verdict: the caller sees False
         return False
+
+
+def journal_append(fh, record):
+    try:
+        fh.write(record)
+        fh.flush()
+    except OSError as e:  # typed refusal: admission fails loudly, the
+        raise ServingError(f"journal append failed: {e}")  # client retries
+
+
+def journal_replay(door, records):
+    deferred = []
+    for rec in records:
+        try:
+            door.execute(rec["method"], rec["params"])
+        except ServingError as e:  # logged defer: the next replay pass
+            logger.warning("replay deferred %s: %s",  # picks it up
+                           rec["request_id"], e)
+            deferred.append(rec)
+    return deferred
+
+
+def claim_result(client, request_id):
+    try:
+        return client.claim(request_id)
+    except TimeoutError as e:  # mapped to the typed reclaim verdict
+        raise ServingError(f"claim of {request_id} timed out: {e}")

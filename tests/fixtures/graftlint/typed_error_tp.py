@@ -71,3 +71,26 @@ def ship_pages(conn, frames):
             conn.sendall(frame)
         except ConnectionAbortedError:  # TP: a dropped page frame is
             break                       # silent corruption downstream
+
+
+def journal_append(fh, record):
+    try:
+        fh.write(record)
+        fh.flush()
+    except OSError:  # TP: a lost WAL append silently breaks the
+        pass         # exactly-once promise — the admit never happened
+
+
+def journal_replay(door, records):
+    for rec in records:
+        try:
+            door.execute(rec["method"], rec["params"])
+        except ConnectionError:  # TP: a skipped replay strands an
+            continue             # accepted request forever
+
+
+def claim_result(client, request_id):
+    try:
+        return client.claim(request_id)
+    except TimeoutError:  # TP: bare return — the caller cannot tell
+        return            # "lost" from "still decoding"

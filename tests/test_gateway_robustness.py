@@ -348,6 +348,42 @@ def test_entrypoint_shutdown_not_remotely_invokable():
         server.stop()
 
 
+# ------------------------------------------------ RPC retry-story contract
+def test_every_gateway_rpc_has_a_classified_retry_story():
+    """Every public entry-point method must be classified in
+    `serving.exactly_once`: either its retry-safety comes from the dedup
+    door (`DEDUPED_RPCS`) or it is documented side-effect-free
+    (`SIDE_EFFECT_FREE_RPCS`). A new RPC in neither set fails here —
+    nobody ships an endpoint without deciding its retry story."""
+    import inspect
+
+    from deeplearning4j_tpu.gateway import EntryPoint
+    from deeplearning4j_tpu.serving.exactly_once import (
+        DEDUPED_RPCS,
+        JOURNALED_RPCS,
+        SIDE_EFFECT_FREE_RPCS,
+    )
+    from deeplearning4j_tpu.serving.remote_replica import ReplicaEntryPoint
+
+    assert not DEDUPED_RPCS & SIDE_EFFECT_FREE_RPCS, (
+        "an RPC cannot be both deduped-only and side-effect-free: "
+        f"{sorted(DEDUPED_RPCS & SIDE_EFFECT_FREE_RPCS)}")
+    classified = DEDUPED_RPCS | SIDE_EFFECT_FREE_RPCS
+    for cls in (EntryPoint, ReplicaEntryPoint):
+        exposed = {
+            name for name, member in inspect.getmembers(cls)
+            if callable(member) and not name.startswith("_")
+            and name not in cls._RPC_EXCLUDED
+        }
+        unclassified = exposed - classified
+        assert not unclassified, (
+            f"{cls.__name__} exposes RPCs with no declared retry story: "
+            f"{sorted(unclassified)} — add each to DEDUPED_RPCS or "
+            "SIDE_EFFECT_FREE_RPCS in serving/exactly_once.py")
+    # journaled traffic is the crash-recoverable subset of stamped calls
+    assert JOURNALED_RPCS <= classified
+
+
 def test_serving_tier_survives_stop_start_cycle():
     """stop() drains the ModelServers; a restarted gateway must re-wrap
     lazily, not silently serve unprotected."""

@@ -715,3 +715,71 @@ def test_kill9_decode_replica_mid_generation_zero_failed_requests(
             pool.generate(prompts[1], 6, timeout=120.0), expected[1])
     finally:
         pool.shutdown(drain_timeout=5.0)
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+def test_cross_process_warm_migration_on_scale_down(
+        net, tmp_path, _wedge_guard):
+    """Warm migration across REAL process boundaries: a replica process
+    is scaled away while one of its slots is actively emitting tokens —
+    the slot's KV pages ship over the handoff RPCs, the surviving
+    PROCESS resumes it mid-sequence (`migrations_in`, the warm re-bind
+    counter, moves on the survivor — no silent re-prefill), and the
+    caller sees tokens argmax-identical to an uninterrupted run."""
+    from deeplearning4j_tpu.serving import spawn_replica_pool
+
+    gen = {"n_slots": 2, "max_len": 64, "prompt_buckets": [8],
+           "decode_chunk": 1}
+    prompt = _prompts(1, 8, seed=31)[0]
+    n_tokens = 56  # fills the model's 64-position window: a long tail
+    expected = generate(net, prompt[None], n_tokens, temperature=0.0)[0]
+    pool = spawn_replica_pool(
+        net, 2, scratch_dir=tmp_path,
+        server_kwargs={"generation": gen},
+        pool_kwargs=dict(probe_interval=0.25, probe_timeout=10.0,
+                         watchdog_timeout=10.0))
+    try:
+        # warm both replica processes' compile caches (and this bucket)
+        pool.generate(prompt, 4, timeout=120.0)
+        pool.generate(prompt, 4, timeout=120.0)
+
+        def tokens_by_replica():
+            return {rid: r.get("generation", {}).get("tokens_generated", 0)
+                    for rid, r in pool.stats()["replicas"].items()}
+
+        base = tokens_by_replica()
+        res = {}
+
+        def run():
+            res["out"] = pool.generate(prompt, n_tokens, timeout=120.0)
+
+        t = threading.Thread(target=run)
+        t.start()
+
+        def warm_victim():
+            # active AND past its first emitted token: the export will
+            # be a WARM one, not a queued-request cold re-prefill
+            for rid, r in pool.stats()["replicas"].items():
+                g = r.get("generation", {})
+                if g.get("active_slots", 0) > 0 \
+                        and g.get("tokens_generated", 0) > base[rid]:
+                    return int(rid)
+            return None
+
+        _await(lambda: warm_victim() is not None, 120.0,
+               "a mid-decode slot to scale away from")
+        pool.shrink_replica(warm_victim(), drain_timeout=60.0)
+        t.join(120.0)
+        assert not t.is_alive(), "migrated generation never completed"
+        np.testing.assert_array_equal(res["out"], expected)
+        s = pool.stats()
+        assert s["migrations"] >= 1, "scale-down did not migrate"
+        assert s["migration_fallbacks"] == 0, \
+            "the handoff fell back to re-prefill — not a warm migration"
+        survivors = [r.get("generation", {}).get("migrations_in", 0)
+                     for r in s["replicas"].values()]
+        assert sum(survivors) >= 1, \
+            "no survivor re-bound shipped KV pages (cold resume?)"
+    finally:
+        pool.shutdown(drain_timeout=5.0)

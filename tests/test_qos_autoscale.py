@@ -19,7 +19,7 @@ import numpy as np
 import pytest
 
 import deeplearning4j_tpu as dl4j
-from deeplearning4j_tpu.models.transformer import gpt_configuration
+from deeplearning4j_tpu.models.transformer import generate, gpt_configuration
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.ops.activations import Activation
@@ -410,6 +410,79 @@ def test_interactive_selected_from_queue_ahead_of_batch(net):
         eng.shutdown(drain_timeout=30.0)
 
 
+# --------------------------------------------- batch-lane fair queueing
+
+
+def _hold(dt=0.02):
+    """Per-step decode drag: keeps a blocker generation on the slot long
+    enough for the whole backlog to queue behind it."""
+    def hook(phase, info):
+        if phase == "pre_decode":
+            time.sleep(dt)
+    return hook
+
+
+def _batch_admits(eng, tenants):
+    return [e["tenant"] for e in eng.flight_record()["events"]
+            if e["kind"] == "admit" and e.get("tenant") in tenants]
+
+
+def test_wfq_equal_weight_batch_tenants_split_admission_evenly(net):
+    """Weighted-fair queueing in the batch lane: two equal-weight
+    tenants queued back-to-back (6 of a, THEN 6 of b) are admitted
+    alternately — every admission prefix stays within one request of
+    50/50, so neither tenant's burst parks in front of the other."""
+    eng = _engine(net, n_slots=1, step_hooks=[_hold()],
+                  pool_pages=8, max_queued_pages=64)
+    try:
+        eng.submit(_prompt(), 4).result(timeout=60.0)  # compile warm-up
+        blocker = eng.submit(_prompt(8, 5), 24)  # pins the only slot
+        reqs = [eng.submit(_prompt(8, 10 + i), 4, tenant="a",
+                           priority="batch") for i in range(6)]
+        reqs += [eng.submit(_prompt(8, 20 + i), 4, tenant="b",
+                            priority="batch") for i in range(6)]
+        blocker.result(timeout=60.0)
+        for r in reqs:
+            r.result(timeout=120.0)
+        admits = _batch_admits(eng, ("a", "b"))
+        assert len(admits) == 12
+        for k in range(1, 13):
+            diff = admits[:k].count("a") - admits[:k].count("b")
+            assert abs(diff) <= 1, \
+                f"admission order is not fair: {admits[:k]}"
+    finally:
+        eng.shutdown(drain_timeout=30.0)
+
+
+def test_wfq_weight_two_tenant_gets_double_share(net):
+    """A weight-2 tenant's stride is half a weight-1 peer's: under a
+    saturated batch lane its whole backlog is admitted while the peer
+    is still waiting on its fourth."""
+    eng = _engine(net, n_slots=1, step_hooks=[_hold()],
+                  pool_pages=8, max_queued_pages=64,
+                  qos={"tenants": {"heavy": {"weight": 2.0}}})
+    try:
+        eng.submit(_prompt(), 4).result(timeout=60.0)
+        blocker = eng.submit(_prompt(8, 5), 24)
+        reqs = [eng.submit(_prompt(8, 10 + i), 4, tenant="heavy",
+                           priority="batch") for i in range(6)]
+        reqs += [eng.submit(_prompt(8, 20 + i), 4, tenant="light",
+                            priority="batch") for i in range(6)]
+        blocker.result(timeout=60.0)
+        for r in reqs:
+            r.result(timeout=120.0)
+        admits = _batch_admits(eng, ("heavy", "light"))
+        assert len(admits) == 12
+        sixth_heavy = [i for i, t in enumerate(admits)
+                       if t == "heavy"][5]
+        fourth_light = [i for i, t in enumerate(admits)
+                        if t == "light"][3]
+        assert sixth_heavy < fourth_light, \
+            f"weight 2 did not earn a 2:1 share: {admits}"
+    finally:
+        eng.shutdown(drain_timeout=30.0)
+
+
 # ------------------------------------------------------ stats contracts
 
 
@@ -672,6 +745,70 @@ def test_autoscaler_scale_cycle_on_real_pool(mlp, x):
     finally:
         scaler.stop()
         pool.shutdown(drain_timeout=10.0)
+
+
+def test_scale_down_not_pinned_by_long_generation(net):
+    """Scale-down is a bounded handoff, not a wait: forced onto the
+    replica holding a LIVE long generation (the least-loaded default
+    would dodge it), `scale_down` returns in a fraction of the decode's
+    remaining wall time — the slot migrates warm to the survivor, the
+    caller still gets the exact tokens, and `last_scale_down_ms`
+    records the bound."""
+    gen = {"n_slots": 2, "max_len": 64, "prompt_buckets": (8,),
+           "decode_chunk": 1, "step_hooks": [_hold(0.08)]}
+    prompt = _prompt(seed=5)
+    n_tokens = 48  # ≈ 48 × 0.08 s ≈ 3.8 s of decode left to pin on
+    expected = generate(net, prompt[None], n_tokens, temperature=0.0)[0]
+    pool = ReplicaPool.from_net(net, 2, server_kwargs={"generation": gen},
+                                probe_interval=30.0)
+    scaler = Autoscaler(pool, min_replicas=1, max_replicas=2,
+                        drain_timeout=60.0)
+    res = {}
+    try:
+        # warm BOTH replicas' prefill/decode jit caches: the drill times
+        # the handoff, not the survivor's first compile
+        warm = [threading.Thread(
+                    target=lambda: pool.generate(_prompt(seed=9), 4,
+                                                 timeout=120.0))
+                for _ in range(2)]
+        for w in warm:
+            w.start()
+        for w in warm:
+            w.join(120.0)
+
+        def run():
+            res["out"] = pool.generate(prompt, n_tokens, timeout=120.0)
+
+        t = threading.Thread(target=run)
+        t.start()
+
+        def busy_rid():
+            for rid, r in pool.stats()["replicas"].items():
+                if r.get("generation", {}).get("active_slots", 0) > 0:
+                    return int(rid)
+            return None
+
+        _wait(lambda: busy_rid() is not None, 60.0,
+              "a live decode slot to pin the victim on")
+        scaler._pick_victim = busy_rid  # force the pathological victim
+        t0 = time.monotonic()
+        scaler.scale_down()
+        took_ms = (time.monotonic() - t0) * 1000.0
+        # the generation has ≳3 s of slowed decode left; a drain that
+        # waited on it would blow well past this bound
+        assert took_ms < 2000.0, \
+            f"scale_down blocked on the in-flight generation: {took_ms}ms"
+        st = scaler.stats()
+        assert st["last_scale_down_ms"] is not None
+        assert st["last_scale_down_ms"] < 2000.0
+        t.join(60.0)
+        assert not t.is_alive(), "migrated generation never completed"
+        np.testing.assert_array_equal(res["out"], expected)
+        ps = pool.stats()
+        assert ps["migrations"] >= 1 and ps["migration_fallbacks"] == 0
+    finally:
+        scaler.stop()
+        pool.shutdown(drain_timeout=30.0)
 
 
 # ----------------------------------------------------- gateway plumbing

@@ -41,10 +41,11 @@ RULE_FIXTURES = {
     # 2: same-class inversion + cross-object (self.pool._lock) inversion
     "lock-order": ("lock_order", 2),
     "guarded-by": ("guarded_by", 2),
-    # 9: generic raises + broad catches + the silent-wire-absorb
+    # 12: generic raises + broad catches + the silent-wire-absorb
     # sub-check, incl. the KV-transfer edges (page fetch, lease
     # commit, frame shipping) added with the disagg/migration plane
-    "typed-error": ("typed_error", 9),
+    # and the exactly-once edges (journal append/replay, claim)
+    "typed-error": ("typed_error", 12),
     "rng-reuse": ("rng", 3),
 }
 
