@@ -197,10 +197,17 @@ class EntryPoint:
     # (after which predict would silently bypass the serving tier)
     _RPC_EXCLUDED = frozenset({"shutdown"})
 
-    def __init__(self, serving: Optional[dict] = None):
+    def __init__(self, serving: Optional[dict] = None,
+                 streaming: Optional[dict] = None):
+        from deeplearning4j_tpu.serving.streaming import StreamRegistry
+
         self._models: Dict[str, Any] = {}
         self._servers: Dict[str, Any] = {}
         self._serving = {} if serving is True else serving
+        # per-request emitted-token rings (`generate_stream` /
+        # `resume_stream`); `streaming` carries StreamRegistry kwargs
+        self.streams = StreamRegistry(**(streaming or {}))
+        self._stream_stats_bound: set = set()
 
     # -- model lifecycle --------------------------------------------------
     def create_model(self, name: str, config: dict) -> str:
@@ -402,7 +409,8 @@ class EntryPoint:
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 priority: str = "interactive") -> np.ndarray:
+                 priority: str = "interactive",
+                 logprobs: int = 0):
         """Autoregressive generation for a `gpt_configuration` model
         through the serving tier's continuous-batching decode engine —
         concurrent gateway callers share the slot pool, so no request
@@ -411,12 +419,63 @@ class EntryPoint:
         errors (`ServerOverloadedError` + retry_after, ...) surface in
         the error payload like `predict`'s. `tenant` and `priority`
         ("interactive" | "batch") feed the engine's multi-tenant QoS
-        doors when a `"qos"` generation config is present."""
+        doors when a `"qos"` generation config is present. With
+        `logprobs=K > 0` (needs `"generation": {"logprobs": K, ...}`)
+        the reply is `{"tokens", "logprobs"}` — one per-step entry of
+        the chosen token's logprob plus the top-K alternatives, from
+        the UNSCALED model distribution."""
         srv = self._server(name)
+        kw = {"logprobs": int(logprobs)} if logprobs else {}
         return srv.generate(np.asarray(prompt_ids), int(n_tokens),
                             temperature=float(temperature),
                             seed=int(seed), timeout=timeout,
-                            tenant=tenant, priority=priority)
+                            tenant=tenant, priority=priority, **kw)
+
+    def generate_stream(self, name: str, prompt_ids, n_tokens: int,
+                        temperature: float = 0.0, seed: int = 0,
+                        timeout: Optional[float] = None,
+                        tenant: Optional[str] = None,
+                        priority: str = "interactive",
+                        logprobs: int = 0,
+                        request_id: Optional[str] = None,
+                        _finish_stream: bool = True):
+        """`generate` with every emitted token published into a
+        per-request `TokenStream` ring keyed by `request_id` — the
+        gateway handler pumps the ring to the socket as incremental
+        frames, and `resume_stream(request_id, cursor)` replays it
+        after a disconnect. Servers whose adapters cannot carry a sink
+        across the wire (`supports_stream_sink = False`) fall back to
+        unary execution: no incremental frames, but the terminal result
+        still lands and resume/claim semantics hold. Returns the same
+        value as `generate` (journal replay executes this method
+        directly; the stream it re-opens serves late resumes)."""
+        srv = self._server(name)
+        rid = str(request_id) if request_id else f"stream-{uuid.uuid4()}"
+        stream = self.streams.open(rid)
+        metrics = getattr(srv, "metrics", None)
+        if metrics is not None and id(metrics) not in self._stream_stats_bound:
+            # lazy: the first streamed request pins the registry stats
+            # into this server's Prometheus exposition
+            metrics.register_stats("streaming", self.streams.stats)
+            self._stream_stats_bound.add(id(metrics))
+        kw = {"logprobs": int(logprobs)} if logprobs else {}
+        if getattr(srv, "supports_stream_sink", False):
+            kw["on_token"] = stream.publish
+        try:
+            out = srv.generate(np.asarray(prompt_ids), int(n_tokens),
+                               temperature=float(temperature),
+                               seed=int(seed), timeout=timeout,
+                               tenant=tenant, priority=priority, **kw)
+        except Exception as e:
+            # park the typed failure as the terminal frame so a resume
+            # after the fact sees the error instead of hanging; the
+            # raise still reaches the caller's error shaping
+            self.streams.finish(stream, {
+                "error": str(e), "error_type": type(e).__name__})
+            raise
+        if _finish_stream:
+            self.streams.finish(stream, {"result": encode_value(out)})
+        return out
 
     # -- serving management ----------------------------------------------
     @staticmethod
@@ -606,12 +665,28 @@ class GatewayServer:
                  max_request_bytes: int = 64 << 20,
                  recv_timeout: Optional[float] = 600.0,
                  serving: Optional[dict] = None,
-                 exactly_once=None):
+                 exactly_once=None,
+                 streaming: Optional[dict] = None,
+                 stream_send_timeout: float = 30.0,
+                 stream_coalesce: float = 0.005):
         if max_request_bytes < 1:
             raise ValueError("max_request_bytes must be >= 1")
-        self.entry = entry_point or EntryPoint(serving=serving)
+        if stream_send_timeout <= 0:
+            raise ValueError("stream_send_timeout must be > 0")
+        if stream_coalesce < 0:
+            raise ValueError("stream_coalesce must be >= 0")
+        self.entry = entry_point or EntryPoint(serving=serving,
+                                               streaming=streaming)
         self.max_request_bytes = max_request_bytes
         self.recv_timeout = recv_timeout
+        # how long one stream frame write may block before the pump
+        # declares the consumer slow and sheds it (the generation keeps
+        # running; its outcome parks behind the door)
+        self.stream_send_timeout = stream_send_timeout
+        # after the FIRST frame (TTFT is never delayed), the pump waits
+        # this long between reads so tokens batch into fewer frames —
+        # per-token syscall + wakeup cost is the streaming goodput tax
+        self.stream_coalesce = stream_coalesce
         self._host, self._requested_port = host, port
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -640,6 +715,8 @@ class GatewayServer:
         entry = self.entry
         max_bytes = self.max_request_bytes
         recv_timeout = self.recv_timeout
+        send_timeout = self.stream_send_timeout
+        coalesce = self.stream_coalesce
         door = self.door
 
         class Handler(socketserver.StreamRequestHandler):
@@ -647,6 +724,9 @@ class GatewayServer:
             # a silent/stalled client raises socket.timeout out of
             # readline instead of blocking the handler thread forever
             timeout = recv_timeout
+            # streaming pushes many small frames; Nagle + delayed-ACK
+            # turns each into a ~40ms stall on a one-way pipe
+            disable_nagle_algorithm = True
 
             def _respond(self, resp: dict) -> bool:
                 try:
@@ -657,6 +737,177 @@ class GatewayServer:
                     # client vanished mid-response: nothing to salvage
                     logger.info("gateway: client disconnected mid-response")
                     return False
+
+            def _pump(self, stream, cursor: int, req_id):
+                """Feed this socket from `stream`'s ring starting at
+                `cursor`: incremental frames as tokens land, then the
+                terminal body (returned for the common respond path at
+                the bottom of handle()). None means the connection is
+                done — slow-consumer shed or client disconnect — and
+                the handler must close; the generation keeps running
+                and its outcome parks for resume/claim."""
+                from deeplearning4j_tpu.serving.streaming import (
+                    StreamBackpressureError,
+                )
+
+                try:
+                    # frame writes get their own (shorter) timeout: a
+                    # reader that stops draining must be shed, not
+                    # trusted with the idle recv budget
+                    self.connection.settimeout(send_timeout)
+                    sent_any = False
+                    while True:
+                        # after the first frame (TTFT stays prompt) the
+                        # read lingers so tokens batch into fewer frames;
+                        # finish() aborts the linger, so the terminal
+                        # body is never delayed by coalescing
+                        try:
+                            toks, lps, cursor, body = stream.read(
+                                cursor, timeout=0.25,
+                                linger=coalesce if sent_any else 0.0)
+                        except StreamBackpressureError:
+                            # this consumer fell out of the replay ring:
+                            # count the shed, answer typed through the
+                            # common wire-error path (the client falls
+                            # back to the parked outcome)
+                            entry.streams.shed(stream)
+                            raise
+                        if toks:
+                            sent_any = True
+                            payload = {"cursor": cursor, "tokens": toks}
+                            if lps is not None:
+                                payload["logprobs"] = encode_value(lps)
+                            frame = {"id": req_id, "frame": payload}
+                            try:
+                                self.wfile.write(
+                                    (json.dumps(frame) + "\n").encode())
+                                self.wfile.flush()
+                            # socket.timeout subclasses OSError: the
+                            # slow-consumer verdict must be caught first
+                            # or it reads as a disconnect
+                            except (socket.timeout, TimeoutError):
+                                entry.streams.shed(stream)
+                                logger.warning(
+                                    "gateway: stream %s consumer stalled "
+                                    "past stream_send_timeout=%.1fs; "
+                                    "shed — the outcome parks for "
+                                    "resume/claim", stream.request_id,
+                                    send_timeout)
+                                return None
+                            except (BrokenPipeError, ConnectionResetError,
+                                    OSError):
+                                logger.info(
+                                    "gateway: stream %s consumer gone at "
+                                    "cursor %d (resumable)",
+                                    stream.request_id, cursor)
+                                return None
+                        elif body is not None:
+                            return {"id": req_id, **body}
+                finally:
+                    self.connection.settimeout(recv_timeout)
+
+            def _generate_stream(self, req, req_id, ctx, request_key):
+                """Execute `generate_stream` on a worker thread feeding
+                the request's ring while THIS thread pumps the ring to
+                the socket — the worker outlives any number of consumer
+                disconnects, parks the terminal body behind the door,
+                and finishes the stream for late resumes."""
+                params = decode_value(req.get("params") or {})
+                # without a door the wire stamp never becomes a
+                # request_key, but it must still key the ring or a
+                # door-less server could not serve resumes
+                rid = str(request_key or params.get("request_id")
+                          or req.get("request_id")
+                          or f"stream-{uuid.uuid4()}")
+                params["request_id"] = rid
+                stream = entry.streams.open(rid)
+
+                def work():
+                    trace = None
+                    try:
+                        if observability.tracing_enabled():
+                            trace = observability.Trace(
+                                trace_id=ctx.get("trace_id")
+                                if ctx else None)
+                        if trace is not None:
+                            with observability.use_trace(trace), \
+                                    trace.span("gateway",
+                                               method="generate_stream"):
+                                result = entry.generate_stream(
+                                    _finish_stream=False, **params)
+                        else:
+                            result = entry.generate_stream(
+                                _finish_stream=False, **params)
+                        body = {"result": encode_value(result)}
+                        if trace is not None:
+                            trace.finish("served")
+                            body["trace_id"] = trace.trace_id
+                            body["trace"] = trace.to_dict()
+                    # graftlint: disable=typed-error  RPC boundary
+                    # (worker half): any failure must become the
+                    # stream's typed terminal frame, never kill the
+                    # worker silently
+                    except Exception as e:
+                        body = {"error": f"{type(e).__name__}: {e}",
+                                "error_type": type(e).__name__}
+                        retry_after = getattr(e, "retry_after", None)
+                        if retry_after is not None:
+                            body["retry_after"] = float(retry_after)
+                        replica_id = getattr(e, "replica_id", None)
+                        if replica_id is not None:
+                            body["replica_id"] = int(replica_id)
+                        wire_payload = getattr(e, "wire_payload", None)
+                        if callable(wire_payload):
+                            body["error_payload"] = encode_value(
+                                wire_payload())
+                        if trace is not None:
+                            trace.finish(type(e).__name__)
+                            body["trace_id"] = trace.trace_id
+                            body["trace"] = trace.to_dict()
+                    if request_key is not None:
+                        retryable = "error" in body \
+                            and "retry_after" in body
+                        try:
+                            door.complete(request_key, body,
+                                          retryable=retryable)
+                        # graftlint: disable=typed-error  the terminal
+                        # frame must still land when parking fails —
+                        # logged loudly, never silent
+                        except Exception:
+                            logger.exception(
+                                "gateway: exactly-once complete failed "
+                                "for %r", request_key)
+                    entry.streams.finish(stream, body)
+
+                threading.Thread(target=work, daemon=True,
+                                 name=f"gateway-stream-{rid}").start()
+                return self._pump(stream, 0, req_id)
+
+            def _resume_stream(self, req, req_id):
+                """Re-attach a reconnecting consumer at its cursor. A
+                live (or TTL-retained finished) stream replays from the
+                ring; an aged-out stream falls back to the parked
+                exactly-once outcome, whose full result the client
+                trims by cursor. Typed errors (pending / unknown /
+                backpressure) surface through the common wire-error
+                path."""
+                from deeplearning4j_tpu.serving.exactly_once import (
+                    UnknownRequestError,
+                )
+
+                params = decode_value(req.get("params") or {})
+                rid = str(params.get("request_id"))
+                cursor = int(params.get("cursor") or 0)
+                stream = entry.streams.attach(rid)
+                if stream is not None:
+                    return self._pump(stream, cursor, req_id)
+                if door is None:
+                    raise UnknownRequestError(
+                        f"stream {rid!r}: no ring retained and no "
+                        "exactly-once door to claim the outcome from — "
+                        "re-issue the generation")
+                outcome = door.claim(rid)
+                return {"id": req_id, **outcome}
 
             def handle(self):
                 while True:
@@ -718,6 +969,14 @@ class GatewayServer:
                             else:
                                 resp = {"id": req_id,
                                         "result": door.stats()}
+                        elif isinstance(req, dict) \
+                                and req.get("method") == "resume_stream":
+                            # stream re-attach: never deduped (the
+                            # resume IS the retry) — ring replay, else
+                            # parked-outcome fallback
+                            resp = self._resume_stream(req, req_id)
+                            if resp is None:
+                                return  # shed or disconnected mid-pump
                         elif door is not None and request_key is not None:
                             verdict, info = door.admit(
                                 request_key, req["method"],
@@ -740,6 +999,16 @@ class GatewayServer:
                                 owner = True
                         if resp is not None:
                             pass  # door short-circuit: skip dispatch
+                        elif req["method"] == "generate_stream":
+                            # frames ride this socket from a worker-fed
+                            # ring; the worker parks the outcome itself,
+                            # so this handler must NOT double-complete
+                            resp = self._generate_stream(
+                                req, req_id, ctx,
+                                request_key if owner else None)
+                            owner = False
+                            if resp is None:
+                                return  # shed or disconnected mid-pump
                         else:
                             if req["method"].startswith("_") \
                                     or req["method"] \
@@ -1065,8 +1334,12 @@ class GatewayClient:
 
     # -- connection pool ---------------------------------------------------
     def _open(self) -> _PooledConn:
-        return _PooledConn(socket.create_connection(
-            (self._host, self._port), timeout=self._timeout))
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        # request lines are small; without NODELAY the resume handshake
+        # and every unary call eat Nagle + delayed-ACK stalls
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return _PooledConn(sock)
 
     def _borrow(self) -> _PooledConn:
         while True:
@@ -1154,6 +1427,44 @@ class GatewayClient:
                     type(e).__name__, method, attempt,
                     self.max_retries, backoff)
                 time.sleep(backoff)
+
+    def generate_stream(self, name: str, prompt_ids, n_tokens: int, *,
+                        temperature: float = 0.0, seed: int = 0,
+                        timeout: Optional[float] = None,
+                        tenant: Optional[str] = None,
+                        priority: str = "interactive",
+                        logprobs: int = 0,
+                        max_resumes: int = 8,
+                        _timeout: Optional[float] = None,
+                        _request_id: Optional[str] = None) -> "_GenStream":
+        """Streamed `generate`: returns an iterator of frame dicts
+        (`{"cursor", "tokens"[, "logprobs"]}`) pushed as the decode
+        engine emits tokens. On ANY wire failure the iterator
+        transparently reconnects and re-attaches via
+        `resume_stream(request_id, cursor)` (up to `max_resumes`
+        times): the server replays retained ring history and the
+        client trims by cursor, so the concatenated `.tokens` is
+        identical to the unary `generate` result — zero lost, zero
+        duplicated, in order. A consumer that stalled past the ring
+        falls back to the parked exactly-once outcome (`claim`)
+        automatically. After exhaustion `.tokens`/`.logprobs` hold the
+        full sequence and `.result` the terminal value; `.resumes`
+        counts reconnects survived."""
+        with self._lock:
+            self._next_request += 1
+            request_id = _request_id \
+                or f"{self.client_id}-{self._next_request}"
+        self.last_request_id = request_id
+        params = {"name": name, "prompt_ids": np.asarray(prompt_ids),
+                  "n_tokens": int(n_tokens),
+                  "temperature": float(temperature), "seed": int(seed),
+                  "timeout": timeout, "tenant": tenant,
+                  "priority": priority}
+        if logprobs:
+            params["logprobs"] = int(logprobs)
+        return _GenStream(self, params, request_id,
+                          self._timeout if _timeout is None else _timeout,
+                          max_resumes)
 
     def claim(self, request_id: str, timeout: Optional[float] = None,
               _timeout: Optional[float] = None):
@@ -1254,3 +1565,227 @@ class GatewayClient:
             idle, self._idle = self._idle, []
         for conn in idle:
             conn.close()
+
+
+class _GenStream:
+    """One streamed generation: iterate for frame dicts
+    (`{"cursor", "tokens"[, "logprobs"]}`), each carrying only tokens
+    not yet delivered THROUGH THIS ITERATOR — a resume replays ring
+    history, and the client-side cursor trim drops everything already
+    seen, so the frames concatenate to exactly the unary result no
+    matter how many times the wire died in between.
+
+    Borrows a pooled connection for EXCLUSIVE use while the stream is
+    live (a multi-frame response cannot interleave with unary calls on
+    one socket); a cleanly-terminated stream ends at a line boundary,
+    so the connection goes back to the pool — a torn one is closed."""
+
+    def __init__(self, client: GatewayClient, params: dict,
+                 request_id: str, timeout: Optional[float],
+                 max_resumes: int):
+        self._client = client
+        self.request_id = request_id
+        self._timeout = timeout
+        self._max_resumes = int(max_resumes)
+        self.tokens: list = []
+        self.logprobs: list = []
+        self.resumes = 0
+        self.result = None
+        self.trace_id = None
+        self.trace = None
+        self._done = False
+        self._conn: Optional[_PooledConn] = None
+        self._req_id = None
+        self._pending_deadline: Optional[float] = None
+        self._send({"method": "generate_stream",
+                    "params": encode_value(params),
+                    "request_id": request_id})
+
+    # -- wire --------------------------------------------------------------
+    def _send(self, body: dict) -> None:
+        self.close()
+        conn = self._client._borrow()
+        try:
+            conn.sock.settimeout(self._timeout)
+            with self._client._lock:
+                self._client._next_id += 1
+                self._req_id = self._client._next_id
+            req = dict(body, id=self._req_id)
+            conn.file.write((json.dumps(req) + "\n").encode())
+            conn.file.flush()
+        except BaseException:
+            conn.close()
+            raise
+        self._conn = conn
+
+    def _read_line(self) -> dict:
+        max_bytes = self._client.max_response_bytes
+        line = self._conn.file.readline(max_bytes + 1)
+        if not line:
+            raise ConnectionError("gateway closed the stream connection")
+        if len(line) > max_bytes:
+            raise GatewayProtocolError(
+                f"stream line exceeds max_response_bytes={max_bytes}")
+        if not line.endswith(b"\n"):
+            raise GatewayProtocolError(
+                "stream line truncated mid-frame (peer died while "
+                "writing)")
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            raise GatewayProtocolError(
+                f"unparseable stream line: {e}") from e
+        if not isinstance(obj, dict) or not (
+                "frame" in obj or "result" in obj or "error" in obj):
+            raise GatewayProtocolError(
+                "malformed stream line (no frame/result/error)")
+        if obj.get("id") not in (self._req_id, None):
+            raise GatewayProtocolError(
+                f"stream response id {obj.get('id')!r} does not match "
+                f"request id {self._req_id} (stream desynced)")
+        return obj
+
+    def _resume(self) -> None:
+        """Reconnect and re-attach at the current cursor (bounded)."""
+        self.resumes += 1
+        self._send({"method": "resume_stream",
+                    "params": {"request_id": self.request_id,
+                               "cursor": len(self.tokens)}})
+
+    # -- terminal handling -------------------------------------------------
+    def _finish(self, full) -> Optional[dict]:
+        """Fold the terminal full result in: whatever tail the frames
+        never delivered becomes one last frame (None when the frames
+        already covered everything)."""
+        self.result = full
+        self._done = True
+        # the terminal line is the stream's last byte: the connection
+        # sits at a clean line boundary, so it can serve unary calls
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            self._client._release(conn)
+        full_toks = full["tokens"] if isinstance(full, dict) else full
+        full_toks = [int(t) for t in np.asarray(full_toks).reshape(-1)]
+        rest = full_toks[len(self.tokens):]
+        if not rest:
+            return None
+        self.tokens.extend(rest)
+        out = {"cursor": len(self.tokens), "tokens": rest}
+        if isinstance(full, dict):
+            fresh_lps = list(full.get("logprobs")
+                             or [])[len(self.logprobs):]
+            if fresh_lps:
+                self.logprobs.extend(fresh_lps)
+                out["logprobs"] = fresh_lps
+        return out
+
+    # -- iterator protocol -------------------------------------------------
+    def __iter__(self) -> "_GenStream":
+        return self
+
+    def __next__(self) -> dict:
+        while True:
+            if self._done:
+                raise StopIteration
+            try:
+                obj = self._read_line()
+            # socket.timeout and ConnectionError are OSError subclasses:
+            # one catch covers torn, reset, and silent connections
+            except (OSError, GatewayProtocolError):
+                self.close()
+                if self.resumes >= self._max_resumes:
+                    raise
+                # first reconnect is immediate — the tear already cost
+                # the consumer latency; back off only on repeat failures
+                if self.resumes:
+                    time.sleep(self._client.retry_backoff
+                               * (2 ** min(self.resumes - 1, 6)))
+                self._resume()
+                continue
+            if "frame" in obj:
+                self._pending_deadline = None
+                frame = obj["frame"]
+                cursor = int(frame.get("cursor", 0))
+                toks = [int(t) for t in frame.get("tokens") or []]
+                fresh = cursor - len(self.tokens)
+                if fresh <= 0 or not toks:
+                    continue  # wholly-duplicate replay frame
+                fresh = min(fresh, len(toks))
+                out = {"cursor": cursor, "tokens": toks[-fresh:]}
+                self.tokens.extend(toks[-fresh:])
+                lps = frame.get("logprobs")
+                if lps is not None:
+                    fresh_lps = decode_value(lps)[-fresh:]
+                    self.logprobs.extend(fresh_lps)
+                    out["logprobs"] = fresh_lps
+                return out
+            if "result" in obj:
+                self.trace_id = obj.get("trace_id")
+                self.trace = obj.get("trace")
+                self._client.last_trace_id = self.trace_id
+                self._client.last_trace = self.trace
+                out = self._finish(decode_value(obj["result"]))
+                if out is not None:
+                    return out
+                raise StopIteration
+            # error line
+            err_type = obj.get("error_type")
+            if err_type == "ResultPendingError":
+                # the original execution is still running server-side
+                # (a resume raced it past the ring TTL): poll the
+                # parked outcome instead of failing finished work
+                now = time.monotonic()
+                if self._pending_deadline is None:
+                    self._pending_deadline = now + (
+                        self._timeout or 60.0)
+                if now < self._pending_deadline:
+                    time.sleep(min(obj.get("retry_after") or 0.05,
+                                   self._pending_deadline - now))
+                    self._resume()
+                    continue
+            elif err_type == "StreamBackpressureError":
+                # this consumer stalled out of the replay ring — the
+                # generation finished (or will); recover the full
+                # sequence from the parked exactly-once outcome and
+                # trim it like any other terminal
+                self.close()
+                try:
+                    full = self._client.claim(self.request_id,
+                                              _timeout=self._timeout)
+                except GatewayError as claim_err:
+                    # no door (or the outcome is gone): the typed
+                    # backpressure verdict must not be masked by the
+                    # failed fallback
+                    raise GatewayError(
+                        obj.get("error", "stream fell out of the "
+                                         "replay ring"),
+                        error_type=err_type,
+                        retry_after=obj.get("retry_after"),
+                        trace_id=obj.get("trace_id"),
+                    ) from claim_err
+                out = self._finish(full)
+                if out is not None:
+                    return out
+                raise StopIteration
+            self.close()
+            err_payload = obj.get("error_payload")
+            raise GatewayError(obj.get("error", "stream failed"),
+                               error_type=err_type,
+                               retry_after=obj.get("retry_after"),
+                               replica_id=obj.get("replica_id"),
+                               trace_id=obj.get("trace_id"),
+                               trace=obj.get("trace"),
+                               payload=decode_value(err_payload)
+                               if err_payload is not None else None)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+    def __enter__(self) -> "_GenStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

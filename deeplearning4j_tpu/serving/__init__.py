@@ -25,6 +25,7 @@ from deeplearning4j_tpu.serving.chaos import (
     ReloadCorruptionInjector,
     ReplicaCrashInjector,
     ReplicaHangInjector,
+    SlowConsumerInjector,
     SlowInferenceInjector,
     SlowLorisInjector,
     TenantFloodInjector,
@@ -61,6 +62,11 @@ from deeplearning4j_tpu.serving.quantize import (
     quantize_net_weights,
 )
 from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
+from deeplearning4j_tpu.serving.streaming import (
+    StreamBackpressureError,
+    StreamRegistry,
+    TokenStream,
+)
 from deeplearning4j_tpu.serving.model_server import (
     AutoscaleError,
     CircuitBreaker,
@@ -150,10 +156,14 @@ __all__ = [
     "ServiceUnavailableError",
     "ServingError",
     "SlotMigratedError",
+    "SlowConsumerInjector",
     "SlowInferenceInjector",
     "SlowLorisInjector",
     "TenantFloodInjector",
     "TenantQuotaExceededError",
+    "TokenStream",
+    "StreamBackpressureError",
+    "StreamRegistry",
     "Trace",
     "UnknownRequestError",
     "spawn_replica_pool",

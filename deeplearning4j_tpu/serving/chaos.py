@@ -598,6 +598,82 @@ class ConnectionResetInjector:
         self.proxy.heal()
 
 
+class SlowConsumerInjector:
+    """A streaming consumer that reads `read_frames` frames and then
+    stops draining — the slow-consumer drill for `generate_stream`.
+
+    The contract under drill: the decode slot keeps emitting at full
+    speed (a stalled socket must never block the scheduler loop or
+    other requests), the gateway pump sheds THIS consumer once a frame
+    write stalls past `stream_send_timeout`, and the consumer's
+    recovery ladder is typed the whole way down — ring replay on
+    reconnect while the cursor is retained, `StreamBackpressureError`
+    + parked-outcome `claim` once it fell out. `run()` executes one
+    stalled consumption end to end and returns the outcome record;
+    counters aggregate across runs. `client` is a `GatewayClient`
+    against a server with streaming enabled."""
+
+    def __init__(self, client, name: str, prompt=None,
+                 n_tokens: int = 8, read_frames: int = 1,
+                 stall: float = 1.0, **gen_kw):
+        self.client = client
+        self.name = name
+        self.prompt = (np.arange(8, dtype=np.int32)
+                       if prompt is None else np.asarray(prompt, np.int32))
+        self.n_tokens = int(n_tokens)
+        self.read_frames = int(read_frames)
+        self.stall = float(stall)
+        self.gen_kw = gen_kw
+        self.runs = 0             # guarded by: _lock
+        self.stalls = 0           # guarded by: _lock
+        self.completions = 0      # guarded by: _lock
+        self.backpressure_errors = 0  # guarded by: _lock
+        self.other_errors = 0     # guarded by: _lock
+        self._lock = threading.Lock()
+
+    def run(self) -> dict:
+        from deeplearning4j_tpu.gateway import GatewayError
+
+        with self._lock:
+            self.runs += 1
+        stream = self.client.generate_stream(
+            self.name, self.prompt, self.n_tokens, **self.gen_kw)
+        frames = 0
+        outcome = {"error_type": None}
+        try:
+            for _ in stream:
+                frames += 1
+                if frames == self.read_frames and self.stall > 0:
+                    with self._lock:
+                        self.stalls += 1
+                    # the stall: the socket stays open but nothing
+                    # drains — the server-side pump, not the decode
+                    # slot, must absorb this
+                    time.sleep(self.stall)
+            with self._lock:
+                self.completions += 1
+        except GatewayError as err:
+            outcome["error_type"] = err.error_type
+            with self._lock:
+                if err.error_type == "StreamBackpressureError":
+                    self.backpressure_errors += 1
+                else:
+                    self.other_errors += 1
+        finally:
+            stream.close()
+        outcome.update(frames=frames, resumes=stream.resumes,
+                       tokens=list(stream.tokens),
+                       request_id=stream.request_id)
+        return outcome
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"runs": self.runs, "stalls": self.stalls,
+                    "completions": self.completions,
+                    "backpressure_errors": self.backpressure_errors,
+                    "other_errors": self.other_errors}
+
+
 class TenantFloodInjector:
     """One tenant floods the serving tier with batch-priority generate
     traffic — the multi-tenant isolation drill. `concurrency` threads

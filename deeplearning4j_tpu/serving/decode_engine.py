@@ -142,7 +142,8 @@ class _GenRequest:
                  "slot", "completed_at", "n_pages", "pages",
                  "prefill_pos", "hit_len", "n_shared", "nodes", "digests",
                  "trace", "tenant", "priority", "resumed_at",
-                 "preempted", "handoff", "import_state")
+                 "preempted", "handoff", "import_state", "sink",
+                 "logprobs", "logprob_values")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -187,6 +188,15 @@ class _GenRequest:
         # payload whose shipped pages re-bind at admission
         self.handoff = False
         self.import_state: Optional[dict] = None
+        # streaming emission hook: `sink(cursor, token, logprob)` fires
+        # per emitted token (serving.streaming.TokenStream.publish);
+        # None = unary request, zero per-token overhead
+        self.sink = None
+        # per-step logprob returns: K > 0 asks for {token logprob +
+        # top-K alternatives} per emitted token (requires an engine
+        # built with logprobs=K'); entries accumulate alongside tokens
+        self.logprobs = 0
+        self.logprob_values: List[dict] = []
         # the request timeline, carried across the caller-thread →
         # scheduler-thread hop (thread-locals do not cross it)
         self.trace = observability.NULL_TRACE
@@ -469,7 +479,8 @@ class DecodeEngine:
                  parallel: Optional[dict] = None,
                  qos: Optional[dict] = None,
                  role: str = "both",
-                 handoff_ttl: float = 30.0):
+                 handoff_ttl: float = 30.0,
+                 logprobs: int = 0):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(
                 'role must be "both", "prefill" or "decode", got %r'
@@ -500,6 +511,18 @@ class DecodeEngine:
             if quantize.get("kv") not in (None, "int8"):
                 raise ValueError("quantize['kv'] must be 'int8', got %r"
                                  % (quantize.get("kv"),))
+        if logprobs < 0:
+            raise ValueError("logprobs must be >= 0")
+        if logprobs and speculative:
+            raise ValueError(
+                "logprobs=K cannot combine with speculative decoding: "
+                "accepted draft tokens have no single per-step target "
+                "distribution to report")
+        if logprobs and parallel and parallel.get("tp", 1) > 1:
+            raise ValueError(
+                "logprobs=K cannot combine with tensor parallelism yet "
+                "(the top-K gather is not sharded)")
+        self._logprobs_k = int(logprobs)
         self._quantize_cfg = dict(quantize) if quantize else None
         if excursion not in (None, False) and not isinstance(excursion, dict):
             raise ValueError("excursion must be None, False, or a dict")
@@ -655,6 +678,11 @@ class DecodeEngine:
         self.metrics.register_stats("decode_engine", self.stats)
         self._gen_latency_hist = self.metrics.histogram(
             "decode_engine_generate_latency_ms")
+        # time-to-first-token: observed at the first emitted token of
+        # every FRESH request (resumed/migrated requests already paid
+        # their TTFT on the original replica)
+        self._ttft_hist = self.metrics.histogram(
+            "decode_engine_ttft_ms")
         if excursion is not False:
             exc_cfg = dict(excursion) if excursion else {}
             self._gen_latency_hist.enable_excursion(
@@ -812,6 +840,24 @@ class DecodeEngine:
         def write_pages(kp_, vp_, kcol, vrow, wpids, woff):
             return _write_pages(kp_, vp_, kcol, vrow, wpids, woff, page)
 
+        # logprob returns (ROADMAP 5(c)): K > 0 makes every sampler
+        # site also emit (chosen logprob, top-K logprobs, top-K ids)
+        # from the UNSCALED model distribution — the values are a
+        # report on the model, not on the temperature/top-k sampling
+        # transform, so greedy and sampled requests read the same
+        # per-token numbers. Incompatible with speculative decoding
+        # and TP (validated at construction), so when K > 0 the extra
+        # tuple never has to cross a shard_map boundary.
+        K = self._logprobs_k
+
+        def lp_math(logits, chosen_tok):
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            chosen = jnp.take_along_axis(
+                lsm, chosen_tok[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            top_v, top_i = jax.lax.top_k(lsm, K)
+            return chosen, top_v, top_i.astype(jnp.int32)
+
         def _shard(fn, n_in, n_out):
             """Identity on one device; under TP the body becomes the
             per-shard program of a `shard_map` over the tp mesh
@@ -882,6 +928,9 @@ class DecodeEngine:
             nxt, new_keys = sample_slots(logits, keys, temps)
             nxt = jnp.where(active, nxt, tok)
             new_pos = jnp.where(active, pos + 1, pos)
+            if K:
+                return new_caches, nxt, new_pos, new_keys, \
+                    logits_ok(logits, active), lp_math(logits, nxt)
             return new_caches, nxt, new_pos, new_keys, \
                 logits_ok(logits, active)
 
@@ -902,11 +951,19 @@ class DecodeEngine:
 
             def body(carry, _):
                 caches, tok, pos, keys = carry
-                caches, tok, pos, keys, step_ok = step_math(
-                    bp, params, caches, page_table, tok, pos, keys,
-                    temps, active)
+                out = step_math(bp, params, caches, page_table, tok,
+                                pos, keys, temps, active)
+                if K:
+                    caches, tok, pos, keys, step_ok, lp = out
+                    return (caches, tok, pos, keys), (tok, step_ok, lp)
+                caches, tok, pos, keys, step_ok = out
                 return (caches, tok, pos, keys), (tok, step_ok)
 
+            if K:
+                (caches, tok, pos, keys), (toks, oks, lps) = jax.lax.scan(
+                    body, (caches, tok, pos, keys), None,
+                    length=self.decode_chunk)
+                return caches, tok, pos, keys, toks, oks, lps
             (caches, tok, pos, keys), (toks, oks) = jax.lax.scan(
                 body, (caches, tok, pos, keys), None,
                 length=self.decode_chunk)
@@ -971,8 +1028,11 @@ class DecodeEngine:
             pos = pos.at[slot].set(t0)
             keys = keys.at[slot].set(kdec)
             temps = temps.at[slot].set(temp)
-            return new_caches, tok, pos, keys, temps, tok0, \
-                jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
+            ok0 = jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
+            if K:
+                return new_caches, tok, pos, keys, temps, tok0, ok0, \
+                    lp_math(logits, tok0)
+            return new_caches, tok, pos, keys, temps, tok0, ok0
 
         def prefill_chunk_fn(params, caches, page_row, ids, off, woff,
                              t0, slot, wpids, tok, pos, keys, temps, kp,
@@ -1044,6 +1104,9 @@ class DecodeEngine:
             # cache it just wrote, and must fail HERE, typed
             ok = jnp.all(jnp.isfinite(logits.astype(jnp.float32))) \
                 & jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+            if K:
+                return new_caches, tok, pos, keys, temps, tok0, ok, \
+                    lp_math(logits, tok0)
             return new_caches, tok, pos, keys, temps, tok0, ok
 
         # jit OUTSIDE the shard_map (donation must alias the sharded
@@ -1288,6 +1351,46 @@ class DecodeEngine:
         observability.attach_trace(err, trace)
         self.recorder.record(trace, decision, kind="generate", **attrs)
 
+    # graftlint: hot-loop
+    def _emit_token(self, req: _GenRequest, lp=None,
+                    lp_idx: int = 0) -> None:
+        """Per-emitted-token bookkeeping, called right after a token is
+        appended to `req.tokens`: record the request's logprob entry
+        (when it asked for K > 0; `lp` is the device-fetched
+        (chosen, top_values, top_ids) batch, `lp_idx` this token's row),
+        observe TTFT on a fresh request's first token, and publish into
+        the request's stream sink (`streaming.TokenStream.publish` —
+        O(1), never blocks on a consumer). A raising sink is a consumer
+        bug: it is disarmed loudly so it can never poison the scheduler
+        loop — the unary result still delivers."""
+        if lp is not None and req.logprobs:
+            kk = req.logprobs
+            chosen, top_v, top_i = lp
+            req.logprob_values.append({
+                "token": int(req.tokens[-1]),
+                "logprob": float(chosen[lp_idx]),
+                "top_tokens": [int(t) for t in top_i[lp_idx][:kk]],
+                "top_logprobs": [float(v) for v in top_v[lp_idx][:kk]],
+            })
+        if len(req.tokens) == 1 and req.resumed_at == 0 \
+                and not req.preempted:
+            self._ttft_hist.observe(
+                1e3 * (time.monotonic() - req.enqueued_at),
+                trace=req.trace)
+        sink = req.sink
+        if sink is not None:
+            entry = req.logprob_values[-1] \
+                if req.logprobs and req.logprob_values else None
+            try:
+                sink(len(req.tokens), req.tokens[-1], entry)
+            # graftlint: disable=typed-error  scheduler protection: a
+            # broken stream sink must cost the CONSUMER its stream, not
+            # the engine its loop — logged + disarmed, decode continues
+            except Exception:
+                logger.exception(
+                    "decode engine: stream sink failed; detaching it")
+                req.sink = None
+
     def flight_record(self) -> dict:
         """Dump the flight recorder (request timelines + scheduler
         events) — shared with the owning `ModelServer` when there is
@@ -1305,7 +1408,9 @@ class DecodeEngine:
                temperature: float = 0.0, seed: int = 0,
                timeout: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority: str = "interactive") -> _GenRequest:
+               priority: str = "interactive",
+               logprobs: int = 0,
+               on_token: Optional[Callable] = None) -> _GenRequest:
         """Admit one generation request (non-blocking). Typed give-ups:
         `ServerOverloadedError` (queue full), `OutOfPagesError` (the
         paged KV pool cannot reserve this request's pages right now),
@@ -1317,11 +1422,24 @@ class DecodeEngine:
         `"batch"` — the batch lane fills otherwise-idle slots and
         yields them (preemption, `qos={...}`) under interactive
         pressure. Returns the request handle; `request.result()` blocks
-        for the tokens."""
+        for the tokens. `logprobs=K` (K > 0; requires an engine built
+        with `logprobs >= K`) asks for per-token logprob entries
+        alongside the tokens; `on_token(cursor, token, logprob)` is the
+        streaming emission hook — called from the scheduler thread per
+        emitted token, it must be O(1) and non-blocking
+        (`serving.streaming.TokenStream.publish` is the intended
+        sink)."""
         if priority not in ("interactive", "batch"):
             raise ValueError(
                 f"priority must be 'interactive' or 'batch', got "
                 f"{priority!r}")
+        if logprobs < 0:
+            raise ValueError("logprobs must be >= 0")
+        if logprobs > self._logprobs_k:
+            raise ValueError(
+                f"logprobs={logprobs} exceeds the engine's configured "
+                f"logprobs={self._logprobs_k} — build the engine with "
+                "logprobs=K to enable per-token logprob returns")
         prompt = np.asarray(prompt_ids)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -1374,6 +1492,8 @@ class DecodeEngine:
                           tenant=tenant, priority=priority)
         req.n_pages = need
         req.trace = trace
+        req.logprobs = int(logprobs)
+        req.sink = on_token
         # a prefill-role engine never decodes: the finished prefill is
         # exported under a lease and the caller redirected
         req.handoff = self._role == "prefill"
@@ -1692,13 +1812,16 @@ class DecodeEngine:
         return True
 
     def resume_submit(self, payload: dict,
-                      timeout: Optional[float] = None) -> _GenRequest:
+                      timeout: Optional[float] = None, *,
+                      on_token: Optional[Callable] = None) -> _GenRequest:
         """Admit a fetched handoff payload: validate it against this
         engine's weights/geometry (typed `KVTransferError` on ANY
         mismatch or corruption — nothing is touched), then enqueue a
         request whose shipped pages re-bind at admission (warm) or that
         re-prefills from the prompt (cold). The deadline is the
-        SMALLER of the sender's remaining budget and `timeout`."""
+        SMALLER of the sender's remaining budget and `timeout`.
+        `on_token` re-attaches a stream sink so a mid-stream migration
+        keeps publishing under the sender's cursor."""
         from deeplearning4j_tpu.serving.kv_transfer import (
             KVTransferError,
             verify_payload,
@@ -1728,6 +1851,14 @@ class DecodeEngine:
         req.tokens = [int(t) for t in payload["tokens"]]
         req.resumed_at = int(payload["resumed_at"])
         req.preempted = int(payload["preempted"])
+        req.logprobs = int(payload.get("logprobs", 0) or 0)
+        if req.logprobs > self._logprobs_k:
+            raise KVTransferError(
+                f"handoff requests logprobs={req.logprobs} but the "
+                f"receiving engine was built with logprobs="
+                f"{self._logprobs_k}")
+        req.logprob_values = list(payload.get("logprob_values") or [])
+        req.sink = on_token
         if payload["kind"] == "cold":
             # fold emitted tokens into the prompt exactly like a
             # preemption resume: re-prefill reproduces the sequence
@@ -1805,25 +1936,42 @@ class DecodeEngine:
         return req
 
     def resume_generate(self, payload: dict,
-                        timeout: Optional[float] = None) -> np.ndarray:
+                        timeout: Optional[float] = None, *,
+                        on_token: Optional[Callable] = None):
         """Blocking `resume_submit`: returns only the TAIL tokens this
         engine generates — the caller splices them after the redirect's
-        already-emitted `tokens`."""
-        req = self.resume_submit(payload, timeout=timeout)
+        already-emitted `tokens`. When the handoff carries logprobs, a
+        dict `{"tokens", "logprobs"}` holding only the tail's share."""
+        req = self.resume_submit(payload, timeout=timeout,
+                                 on_token=on_token)
         already = len(req.tokens)
+        already_lp = len(req.logprob_values)
         out = req.result()
+        if req.logprobs:
+            return {"tokens": out[already:],
+                    "logprobs": list(req.logprob_values[already_lp:])}
         return out[already:]
 
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 priority: str = "interactive") -> np.ndarray:
+                 priority: str = "interactive",
+                 logprobs: int = 0,
+                 on_token: Optional[Callable] = None):
         """Blocking convenience: submit + wait. Returns the generated
-        tokens (1-D int32; shorter than `n_tokens` only on EOS)."""
-        return self.submit(prompt_ids, n_tokens, temperature=temperature,
-                           seed=seed, timeout=timeout, tenant=tenant,
-                           priority=priority).result()
+        tokens (1-D int32; shorter than `n_tokens` only on EOS) — or,
+        with `logprobs=K > 0`, a dict `{"tokens", "logprobs"}` where
+        `logprobs` carries one per-step entry (chosen-token logprob +
+        top-K) per generated token."""
+        req = self.submit(prompt_ids, n_tokens, temperature=temperature,
+                          seed=seed, timeout=timeout, tenant=tenant,
+                          priority=priority, logprobs=logprobs,
+                          on_token=on_token)
+        out = req.result()
+        if logprobs:
+            return {"tokens": out, "logprobs": list(req.logprob_values)}
+        return out
 
     def pending(self) -> int:
         """Queued + in-slot generation requests — the engine's share of
@@ -2424,16 +2572,22 @@ class DecodeEngine:
         self._hook("pre_prefill", info)
 
         def run():
-            (self._caches, self._tok, self._pos, self._keys, self._temps,
-             tok0, ok) = self._prefill(
-                self._dparams, self._caches, jnp.asarray(ids),
-                jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
-                wpids, self._tok, self._pos, self._keys, self._temps,
-                kp, kdec, jnp.asarray(req.temperature, jnp.float32))
-            return jax.device_get((tok0, ok))
+            args = (self._dparams, self._caches, jnp.asarray(ids),
+                    jnp.asarray(t0, jnp.int32),
+                    jnp.asarray(slot, jnp.int32),
+                    wpids, self._tok, self._pos, self._keys, self._temps,
+                    kp, kdec, jnp.asarray(req.temperature, jnp.float32))
+            if self._logprobs_k:
+                (self._caches, self._tok, self._pos, self._keys,
+                 self._temps, tok0, ok, lp0) = self._prefill(*args)
+            else:
+                (self._caches, self._tok, self._pos, self._keys,
+                 self._temps, tok0, ok) = self._prefill(*args)
+                lp0 = None
+            return jax.device_get((tok0, ok, lp0))
 
         tp0 = time.monotonic()
-        first, ok = _dispatched(run, span=self._tp_span)
+        first, ok, lp0 = _dispatched(run, span=self._tp_span)
         tp1 = time.monotonic()
         # host clock around the dispatch+materialization — already
         # synced, so the span costs no extra device round-trip
@@ -2459,6 +2613,7 @@ class DecodeEngine:
         if self._spec is not None:
             self._spec.seed_slot(slot, req.seed)
         req.tokens.append(first)
+        self._emit_token(req, lp0)
         # >= len comparison, not n_tokens == 1: a preempted request
         # re-prefills with its emitted tokens folded into the prompt,
         # so this "first" token may already be its last
@@ -2526,20 +2681,27 @@ class DecodeEngine:
         self._hook("pre_prefill", info)
 
         def run():
-            (self._caches, self._tok, self._pos, self._keys, self._temps,
-             tok0, ok) = self._prefill_chunk_fn(
-                self._dparams, self._caches, self._page_table[slot],
-                jnp.asarray(ids), jnp.asarray(off, jnp.int32),
-                jnp.asarray(woff, jnp.int32), jnp.asarray(t0, jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(np.asarray(pids, np.int32)),
-                self._tok, self._pos, self._keys, self._temps, kp, kdec,
-                jnp.asarray(req.temperature, jnp.float32))
-            return jax.device_get((tok0, ok))
+            args = (self._dparams, self._caches, self._page_table[slot],
+                    jnp.asarray(ids), jnp.asarray(off, jnp.int32),
+                    jnp.asarray(woff, jnp.int32),
+                    jnp.asarray(t0, jnp.int32),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(np.asarray(pids, np.int32)),
+                    self._tok, self._pos, self._keys, self._temps, kp,
+                    kdec, jnp.asarray(req.temperature, jnp.float32))
+            if self._logprobs_k:
+                (self._caches, self._tok, self._pos, self._keys,
+                 self._temps, tok0, ok, lp0) = self._prefill_chunk_fn(
+                    *args)
+            else:
+                (self._caches, self._tok, self._pos, self._keys,
+                 self._temps, tok0, ok) = self._prefill_chunk_fn(*args)
+                lp0 = None
+            return jax.device_get((tok0, ok, lp0))
 
         tp0 = time.monotonic()
         try:
-            first, ok = _dispatched(run, span=self._tp_span)
+            first, ok, lp0 = _dispatched(run, span=self._tp_span)
             tp1 = time.monotonic()
             req.trace.add_timed("prefill-chunk", tp0, tp1,
                                 chunk_off=off, width=W, final=final)
@@ -2573,6 +2735,7 @@ class DecodeEngine:
             self._spec.seed_slot(slot, req.seed)
         first = int(first[0])
         req.tokens.append(first)
+        self._emit_token(req, lp0)
         # >= len, not n_tokens == 1: a resumed (preempted) request may
         # complete on its re-prefill token
         if len(req.tokens) >= req.n_tokens or first == self.eos_token:
@@ -2698,7 +2861,8 @@ class DecodeEngine:
             tokens=req.tokens, blocks=blocks, pages_shipped=used,
             pos=pos, tok=int(tok_), key=np.asarray(key_, np.uint32),
             temp=float(temp_), tenant=req.tenant, priority=req.priority,
-            preempted=req.preempted,
+            preempted=req.preempted, logprobs=req.logprobs,
+            logprob_values=list(req.logprob_values),
             deadline_remaining=None if req.deadline is None
             else max(0.0, req.deadline - time.monotonic()))
         nbytes = kv_transfer.payload_nbytes(payload)
@@ -2746,7 +2910,8 @@ class DecodeEngine:
             seed=req.seed, resumed_at=req.resumed_at,
             tokens=req.tokens, blocks=[], pages_shipped=0,
             tenant=req.tenant, priority=req.priority,
-            preempted=req.preempted,
+            preempted=req.preempted, logprobs=req.logprobs,
+            logprob_values=list(req.logprob_values),
             deadline_remaining=None if req.deadline is None
             else max(0.0, req.deadline - time.monotonic()))
         with self._cond:
@@ -3000,11 +3165,13 @@ class DecodeEngine:
 
     # graftlint: hot-loop
     def _retire_or_poison(self, s: int, req: _GenRequest, toks, oks,
-                          n_steps: int) -> None:
+                          n_steps: int, lps=None) -> None:
         """Consume one slot's emitted tokens from a decode/verify
         dispatch: append until done (count or EOS — overshoot dropped
         with the slot) or until a poisoned step fails the request typed
-        while healthy neighbors keep decoding."""
+        while healthy neighbors keep decoding. `lps` is the slot's
+        per-step (chosen, top_values, top_ids) logprob batch when the
+        engine computes logprobs."""
         done = False
         poisoned = False
         for t in range(n_steps):
@@ -3013,6 +3180,7 @@ class DecodeEngine:
                 break
             tok = int(toks[t])
             req.tokens.append(tok)
+            self._emit_token(req, lps, t)
             with self._cond:
                 self.tokens_generated += 1
             if len(req.tokens) >= req.n_tokens \
@@ -3145,24 +3313,43 @@ class DecodeEngine:
 
             def run():
                 if chunked:
+                    if self._logprobs_k:
+                        (self._caches, self._tok, self._pos, self._keys,
+                         toks_d, oks_d, lps_d) = self._decode_chunked(
+                            self._dparams, self._caches,
+                            self._page_table, self._tok, self._pos,
+                            self._keys, self._temps,
+                            jnp.asarray(self._active))
+                    else:
+                        (self._caches, self._tok, self._pos, self._keys,
+                         toks_d, oks_d) = self._decode_chunked(
+                            self._dparams, self._caches,
+                            self._page_table, self._tok, self._pos,
+                            self._keys, self._temps,
+                            jnp.asarray(self._active))
+                        lps_d = None
+                    # (chunk, S) tokens + per-step flags, ONE host sync
+                    return jax.device_get((toks_d, oks_d, lps_d))
+                if self._logprobs_k:
                     (self._caches, self._tok, self._pos, self._keys,
-                     toks_d, oks_d) = self._decode_chunked(
+                     ok_d, lp_d) = self._decode_step(
                         self._dparams, self._caches, self._page_table,
                         self._tok, self._pos, self._keys, self._temps,
                         jnp.asarray(self._active))
-                    # (chunk, S) tokens + per-step flags, ONE host sync
-                    return jax.device_get((toks_d, oks_d))
-                (self._caches, self._tok, self._pos, self._keys,
-                 ok_d) = self._decode_step(
-                    self._dparams, self._caches, self._page_table,
-                    self._tok, self._pos, self._keys, self._temps,
-                    jnp.asarray(self._active))
+                else:
+                    (self._caches, self._tok, self._pos, self._keys,
+                     ok_d) = self._decode_step(
+                        self._dparams, self._caches, self._page_table,
+                        self._tok, self._pos, self._keys, self._temps,
+                        jnp.asarray(self._active))
+                    lp_d = None
                 # THE per-iteration host sync — the price of
                 # iteration-level scheduling; chunking amortizes it
-                t, o = jax.device_get((self._tok, ok_d))
-                return t[None], o[None]
+                t, o, lp = jax.device_get((self._tok, ok_d, lp_d))
+                return t[None], o[None], (None if lp is None else
+                                          tuple(a[None] for a in lp))
 
-            toks, oks = _dispatched(run, span=self._tp_span)
+            toks, oks, lps = _dispatched(run, span=self._tp_span)
             self._hook("post_decode", info)
         # graftlint: disable=typed-error  converts to a typed failure:
         # _decode_failure wraps the cause in InferenceFailedError for the
@@ -3185,7 +3372,10 @@ class DecodeEngine:
             # unless it already completed via EOS at an earlier step of
             # the chunk — and healthy neighbors keep decoding (their
             # pages are untouched)
-            self._retire_or_poison(s, req, toks[:, s], oks[:, s], n_steps)
+            lp_s = None if lps is None else \
+                (lps[0][:, s], lps[1][:, s], lps[2][:, s])
+            self._retire_or_poison(s, req, toks[:, s], oks[:, s],
+                                   n_steps, lps=lp_s)
 
     # graftlint: hot-loop
     def _maybe_swap(self) -> None:

@@ -59,6 +59,9 @@ logger = logging.getLogger("deeplearning4j_tpu")
 DEDUPED_RPCS = frozenset({
     "fit", "create_model", "load_model", "reload_model", "rolling_reload",
     "resume_generate",
+    # streaming: a reconnect must re-attach to the live ring (or claim
+    # the parked outcome), never start a second decode of the sequence
+    "generate_stream",
     # remote-replica entry-point extras (install-like)
     "serve_net", "restore_snapshot",
 })
@@ -72,6 +75,9 @@ SIDE_EFFECT_FREE_RPCS = frozenset({
     "server_stats", "pool_stats", "autoscaler_stats", "metrics",
     "flight_record", "set_tenant_quota", "migrate_slots",
     "fetch_handoff", "commit_handoff", "abort_handoff",
+    # streaming: re-attach-by-id + cursor dedup in the ring — a replayed
+    # resume can only re-deliver frames the client already trimmed
+    "resume_stream",
     # remote-replica entry-point extras (reads)
     "health", "snapshot_model", "replica_metrics",
 })
@@ -80,7 +86,8 @@ SIDE_EFFECT_FREE_RPCS = frozenset({
 # data-path requests a gateway crash must not lose, plus fit (whose
 # durable complete record is what makes a post-restart retry return the
 # original outcome instead of training twice).
-JOURNALED_RPCS = frozenset({"generate", "predict", "fit"})
+JOURNALED_RPCS = frozenset({"generate", "generate_stream", "predict",
+                            "fit"})
 
 
 # ---------------------------------------------------------------------------

@@ -137,7 +137,9 @@ def build_payload(*, handoff_id: str, kind: str, weight_version: str,
                   tenant: Optional[str] = None, priority: str = "normal",
                   preempted: int = 0,
                   deadline_remaining: Optional[float] = None,
-                  source: Optional[str] = None) -> dict:
+                  source: Optional[str] = None,
+                  logprobs: int = 0,
+                  logprob_values: Optional[List[dict]] = None) -> dict:
     """Assemble one handoff payload (checksums computed here). All
     leaves are plain scalars / lists / numpy arrays, so the gateway's
     recursive codec ships it without a custom frame type."""
@@ -169,6 +171,10 @@ def build_payload(*, handoff_id: str, kind: str, weight_version: str,
         "blocks": blocks,
         "sums": [_block_sums(b) for b in blocks],
         "source": source,
+        # streaming/logprobs state rides the handoff so the peer keeps
+        # emitting per-step entries under the same cursor
+        "logprobs": int(logprobs),
+        "logprob_values": list(logprob_values or []),
     }
 
 

@@ -866,11 +866,17 @@ class ModelServer:
                 self._engine = DecodeEngine(self._net, **cfg)
             return self._engine
 
+    # streaming sinks (`on_token=`) reach the engine in-process here;
+    # remote adapters that cannot ship a callable override this False
+    supports_stream_sink = True
+
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 priority: str = "interactive") -> np.ndarray:
+                 priority: str = "interactive",
+                 logprobs: int = 0,
+                 on_token: Optional[Callable] = None):
         """Serve one generation request through the continuous-batching
         decode engine (`serving.decode_engine.DecodeEngine`): admitted
         into a decode slot as soon as one frees, decoded alongside every
@@ -880,13 +886,17 @@ class ModelServer:
         `predict`'s. `tenant`/`priority` feed the engine's QoS admission
         path (per-tenant token-rate quotas; `"interactive"` preempts
         the `"batch"` lane under pressure). Returns the generated token
-        ids (1-D int32)."""
+        ids (1-D int32) — or, with `logprobs=K > 0`, a dict
+        `{"tokens", "logprobs"}` carrying per-step top-K entries.
+        `on_token(cursor, token, logprob_entry)` streams each emitted
+        token into a `serving.streaming.TokenStream` ring."""
         engine = self._ensure_engine()
         timeout = self.default_timeout if timeout is None else timeout
         return engine.generate(prompt_ids, n_tokens,
                                temperature=temperature, seed=seed,
                                timeout=timeout, tenant=tenant,
-                               priority=priority)
+                               priority=priority, logprobs=logprobs,
+                               on_token=on_token)
 
     def set_tenant_quota(self, tenant: str, rate: Optional[float] = None,
                          burst: Optional[float] = None,
@@ -914,14 +924,17 @@ class ModelServer:
         return self._ensure_engine().migrate_slots(wait=wait)
 
     def resume_generate(self, payload: dict,
-                        timeout: Optional[float] = None) -> np.ndarray:
+                        timeout: Optional[float] = None, *,
+                        on_token: Optional[Callable] = None):
         """Admit a fetched KV handoff payload and return the TAIL
         tokens this server generates (typed `KVTransferError` when the
         payload fails validation against this server's weights or
-        geometry)."""
+        geometry). `on_token` re-attaches a stream sink so a mid-stream
+        migration keeps publishing under the sender's cursor."""
         timeout = self.default_timeout if timeout is None else timeout
         return self._ensure_engine().resume_generate(payload,
-                                                     timeout=timeout)
+                                                     timeout=timeout,
+                                                     on_token=on_token)
 
     def fetch_handoff(self, handoff_id: str) -> dict:
         return self._ensure_engine().fetch_handoff(handoff_id)

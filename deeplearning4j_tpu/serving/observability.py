@@ -876,3 +876,14 @@ EXACTLY_ONCE_STATS_KEYS = frozenset({
 POOL_REPLICA_STATS_KEYS = frozenset({
     "state", "consecutive_failures", "evictions", "stale",
 }) | MODEL_SERVER_STATS_KEYS
+
+# `StreamRegistry.stats()` (`serving.streaming`) — registered under the
+# serving tier's metrics as component "streaming" by the first streamed
+# request, so the gateway `metrics` exposition carries the resumable-
+# streaming counters (`stream_resumes`, backpressure sheds, the cursor
+# dedup totals) the chaos drills and bench assert on.
+STREAMING_STATS_KEYS = frozenset({
+    "streams_active", "streams_opened", "streams_finished",
+    "stream_resumes", "stream_backpressure_sheds",
+    "duplicate_tokens_dropped", "ring_capacity", "ttl_s",
+})

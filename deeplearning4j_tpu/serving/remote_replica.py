@@ -392,11 +392,17 @@ class RemoteReplica:
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 priority: str = "interactive") -> np.ndarray:
-        return np.asarray(self._data_call(
+                 priority: str = "interactive",
+                 logprobs: int = 0):
+        # logprobs rides the wire as a plain kwarg (omitted when 0 so
+        # older gateways keep accepting the call); the dict reply passes
+        # through un-coerced
+        kw = {"logprobs": int(logprobs)} if logprobs else {}
+        out = self._data_call(
             "generate", timeout, prompt_ids=np.asarray(prompt_ids),
             n_tokens=int(n_tokens), temperature=float(temperature),
-            seed=int(seed), tenant=tenant, priority=priority))
+            seed=int(seed), tenant=tenant, priority=priority, **kw)
+        return out if isinstance(out, dict) else np.asarray(out)
 
     def set_tenant_quota(self, tenant: str, rate=None, burst=None,
                          max_pages=None, weight=None) -> None:
@@ -422,14 +428,16 @@ class RemoteReplica:
                                what="migrate_slots")
 
     def resume_generate(self, payload: dict,
-                        timeout: Optional[float] = None) -> np.ndarray:
+                        timeout: Optional[float] = None):
         """Admit a fetched handoff payload on the remote engine; returns
-        the TAIL tokens it generates. NOT retried on ambiguous wire
-        failures — a re-send could double-admit the same handoff (the
-        caller's fallback is re-prefill, which is always safe)."""
-        return np.asarray(self._data_call(
+        the TAIL tokens it generates (a `{"tokens", "logprobs"}` dict
+        when the handoff carries logprobs). NOT retried on ambiguous
+        wire failures — a re-send could double-admit the same handoff
+        (the caller's fallback is re-prefill, which is always safe)."""
+        out = self._data_call(
             "resume_generate", timeout, payload=payload,
-            _idempotent=False))
+            _idempotent=False)
+        return out if isinstance(out, dict) else np.asarray(out)
 
     def fetch_handoff(self, handoff_id: str,
                       timeout: Optional[float] = None) -> dict:
@@ -1022,6 +1030,10 @@ class RemoteReplicaPool(ReplicaPool):
     marked stale, not fatal), `rolling_reload` re-points the
     supervisor at the deployed weights so respawns serve the new
     version, and `shutdown` stops the supervisor."""
+
+    # a streaming sink is a callable — it cannot cross the process
+    # boundary, so remote pools serve streams unary-fallback style
+    supports_stream_sink = False
 
     def __init__(self, replicas: Sequence, *, supervisor=None,
                  template_net=None, scratch_dir=None, **pool_kwargs):

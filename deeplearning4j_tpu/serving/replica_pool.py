@@ -87,7 +87,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -755,19 +755,35 @@ class ReplicaPool:
                 cond.wait(max(1e-4, min(waits)))
 
     # -- generation --------------------------------------------------------
+    # in-process replicas accept a streaming sink; the remote pool
+    # overrides this False (a callable cannot cross the wire)
+    supports_stream_sink = True
+
     def generate(self, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 priority: str = "interactive") -> np.ndarray:
+                 priority: str = "interactive",
+                 logprobs: int = 0,
+                 on_token: Optional[Callable] = None):
         """Route one generation request (each replica's lazily-built
         `DecodeEngine`) with least-loaded routing + failover. Safe to
         re-route: generation is seeded, so a failover re-send
-        recomputes identical tokens. Shares the pool admission budget
-        with `predict`. `tenant`/`priority` ride through to the chosen
-        replica's engine-level QoS doors."""
+        recomputes identical tokens — and with an `on_token` stream
+        sink attached, a re-send republishes cursors 1..k into the same
+        ring where they deduplicate, so the consumer-visible stream
+        stays append-only across failovers. Shares the pool admission
+        budget with `predict`. `tenant`/`priority` ride through to the
+        chosen replica's engine-level QoS doors."""
         timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
+        # passed conditionally so adapters with the narrower pre-logprobs
+        # signature keep working untouched
+        genkw = {}
+        if logprobs:
+            genkw["logprobs"] = int(logprobs)
+        if on_token is not None:
+            genkw["on_token"] = on_token
         trace = observability.maybe_trace()
         try:
             self._admit()
@@ -782,7 +798,7 @@ class ReplicaPool:
                         rep, lambda: rep.server.generate(
                             prompt_ids, n_tokens, temperature=temperature,
                             seed=seed, timeout=rem, tenant=tenant,
-                            priority=priority),
+                            priority=priority, **genkw),
                         track_latency=False)
                 except SlotMigratedError as e:
                     # a redirect, not a failure: the replica exported
@@ -792,7 +808,8 @@ class ReplicaPool:
                     # InferenceFailedError so THIS loop re-routes the
                     # full seeded generate (identical output, just the
                     # re-prefill cost)
-                    return self._resume_migrated(rep, e, deadline, tried)
+                    return self._resume_migrated(rep, e, deadline, tried,
+                                                 on_token=on_token)
 
             with observability.use_trace(trace):
                 out = self._route_with_failover(attempt)
@@ -819,7 +836,7 @@ class ReplicaPool:
 
     def _resume_migrated(self, victim: _Replica,
                          redirect: SlotMigratedError, deadline,
-                         tried: set) -> np.ndarray:
+                         tried: set, on_token: Optional[Callable] = None):
         """Finish one migrated generation: fetch the leased KV payload
         from the exporting `victim`, resume it on a healthy peer, splice
         the victim's already-emitted tokens in front of the peer's tail.
@@ -846,9 +863,10 @@ class ReplicaPool:
             if trace:
                 trace.event("migrate-resume", replica=peer.id,
                             handoff_id=handoff_id)
+            reskw = {} if on_token is None else {"on_token": on_token}
             tail = self._call_replica(
                 peer, lambda: peer.server.resume_generate(
-                    payload, timeout=rem),
+                    payload, timeout=rem, **reskw),
                 track_latency=False)
         except DeadlineExceededError:
             raise  # terminal: a peer cannot give the time back
@@ -890,9 +908,17 @@ class ReplicaPool:
             trace.event("migrate-done", handoff_id=handoff_id,
                         spliced=len(redirect.tokens))
         self.recorder.event("migrate-done", handoff_id=handoff_id)
-        return np.concatenate([
-            np.asarray(redirect.tokens, np.int32),
-            np.asarray(tail, np.int32).reshape(-1)])
+        head = np.asarray(redirect.tokens, np.int32)
+        if isinstance(tail, dict):
+            # logprobs rode the handoff: splice the victim's per-step
+            # entries in front of the peer's tail, mirroring the tokens
+            head_lps = list(payload.get("logprob_values")
+                            or [])[:len(redirect.tokens)]
+            return {"tokens": np.concatenate(
+                        [head, np.asarray(tail["tokens"],
+                                          np.int32).reshape(-1)]),
+                    "logprobs": head_lps + list(tail["logprobs"])}
+        return np.concatenate([head, np.asarray(tail, np.int32).reshape(-1)])
 
     # -- health probing ----------------------------------------------------
     def _probe_input(self) -> Optional[np.ndarray]:

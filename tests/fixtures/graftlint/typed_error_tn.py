@@ -106,3 +106,30 @@ def claim_result(client, request_id):
         return client.claim(request_id)
     except TimeoutError as e:  # mapped to the typed reclaim verdict
         raise ServingError(f"claim of {request_id} timed out: {e}")
+
+
+class StreamBackpressureError(ServingError):
+    pass
+
+
+def push_stream_frame(conn, frame):
+    try:
+        conn.sendall(frame)
+    except BrokenPipeError as e:  # mapped to a typed resumable error:
+        raise ServingError(f"stream consumer gone: {e}")  # legal
+
+
+def resume_stream(registry, request_id, cursor):
+    try:
+        return registry.attach(request_id)
+    except ConnectionResetError as e:  # logged absorb: the caller falls
+        logger.warning("resume of %s failed: %s",  # back to the
+                       request_id, e)              # parked-outcome claim
+        return False
+
+
+def shed_slow_consumer(stream, consumer):
+    try:
+        consumer.drain(stream)
+    except TimeoutError as e:  # typed shed: the consumer gets a
+        raise StreamBackpressureError(f"reader stalled: {e}")  # verdict

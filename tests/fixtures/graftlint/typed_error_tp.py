@@ -94,3 +94,24 @@ def claim_result(client, request_id):
         return client.claim(request_id)
     except TimeoutError:  # TP: bare return — the caller cannot tell
         return            # "lost" from "still decoding"
+
+
+def push_stream_frame(conn, frame):
+    try:
+        conn.sendall(frame)
+    except BrokenPipeError:  # TP: the consumer silently loses this
+        return               # frame's cursor — the stream desyncs
+
+
+def resume_stream(registry, request_id, cursor):
+    try:
+        return registry.attach(request_id)
+    except ConnectionResetError:  # TP: a vanished resume strands the
+        pass                      # reconnecting consumer mid-sequence
+
+
+def shed_slow_consumer(stream, consumer):
+    try:
+        consumer.drain(stream)
+    except socket.timeout:  # TP: the stall verdict is dropped — the
+        return None         # consumer never learns it was shed
