@@ -2169,6 +2169,34 @@ def bench_serve_disagg():
         for mix in mixes}
     bench_serve_disagg.kv_transfer_mbytes_per_sec = wire
 
+    # -- delta vs full handoff bytes: shared-prefix traffic ---------------
+    # with the cluster prefix cache on (ISSUE 20), the decode replica's
+    # resident chain lets each prefill→decode handoff ship only the
+    # pages the receiver doesn't already hold; the MB gap is pure wire
+    # saved on every handoff of chat-shaped (shared-prefix) traffic
+    shared = rng.integers(0, shp["vocab"], 32).astype(np.int32)
+    sp_prompts = [
+        np.concatenate([shared,
+                        rng.integers(0, shp["vocab"], 8).astype(np.int32)])
+        for _ in range(8)]
+    sp_kw = _gen_kw(prefix_cache=True, prefill_chunk=16)
+    sp_kw["prompt_buckets"] = (16,)  # force the chunked-prefill path
+    handoff_mb = {}
+    for label, cluster in (("full", False), ("delta", True)):
+        co = DisaggCoordinator(net, server_kwargs={"generation": sp_kw},
+                               prefix_cluster=cluster)
+        try:
+            for p in sp_prompts:
+                co.generate(p, 4, timeout=120.0)
+            st = co.stats()
+            handoff_mb[label] = round(st["kv_transfer_mbytes"], 4)
+            if cluster:
+                bench_serve_disagg.delta_pages_skipped = \
+                    st["delta_pages_skipped"]
+        finally:
+            co.shutdown(drain_timeout=30.0)
+    bench_serve_disagg.delta_vs_full_handoff_mbytes = handoff_mb
+
     # -- migration resume: warm re-bind vs cold re-prefill ----------------
     mix = shp["prefill_heavy"]  # long prompt: the cold path repays it
 
@@ -2211,6 +2239,236 @@ def bench_serve_disagg():
 
     return ("serve_disagg_decode_heavy_tokens_per_sec",
             disagg["decode_heavy"], None, 1.0)
+
+
+_SERVE_PREFIX_CLUSTER_SHAPE = {
+    "vocab": 256, "d_model": 128, "n_heads": 4, "n_layers": 2,
+    # chat-shaped: one long shared system prefix, short unique tails —
+    # the workload where cross-host sharing pays (each replica would
+    # otherwise cold-prefill the SAME prefix once per pool member)
+    "shared_prefix_len": 192, "tail_len": 8,
+    "n_requests": 18, "n_tokens": 6, "mean_interarrival": 0.02,
+    # margin sized to the fetch path: once the spread leg has warmed
+    # every replica over the wire (~a few hundred ms each), packing
+    # the mix onto the holder forfeits the pool's decode parallelism —
+    # a small margin takes the free affinity wins but spills the bulk
+    # to the (now warm) peers; the margin is the policy knob that
+    # encodes exactly that trade
+    "affinity_margin": 2,
+    # slots sized so affinity CONCENTRATION doesn't forfeit decode
+    # batching: the warm holder can decode the whole absorbed burst in
+    # one iteration-level batch instead of queueing it 4 at a time
+    "n_replicas": 3, "n_slots": 8, "page_size": 16, "prefill_chunk": 32,
+}
+
+
+def bench_serve_prefix_cluster():
+    """Cluster-global prefix cache priced end to end (ISSUE 20), three
+    numbers in one config:
+
+    **cluster_vs_local_prefix_goodput** — the same shared-system-prompt
+    Poisson mix driven through a 3-replica `ReplicaPool` twice: once
+    with only per-replica prefix caches (every replica cold-prefills
+    the shared prefix on first contact) and once with a bound
+    `PrefixDirectory` (the first replica prefills it, the others fetch
+    the KV pages over the handoff wire and suffix-prefill only the
+    tail). The ratio prices what cross-host sharing buys; > 1.0 means
+    the wire fetch beats re-prefilling.
+
+    **first_token_ms (local vs cluster)** — p50/p99 time-to-first-token
+    from an `on_token` sink, same arrival schedule both runs. The p99
+    is where the win concentrates: the unlucky requests that land on a
+    cold replica.
+
+    **fetch_vs_reprefill_ms** — the crossover economics, measured
+    directly: average wall time of one directory fetch of the shared
+    chain (wire + checksum + bind) vs one cold chunked prefill of the
+    same prefix. Fetch cost is mostly fixed per transfer while prefill
+    grows with depth, so `crossover_pages` ~ fetch_ms / per-page
+    prefill ms is the depth above which fetching always wins."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import gpt_configuration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import PrefixDirectory, ReplicaPool
+
+    shp = _SERVE_PREFIX_CLUSTER_SHAPE
+    rng = np.random.default_rng(0)
+    t0 = shp["shared_prefix_len"] + shp["tail_len"]
+    max_len = t0 + shp["n_tokens"] + 8
+    net = MultiLayerNetwork(
+        gpt_configuration(vocab_size=shp["vocab"], d_model=shp["d_model"],
+                          n_heads=shp["n_heads"], n_layers=shp["n_layers"],
+                          max_length=max_len),
+        compute_dtype=jnp.bfloat16)
+    net.init()
+    gen = dict(n_slots=shp["n_slots"], max_len=max_len,
+               page_size=shp["page_size"],
+               prompt_buckets=(shp["page_size"],),  # chunked prefill path
+               prefill_chunk=shp["prefill_chunk"],
+               prefix_cache=True, max_queue=256)
+    shared = rng.integers(0, shp["vocab"],
+                          shp["shared_prefix_len"]).astype(np.int32)
+    n = shp["n_requests"]
+    prompts = [np.concatenate(
+        [shared,
+         rng.integers(0, shp["vocab"], shp["tail_len"]).astype(np.int32)])
+        for _ in range(n)]
+    arrivals = np.cumsum(rng.exponential(shp["mean_interarrival"], n))
+    # warmup prefix DISTINCT from the measured one: compiles every
+    # replica's engine without pre-warming the shared chain anywhere
+    warm_prompts = [np.concatenate(
+        [rng.integers(0, shp["vocab"],
+                      shp["shared_prefix_len"]).astype(np.int32),
+         rng.integers(0, shp["vocab"], shp["tail_len"]).astype(np.int32)])
+        for _ in range(shp["n_replicas"])]
+
+    def _drive(pool):
+        ttfts = [None] * n
+        toks = [0] * n
+        errs = []
+        # the LB-spread leg: after a scale-out (or failover) the
+        # front-end fans traffic across ALL replicas, so pin one
+        # shared-prefix request on each cold replica at window open.
+        # This leg is the work the A/B prices — local arm: one full
+        # cold prefill per replica; cluster arm: one page fetch per
+        # replica — and pinning it makes the ratio measure the
+        # feature, not least-loaded routing luck (which otherwise
+        # concentrates the whole mix on the warm holder in BOTH arms)
+        spread = shp["n_replicas"] - 1
+
+        def one(i, t_req):
+            def sink(cursor, token, logprob):
+                if ttfts[i] is None:
+                    ttfts[i] = time.perf_counter() - t_req
+            try:
+                if i < spread:
+                    toks[i] = len(pool._replicas[1 + i].server.generate(
+                        prompts[i], shp["n_tokens"], timeout=300.0,
+                        on_token=sink))
+                else:
+                    toks[i] = len(pool.generate(
+                        prompts[i], shp["n_tokens"], timeout=300.0,
+                        on_token=sink))
+            except Exception as e:  # noqa: BLE001 — bench counts, not hides
+                errs.append(e)
+
+        t_start = time.monotonic()
+        threads = []
+        for i in range(n):
+            if i >= spread:  # spread requests burst at window open
+                lag = t_start + arrivals[i] - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+            th = threading.Thread(target=one, args=(i, time.perf_counter()))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        dt = time.monotonic() - t_start
+        if errs:
+            raise errs[0]
+        return sum(toks) / dt, [t for t in ttfts if t is not None]
+
+    def _pct(xs):
+        return {"p50": round(1e3 * float(np.percentile(xs, 50)), 2),
+                "p99": round(1e3 * float(np.percentile(xs, 99)), 2)}
+
+    goodput, ttft = {}, {}
+    fetch_stats = {}
+    for label, cluster in (("local", False), ("cluster", True)):
+        # probe_interval stretched past the measured window: the auto-
+        # armed generation canary is a LONG prompt here, and a 1 s probe
+        # cadence would re-prefill (or re-fetch) it on every replica
+        # mid-drive, drowning the A/B in canary traffic
+        pool = ReplicaPool.from_net(
+            net, shp["n_replicas"], server_kwargs={"generation": gen},
+            prefix_directory=PrefixDirectory() if cluster else None,
+            affinity_margin=shp["affinity_margin"], probe_interval=120.0)
+        try:
+            # concurrent warmups: one per replica, distinct prefix
+            ws = [threading.Thread(
+                target=lambda p=p: pool.generate(p, 2, timeout=300.0))
+                for p in warm_prompts]
+            for w in ws:
+                w.start()
+            for w in ws:
+                w.join()
+            # warm the measured prefix on ONE pinned replica (both
+            # runs): the scenario the cluster tier targets is a pool
+            # where the prefix is already hot SOMEWHERE — after a
+            # failover, autoscale-up, or simply yesterday's traffic —
+            # and the question is what the OTHER replicas pay: a cold
+            # prefill each (local) vs an affinity route or page fetch
+            # (cluster). Pinned so the drive's spread leg knows which
+            # replicas start cold
+            pool._replicas[0].server.generate(prompts[0], 2,
+                                              timeout=300.0)
+            goodput[label], ts = _drive(pool)
+            ttft[label] = _pct(ts)
+            if cluster:
+                st = pool.stats()
+                fetch_stats = {
+                    "affinity_routes": st["affinity_routes"],
+                    "directory_entries": st["directory_entries"]}
+                agg = {}
+                for rep in pool._replicas:
+                    g = rep.server.stats().get("generation", {})
+                    for k in ("prefix_fetches", "prefix_fetch_ms",
+                              "prefix_fetch_bytes",
+                              "prefix_fetch_fallbacks"):
+                        agg[k] = agg.get(k, 0) + g.get(k, 0)
+                fetch_stats.update(agg)
+        finally:
+            pool.shutdown(drain_timeout=30.0)
+    bench_serve_prefix_cluster.cluster_vs_local_prefix_goodput = round(
+        goodput["cluster"] / max(1e-9, goodput["local"]), 3)
+    bench_serve_prefix_cluster.first_token_ms = ttft
+    bench_serve_prefix_cluster.cluster_fetch = fetch_stats
+
+    # -- fetch-vs-reprefill crossover: one fetch vs one cold prefill ------
+    from deeplearning4j_tpu.serving.decode_engine import DecodeEngine
+
+    d = PrefixDirectory()
+    prefix_pages = (t0 - 1) // shp["page_size"]
+    holder = DecodeEngine(net, **gen)
+    peers = {"holder": holder}
+    holder.bind_prefix_directory(d, "holder", peers.get)
+    fetch_ms, reprefill_ms = [], []
+    try:
+        holder.generate(prompts[0], 2)  # warm + publish the chain
+        for trial in range(2):
+            cold = DecodeEngine(net, **gen)
+            try:
+                tw = time.perf_counter()
+                cold.generate(prompts[0], 2)  # cold chunked prefill
+                reprefill_ms.append(1e3 * (time.perf_counter() - tw))
+            finally:
+                cold.shutdown(drain_timeout=30.0)
+            fetcher = DecodeEngine(net, **gen)
+            fetcher.bind_prefix_directory(d, f"f{trial}", peers.get)
+            try:
+                tw = time.perf_counter()
+                fetcher.generate(prompts[0], 2)  # fetch + suffix prefill
+                fetch_ms.append(1e3 * (time.perf_counter() - tw))
+                assert fetcher.stats()["prefix_fetches"] == 1
+            finally:
+                fetcher.shutdown(drain_timeout=30.0)
+    finally:
+        holder.shutdown(drain_timeout=30.0)
+    f_ms = float(np.median(fetch_ms))
+    r_ms = float(np.median(reprefill_ms))
+    per_page = max(1e-9, r_ms / max(1, prefix_pages))
+    bench_serve_prefix_cluster.fetch_vs_reprefill_ms = {
+        "fetch_ms": round(f_ms, 2), "reprefill_ms": round(r_ms, 2),
+        "prefix_pages": prefix_pages,
+        "crossover_pages": round(f_ms / per_page, 1)}
+
+    return ("serve_prefix_cluster_tokens_per_sec", goodput["cluster"],
+            None, 1.0)
+
 
 def bench_serve_exactly_once():
     """Exactly-once serving priced end to end (ISSUE 18), three numbers
@@ -2605,6 +2863,7 @@ _CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "serve_generate": bench_serve_generate,
             "serve_qos": bench_serve_qos,
             "serve_disagg": bench_serve_disagg,
+            "serve_prefix_cluster": bench_serve_prefix_cluster,
             "serve_exactly_once": bench_serve_exactly_once,
             "serve_stream": bench_serve_stream}
 
@@ -2769,7 +3028,15 @@ def main() -> None:
                 ("gateway_crash_recovery_ms",
                  "gateway_crash_recovery_ms"),
                 ("crash_requests_lost", "crash_requests_lost"),
-                ("crash_double_executions", "crash_double_executions")):
+                ("crash_double_executions", "crash_double_executions"),
+                ("delta_vs_full_handoff_mbytes",
+                 "delta_vs_full_handoff_mbytes"),
+                ("delta_pages_skipped", "delta_pages_skipped"),
+                ("cluster_vs_local_prefix_goodput",
+                 "cluster_vs_local_prefix_goodput"),
+                ("first_token_ms", "first_token_ms"),
+                ("cluster_fetch", "cluster_fetch"),
+                ("fetch_vs_reprefill_ms", "fetch_vs_reprefill_ms")):
             extra = getattr(_CONFIGS[name], attr, None)
             if extra is not None:
                 entries[name][key] = extra

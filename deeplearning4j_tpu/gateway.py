@@ -592,6 +592,54 @@ class EntryPoint:
         the TTL sweep. Idempotent; False when already gone."""
         return bool(self._server(name).abort_handoff(handoff_id))
 
+    # -- cluster prefix cache (serving.prefix_directory) -------------------
+    def export_prefix(self, name: str, prompt_ids, have_pages: int = 0,
+                      tenant: Optional[str] = None,
+                      frame_pages: Optional[int] = None,
+                      timeout: Optional[float] = None) -> dict:
+        """Lease model `name`'s resident KV pages for `prompt_ids`'
+        cached prefix chain beyond the `have_pages` the caller already
+        holds; returns the framed-transfer HEADER (drain the frames
+        with `fetch_handoff_frame`, then commit/abort the lease).
+        Typed refusal when the chain is no longer resident — the
+        fetcher falls back to cold prefill."""
+        return self._server(name).export_prefix(
+            prompt_ids, have_pages=int(have_pages), tenant=tenant,
+            frame_pages=frame_pages, timeout=timeout)
+
+    def fetch_handoff_header(self, name: str, handoff_id: str,
+                             skip_pages: int = 0,
+                             frame_pages: Optional[int] = None) -> dict:
+        """Blockless header of a leased handoff, advanced by
+        `skip_pages` receiver-resident pages and annotated with the
+        frame schedule (delta transfers; extends the lease TTL)."""
+        return self._server(name).fetch_handoff_header(
+            handoff_id, skip_pages=int(skip_pages),
+            frame_pages=frame_pages)
+
+    def fetch_handoff_frame(self, name: str, handoff_id: str, frame: int,
+                            skip_pages: int = 0,
+                            frame_pages: Optional[int] = None) -> dict:
+        """One bounded frame of a leased handoff's page slices
+        (stateless: pass back the header's skip/frame_pages pair)."""
+        return self._server(name).fetch_handoff_frame(
+            handoff_id, int(frame), skip_pages=int(skip_pages),
+            frame_pages=frame_pages)
+
+    def prefix_depth(self, name: str, prompt_ids,
+                     tenant: Optional[str] = None) -> int:
+        """How many leading pages of `prompt_ids`' prefix chain are
+        resident on model `name` — the receiver-side probe a delta
+        transfer uses to decide how many pages to skip."""
+        return int(self._server(name).prefix_depth(prompt_ids,
+                                                   tenant=tenant))
+
+    def prefix_chains(self, name: str) -> dict:
+        """Snapshot of model `name`'s resident prefix chain keys
+        (``{"weight_version", "page_size", "chains"}``) — the pull-mode
+        publication feed for a cluster prefix directory."""
+        return self._server(name).prefix_chains()
+
     def autoscaler_stats(self, name: str) -> dict:
         """The autoscaler's decision counters and live pressure signal
         for model `name` (requires serving={'replicas': N, 'autoscale':
@@ -1275,7 +1323,14 @@ class GatewayClient:
                              # resume_generate is NOT here: a re-send
                              # could double-admit the same handoff.
                              "fetch_handoff", "commit_handoff",
-                             "abort_handoff", "migrate_slots"})
+                             "abort_handoff", "migrate_slots",
+                             # cluster prefix cache: header/frame reads
+                             # and the depth/chains probes are pure
+                             # reads; export_prefix re-grants a fresh
+                             # lease (the orphan's TTL sweep unpins it)
+                             "fetch_handoff_header", "fetch_handoff_frame",
+                             "prefix_depth", "prefix_chains",
+                             "export_prefix"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 25333,
                  timeout: float = 60.0, retry_backoff: float = 0.05,

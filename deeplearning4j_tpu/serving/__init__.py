@@ -22,6 +22,7 @@ from deeplearning4j_tpu.serving.chaos import (
     LoadSpikeInjector,
     NetworkLatencyInjector,
     PartitionInjector,
+    PrefixFetchSaboteur,
     ReloadCorruptionInjector,
     ReplicaCrashInjector,
     ReplicaHangInjector,
@@ -54,7 +55,8 @@ from deeplearning4j_tpu.serving.observability import (
     tracing_enabled,
     use_trace,
 )
-from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache, chain_keys
+from deeplearning4j_tpu.serving.prefix_directory import PrefixDirectory
 from deeplearning4j_tpu.serving.quantize import (
     argmax_drift_rate,
     drift_report,
@@ -138,6 +140,8 @@ __all__ = [
     "OutOfPagesError",
     "PartitionInjector",
     "PrefixCache",
+    "PrefixDirectory",
+    "PrefixFetchSaboteur",
     "RemoteReplica",
     "RemoteReplicaPool",
     "ReplicaEntryPoint",
@@ -169,6 +173,7 @@ __all__ = [
     "spawn_replica_pool",
     "argmax_drift_rate",
     "attach_trace",
+    "chain_keys",
     "current_trace",
     "drift_report",
     "maybe_trace",

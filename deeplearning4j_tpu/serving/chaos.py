@@ -330,6 +330,65 @@ class KVTransferCorruptionInjector:
         self.corruptions += 1
 
 
+class PrefixFetchSaboteur:
+    """Wire hazards on the cluster-prefix fetch path: wraps a holder
+    server (the `peers` resolver hands the fetching engine THIS object
+    instead) and damages the framed transfer in one of three ways a
+    real deployment produces. The contract under every mode is the
+    same — the fetching engine degrades to cold prefill with ZERO
+    failed requests, counting `prefix_fetch_fallbacks`, never binding
+    damaged pages.
+
+    - ``mode="corrupt-frame"`` — one frame's page bytes flipped in
+      transit: the reassembled payload's per-page checksum refuses it.
+    - ``mode="die-after-header"`` — the holder vanishes between the
+      header and the first frame (kill -9 mid-fetch): the fetcher sees
+      a raw `ConnectionError`.
+    - ``mode="stale-version"`` — the header claims a `weight_version`
+      the holder no longer serves (a rolling reload landed between
+      directory lookup and fetch): `verify_payload` refuses the skew.
+
+    `sabotages` counts injected damages."""
+
+    def __init__(self, holder, mode: str = "corrupt-frame"):
+        if mode not in ("corrupt-frame", "die-after-header",
+                        "stale-version"):
+            raise ValueError(f"unknown sabotage mode {mode!r}")
+        self._holder = holder
+        self.mode = mode
+        self.sabotages = 0
+
+    def __getattr__(self, name):
+        return getattr(self._holder, name)
+
+    def export_prefix(self, *a, **kw) -> dict:
+        header = self._holder.export_prefix(*a, **kw)
+        if self.mode == "stale-version":
+            header = dict(header)
+            header["weight_version"] = "stale-" * 2 + "deadbeef"
+            self.sabotages += 1
+        return header
+
+    def fetch_handoff_frame(self, handoff_id: str, frame: int,
+                            **kw) -> dict:
+        if self.mode == "die-after-header":
+            self.sabotages += 1
+            raise ConnectionResetError(
+                "injected: holder died between header and frame 0")
+        out = self._holder.fetch_handoff_frame(handoff_id, frame, **kw)
+        if self.mode == "corrupt-frame" and frame == 0:
+            out = dict(out)
+            out["blocks"] = [dict(b) for b in out["blocks"]]
+            blk = out["blocks"][0]
+            name = next(iter(blk))
+            arr = np.array(blk[name])
+            flat = arr.view(np.uint8).reshape(-1)
+            flat[: min(16, flat.size)] ^= 0xFF
+            blk[name] = arr
+            self.sabotages += 1
+        return out
+
+
 # -- network chaos (cross-process replica pool) ---------------------------
 
 class ChaosProxy:

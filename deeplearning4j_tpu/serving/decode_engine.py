@@ -142,8 +142,8 @@ class _GenRequest:
                  "slot", "completed_at", "n_pages", "pages",
                  "prefill_pos", "hit_len", "n_shared", "nodes", "digests",
                  "trace", "tenant", "priority", "resumed_at",
-                 "preempted", "handoff", "import_state", "sink",
-                 "logprobs", "logprob_values")
+                 "preempted", "handoff", "import_state", "prefix_import",
+                 "sink", "logprobs", "logprob_values")
 
     def __init__(self, prompt: np.ndarray, n_tokens: int,
                  temperature: float, seed: int,
@@ -188,6 +188,11 @@ class _GenRequest:
         # payload whose shipped pages re-bind at admission
         self.handoff = False
         self.import_state: Optional[dict] = None
+        # cluster prefix fetch: a verified "prefix" payload fetched from
+        # a directory holder on the SUBMIT thread; the scheduler binds
+        # its pages at admission (or silently drops it and prefills
+        # cold — the fetch is an optimization, never a dependency)
+        self.prefix_import: Optional[dict] = None
         # streaming emission hook: `sink(cursor, token, logprob)` fires
         # per emitted token (serving.streaming.TokenStream.publish);
         # None = unary request, zero per-token overhead
@@ -664,6 +669,38 @@ class DecodeEngine:
         self.prefix_hits = 0  # guarded by: _cond
         self.prefix_misses = 0  # guarded by: _cond
         self.prefix_hit_tokens = 0  # guarded by: _cond
+        # cluster prefix tier (`bind_prefix_directory`): the directory,
+        # this engine's holder id, and the peers resolver are all None
+        # until bound — every cluster path is a no-op without them
+        self._prefix_directory = None
+        self._holder_id: Optional[str] = None
+        self._prefix_peers = None  # holder_id -> peer handle, or None
+        self._prefix_fetch_frame_pages = 8
+        self._prefix_fetch_timeout = 5.0
+        self._prefix_min_fetch_pages = 1
+        # scheduler-serviced prefix export queue: RPC threads park an
+        # export request here and wait; the scheduler thread — the only
+        # thread allowed to touch device pools under donation — fills
+        # it between dispatches
+        # guarded by: _cond
+        self._prefix_exports: collections.deque = collections.deque()
+        # single-flight: chains with a cluster fetch in progress, so a
+        # burst of same-prefix admits pulls the pages over the wire
+        # ONCE — the rest wait and re-check the local cache
+        # guarded by: _cond
+        self._prefix_fetching: set = set()
+        # fetched bundles still riding the queue toward the cache
+        # (bound at ADMISSION, not at submit): waiters share the
+        # winner's bundle instead of re-fetching; TTL'd by the fetch
+        # timeout, duplicate binds dropped by admission's stale-check
+        # guarded by: _cond
+        self._prefix_fetch_ready: dict = {}
+        self.prefix_fetches = 0  # guarded by: _cond
+        self.prefix_fetch_fallbacks = 0  # guarded by: _cond
+        self.prefix_fetch_bytes = 0  # guarded by: _cond
+        self.prefix_fetch_seconds = 0.0  # guarded by: _cond
+        self.prefix_exports_served = 0  # guarded by: _cond
+        self.cluster_prefix_hit_tokens = 0  # guarded by: _cond
         self.spec_steps = 0  # guarded by: _cond
         self.spec_proposed = 0  # guarded by: _cond
         self.spec_accepted = 0  # guarded by: _cond
@@ -1172,6 +1209,12 @@ class DecodeEngine:
             self._prefix_cache = PrefixCache(page, **pc_kw) \
                 .bind_guard(self._cond).bind_recorder(self.recorder) \
                 .bind_version(self._weight_version)
+            if self._prefix_directory is not None:
+                # a rebuild keeps the engine's cluster membership: the
+                # fresh cache re-publishes under the NEW weight version
+                # as it warms (old entries age out / were dropped)
+                self._prefix_cache.bind_directory(
+                    self._prefix_directory, self._holder_id)
         self._spec = None
         if self._speculative_cfg is not None:
             from deeplearning4j_tpu.serving.speculative import (
@@ -1318,7 +1361,8 @@ class DecodeEngine:
         if self._prefix_cache is None or req.pages is None:
             return
         req.nodes, freed = self._prefix_cache.insert(req.prompt, req.pages,
-                                                     req.nodes or [])
+                                                     req.nodes or [],
+                                                     tenant=req.tenant)
         req.n_shared = len(req.nodes)
         # pages evicted to respect the cache's max_pages cap go straight
         # back to the pool — a cap-driven eviction must never leak
@@ -1497,6 +1541,14 @@ class DecodeEngine:
         # a prefill-role engine never decodes: the finished prefill is
         # exported under a lease and the caller redirected
         req.handoff = self._role == "prefill"
+        if self._prefix_directory is not None \
+                and self._prefix_peers is not None:
+            # cluster prefix fetch rides the SUBMIT thread — wire I/O
+            # must never stall the scheduler. `_admit` binds the
+            # verified payload under the lock, or drops it and
+            # prefills cold (a fetch wasted on a door refusal below is
+            # accepted; it touched no engine state)
+            req.prefix_import = self._fetch_prefix_for(req.prompt, tenant)
         with self._cond:
             if self._closed:
                 err = ServerClosedError("decode engine is shut down")
@@ -1704,6 +1756,7 @@ class DecodeEngine:
         for req in self._queue:
             if req.expired(now):
                 self._pages_demand_queued -= req.n_pages
+                self._free_request_pages_locked(req)  # delta-pin release
                 self.shed_deadline += 1
                 req.trace.add_timed("queue-wait", req.enqueued_at, now,
                                     decision="expired")
@@ -1740,6 +1793,288 @@ class DecodeEngine:
         self.recorder.event("quota-set", tenant=tenant, rate=rate,
                             burst=burst, max_pages=max_pages,
                             weight=weight)
+
+    # -- cluster-global prefix cache (prefix_directory) --------------------
+    def bind_prefix_directory(self, directory, holder_id: str,
+                              peers: Optional[Callable] = None, *,
+                              fetch_timeout: float = 5.0,
+                              frame_pages: int = 8,
+                              min_fetch_pages: int = 1) -> "DecodeEngine":
+        """Join a cluster-wide `PrefixDirectory`: this engine's prefix
+        cache publishes its promoted chains under `holder_id` (and
+        retracts on evict/clear), and — when `peers` is given — a
+        local prefix miss with a directory hit FETCHES the chain's
+        pages from the holder instead of re-prefilling them.
+        `peers(holder_id)` resolves a holder name to an engine-shaped
+        handle exposing `export_prefix` / `fetch_handoff_frame` /
+        `commit_handoff` / `abort_handoff` (an in-process engine, a
+        `ModelServer`, or a `RemoteReplica` — the deployment seam);
+        returning None skips the fetch. Every wire failure degrades to
+        cold prefill — the fetch path is never load-bearing.
+        Chainable."""
+        with self._cond:
+            self._prefix_directory = directory
+            self._holder_id = str(holder_id)
+            self._prefix_peers = peers
+            self._prefix_fetch_timeout = float(fetch_timeout)
+            self._prefix_fetch_frame_pages = max(1, int(frame_pages))
+            self._prefix_min_fetch_pages = max(1, int(min_fetch_pages))
+            if self._prefix_cache is not None:
+                self._prefix_cache.bind_directory(directory,
+                                                  self._holder_id)
+                chains = self._prefix_cache.chains()
+                if chains:  # late bind: announce what is already warm
+                    directory.publish(self._weight_version,
+                                      self.page_size, chains,
+                                      self._holder_id)
+        return self
+
+    def prefix_depth(self, prompt_ids,
+                     tenant: Optional[str] = None) -> int:
+        """Fully-covered resident prefix pages this engine holds for
+        `prompt_ids` at its CURRENT weight version — the receiver-side
+        answer a delta sender asks before choosing `skip_pages`."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        with self._cond:
+            if self._prefix_cache is None:
+                return 0
+            return len(self._prefix_cache.match(prompt, tenant=tenant))
+
+    def prefix_chains(self) -> dict:
+        """Snapshot of every resident chain key at the current weight
+        version — the pull-mode directory refresh for remote replicas
+        whose promotions cannot ride a shared in-process directory."""
+        with self._cond:
+            chains = [] if self._prefix_cache is None \
+                else self._prefix_cache.chains()
+            return {"weight_version": self._weight_version,
+                    "page_size": self.page_size, "chains": chains}
+
+    def export_prefix(self, prompt_ids, have_pages: int = 0,
+                      tenant: Optional[str] = None,
+                      frame_pages: Optional[int] = None,
+                      timeout: Optional[float] = None) -> dict:
+        """Holder-side cluster-prefix export: serialize this engine's
+        resident chain pages for `prompt_ids` (beyond the receiver's
+        `have_pages`) into a leased `kind="prefix"` handoff and return
+        its framed HEADER — the receiver then drains
+        `fetch_handoff_frame` and commits. The device read runs on the
+        scheduler thread via a parked work item (only that thread may
+        touch the pools between dispatches under donation); this
+        caller blocks up to `timeout`. Typed `KVTransferError` when
+        the chain is no longer resident deeper than `have_pages` (the
+        directory entry was stale)."""
+        from deeplearning4j_tpu.serving.kv_transfer import KVTransferError
+
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        item = {"prompt": prompt, "have": max(0, int(have_pages)),
+                "tenant": tenant, "frame_pages": frame_pages,
+                "done": threading.Event(), "result": None, "error": None}
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("decode engine is shut down")
+            self._prefix_exports.append(item)
+            self._cond.notify_all()
+        wait = self._prefix_fetch_timeout if timeout is None \
+            else float(timeout)
+        if not item["done"].wait(wait):
+            raise KVTransferError(
+                f"prefix export timed out after {wait:.1f}s (scheduler "
+                "busy); fall back to cold prefill")
+        if item["error"] is not None:
+            raise item["error"]
+        return item["result"]
+
+    def fetch_handoff_header(self, handoff_id: str, skip_pages: int = 0,
+                             frame_pages: Optional[int] = None) -> dict:
+        """Framed-transfer entry for ANY leased handoff (migration or
+        prefix export): the blockless header, advanced by `skip_pages`
+        pages the receiver proved it holds (delta transfer). Extends
+        the lease TTL. Typed `KVTransferError` on an unknown lease."""
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        with self._cond:
+            lease = self._leases.touch(handoff_id)
+            if lease is None:
+                raise kv_transfer.KVTransferError(
+                    f"unknown or expired handoff lease {handoff_id!r}; "
+                    "fall back to re-prefill from the prompt")
+            return kv_transfer.payload_header(
+                lease.payload, skip_pages=skip_pages,
+                frame_pages=frame_pages)
+
+    def fetch_handoff_frame(self, handoff_id: str, frame: int,
+                            skip_pages: int = 0,
+                            frame_pages: Optional[int] = None) -> dict:
+        """One bounded frame of a leased handoff (host-side numpy
+        slicing — safe on any RPC thread). Extends the lease TTL, so a
+        receiver mid-drain cannot lose the race against the orphan
+        sweep."""
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        with self._cond:
+            lease = self._leases.touch(handoff_id)
+            if lease is None:
+                raise kv_transfer.KVTransferError(
+                    f"unknown or expired handoff lease {handoff_id!r}; "
+                    "fall back to re-prefill from the prompt")
+            return kv_transfer.slice_frame(
+                lease.payload, frame, skip_pages=skip_pages,
+                frame_pages=frame_pages)
+
+    def _fetch_prefix_for(self, prompt: np.ndarray,
+                          tenant: Optional[str]) -> Optional[dict]:
+        """Submit-thread cluster-prefix fetch: on a local miss with a
+        directory hit, pull the chain's missing pages from a holder
+        and return a verified ``{"payload", "have", "depth",
+        "source"}`` bundle for `_admit` to bind. Returns None — never
+        raises — on any miss, skew, or wire failure: the request then
+        cold-prefills exactly as it would today (the never-slower
+        contract)."""
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        t0 = int(prompt.shape[0])
+        page = self.page_size
+        cap = max(0, (t0 - 1) // page)
+        if cap < self._prefix_min_fetch_pages:
+            return None
+        with self._cond:
+            if self._prefix_cache is None:
+                return None
+            local = len(self._prefix_cache.match(prompt, tenant=tenant))
+        if cap - local < self._prefix_min_fetch_pages:
+            return None
+        hit = self._prefix_directory.best_holder(
+            prompt, tenant, exclude=(self._holder_id,))
+        if hit is None or hit["weight_version"] != self._weight_version \
+                or int(hit["page_size"]) != page:
+            return None
+        depth = min(int(hit["depth"]), cap)
+        if depth - local < self._prefix_min_fetch_pages:
+            return None
+        holder = hit["holders"][0]
+        # single-flight per chain: a same-prefix burst on a cold engine
+        # must not become a thundering herd of identical wire fetches —
+        # one admit pulls the pages, the rest wait (bounded by the
+        # fetch timeout) and re-check the cache the winner filled
+        sf_key = (hit["weight_version"], tenant,
+                  prompt[:depth * page].tobytes())
+        sf_deadline = time.monotonic() + self._prefix_fetch_timeout
+        with self._cond:
+            while sf_key in self._prefix_fetching:
+                remaining = sf_deadline - time.monotonic()
+                if remaining <= 0:
+                    return None  # waited out: cold prefill, never slower
+                self._cond.wait(remaining)
+            if self._prefix_cache is None:
+                return None
+            local = len(self._prefix_cache.match(prompt, tenant=tenant))
+            if depth - local < self._prefix_min_fetch_pages:
+                return None  # the winner's bind covers us: warm admit
+            ready = self._prefix_fetch_ready.get(sf_key)
+            if ready is not None:
+                bundle, expires = ready
+                if time.monotonic() < expires:
+                    # the winner's bundle is still queued toward the
+                    # cache (binding happens at admission, on the
+                    # scheduler thread) — share it instead of pulling
+                    # the same pages over the wire again; every bind
+                    # after the first is dropped by the stale-check
+                    self.recorder.event("prefix-fetch",
+                                        decision="reused", depth=depth)
+                    return dict(bundle)
+                del self._prefix_fetch_ready[sf_key]
+            self._prefix_fetching.add(sf_key)
+        bundle = None
+        try:
+            bundle = self._fetch_prefix_chain(
+                prompt, tenant, hit, depth, local, holder)
+            return bundle
+        finally:
+            with self._cond:
+                if bundle is not None:
+                    now = time.monotonic()
+                    stale = [k for k, (_, exp)
+                             in self._prefix_fetch_ready.items()
+                             if exp <= now]
+                    for k in stale:
+                        del self._prefix_fetch_ready[k]
+                    self._prefix_fetch_ready[sf_key] = (
+                        bundle, now + self._prefix_fetch_timeout)
+                self._prefix_fetching.discard(sf_key)
+                self._cond.notify_all()
+
+    def _fetch_prefix_chain(self, prompt, tenant, hit, depth, local,
+                            holder) -> Optional[dict]:
+        """The wire leg of `_fetch_prefix_for`, run under the chain's
+        single-flight slot: export → frames → verify → commit."""
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        page = self.page_size
+        start = time.monotonic()
+        header = None
+        try:
+            peer = self._prefix_peers(holder)
+            if peer is None:
+                return None
+            header = peer.export_prefix(
+                [int(x) for x in prompt[:depth * page]],
+                have_pages=local, tenant=tenant,
+                frame_pages=self._prefix_fetch_frame_pages,
+                timeout=self._prefix_fetch_timeout)
+            frames = [peer.fetch_handoff_frame(
+                          header["handoff_id"], i, skip_pages=0,
+                          frame_pages=header["frame_pages"])
+                      for i in range(int(header["n_frames"]))]
+            payload = kv_transfer.assemble_payload(header, frames)
+            payload = kv_transfer.verify_payload(
+                payload, weight_version=self._weight_version,
+                kv_quant=self._kv_quant, page_size=page,
+                n_blocks=len(self._caches), max_len=self.max_len,
+                kinds=("prefix",))
+        # graftlint: disable=typed-error  never-slower contract: ANY
+        # fetch-path failure (wire fault, refusal, corruption) degrades
+        # to cold prefill; the typed cause is recorded, not raised
+        except BaseException as e:
+            if header is not None:
+                try:
+                    peer.abort_handoff(header["handoff_id"])
+                # graftlint: disable=typed-error  best-effort abort of
+                # a lease on a peer that may already be dead — its TTL
+                # sweep unpins regardless
+                except BaseException:
+                    pass
+            with self._cond:
+                self.prefix_fetch_fallbacks += 1
+            self.recorder.event(
+                "prefix-fetch", decision="fallback", holder=holder,
+                depth=depth, have=local, error=type(e).__name__)
+            logger.warning(
+                "cluster prefix fetch from %s failed (%s: %s); cold "
+                "prefill", holder, type(e).__name__, e)
+            return None
+        try:
+            peer.commit_handoff(header["handoff_id"])
+        # graftlint: disable=typed-error  commit is an optimization
+        # (early unpin on the holder); its lease TTL unpins regardless
+        except BaseException:
+            logger.warning(
+                "prefix fetch commit_handoff(%s) failed; the holder's "
+                "lease sweep will unpin", header["handoff_id"])
+        dt = time.monotonic() - start
+        nbytes = kv_transfer.payload_nbytes(payload)
+        with self._cond:
+            self.prefix_fetches += 1
+            self.prefix_fetch_bytes += nbytes
+            self.prefix_fetch_seconds += dt
+        omitted = int(payload.get("pages_omitted", 0))
+        self.recorder.event(
+            "prefix-fetch", decision="fetched", holder=holder,
+            depth=depth, have=local,
+            pages=int(payload["pages_shipped"]), skipped=omitted,
+            bytes=nbytes, ms=round(1e3 * dt, 2))
+        return {"payload": payload, "have": omitted, "depth": depth,
+                "source": holder}
 
     # -- KV handoff public surface (kv_transfer) ---------------------------
     def migrate_slots(self, wait: Optional[float] = 5.0) -> int:
@@ -1859,6 +2194,7 @@ class DecodeEngine:
                 f"{self._logprobs_k}")
         req.logprob_values = list(payload.get("logprob_values") or [])
         req.sink = on_token
+        omitted = 0
         if payload["kind"] == "cold":
             # fold emitted tokens into the prompt exactly like a
             # preemption resume: re-prefill reproduces the sequence
@@ -1872,10 +2208,11 @@ class DecodeEngine:
                 t0, max(1, n_tokens - req.resumed_at))
         else:
             req.import_state = payload
+            omitted = int(payload.get("pages_omitted", 0))
             t0 = prompt.shape[0]
             span = t0 + max(1, n_tokens - req.resumed_at) - 1
             req.n_pages = max(-(-span // self.page_size),
-                              int(payload["pages_shipped"]))
+                              omitted + int(payload["pages_shipped"]))
         if req.n_pages > self.pool_pages:
             raise KVTransferError(
                 f"handoff needs {req.n_pages} KV pages but the "
@@ -1925,6 +2262,26 @@ class DecodeEngine:
                             pages_needed=req.n_pages)
                         raise err
                 tstate.submitted += 1
+            if omitted:
+                # delta handoff: the sender elided the first `omitted`
+                # chain pages because this engine's directory entry
+                # claimed them resident — pin them NOW (refcounted), so
+                # eviction cannot race the bind; refused typed when the
+                # chain is no longer deep enough (the sender's ladder
+                # re-sends without skip_pages)
+                have = [] if self._prefix_cache is None else \
+                    self._prefix_cache.match(prompt, tenant=req.tenant)
+                if len(have) < omitted:
+                    err = KVTransferError(
+                        f"delta handoff omits {omitted} prefix pages "
+                        f"but only {len(have)} are resident here; "
+                        "re-send without skip_pages")
+                    self._shed_obs(req.trace, err, tenant=req.tenant)
+                    raise err
+                have = have[:omitted]
+                self._prefix_cache.acquire(have)
+                req.nodes = have
+                req.n_shared = omitted
             self.submitted += 1
             self._pages_demand_queued += req.n_pages
             self._queue.append(req)
@@ -2060,6 +2417,21 @@ class DecodeEngine:
                "handoff_leases": leases,
                "handoffs_unfetched": unfetched,
                "kv_transfer_bytes": self.kv_transfer_bytes,
+               # cluster prefix plane: unconditional (all zero while no
+               # directory is bound) so the stats-schema contract and
+               # dashboards never branch on key presence
+               "prefix_fetches": self.prefix_fetches,
+               "prefix_fetch_fallbacks": self.prefix_fetch_fallbacks,
+               "prefix_fetch_bytes": self.prefix_fetch_bytes,
+               "prefix_fetch_ms": round(
+                   1e3 * self.prefix_fetch_seconds, 2),
+               "prefix_exports": self.prefix_exports_served,
+               "cluster_prefix_hit_tokens":
+                   self.cluster_prefix_hit_tokens,
+               "cluster_prefix_hit_tokens_pct": round(
+                   100.0 * self.cluster_prefix_hit_tokens
+                   / self.prompt_tokens, 1) if self.prompt_tokens
+                   else 0.0,
                "prompt_buckets": list(self.prompt_buckets)}
         if self._prefix_cache is not None:
             hit_pct = (100.0 * self.prefix_hit_tokens / self.prompt_tokens
@@ -2188,9 +2560,12 @@ class DecodeEngine:
                     while self._queue:
                         req = self._queue.popleft()
                         self._pages_demand_queued -= req.n_pages
+                        self._free_request_pages_locked(req)
                         self._finish_obs(req, ServerClosedError(
                             "engine shut down before this request "
                             "could be served"))
+                    self._drain_prefix_exports_locked(ServerClosedError(
+                        "decode engine is shut down"))
                     if not any(r is not None for r in self._slots):
                         self._abort_pending_swap_locked()
                         self._cond.notify_all()
@@ -2200,6 +2575,7 @@ class DecodeEngine:
                     self._admit()
                 self._expire_in_flight()
                 self._step_migrations()
+                self._serve_prefix_exports()
                 self._sweep_leases()
                 self._step_prefills()
                 self._step_active()
@@ -2234,13 +2610,17 @@ class DecodeEngine:
             return True  # reach _maybe_swap even with empty slots
         if self._migrate_all or self._leases.expired_pending():
             return True  # reach the migration pass / lease sweep
+        if self._prefix_exports:
+            return True  # a peer is waiting on a prefix export
         return bool(self._queue) and not self._draining
 
     def _fail_all_locked(self, err: BaseException) -> None:
         assert_owned(self._cond, "DecodeEngine._fail_all_locked")
+        self._drain_prefix_exports_locked(err)
         while self._queue:
             req = self._queue.popleft()
             self._pages_demand_queued -= req.n_pages
+            self._free_request_pages_locked(req)
             self._finish_obs(req, err)  # never acquired the breaker
         for s, req in enumerate(self._slots):
             if req is not None:
@@ -2398,6 +2778,8 @@ class DecodeEngine:
                 head_idx = self._select_head_locked()
                 head = self._queue[head_idx]
                 nodes: list = []
+                pim = None
+                pre_pinned = False
                 need = head.n_pages
                 if not free:
                     # every slot taken, an interactive head waiting: the
@@ -2407,15 +2789,42 @@ class DecodeEngine:
                     if preempt is None:
                         return
                 elif not head.expired():
-                    if self._prefix_cache is not None \
+                    if head.import_state is not None and head.nodes:
+                        # delta handoff: its prefix-chain pages were
+                        # pinned at resume_submit — they bind as shared
+                        # pages, only the shipped tail allocates fresh
+                        nodes = head.nodes
+                        pre_pinned = True
+                        need = head.n_pages - len(nodes)
+                    elif self._prefix_cache is not None \
                             and head.import_state is None:
                         # only the scheduler thread mutates the cache,
                         # so this lookup stays valid through the bind;
                         # a page-blocked head retries every iteration —
                         # its chunk digests are memoized on the request
-                        nodes = self._prefix_cache.lookup(head.prompt,
-                                                          head.digests)
-                        if nodes:
+                        nodes = self._prefix_cache.lookup(
+                            head.prompt, head.digests,
+                            tenant=head.tenant)
+                        pim = head.prefix_import
+                        if pim is not None:
+                            pay = pim["payload"]
+                            if pay["weight_version"] \
+                                    != self._weight_version \
+                                    or int(pay["page_size"]) \
+                                    != self.page_size \
+                                    or not (int(pim["have"])
+                                            <= len(nodes)
+                                            < int(pim["depth"])):
+                                # the fetched bundle went stale between
+                                # submit and admission (weight swap,
+                                # seed-chain eviction, or the local
+                                # cache caught up) — drop it; prefill
+                                # covers the request regardless
+                                head.prefix_import = pim = None
+                                self.recorder.event(
+                                    "prefix-fetch", decision="dropped",
+                                    have=len(nodes))
+                        if nodes or pim is not None:
                             # resumed (preempted) requests span only
                             # their REMAINING tokens past the extended
                             # prompt
@@ -2495,7 +2904,10 @@ class DecodeEngine:
             req.probe = probe
             slot = free[0]
             with self._cond:
-                if nodes:
+                if pre_pinned:
+                    # acquired at resume_submit — only account here
+                    req.n_shared = len(nodes)
+                elif nodes:
                     self._prefix_cache.acquire(nodes)
                     req.nodes = nodes
                     req.n_shared = len(nodes)
@@ -2523,6 +2935,12 @@ class DecodeEngine:
             row[:len(req.pages)] = req.pages
             self._page_table = self._page_table.at[slot].set(
                 jnp.asarray(row))
+            if req.prefix_import is not None:
+                # fetched cluster-prefix pages scatter into the freshly
+                # allocated tail pages and promote into the local cache
+                # as if prefilled here; ANY failure falls back to
+                # prefilling from the local hit (or cold)
+                self._bind_prefix_import(req)
             if req.import_state is not None:
                 # shipped KV re-binds directly into the slot: no
                 # prefill — the pages already hold the sender's state
@@ -2535,7 +2953,11 @@ class DecodeEngine:
                     self._import_failure(slot, req, e)
                 continue
             t0 = req.prompt.shape[0]
-            if req.hit_len or self._is_chunked(t0):
+            if req.hit_len or pim is not None or self._is_chunked(t0):
+                # `pim is not None` forces the chunk path even when the
+                # bind failed with no local hit: the hit-style page
+                # allocation cannot cover a one-shot prefill's padded
+                # bucket width
                 with self._cond:
                     # hit requests always ride the chunk path: suffix
                     # prefill starts at the first uncached page
@@ -2940,6 +3362,7 @@ class DecodeEngine:
             self._queue.clear()
             for r in queued:
                 self._pages_demand_queued -= r.n_pages
+                self._free_request_pages_locked(r)  # delta-pin release
             parked = []
             decoding = []
             for s, r in enumerate(self._slots):
@@ -2962,6 +3385,101 @@ class DecodeEngine:
             self._export_cold(r, reason="migrate")
         for s, r in decoding:
             self._export_slot(s, r, attached=True, reason="migrate")
+
+    def _drain_prefix_exports_locked(self, err: BaseException) -> None:
+        """Release every parked `export_prefix` waiter with `err` — a
+        scheduler exiting (shutdown/kill) must not leave RPC threads
+        blocked until their timeout."""
+        assert_owned(self._cond,
+                     "DecodeEngine._drain_prefix_exports_locked")
+        while self._prefix_exports:
+            item = self._prefix_exports.popleft()
+            item["error"] = err
+            item["done"].set()
+
+    def _serve_prefix_exports(self) -> None:
+        """Scheduler-thread service for parked `export_prefix` items:
+        only this thread may read the pools between dispatches (a
+        donated dispatch invalidates the old buffers), so the
+        device_get of the chain's pages happens here; the lease grant
+        pins the chain nodes for the drain, and the waiting RPC thread
+        gets the framed header."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.serving import kv_transfer
+
+        while True:
+            with self._cond:
+                if not self._prefix_exports:
+                    return
+                item = self._prefix_exports.popleft()
+                nodes = [] if self._prefix_cache is None else \
+                    self._prefix_cache.match(item["prompt"],
+                                             tenant=item["tenant"])
+                depth = len(nodes)
+                have = item["have"]
+                if depth <= have:
+                    item["error"] = kv_transfer.KVTransferError(
+                        f"prefix chain no longer resident here beyond "
+                        f"{have} pages (holds {depth}); the directory "
+                        "entry was stale — fall back to cold prefill")
+                    item["done"].set()
+                    continue
+                self._prefix_cache.acquire(nodes)
+                pages = [n.page_id for n in nodes]
+            try:
+                jidx = jnp.asarray(np.asarray(pages[have:], np.int32))
+                names = ("k", "v", "ks", "vs") if self._kv_quant \
+                    else ("k", "v")
+                blocks = []
+                for c in self._caches:
+                    blocks.append(
+                        {name: np.asarray(jax.device_get(arr[jidx]))
+                         for name, arr in zip(names, c)})
+                handoff_id = kv_transfer.LeaseTable.new_id()
+                payload = kv_transfer.build_payload(
+                    handoff_id=handoff_id, kind="prefix",
+                    weight_version=self._weight_version,
+                    kv_quant=self._kv_quant, page_size=self.page_size,
+                    n_blocks=len(self._caches),
+                    prompt=item["prompt"][:depth * self.page_size],
+                    n_tokens=0, temperature=0.0, seed=0, resumed_at=0,
+                    tokens=[], blocks=blocks,
+                    pages_shipped=depth - have, pages_omitted=have,
+                    tenant=item["tenant"], source=self._holder_id)
+                header = kv_transfer.payload_header(
+                    payload,
+                    frame_pages=item["frame_pages"]
+                    or self._prefix_fetch_frame_pages)
+            # graftlint: disable=typed-error  the export dies typed on
+            # the WAITER (a wire edge), never in the scheduler loop;
+            # the pins release like an aborted lease
+            except BaseException as e:
+                with self._cond:
+                    self._prefix_cache.release(nodes)
+                    self._cond.notify_all()
+                item["error"] = e if isinstance(e, ServingError) else \
+                    kv_transfer.KVTransferError(
+                        f"prefix export failed: {type(e).__name__}: {e}")
+                item["done"].set()
+                continue
+            nbytes = kv_transfer.payload_nbytes(payload)
+            with self._cond:
+                # n_shared == len(pages): lease resolution releases the
+                # pins and returns NOTHING to the free list — the cache
+                # owns these pages; the lease only pins them while the
+                # receiver drains frames
+                self._leases.grant(payload, pages=pages,
+                                   n_shared=len(pages), nodes=nodes)
+                self.prefix_exports_served += 1
+                self._cond.notify_all()
+            item["result"] = header
+            item["done"].set()
+            self.recorder.event(
+                "prefix-export", holder=self._holder_id,
+                handoff_id=handoff_id, pages=depth - have,
+                skipped=have, bytes=nbytes)
 
     def _sweep_leases(self) -> None:
         """Orphan reclamation: a receiver that died (or never
@@ -2990,6 +3508,85 @@ class DecodeEngine:
         lease.pages = None
 
     # graftlint: hot-loop
+    def _bind_prefix_import(self, req: _GenRequest) -> None:
+        """Bind a verified cluster-prefix fetch into this request's
+        pages: scatter the shipped chain pages into the pool (eager
+        `.at[].set`, like `_import_into`), insert the now-resident
+        chain into the local prefix cache (publishing to the directory
+        exactly as a locally promoted prefix would), and extend the
+        request's hit span so suffix prefill starts at the fetched
+        depth. A failed scatter drops the bundle and keeps the local
+        hit — the request still serves, just colder."""
+        import jax.numpy as jnp
+
+        pim, req.prefix_import = req.prefix_import, None
+        payload = pim["payload"]
+        page = self.page_size
+        have = req.n_shared          # local chain pages already bound
+        depth = int(pim["depth"])
+        omitted = int(payload.get("pages_omitted", 0))
+        shipped = int(payload["pages_shipped"])
+        off = have - omitted         # leading shipped pages held here
+        n_new = depth - have
+        if off < 0 or off + n_new > shipped or n_new <= 0:
+            self.recorder.event("prefix-fetch", decision="dropped",
+                                have=have, depth=depth, skipped=omitted)
+            return
+        try:
+            jidx = jnp.asarray(
+                np.asarray(req.pages[have:depth], np.int32))
+            names = ("k", "v", "ks", "vs") if self._kv_quant \
+                else ("k", "v")
+            new_caches = []
+            for blk, c in zip(payload["blocks"], self._caches):
+                new_c = []
+                for name, arr in zip(names, c):
+                    src = np.asarray(blk[name])[off:off + n_new]
+                    out = arr.at[jidx].set(jnp.asarray(src))
+                    if self._tp is not None:
+                        out = self._tp.shard_pool(out)
+                    new_c.append(out)
+                new_caches.append(tuple(new_c))
+            self._caches = new_caches
+        # graftlint: disable=typed-error  never-slower contract: a
+        # failed scatter falls back to prefilling from the local hit;
+        # the pools stay valid (eager updates are not donated
+        # dispatches)
+        except BaseException as e:
+            with self._cond:
+                self.prefix_fetch_fallbacks += 1
+            self.recorder.event("prefix-fetch", decision="bind-failed",
+                                error=type(e).__name__)
+            logger.warning("cluster prefix bind failed (%s: %s); "
+                           "prefilling from the local hit",
+                           type(e).__name__, e)
+            return
+        with self._cond:
+            pnodes, freed = self._prefix_cache.insert(
+                req.prompt[:depth * page], req.pages[:depth],
+                req.nodes or [], tenant=req.tenant)
+            self._free_pages.extend(freed)
+            gained = (len(pnodes) - have) * page
+            req.nodes = pnodes
+            req.n_shared = len(pnodes)
+            req.hit_len = len(pnodes) * page
+            if have == 0:
+                # the local lookup missed but the CLUSTER hit: fold
+                # the request back into the hit column
+                self.prefix_hits += 1
+                self.prefix_misses -= 1
+            self.prefix_hit_tokens += gained
+            self.cluster_prefix_hit_tokens += gained
+            self._cond.notify_all()
+        req.trace.event("prefix-fetch-bind",
+                        pages=len(pnodes) - have,
+                        hit_tokens=req.hit_len, source=pim["source"])
+        self.recorder.event("prefix-fetch", decision="bound",
+                            holder=pim["source"],
+                            pages=len(pnodes) - have,
+                            hit_tokens=req.hit_len)
+
+    # graftlint: hot-loop
     def _import_into(self, slot: int, req: _GenRequest) -> None:
         """Re-bind a validated warm handoff into a free slot: scatter
         the shipped pages into every block's pools (+ scale sidecars),
@@ -3002,7 +3599,12 @@ class DecodeEngine:
 
         payload = req.import_state
         shipped = int(payload["pages_shipped"])
-        jidx = jnp.asarray(np.asarray(req.pages[:shipped], np.int32))
+        omitted = int(payload.get("pages_omitted", 0))
+        # delta handoff: the first `omitted` pages are the locally
+        # resident prefix chain (pinned at resume_submit, already in
+        # req.pages as shared pages) — shipped pages land after them
+        jidx = jnp.asarray(np.asarray(
+            req.pages[omitted:omitted + shipped], np.int32))
         names = ("k", "v", "ks", "vs") if self._kv_quant else ("k", "v")
         new_caches = []
         for blk, c in zip(payload["blocks"], self._caches):
@@ -3079,6 +3681,7 @@ class DecodeEngine:
                 if req.expired(now):
                     expired_queued.append(req)
                     self._pages_demand_queued -= req.n_pages
+                    self._free_request_pages_locked(req)
                 else:
                     keep.append(req)
             self._queue = keep
@@ -3420,6 +4023,12 @@ class DecodeEngine:
                 reserved = 0
                 while self._queue:
                     r = self._queue.popleft()
+                    # delta-import pins reference the PRE-swap cache
+                    # object (replaced by the rebuild, its pages
+                    # reclaimed wholesale): null them, never release
+                    # against the fresh cache
+                    r.nodes = None
+                    r.n_shared = 0
                     if r.import_state is not None:
                         # queued warm handoff: its KV was computed under
                         # the PRE-swap weights — binding it now would

@@ -75,6 +75,11 @@ SIDE_EFFECT_FREE_RPCS = frozenset({
     "server_stats", "pool_stats", "autoscaler_stats", "metrics",
     "flight_record", "set_tenant_quota", "migrate_slots",
     "fetch_handoff", "commit_handoff", "abort_handoff",
+    # cluster prefix cache: reads (header/frame/depth/chains) plus
+    # export_prefix, whose re-execution grants a fresh lease the
+    # orphaned original's TTL sweep unpins
+    "fetch_handoff_header", "fetch_handoff_frame", "prefix_depth",
+    "prefix_chains", "export_prefix",
     # streaming: re-attach-by-id + cursor dedup in the ring — a replayed
     # resume can only re-deliver frames the client already trimmed
     "resume_stream",

@@ -139,14 +139,23 @@ def build_payload(*, handoff_id: str, kind: str, weight_version: str,
                   deadline_remaining: Optional[float] = None,
                   source: Optional[str] = None,
                   logprobs: int = 0,
-                  logprob_values: Optional[List[dict]] = None) -> dict:
+                  logprob_values: Optional[List[dict]] = None,
+                  pages_omitted: int = 0) -> dict:
     """Assemble one handoff payload (checksums computed here). All
     leaves are plain scalars / lists / numpy arrays, so the gateway's
-    recursive codec ships it without a custom frame type."""
+    recursive codec ships it without a custom frame type.
+
+    `pages_omitted` is the DELTA-transfer contract: the shipped blocks
+    cover logical pages ``[pages_omitted, pages_omitted +
+    pages_shipped)`` of the sequence; the receiver supplies the first
+    `pages_omitted` pages from its own resident prefix chain (and must
+    refuse the payload, typed, if it cannot)."""
     return {
         "version": WIRE_VERSION,
         "handoff_id": handoff_id,
-        "kind": kind,  # "warm" = KV pages ride along; "cold" = re-prefill
+        # "warm" = KV pages ride along; "cold" = re-prefill;
+        # "prefix" = prompt-prefix pages only (cluster prefix fetch)
+        "kind": kind,
         "weight_version": weight_version,
         "kv_quant": kv_quant,
         "page_size": int(page_size),
@@ -168,6 +177,7 @@ def build_payload(*, handoff_id: str, kind: str, weight_version: str,
                 else np.asarray(key, np.uint32)),
         "temp": float(temp),
         "pages_shipped": int(pages_shipped),
+        "pages_omitted": int(pages_omitted),
         "blocks": blocks,
         "sums": [_block_sums(b) for b in blocks],
         "source": source,
@@ -182,11 +192,15 @@ def verify_payload(payload: dict, *, weight_version: Optional[str] = None,
                    kv_quant: Optional[str] = "unchecked",
                    page_size: Optional[int] = None,
                    n_blocks: Optional[int] = None,
-                   max_len: Optional[int] = None) -> dict:
+                   max_len: Optional[int] = None,
+                   kinds=("warm", "cold")) -> dict:
     """Validate a handoff payload structurally and against the
     receiving engine's geometry, then re-verify every page checksum.
     Raises the typed `KVTransferError` on ANY discrepancy — a payload
-    that fails here has touched no engine state."""
+    that fails here has touched no engine state. `kinds` is the
+    caller's acceptance policy: `resume_submit` takes warm/cold, the
+    cluster prefix-fetch path takes only "prefix" — a payload of the
+    wrong kind is refused typed, never half-bound."""
     if not isinstance(payload, dict):
         raise KVTransferError(
             f"malformed handoff payload: expected dict, got "
@@ -199,9 +213,13 @@ def verify_payload(payload: dict, *, weight_version: Optional[str] = None,
         raise KVTransferError(
             f"handoff wire version {payload['version']} != "
             f"{WIRE_VERSION}")
-    if payload["kind"] not in ("warm", "cold"):
+    if payload["kind"] not in ("warm", "cold", "prefix"):
         raise KVTransferError(
             f"unknown handoff kind {payload['kind']!r}")
+    if payload["kind"] not in kinds:
+        raise KVTransferError(
+            f"handoff kind {payload['kind']!r} refused here "
+            f"(acceptable: {list(kinds)})")
     if weight_version is not None \
             and payload["weight_version"] != weight_version:
         raise KVTransferError(
@@ -239,6 +257,10 @@ def verify_payload(payload: dict, *, weight_version: Optional[str] = None,
                 f"handoff span {span} exceeds receiver max_len "
                 f"{max_len}")
     shipped = int(payload["pages_shipped"])
+    omitted = int(payload.get("pages_omitted", 0))
+    if omitted < 0:
+        raise KVTransferError(
+            f"handoff pages_omitted={omitted} must be >= 0")
     blocks = payload["blocks"]
     sums = payload["sums"]
     if payload["kind"] == "cold":
@@ -246,7 +268,8 @@ def verify_payload(payload: dict, *, weight_version: Optional[str] = None,
             raise KVTransferError("cold handoff must carry zero pages")
         return payload
     if shipped <= 0:
-        raise KVTransferError("warm handoff carries zero shipped pages")
+        raise KVTransferError(
+            f"{payload['kind']} handoff carries zero shipped pages")
     if len(blocks) != len(sums):
         raise KVTransferError(
             f"truncated handoff: {len(blocks)} blocks vs "
@@ -274,6 +297,117 @@ def verify_payload(payload: dict, *, weight_version: Optional[str] = None,
                         f"corrupted handoff frame: block {bi} tensor "
                         f"{name!r} page {i} checksum {got} != "
                         f"{ref[name][i]}")
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# delta framing — ship only what the receiver lacks, in bounded frames
+#
+# A 32k-token handoff serialized as ONE message is both a memory spike
+# and an all-or-nothing wire unit. The frame protocol splits a leased
+# payload into a blockless HEADER (scalars + per-page checksums) plus N
+# bounded FRAMES of page slices, and lets the receiver skip the leading
+# pages it already holds for the sequence's prefix chain
+# (`pages_omitted`). The header's checksums are sliced to exactly the
+# shipped span, so `verify_payload` on the reassembled payload re-proves
+# every page end-to-end — a frame corrupted, duplicated, reordered, or
+# dropped in transit is a typed refusal, never silently-wrong tokens.
+
+_FRAME_META = ("n_frames", "frame_pages")
+
+
+def payload_header(payload: dict, *, skip_pages: int = 0,
+                   frame_pages: Optional[int] = None) -> dict:
+    """Blockless copy of a leased payload, advanced by `skip_pages`
+    already-held pages and annotated with the frame schedule
+    (`n_frames`, `frame_pages`). The caller clamps `skip_pages` to what
+    the receiver proved it holds; this function clamps it to the
+    shipped span (at least one page always ships — the resume point's
+    page is never elidable)."""
+    shipped = int(payload["pages_shipped"])
+    skip = max(0, min(int(skip_pages), shipped - 1))
+    fp = shipped - skip if frame_pages is None else int(frame_pages)
+    if fp < 1:
+        raise KVTransferError(f"frame_pages must be >= 1, got {fp}")
+    header = {k: v for k, v in payload.items() if k != "blocks"}
+    header["sums"] = [{name: sums[skip:] for name, sums in ref.items()}
+                      for ref in payload["sums"]]
+    header["pages_shipped"] = shipped - skip
+    header["pages_omitted"] = int(payload.get("pages_omitted", 0)) + skip
+    header["n_frames"] = -(-(shipped - skip) // fp)
+    header["frame_pages"] = fp
+    return header
+
+
+def slice_frame(payload: dict, frame: int, *, skip_pages: int = 0,
+                frame_pages: Optional[int] = None) -> dict:
+    """One bounded frame of a leased payload: page slices
+    ``[skip + frame*fp, skip + (frame+1)*fp)`` of every block tensor.
+    Stateless — the receiver passes back the (skip, frame_pages) pair
+    from its header, so the sender keeps no per-receiver cursor."""
+    shipped = int(payload["pages_shipped"])
+    skip = max(0, min(int(skip_pages), shipped - 1))
+    fp = shipped - skip if frame_pages is None else int(frame_pages)
+    if fp < 1:
+        raise KVTransferError(f"frame_pages must be >= 1, got {fp}")
+    n_frames = -(-(shipped - skip) // fp)
+    if not 0 <= int(frame) < n_frames:
+        raise KVTransferError(
+            f"frame {frame} outside [0, {n_frames}) for "
+            f"{shipped - skip} shipped pages / {fp} per frame")
+    lo = skip + int(frame) * fp
+    hi = min(skip + (int(frame) + 1) * fp, shipped)
+    return {"handoff_id": payload["handoff_id"],
+            "frame": int(frame), "n_frames": n_frames,
+            "blocks": [{name: np.asarray(arr)[lo:hi]
+                        for name, arr in block.items()}
+                       for block in payload["blocks"]]}
+
+
+def assemble_payload(header: dict, frames: List[dict]) -> dict:
+    """Reassemble a full payload from a header plus its frames,
+    checking identity, order, and page-count closure. The result still
+    goes through `verify_payload` (checksums) before anything binds."""
+    n_frames = int(header.get("n_frames", 0))
+    if len(frames) != n_frames:
+        raise KVTransferError(
+            f"truncated framed handoff: {len(frames)} frames received, "
+            f"header promised {n_frames}")
+    n_blocks = int(header["n_blocks"])
+    for i, fr in enumerate(frames):
+        if fr.get("handoff_id") != header["handoff_id"]:
+            raise KVTransferError(
+                f"framed handoff identity mismatch at frame {i}: "
+                f"{fr.get('handoff_id')!r} != {header['handoff_id']!r}")
+        if int(fr.get("frame", -1)) != i:
+            raise KVTransferError(
+                f"framed handoff out of order: got frame "
+                f"{fr.get('frame')} at position {i}")
+        if len(fr.get("blocks", ())) != n_blocks:
+            raise KVTransferError(
+                f"framed handoff frame {i} carries "
+                f"{len(fr.get('blocks', ()))} blocks, expected {n_blocks}")
+    payload = {k: v for k, v in header.items() if k not in _FRAME_META}
+    if n_frames == 0:
+        payload["blocks"] = []
+        return payload
+    names = list(frames[0]["blocks"][0].keys()) if n_blocks else []
+    blocks = []
+    for bi in range(n_blocks):
+        blocks.append({
+            name: np.concatenate(
+                [np.asarray(fr["blocks"][bi][name]) for fr in frames],
+                axis=0)
+            for name in names})
+    payload["blocks"] = blocks
+    shipped = int(header["pages_shipped"])
+    for bi, block in enumerate(blocks):
+        for name, arr in block.items():
+            if arr.shape[0] != shipped:
+                raise KVTransferError(
+                    f"framed handoff block {bi} tensor {name!r} "
+                    f"reassembles {arr.shape[0]} pages, header promised "
+                    f"{shipped}")
     return payload
 
 
@@ -410,7 +544,9 @@ class DisaggCoordinator:
     """
 
     def __init__(self, net, *, prefill_replicas: int = 1,
-                 decode_replicas: int = 1, server_kwargs: Optional[dict] = None):
+                 decode_replicas: int = 1, server_kwargs: Optional[dict] = None,
+                 prefix_cluster: bool = False, affinity_margin: int = 2,
+                 frame_pages: int = 8):
         from deeplearning4j_tpu.serving.model_server import ModelServer
 
         if prefill_replicas < 1 or decode_replicas < 1:
@@ -441,10 +577,37 @@ class DisaggCoordinator:
         self.fallbacks = 0
         self.transfer_bytes = 0
         self.transfer_seconds = 0.0
+        # cluster-global prefix cache: one directory across both roles,
+        # so a system prompt prefilled on prefill-0 is fetchable by
+        # prefill-1 (skipping its prefill) and delta handoffs to decode
+        # servers skip pages the receiver already holds
+        self._prefix_cluster = bool(prefix_cluster)
+        self._affinity_margin = int(affinity_margin)
+        self._frame_pages = int(frame_pages)
+        self.affinity_routes = 0      # guarded by: _lock
+        self.delta_pages_skipped = 0  # guarded by: _lock
+        self.prefix_directory = None
+        self._holders: Dict[str, object] = {}
+        if self._prefix_cluster:
+            from deeplearning4j_tpu.serving.prefix_directory import (
+                PrefixDirectory,
+            )
+
+            self.prefix_directory = PrefixDirectory()
+            for i, srv in enumerate(self.prefill):
+                self._holders[f"prefill-{i}"] = srv
+            for i, srv in enumerate(self.decode):
+                self._holders[f"decode-{i}"] = srv
+            for holder_id, srv in self._holders.items():
+                srv.bind_prefix_directory(
+                    self.prefix_directory, holder_id,
+                    peers=self._holders.get,
+                    frame_pages=self._frame_pages)
 
     # -- routing ----------------------------------------------------------
 
-    def _next(self, servers: list, which: str) -> tuple:
+    def _next(self, servers: list, which: str, prompt=None,
+              tenant: Optional[str] = None) -> tuple:
         with self._lock:
             if self._closed:
                 raise ServerClosedError("disagg coordinator is shut down")
@@ -452,7 +615,45 @@ class DisaggCoordinator:
                 i = self._rr_prefill = (self._rr_prefill + 1) % len(servers)
             else:
                 i = self._rr_decode = (self._rr_decode + 1) % len(servers)
+        if prompt is not None:
+            j = self._affine(servers, which, prompt, tenant)
+            if j is not None:
+                return j, servers[j]
         return i, servers[i]
+
+    def _affine(self, servers: list, which: str, prompt,
+                tenant: Optional[str]) -> Optional[int]:
+        """Prefix-affinity override of round-robin: when the directory
+        names a server in this role as holding the prompt's deepest
+        cached chain AND that server is no more than `affinity_margin`
+        pending requests busier than the least-loaded one, route to the
+        holder — its prefill covers only the uncached suffix. Load
+        always wins past the margin: a hot holder must not become a
+        hotspot."""
+        if self.prefix_directory is None:
+            return None
+        hit = self.prefix_directory.best_holder(
+            np.asarray(prompt), tenant)
+        if hit is None:
+            return None
+        mine = [int(h.split("-", 1)[1]) for h in hit["holders"]
+                if h.startswith(which + "-")]
+        mine = [j for j in mine if j < len(servers)]
+        if not mine:
+            return None
+        loads = [s.pending() for s in servers]
+        floor = min(loads)
+        best = min((j for j in mine
+                    if loads[j] <= floor + self._affinity_margin),
+                   key=lambda j: loads[j], default=None)
+        if best is None:
+            return None
+        with self._lock:
+            self.affinity_routes += 1
+        self.prefill[0].recorder.event(
+            "affinity-route", role=which, holder=f"{which}-{best}",
+            depth_pages=hit["depth"], pending=loads[best])
+        return best
 
     @property
     def net(self):
@@ -477,7 +678,8 @@ class DisaggCoordinator:
         last_err: Optional[BaseException] = None
         avoid_decode = -1
         for round_ in range(2):  # ladder: one full re-prefill retry
-            _, psrv = self._next(self.prefill, "prefill")
+            _, psrv = self._next(self.prefill, "prefill",
+                                 prompt=prompt_ids, tenant=tenant)
             try:
                 toks = psrv.generate(
                     np.asarray(prompt_ids), int(n_tokens),
@@ -503,12 +705,27 @@ class DisaggCoordinator:
 
     def _resume(self, psrv, redirect: SlotMigratedError, remaining,
                 avoid_decode: int) -> np.ndarray:
-        payload = psrv.fetch_handoff(redirect.handoff_id)
         i, dsrv = self._next(self.decode, "decode")
         if i == avoid_decode and len(self.decode) > 1:
             i, dsrv = self._next(self.decode, "decode")
+        if self._prefix_cluster:
+            payload, skipped = self._fetch_framed(
+                psrv, redirect.handoff_id, dsrv)
+        else:
+            payload = psrv.fetch_handoff(redirect.handoff_id)
+            skipped = 0
         t0 = time.monotonic()
-        tail = dsrv.resume_generate(payload, timeout=remaining())
+        try:
+            tail = dsrv.resume_generate(payload, timeout=remaining())
+        except KVTransferError:
+            if not skipped:
+                raise
+            # the decode server's resident prefix vanished between the
+            # depth probe and admit (eviction race) — one full re-fetch,
+            # same handoff, before the outer ladder re-prefills
+            payload, skipped = self._fetch_framed(
+                psrv, redirect.handoff_id, dsrv, skip=0)
+            tail = dsrv.resume_generate(payload, timeout=remaining())
         dt = time.monotonic() - t0
         try:
             psrv.commit_handoff(redirect.handoff_id)
@@ -522,9 +739,40 @@ class DisaggCoordinator:
             self.handoffs += 1
             self.transfer_bytes += payload_nbytes(payload)
             self.transfer_seconds += dt
+            self.delta_pages_skipped += skipped
         return np.concatenate(
             [np.asarray(redirect.tokens, np.int32),
              np.asarray(tail, np.int32)])
+
+    def _fetch_framed(self, psrv, handoff_id: str, dsrv,
+                      skip: Optional[int] = None) -> tuple:
+        """Delta-framed handoff fetch: probe the receiver for how many
+        leading pages of this sequence's prefix chain it already holds,
+        then pull only the remainder in bounded frames. Returns
+        ``(payload, pages_skipped)``; checksums re-verify the
+        reassembled payload at admit, so a bad frame is a typed refusal
+        upstream of any binding."""
+        header = psrv.fetch_handoff_header(
+            handoff_id, frame_pages=self._frame_pages)
+        if skip is None:
+            already = int(header.get("pages_omitted", 0))
+            have = dsrv.prefix_depth(header["prompt"],
+                                     header.get("tenant"))
+            skip = max(0, int(have) - already)
+        if skip:
+            base = int(header.get("pages_omitted", 0))
+            header = psrv.fetch_handoff_header(
+                handoff_id, skip_pages=skip,
+                frame_pages=self._frame_pages)
+            # the sender clamps skip to shipped-1 (the resume point's
+            # page always ships); honor its clamp so the frame requests
+            # and the skipped-page count both match the wire truth
+            skip = int(header.get("pages_omitted", 0)) - base
+        frames = [psrv.fetch_handoff_frame(
+                      handoff_id, f, skip_pages=skip,
+                      frame_pages=header["frame_pages"])
+                  for f in range(int(header["n_frames"]))]
+        return assemble_payload(header, frames), int(skip)
 
     # -- server-shaped facade (gateway RPC surface) ------------------------
 
@@ -547,7 +795,12 @@ class DisaggCoordinator:
                 "fallbacks": self.fallbacks,
                 "kv_transfer_mbytes": mb,
                 "kv_transfer_mbytes_per_sec": mb / secs if secs else 0.0,
+                "prefix_cluster": self._prefix_cluster,
+                "affinity_routes": self.affinity_routes,
+                "delta_pages_skipped": self.delta_pages_skipped,
             }
+        if self.prefix_directory is not None:
+            out.update(self.prefix_directory.stats())
         out["prefill"] = [s.stats() for s in self.prefill]
         out["decode"] = [s.stats() for s in self.decode]
         return out

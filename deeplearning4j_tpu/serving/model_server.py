@@ -539,6 +539,9 @@ class ModelServer:
         self._generation_cfg = {} if generation is True else generation
         self._engine = None  # guarded by: _engine_lock
         self._engine_lock = threading.Lock()
+        # cluster prefix directory binding, stored until the lazy engine
+        # exists (a predict-only server never builds one just to bind)
+        self._prefix_bind = None  # guarded by: _engine_lock
         # counters (observable state for tests/telemetry)
         self.served = 0          # guarded by: _cond — requests completed
         self.batches = 0         # guarded by: _cond — device steps dispatched
@@ -864,6 +867,9 @@ class ModelServer:
                 if self._parallel_cfg:
                     cfg.setdefault("parallel", self._parallel_cfg)
                 self._engine = DecodeEngine(self._net, **cfg)
+                if self._prefix_bind is not None:
+                    a, kw = self._prefix_bind
+                    self._engine.bind_prefix_directory(*a, **kw)
             return self._engine
 
     # streaming sinks (`on_token=`) reach the engine in-process here;
@@ -944,6 +950,52 @@ class ModelServer:
 
     def abort_handoff(self, handoff_id: str) -> bool:
         return self._ensure_engine().abort_handoff(handoff_id)
+
+    # -- cluster prefix cache (prefix_directory) ---------------------------
+    def bind_prefix_directory(self, directory, holder_id: str,
+                              peers=None, **kw) -> "ModelServer":
+        """Join a cluster-global prefix directory (chainable). Applied
+        to the decode engine immediately if it exists, else stored and
+        applied when the lazy engine is first built — binding must not
+        force an engine into a server that may never generate."""
+        with self._engine_lock:
+            self._prefix_bind = ((directory, holder_id, peers), kw)
+            if self._engine is not None:
+                self._engine.bind_prefix_directory(directory, holder_id,
+                                                   peers, **kw)
+        return self
+
+    def prefix_depth(self, prompt_ids, tenant=None) -> int:
+        with self._engine_lock:
+            if self._engine is None:
+                return 0
+        return self._ensure_engine().prefix_depth(prompt_ids,
+                                                  tenant=tenant)
+
+    def prefix_chains(self) -> dict:
+        with self._engine_lock:
+            if self._engine is None:
+                return {}  # never-generated: nothing resident to publish
+        return self._ensure_engine().prefix_chains()
+
+    def export_prefix(self, prompt_ids, have_pages: int = 0,
+                      tenant=None, frame_pages=None,
+                      timeout=None) -> dict:
+        return self._ensure_engine().export_prefix(
+            prompt_ids, have_pages=have_pages, tenant=tenant,
+            frame_pages=frame_pages, timeout=timeout)
+
+    def fetch_handoff_header(self, handoff_id: str, skip_pages: int = 0,
+                             frame_pages=None) -> dict:
+        return self._ensure_engine().fetch_handoff_header(
+            handoff_id, skip_pages=skip_pages, frame_pages=frame_pages)
+
+    def fetch_handoff_frame(self, handoff_id: str, frame: int,
+                            skip_pages: int = 0,
+                            frame_pages=None) -> dict:
+        return self._ensure_engine().fetch_handoff_frame(
+            handoff_id, frame, skip_pages=skip_pages,
+            frame_pages=frame_pages)
 
     # -- batch assembly ----------------------------------------------------
     def _pop_expired(self, req: _Request, now: float) -> bool:  # graftlint: holds _cond

@@ -821,6 +821,14 @@ DECODE_ENGINE_STATS_KEYS = frozenset({
     "shed_page_quota", "migrations_out", "migrations_in",
     "handoffs_committed", "handoffs_aborted", "handoffs_expired",
     "handoff_leases", "handoffs_unfetched", "kv_transfer_bytes",
+    # cluster prefix cache tier (`serving.prefix_directory`): fetches
+    # landed vs degraded to cold prefill, wire bytes/latency of prefix
+    # page pulls, chains exported to peers, and prompt tokens whose
+    # prefill was skipped via pages fetched from ANOTHER host (the
+    # cluster-level hit ratio next to the local prefix_hit_tokens_pct)
+    "prefix_fetches", "prefix_fetch_fallbacks", "prefix_fetch_bytes",
+    "prefix_fetch_ms", "prefix_exports", "cluster_prefix_hit_tokens",
+    "cluster_prefix_hit_tokens_pct",
 })
 
 # Per-tenant counters nested under DecodeEngine ``stats()["tenants"]``
@@ -848,6 +856,10 @@ REPLICA_POOL_STATS_KEYS = frozenset({
     # live decode-state migration: redirects resumed on a peer vs
     # degraded to the full re-prefill fallback
     "migrations", "migration_fallbacks",
+    # cluster prefix cache: dispatches steered to a chain holder within
+    # the affinity margin, and the shared directory's live entry count
+    # (0 when no directory is bound)
+    "affinity_routes", "directory_entries",
 })
 
 # `Autoscaler.stats()` — registered under the pool's metrics registry

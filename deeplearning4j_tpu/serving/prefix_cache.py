@@ -63,13 +63,18 @@ class _PrefixNode:
     `tokens` the page's exact token content (collision guard),
     `requests` the number of slots currently bound to it, `children`
     how many cached nodes extend this chain (a node with children can
-    not be evicted — its descendants would become unreachable pages)."""
+    not be evicted — its descendants would become unreachable pages).
+    `chain` is the node's CLUSTER identity — the cumulative content
+    digest of everything up to and including this page (see
+    `chain_keys`) — and `version` the weight digest its KV was computed
+    under."""
 
     __slots__ = ("seq", "parent", "page_id", "tokens", "requests",
-                 "children", "last_used", "key")
+                 "children", "last_used", "key", "chain", "version")
 
     def __init__(self, seq: int, parent: Optional["_PrefixNode"],
-                 page_id: int, tokens: np.ndarray, key):
+                 page_id: int, tokens: np.ndarray, key,
+                 chain: str = "", version: Optional[str] = None):
         self.seq = seq
         self.parent = parent
         self.page_id = page_id
@@ -78,11 +83,50 @@ class _PrefixNode:
         self.children = 0
         self.last_used = 0
         self.key = key
+        self.chain = chain
+        self.version = version
 
 
 def _digest(tokens: np.ndarray) -> bytes:
     return hashlib.blake2b(np.ascontiguousarray(tokens, np.int32).tobytes(),
                            digest_size=16).digest()
+
+
+def _chain_root(tenant: Optional[str]) -> bytes:
+    """Seed of the cumulative chain digest. The tenant is folded in
+    HERE, at the root, so every downstream chain key — and therefore
+    every directory entry — is tenant-scoped: one tenant's published
+    prefixes are simply unreachable from another tenant's lookups."""
+    h = hashlib.blake2b(b"dl4j-prefix-chain-v1", digest_size=16)
+    if tenant is not None:
+        h.update(b"\x00tenant\x00" + str(tenant).encode())
+    return h.digest()
+
+
+def chain_keys(prompt: np.ndarray, page_size: int,
+               tenant: Optional[str] = None,
+               digest_cache: Optional[list] = None) -> List[str]:
+    """Instance-independent cumulative content keys, one per FULL page
+    of `prompt`: ``key[i] = H(key[i-1] || digest(chunk_i))`` rooted at
+    the tenant-scoped seed. Two hosts compute identical keys for
+    identical (tenant, token-prefix) pairs — the directory's address
+    space. `digest_cache` memoizes per-chunk digests exactly like
+    `PrefixCache.lookup`'s."""
+    prompt = np.asarray(prompt)
+    page = int(page_size)
+    run = _chain_root(tenant)
+    out: List[str] = []
+    for i in range(int(prompt.shape[0]) // page):
+        if digest_cache is not None and i < len(digest_cache):
+            dig = digest_cache[i]
+        else:
+            dig = _digest(np.ascontiguousarray(
+                prompt[i * page:(i + 1) * page], np.int32))
+            if digest_cache is not None:
+                digest_cache.append(dig)
+        run = hashlib.blake2b(run + dig, digest_size=16).digest()
+        out.append(run.hex())
+    return out
 
 
 class PrefixCache:
@@ -112,6 +156,12 @@ class PrefixCache:
         self._nodes: dict = {}   # guarded by: _guard [external] — (parent_seq, digest) -> _PrefixNode
         self._seq = 0  # guarded by: _guard [external]
         self._clock = 0  # guarded by: _guard [external]
+        # tenant-scoped chain roots: tenant -> synthetic root seq (None
+        # tenant keeps the historic root 0; others draw from the same
+        # counter as nodes, so roots and nodes can never collide). A
+        # request can only ever walk chains grown from ITS tenant's
+        # root — cross-tenant page binding is structurally impossible
+        self._roots: dict = {}  # guarded by: _guard [external]
         # structural counters (hit/miss/token accounting lives on the
         # engine, which counts once per BINDING — a page-blocked queue
         # head re-runs lookup every scheduler iteration)
@@ -124,6 +174,11 @@ class PrefixCache:
         # may only promote here after the transfer layer proved the
         # sender's version equal (kv_transfer.verify_payload)
         self.weight_version: Optional[str] = None
+        # optional cluster directory (`bind_directory`): promotions
+        # publish their chain keys, evictions retract, clear drops the
+        # holder wholesale — the in-process push half of the protocol
+        self._directory = None
+        self._holder: Optional[str] = None
 
     def bind_guard(self, lock) -> "PrefixCache":
         """Register the owner's lock. Every mutating method then runs
@@ -142,8 +197,24 @@ class PrefixCache:
 
     def bind_version(self, version: Optional[str]) -> "PrefixCache":
         """Tag the cache with the serving weights' content digest (the
-        key under which cached KV is valid)."""
+        key under which cached KV is valid). Nodes are STAMPED with the
+        version live at their insert and `lookup` only walks nodes
+        matching the CURRENT tag — so re-binding to a different version
+        invalidates every older entry without dropping it, and binding
+        BACK to the original version (a rollback to the same weights)
+        makes those entries hittable again: the pages were computed
+        under exactly those weights."""
         self.weight_version = version
+        return self
+
+    def bind_directory(self, directory, holder: str) -> "PrefixCache":
+        """Register the cluster prefix directory and this cache's
+        holder id: every promotion publishes its chain keys, every
+        eviction retracts, `clear()` drops the holder wholesale. The
+        directory's lock is a leaf — publishing under the engine's
+        condition lock is deadlock-free."""
+        self._directory = directory
+        self._holder = holder
         return self
 
     # -- introspection -----------------------------------------------------
@@ -169,21 +240,28 @@ class PrefixCache:
         boundary strictly before t0-1's page end."""
         return max(0, (t0 - 1) // self.page_size)
 
-    def lookup(self, prompt: np.ndarray,
-               digest_cache: Optional[list] = None) -> List[_PrefixNode]:
-        """Longest cached chain matching `prompt`'s page-aligned prefix
-        (possibly empty). Touches the matched nodes' LRU clocks; does
-        NOT take references — pair with `acquire` under the same lock
-        before any other cache call can run. `digest_cache`: a caller-
-        owned list memoizing the prompt's per-chunk digests — a page-
-        blocked queue head re-runs lookup every scheduler iteration,
-        and the prompt is immutable, so hashing it once is enough."""
-        assert_owned(self._guard, "PrefixCache.lookup")
+    def _root_seq(self, tenant: Optional[str]) -> int:
+        """Synthetic root seq for a tenant's chain space (None keeps
+        the historic root 0). Allocated from the node counter, so a
+        tenant root can never alias a node seq."""
+        if tenant is None:
+            return 0
+        root = self._roots.get(tenant)
+        if root is None:
+            self._seq += 1
+            root = self._roots[tenant] = self._seq
+        return root
+
+    def _walk(self, prompt: np.ndarray, cap: int,
+              digest_cache: Optional[list],
+              tenant: Optional[str]) -> List[_PrefixNode]:
+        """Shared chain walk for `lookup`/`match`: longest cached chain
+        matching `prompt`'s first `cap` pages under `tenant`'s root,
+        current weight version only. Touches matched LRU clocks."""
         page = self.page_size
-        t0 = int(prompt.shape[0])
         out: List[_PrefixNode] = []
-        parent_seq = 0
-        for i in range(self._max_hit_pages(t0)):
+        parent_seq = self._root_seq(tenant)
+        for i in range(cap):
             chunk = np.ascontiguousarray(prompt[i * page:(i + 1) * page],
                                          np.int32)
             if digest_cache is not None and i < len(digest_cache):
@@ -193,7 +271,8 @@ class PrefixCache:
                 if digest_cache is not None:
                     digest_cache.append(dig)
             node = self._nodes.get((parent_seq, dig))
-            if node is None or not np.array_equal(node.tokens, chunk):
+            if node is None or node.version != self.weight_version \
+                    or not np.array_equal(node.tokens, chunk):
                 break
             out.append(node)
             parent_seq = node.seq
@@ -201,6 +280,41 @@ class PrefixCache:
         for node in out:
             node.last_used = self._clock
         return out
+
+    def lookup(self, prompt: np.ndarray,
+               digest_cache: Optional[list] = None,
+               tenant: Optional[str] = None) -> List[_PrefixNode]:
+        """Longest cached chain matching `prompt`'s page-aligned prefix
+        (possibly empty), capped at `_max_hit_pages`. Touches the
+        matched nodes' LRU clocks; does NOT take references — pair with
+        `acquire` under the same lock before any other cache call can
+        run. `digest_cache`: a caller-owned list memoizing the prompt's
+        per-chunk digests — a page-blocked queue head re-runs lookup
+        every scheduler iteration, and the prompt is immutable, so
+        hashing it once is enough."""
+        assert_owned(self._guard, "PrefixCache.lookup")
+        t0 = int(prompt.shape[0])
+        return self._walk(prompt, self._max_hit_pages(t0), digest_cache,
+                          tenant)
+
+    def match(self, prompt: np.ndarray,
+              tenant: Optional[str] = None) -> List[_PrefixNode]:
+        """Longest cached chain over EVERY full page of `prompt` — no
+        `_max_hit_pages` cap, because the caller is not binding a slot:
+        used by the cluster export path (a peer asking for exactly the
+        pages it saw in the directory) and by delta-transfer depth
+        queries."""
+        assert_owned(self._guard, "PrefixCache.match")
+        t0 = int(prompt.shape[0])
+        return self._walk(prompt, t0 // self.page_size, None, tenant)
+
+    def chains(self) -> List[str]:
+        """Chain keys of every resident node at the CURRENT weight
+        version — the pull-mode directory refresh payload
+        (`prefix_chains` RPC)."""
+        assert_owned(self._guard, "PrefixCache.chains")
+        return [n.chain for n in self._nodes.values()
+                if n.version == self.weight_version and n.chain]
 
     def acquire(self, nodes: List[_PrefixNode]) -> None:
         assert_owned(self._guard, "PrefixCache.acquire")
@@ -215,7 +329,7 @@ class PrefixCache:
 
     # -- insertion ---------------------------------------------------------
     def insert(self, prompt: np.ndarray, pages: List[int],
-               held: List[_PrefixNode]):
+               held: List[_PrefixNode], tenant: Optional[str] = None):
         """Promote the prompt's fully-covered pages into the cache after
         a successful prefill. `pages` is the request's LOGICAL page list
         (shared prefix pages first, then owned pages); `held` the nodes
@@ -237,12 +351,17 @@ class PrefixCache:
         nodes = list(held)
         freed: List[int] = []
         parent = held[-1] if held else None
+        chain = (bytes.fromhex(parent.chain) if parent is not None
+                 and parent.chain else _chain_root(tenant))
         self._clock += 1
+        published: List[str] = []
         for i in range(len(held), cacheable):
-            parent_seq = parent.seq if parent is not None else 0
+            parent_seq = (parent.seq if parent is not None
+                          else self._root_seq(tenant))
             chunk = np.ascontiguousarray(prompt[i * page:(i + 1) * page],
                                          np.int32)
-            key = (parent_seq, _digest(chunk))
+            dig = _digest(chunk)
+            key = (parent_seq, dig)
             if key in self._nodes:
                 # raced by another request's promotion of the same
                 # prefix: its page is canonical for future lookups, ours
@@ -255,8 +374,11 @@ class PrefixCache:
                 if evicted is None:
                     break  # cap reached, everything pinned: skip caching
                 freed.append(evicted)
+            chain = hashlib.blake2b(chain + dig, digest_size=16).digest()
             self._seq += 1
-            node = _PrefixNode(self._seq, parent, int(pages[i]), chunk, key)
+            node = _PrefixNode(self._seq, parent, int(pages[i]), chunk,
+                               key, chain=chain.hex(),
+                               version=self.weight_version)
             node.requests = 1  # the promoting request's reference
             node.last_used = self._clock
             if parent is not None:
@@ -265,12 +387,22 @@ class PrefixCache:
             nodes.append(node)
             parent = node
             self.insertions += 1
+            published.append(node.chain)
         if freed and self._recorder is not None:
             # cap pressure displaced resident prefixes — one aggregated
             # event per promotion, not one per page
             self._recorder.event("prefix-cache", decision="cap-evict",
                                  pages=len(freed),
                                  cached_pages=len(self._nodes))
+        if published and self._directory is not None:
+            # publish-on-promotion: the cluster learns this holder has
+            # the chain the moment it becomes shareable locally
+            self._directory.publish(self.weight_version, page,
+                                    published, self._holder)
+            if self._recorder is not None:
+                self._recorder.event("prefix-publish",
+                                     pages=len(published),
+                                     holder=self._holder)
         return nodes, freed
 
     # -- eviction ----------------------------------------------------------
@@ -292,6 +424,11 @@ class PrefixCache:
         if best.parent is not None:
             best.parent.children -= 1
         self.evictions += 1
+        if self._directory is not None and best.chain:
+            # retract-on-evict: the directory must never advertise a
+            # chain whose pages are back on the free list
+            self._directory.retract(best.version, (best.chain,),
+                                    self._holder)
         return best.page_id
 
     def reclaim(self, n_pages: int) -> List[int]:
@@ -317,6 +454,9 @@ class PrefixCache:
         assert_owned(self._guard, "PrefixCache.clear")
         dropped = len(self._nodes)
         self._nodes.clear()
+        self._roots.clear()
+        if self._directory is not None:
+            self._directory.drop_holder(self._holder)
         if dropped and self._recorder is not None:
             self._recorder.event("prefix-cache", decision="invalidate",
                                  dropped=dropped)

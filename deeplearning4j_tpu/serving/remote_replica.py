@@ -473,6 +473,78 @@ class RemoteReplica:
             self._raise_mapped(e, deadline_bound=False,
                                what="abort_handoff")
 
+    # -- cluster prefix cache ----------------------------------------------
+    # The wire mirror of the ModelServer prefix surface, so a remote
+    # replica can serve as a fetch HOLDER (export + frames) and a delta
+    # RECEIVER probe (prefix_depth), and publish into a pool's directory
+    # via `ReplicaPool.refresh_prefix_directory` (prefix_chains pull).
+
+    def export_prefix(self, prompt_ids, have_pages: int = 0,
+                      tenant: Optional[str] = None,
+                      frame_pages: Optional[int] = None,
+                      timeout: Optional[float] = None) -> dict:
+        """Lease the remote's resident prefix-chain pages for
+        `prompt_ids` beyond `have_pages`; returns the framed-transfer
+        header. Retryable: a duplicate grant's lease TTL unpins it."""
+        try:
+            return self._client.call(
+                "export_prefix", name=self.MODEL,
+                prompt_ids=[int(x) for x in np.asarray(prompt_ids)],
+                have_pages=int(have_pages), tenant=tenant,
+                frame_pages=frame_pages, timeout=timeout,
+                _timeout=self._wire_deadline(timeout))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=timeout is not None,
+                               what="export_prefix")
+
+    def fetch_handoff_header(self, handoff_id: str, skip_pages: int = 0,
+                             frame_pages: Optional[int] = None) -> dict:
+        """Blockless delta header of a leased handoff (read-only)."""
+        try:
+            return self._client.call(
+                "fetch_handoff_header", name=self.MODEL,
+                handoff_id=handoff_id, skip_pages=int(skip_pages),
+                frame_pages=frame_pages, _timeout=self.rpc_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="fetch_handoff_header")
+
+    def fetch_handoff_frame(self, handoff_id: str, frame: int,
+                            skip_pages: int = 0,
+                            frame_pages: Optional[int] = None) -> dict:
+        """One bounded frame of a leased handoff (read-only)."""
+        try:
+            return self._client.call(
+                "fetch_handoff_frame", name=self.MODEL,
+                handoff_id=handoff_id, frame=int(frame),
+                skip_pages=int(skip_pages), frame_pages=frame_pages,
+                _timeout=self.rpc_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="fetch_handoff_frame")
+
+    def prefix_depth(self, prompt_ids,
+                     tenant: Optional[str] = None) -> int:
+        """Resident prefix-chain depth (pages) on the remote engine."""
+        try:
+            return int(self._client.call(
+                "prefix_depth", name=self.MODEL,
+                prompt_ids=[int(x) for x in np.asarray(prompt_ids)],
+                tenant=tenant, _timeout=self.rpc_timeout))
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="prefix_depth")
+
+    def prefix_chains(self) -> dict:
+        """Resident chain-key snapshot — the pull-mode directory feed."""
+        try:
+            return self._client.call(
+                "prefix_chains", name=self.MODEL,
+                _timeout=self.rpc_timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._raise_mapped(e, deadline_bound=False,
+                               what="prefix_chains")
+
     # -- health ------------------------------------------------------------
     def probe(self, x=None, timeout: Optional[float] = None
               ) -> Optional[bool]:

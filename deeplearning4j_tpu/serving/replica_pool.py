@@ -172,7 +172,9 @@ class ReplicaPool:
                  admission_budget: Optional[int] = None,
                  hedge: bool = False,
                  hedge_delay: Optional[float] = None,
-                 default_timeout: Optional[float] = None):
+                 default_timeout: Optional[float] = None,
+                 prefix_directory=None,
+                 affinity_margin: int = 2):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("a replica pool needs at least one replica")
@@ -235,6 +237,15 @@ class ReplicaPool:
         self.replicas_removed = 0  # guarded by: _lock
         self.migrations = 0  # guarded by: _lock
         self.migration_fallbacks = 0  # guarded by: _lock
+        # cluster-global prefix cache: a shared PrefixDirectory makes a
+        # prompt prefix prefilled on ANY replica fetchable (in-process
+        # servers bind their engines as publishers+fetchers; remote
+        # replicas publish via `refresh_prefix_directory` pull) and
+        # steers dispatch toward holders within `affinity_margin`
+        # pending requests of the least-loaded replica
+        self._prefix_directory = prefix_directory
+        self._affinity_margin = int(affinity_margin)
+        self.affinity_routes = 0  # guarded by: _lock
         # observability: the pool keeps its own registry + recorder for
         # routing-layer views (failovers, hedges, probe verdicts,
         # evictions, reloads); each replica's ModelServer keeps its own
@@ -248,6 +259,8 @@ class ReplicaPool:
                            lambda: self._in_flight)
         self.metrics.gauge("replica_pool_healthy_replicas",
                            self.healthy_replicas)
+        for rep in self._replicas:
+            self._bind_prefix(rep)
         self._reload_lock = threading.Lock()
         self._probe_wake = threading.Event()
         self._probe_thread = threading.Thread(
@@ -320,6 +333,10 @@ class ReplicaPool:
                 "replicas_removed": self.replicas_removed,
                 "migrations": self.migrations,
                 "migration_fallbacks": self.migration_fallbacks,
+                "affinity_routes": self.affinity_routes,
+                "directory_entries": (
+                    0 if self._prefix_directory is None else
+                    self._prefix_directory.stats()["directory_entries"]),
                 "ewma_latency_ms": round(1e3 * self._lat_ewma, 3),
                 "replicas": per_replica,
             }
@@ -358,18 +375,84 @@ class ReplicaPool:
         observability.attach_trace(err, trace)
         self.recorder.record(trace, decision, kind=kind)
 
+    # -- cluster prefix cache ----------------------------------------------
+    def _bind_prefix(self, rep: _Replica) -> None:
+        """Join `rep`'s engine to the pool's prefix directory (no-op
+        without a directory, or for adapters — remote replicas — that
+        cannot bind an in-process object; those publish via
+        `refresh_prefix_directory` instead)."""
+        if self._prefix_directory is None:
+            return
+        bind = getattr(rep.server, "bind_prefix_directory", None)
+        if bind is None:
+            return
+        bind(self._prefix_directory, f"replica-{rep.id}",
+             peers=self._holder_peer)
+
+    def _holder_peer(self, holder_id: str):
+        """Resolve a directory holder id back to a live server — the
+        peers hook engines use to fetch prefix pages. Only healthy
+        replicas resolve: a fetch must not land on an evicted host."""
+        try:
+            rid = int(str(holder_id).rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return None
+        with self._lock:
+            for rep in self._replicas:
+                if rep.id == rid and rep.state == "healthy":
+                    return rep.server
+        return None
+
+    def refresh_prefix_directory(self) -> int:
+        """Pull-mode publication for replicas whose engines cannot push
+        into the shared directory (remote processes behind the RPC
+        adapter): snapshot each healthy replica's resident chains and
+        publish them under its holder id, refreshing TTLs. Returns the
+        number of chain keys published. In-process replicas publish
+        synchronously on promotion; calling this for them is a harmless
+        TTL refresh."""
+        if self._prefix_directory is None:
+            return 0
+        with self._lock:
+            reps = [(rep.id, rep.server) for rep in self._replicas
+                    if rep.state == "healthy"]
+        published = 0
+        for rid, srv in reps:
+            fn = getattr(srv, "prefix_chains", None)
+            if fn is None:
+                continue
+            try:
+                snap = fn()
+            except ServingError:
+                continue  # unreachable replica: its entries age out
+            if not snap or not snap.get("chains"):
+                continue
+            self._prefix_directory.publish(
+                snap["weight_version"], snap["page_size"],
+                snap["chains"], f"replica-{rid}")
+            published += len(snap["chains"])
+        return published
+
     # -- routing -----------------------------------------------------------
-    def _pick(self, exclude=()) -> Optional[_Replica]:
+    def _pick(self, exclude=(), prompt=None,
+              tenant=None) -> Optional[_Replica]:
         """Least-loaded healthy replica, preferring ones not in
         `exclude` (already failed this request); when every healthy
         replica has been tried, re-allow them — a half-open breaker may
-        admit the retry. None = no healthy replica at all."""
+        admit the retry. None = no healthy replica at all. With a
+        prefix directory bound and a `prompt` given, a replica holding
+        the prompt's deepest cached chain wins the pick when its load
+        is within `affinity_margin` of the least-loaded candidate —
+        hot prefixes concentrate instead of replicating pool-wide."""
         with self._lock:
             healthy = [r for r in self._replicas if r.state == "healthy"]
             if not healthy:
                 return None
             fresh = [r for r in healthy if r.id not in exclude]
             pool = fresh or healthy
+        affine = self._affine(pool, prompt, tenant)
+        if affine is not None:
+            return affine
         # tiebreak on the INDEX within the candidate list (an id-based
         # key collapses to a constant when the surviving ids are
         # congruent mod the pool size, pinning tied traffic to one
@@ -378,6 +461,36 @@ class ReplicaPool:
         best = min(range(len(pool)),
                    key=lambda i: (pool[i].load(), (i - rr) % len(pool)))
         return pool[best]
+
+    def _affine(self, pool, prompt, tenant) -> Optional[_Replica]:
+        if self._prefix_directory is None or prompt is None:
+            return None
+        hit = self._prefix_directory.best_holder(
+            np.asarray(prompt), tenant)
+        if hit is None:
+            return None
+        ids = set()
+        for holder in hit["holders"]:
+            try:
+                ids.add(int(str(holder).rsplit("-", 1)[1]))
+            except (IndexError, ValueError):
+                continue
+        holders = [r for r in pool if r.id in ids]
+        if not holders:
+            return None
+        loads = {r.id: r.load() for r in pool}
+        floor = min(loads.values())
+        best = min((r for r in holders
+                    if loads[r.id] <= floor + self._affinity_margin),
+                   key=lambda r: loads[r.id], default=None)
+        if best is None:
+            return None  # holder too busy: load beats affinity
+        with self._lock:
+            self.affinity_routes += 1
+        self.recorder.event("affinity-route", replica=best.id,
+                            depth_pages=hit["depth"],
+                            pending=loads[best.id])
+        return best
 
     def _degraded(self) -> ServiceUnavailableError:
         with self._lock:
@@ -419,6 +532,11 @@ class ReplicaPool:
         rep.probe_successes = 0
         rep.evictions += 1
         self.evictions += 1
+        if self._prefix_directory is not None:
+            # an evicted host must stop attracting affinity routes and
+            # fetches NOW, not a TTL later (directory has its own leaf
+            # lock; it never calls back into the pool)
+            self._prefix_directory.drop_holder(f"replica-{rep.id}")
         self.recorder.event("evict", replica=rep.id, reason=reason)
         logger.warning("replica pool: evicted replica %d (%s)",
                        rep.id, reason)
@@ -534,7 +652,7 @@ class ReplicaPool:
                 "over; request shed")
         return rem
 
-    def _route_with_failover(self, attempt):
+    def _route_with_failover(self, attempt, prompt=None, tenant=None):
         """The one failover loop `predict` and `generate` share: pick a
         healthy replica, run `attempt(replica, tried)`, and on a
         retryable typed failure — `_RETRYABLE` sickness, or a
@@ -548,7 +666,7 @@ class ReplicaPool:
         tried: set = set()
         reroutes = 0
         while True:
-            rep = self._pick(exclude=tried)
+            rep = self._pick(exclude=tried, prompt=prompt, tenant=tenant)
             if rep is None:
                 raise self._degraded()
             try:
@@ -812,7 +930,8 @@ class ReplicaPool:
                                                  on_token=on_token)
 
             with observability.use_trace(trace):
-                out = self._route_with_failover(attempt)
+                out = self._route_with_failover(attempt, prompt=prompt_ids,
+                                                tenant=tenant)
         except ServingError as e:
             self._shed_obs(trace, e, kind="generate")
             raise
@@ -1319,6 +1438,7 @@ class ReplicaPool:
             self.recorder.event("add-replica", replica=new_id,
                                 state=rep.state,
                                 n_replicas=len(self._replicas))
+        self._bind_prefix(rep)
         logger.info("replica pool: added replica %d (%s)", new_id,
                     rep.state)
         self._probe_wake.set()  # start the ladder immediately
@@ -1360,6 +1480,8 @@ class ReplicaPool:
             self.replicas_removed += 1
             self.recorder.event("remove-replica", replica=replica_id,
                                 n_replicas=len(self._replicas))
+        if self._prefix_directory is not None:
+            self._prefix_directory.drop_holder(f"replica-{replica_id}")
         logger.info("replica pool: removed replica %d (drained clean)",
                     replica_id)
         return rep.server

@@ -133,3 +133,27 @@ def shed_slow_consumer(stream, consumer):
         consumer.drain(stream)
     except TimeoutError as e:  # typed shed: the consumer gets a
         raise StreamBackpressureError(f"reader stalled: {e}")  # verdict
+
+
+def fetch_prefix_chain(holder, prompt):
+    try:
+        return holder.export_prefix(prompt)
+    except ConnectionRefusedError as e:  # logged cold-prefill fallback
+        logger.warning("prefix fetch failed; cold prefill: %s", e)
+        return None
+
+
+def drain_prefix_frames(holder, handoff_id, n_frames):
+    try:
+        return [holder.fetch_handoff_frame(handoff_id, f)
+                for f in range(n_frames)]
+    except ConnectionResetError as e:  # typed refusal: a partial chain
+        raise ServingError(f"prefix frame lost: {e}")  # is never bound
+
+
+def publish_chain(directory, keys, holder_id):
+    try:
+        directory.publish("wv", 16, keys, holder_id)
+    except OSError:  # explicit verdict: the caller re-publishes later
+        return False
+    return True

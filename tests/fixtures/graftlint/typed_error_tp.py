@@ -115,3 +115,28 @@ def shed_slow_consumer(stream, consumer):
         consumer.drain(stream)
     except socket.timeout:  # TP: the stall verdict is dropped — the
         return None         # consumer never learns it was shed
+
+
+def fetch_prefix_chain(holder, prompt):
+    try:
+        return holder.export_prefix(prompt)
+    except ConnectionRefusedError:  # TP: the directory hit silently
+        pass                        # evaporates — no fallback verdict,
+                                    # no counter, the request just hangs
+
+
+def drain_prefix_frames(holder, handoff_id, n_frames):
+    frames = []
+    for f in range(n_frames):
+        try:
+            frames.append(holder.fetch_handoff_frame(handoff_id, f))
+        except ConnectionResetError:  # TP: a truncated chain binds as
+            break                     # if complete — wrong-prefix KV
+    return frames
+
+
+def publish_chain(directory, keys, holder_id):
+    try:
+        directory.publish("wv", 16, keys, holder_id)
+    except OSError:  # TP: the chain silently stops attracting reuse
+        return      # and nobody learns the directory is unreachable

@@ -1,0 +1,453 @@
+"""Cluster-global prefix cache (`serving/prefix_directory.py`, ISSUE 20):
+cross-host prefix sharing, delta KV-page shipping, affinity routing.
+
+The load-bearing contracts:
+
+- **cross-host hit parity**: a prompt prefilled on engine A and served
+  on engine B via a directory fetch is argmax-identical to a cold run,
+  and B's prefill covers only the uncached suffix;
+- **never slower than today**: EVERY fetch-path failure — dead holder,
+  corrupted frame, stale weight version, refusing peer — degrades to
+  cold prefill with zero failed requests and a counted fallback;
+- **isolation**: tenant-scoped chain keys make one tenant's published
+  pages unreachable from another tenant's lookups, and fetched pages
+  still pass the fetching tenant's `max_pages` quota door;
+- **delta transfers**: framed handoffs ship only the pages the
+  receiver does not already hold, for prefix fetches AND disagg
+  migration handoffs;
+- **affinity routing**: `ReplicaPool` and `DisaggCoordinator` steer a
+  prompt toward a chain holder when load permits.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (
+    generate,
+    gpt_configuration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    DisaggCoordinator,
+    KVTransferError,
+    PrefixDirectory,
+    PrefixFetchSaboteur,
+    ReplicaPool,
+    TenantQuotaExceededError,
+    chain_keys,
+)
+from deeplearning4j_tpu.serving import kv_transfer
+
+VOCAB = 48
+
+
+def _gpt_net(seed: int = 12345, **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("max_length", 64)
+    net = MultiLayerNetwork(gpt_configuration(seed=seed, **kw))
+    net.init()
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt_net()
+
+
+def _engine(net, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 40)
+    # buckets stop at 8 so a 21-token prompt takes the CHUNKED prefill
+    # path (prefill_chunks counts chunks, and a 16-token hit leaves a
+    # one-chunk suffix) — the same idiom as test_prefix_spec
+    kw.setdefault("prompt_buckets", (8,))
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefix_cache", True)
+    return DecodeEngine(net, **kw)
+
+
+def _prompt(n=21, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, n).astype(np.int32)
+
+
+# ------------------------------------------------------------ chain keys
+
+
+def test_chain_keys_deterministic_prefix_property_tenant_scoped():
+    """The directory's address space: identical (tenant, tokens) pairs
+    hash identically anywhere; longer prompts EXTEND the shorter's key
+    chain; tenants never collide."""
+    p = _prompt(24)
+    assert chain_keys(p, 8) == chain_keys(np.array(p), 8)
+    assert len(chain_keys(p, 8)) == 3
+    # prefix property: the longer prompt's chain starts with the
+    # shorter's — one published chain serves every extension
+    assert chain_keys(p, 8)[:2] == chain_keys(p[:16], 8)
+    # tenant salt reaches every key in the chain
+    a, b = chain_keys(p, 8, tenant="alice"), chain_keys(p, 8, tenant="bob")
+    assert set(a).isdisjoint(b)
+    assert set(a).isdisjoint(chain_keys(p, 8))
+    # content-addressed: different tokens, different keys
+    q = p.copy()
+    q[0] = (q[0] + 1) % VOCAB
+    assert chain_keys(q, 8)[0] != chain_keys(p, 8)[0]
+
+
+# ------------------------------------------------------------- directory
+
+
+def test_directory_publish_lookup_ttl_expiry():
+    d = PrefixDirectory(ttl=10.0)
+    p = _prompt(24)
+    keys = chain_keys(p, 8)
+    d.publish("wv1", 8, keys[:2], "host-a", now=0.0)
+    hit = d.best_holder(p, now=1.0)
+    assert hit["weight_version"] == "wv1" and hit["page_size"] == 8
+    assert hit["depth"] == 2 and hit["holders"] == ["host-a"]
+    # depth is capped one page short of the prompt end even if a holder
+    # published deeper (the final position is always recomputed live)
+    d.publish("wv1", 8, keys, "host-b", now=1.0)
+    assert d.best_holder(p, now=2.0)["depth"] == 2
+    # exclude=self: an engine never fetches from itself
+    assert d.best_holder(p, exclude=("host-a", "host-b"), now=2.0) is None
+    # TTL: host-a's entries age out; host-b's (fresher) survive
+    hit = d.best_holder(p, now=10.5)
+    assert hit["holders"] == ["host-b"]
+    assert d.sweep(now=12.0) >= 1
+    assert d.best_holder(p, now=12.0) is None
+    st = d.stats()
+    assert st["directory_entries"] == 0 and st["expirations"] >= 1
+
+
+def test_directory_weight_version_keying_and_drop_holder():
+    """A rolling reload strands the old version's entries instead of
+    clearing the world: the new version's lookups never see them, and
+    a rollback to the SAME weights finds them again."""
+    d = PrefixDirectory()
+    p = _prompt(24)
+    d.publish("wv-old", 8, chain_keys(p, 8)[:2], "host-a")
+    hit = d.best_holder(p)
+    assert hit["weight_version"] == "wv-old"
+    # the fetcher compares against ITS weight version — a host on new
+    # weights refuses the hit; a host rolled back to wv-old reuses it
+    assert hit["weight_version"] != "wv-new"
+    d.publish("wv-new", 8, chain_keys(p, 8)[:1], "host-b")
+    deep = d.best_holder(p)
+    assert deep["weight_version"] == "wv-old"  # deepest match wins
+    assert d.drop_holder("host-a") == 2
+    assert d.best_holder(p)["weight_version"] == "wv-new"
+    d.retract("wv-new", chain_keys(p, 8)[:1], "host-b")
+    assert d.best_holder(p) is None
+    assert d.stats()["directory_versions"] == 0
+
+
+def test_tenant_isolation_at_the_directory():
+    d = PrefixDirectory()
+    p = _prompt(24)
+    d.publish("wv", 8, chain_keys(p, 8, tenant="alice")[:2], "host-a")
+    assert d.best_holder(p, "alice") is not None
+    assert d.best_holder(p, "bob") is None
+    assert d.best_holder(p, None) is None
+
+
+# --------------------------------------------------------- delta framing
+
+
+def test_framed_delta_roundtrip_and_corruption_refusal():
+    """Header + frames reassemble into the exact payload; skip_pages
+    advances the shipped span; a flipped frame byte is refused by the
+    per-page checksums after reassembly."""
+    rng = np.random.default_rng(3)
+    blocks = [{"k": rng.standard_normal((4, 8, 2, 4)).astype(np.float32),
+               "v": rng.standard_normal((4, 8, 2, 4)).astype(np.float32)}]
+    payload = kv_transfer.build_payload(
+        handoff_id="h1", kind="prefix", weight_version="wv",
+        kv_quant=None, page_size=8, n_blocks=1,
+        prompt=_prompt(32)[:32], n_tokens=0, temperature=0.0, seed=0,
+        resumed_at=0, tokens=[], blocks=blocks, pages_shipped=4)
+    header = kv_transfer.payload_header(payload, skip_pages=2,
+                                        frame_pages=1)
+    assert header["pages_shipped"] == 2 and header["pages_omitted"] == 2
+    assert header["n_frames"] == 2 and "blocks" not in header
+    frames = [kv_transfer.slice_frame(payload, f, skip_pages=2,
+                                      frame_pages=1)
+              for f in range(header["n_frames"])]
+    out = kv_transfer.verify_payload(
+        kv_transfer.assemble_payload(header, frames),
+        weight_version="wv", page_size=8, n_blocks=1, max_len=40,
+        kinds=("prefix",))
+    np.testing.assert_array_equal(out["blocks"][0]["k"],
+                                  blocks[0]["k"][2:])
+    # skip clamps to shipped-1: the resume point's page always ships
+    clamped = kv_transfer.payload_header(payload, skip_pages=99)
+    assert clamped["pages_shipped"] == 1 and clamped["pages_omitted"] == 3
+    # a corrupted frame fails the checksum re-proof, typed
+    bad = [dict(fr) for fr in frames]
+    bad[0] = dict(bad[0])
+    bad[0]["blocks"] = [{"k": np.array(b["k"]), "v": np.array(b["v"])}
+                        for b in frames[0]["blocks"]]
+    bad[0]["blocks"][0]["k"][0, 0, 0, 0] += 1.0
+    with pytest.raises(KVTransferError):
+        kv_transfer.verify_payload(
+            kv_transfer.assemble_payload(header, bad), kinds=("prefix",))
+    # frame order / truncation are typed refusals too
+    with pytest.raises(KVTransferError):
+        kv_transfer.assemble_payload(header, frames[:1])
+    with pytest.raises(KVTransferError):
+        kv_transfer.assemble_payload(header, frames[::-1])
+
+
+# -------------------------------------------------- cross-host fetch path
+
+
+def _bound_pair(net, directory, peers, fetcher_peers=None, **fetch_kw):
+    """Two engines joined to one directory: A publishes as 'a', B
+    fetches through `peers` (a dict; tests substitute saboteurs)."""
+    engA = _engine(net)
+    engB = _engine(net)
+    peers["a"] = engA
+    peers["b"] = engB
+    engA.bind_prefix_directory(directory, "a", peers.get, frame_pages=1)
+    engB.bind_prefix_directory(
+        directory, "b", (fetcher_peers or peers).get, frame_pages=1,
+        **fetch_kw)
+    return engA, engB
+
+
+def test_cross_host_hit_parity_suffix_only_prefill(net):
+    """The acceptance pin: prefill on A, serve on B via directory
+    fetch — argmax-identical to cold, with B prefilling ONLY the
+    uncached suffix."""
+    p = _prompt(21)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    d = PrefixDirectory()
+    engA, engB = _bound_pair(net, d, {})
+    try:
+        np.testing.assert_array_equal(engA.generate(p, 6), exp)
+        assert d.stats()["directory_entries"] == 2  # (21-1)//8 pages
+        np.testing.assert_array_equal(engB.generate(p, 6), exp)
+        stA, stB = engA.stats(), engB.stats()
+        assert stB["prefix_fetches"] == 1
+        assert stB["prefix_fetch_fallbacks"] == 0
+        assert stB["prefix_fetch_bytes"] > 0
+        assert stB["prefix_fetch_ms"] >= 0
+        assert stA["prefix_exports"] == 1
+        # B prefilled only the 5-token suffix: one chunk vs A's three
+        assert stA["prefill_chunks"] == 3 and stB["prefill_chunks"] == 1
+        assert stB["cluster_prefix_hit_tokens"] == 16
+        assert stB["cluster_prefix_hit_tokens_pct"] > 0
+        # the fetched chain is now resident on B and republished: B is
+        # a holder too (hot prefixes spread to where they are used)
+        assert len(d.best_holder(p)["holders"]) == 2
+        # flight recorder carries the wire events
+        kinds = {e["kind"] for e in engB.recorder.dump()["events"]}
+        assert "prefix-fetch" in kinds and "prefix-publish" in kinds
+        kindsA = {e["kind"] for e in engA.recorder.dump()["events"]}
+        assert "prefix-export" in kindsA
+    finally:
+        engA.shutdown()
+        engB.shutdown()
+
+
+class _RefusingPeer:
+    """A holder that answers every export with a connection failure —
+    the pinned never-slower drill."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def export_prefix(self, *a, **kw):
+        self.calls += 1
+        raise ConnectionRefusedError("injected: holder unreachable")
+
+
+def test_never_slower_fetch_failure_is_cold_prefill(net):
+    p = _prompt(21, seed=7)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    d = PrefixDirectory()
+    refuser = _RefusingPeer()
+    engA, engB = _bound_pair(net, d, {}, fetcher_peers={"a": refuser})
+    try:
+        np.testing.assert_array_equal(engA.generate(p, 6), exp)
+        np.testing.assert_array_equal(engB.generate(p, 6), exp)
+        st = engB.stats()
+        assert refuser.calls == 1
+        assert st["prefix_fetches"] == 0
+        assert st["prefix_fetch_fallbacks"] == 1
+        assert st["served"] == 1 and st["failures"] == 0
+        assert st["prefill_chunks"] == 3  # full cold prefill
+    finally:
+        engA.shutdown()
+        engB.shutdown()
+
+
+@pytest.mark.parametrize("mode", ["corrupt-frame", "die-after-header",
+                                  "stale-version"])
+def test_sabotaged_fetch_degrades_typed_with_zero_failed_requests(
+        net, mode):
+    """The chaos drills: a corrupted frame, a holder killed between
+    header and first frame, and a stale weight_version all degrade to
+    cold prefill — correct tokens, counted fallback, nothing bound."""
+    p = _prompt(21, seed=11)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    d = PrefixDirectory()
+    peers = {}
+    engA = _engine(net)
+    peers["a"] = engA
+    engA.bind_prefix_directory(d, "a", peers.get, frame_pages=1)
+    saboteur = PrefixFetchSaboteur(engA, mode)
+    engB = _engine(net)
+    engB.bind_prefix_directory(d, "b", {"a": saboteur}.get,
+                               frame_pages=1)
+    try:
+        np.testing.assert_array_equal(engA.generate(p, 6), exp)
+        np.testing.assert_array_equal(engB.generate(p, 6), exp)
+        st = engB.stats()
+        assert saboteur.sabotages >= 1
+        assert st["prefix_fetches"] == 0
+        assert st["prefix_fetch_fallbacks"] == 1
+        assert st["served"] == 1 and st["failures"] == 0
+        # nothing damaged was bound: B's own cache re-promoted its COLD
+        # prefill pages, so serving the prompt again still matches
+        np.testing.assert_array_equal(engB.generate(p, 6), exp)
+    finally:
+        engA.shutdown()
+        engB.shutdown()
+
+
+def test_fetch_respects_tenant_scoping_and_quota(net):
+    """Tenant 'alice' warms the chain; tenant 'bob' gets NO cross-
+    tenant hit (cold prefill, zero fetches). And a directory hit never
+    bypasses the fetching tenant's page quota: the pages a fetched
+    chain binds into still pass the max_pages door."""
+    p = _prompt(21, seed=13)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    d = PrefixDirectory()
+    engA, engB = _bound_pair(net, d, {})
+    try:
+        np.testing.assert_array_equal(
+            engA.generate(p, 6, tenant="alice"), exp)
+        np.testing.assert_array_equal(
+            engB.generate(p, 6, tenant="bob"), exp)
+        st = engB.stats()
+        assert st["prefix_fetches"] == 0
+        assert st["prefix_fetch_fallbacks"] == 0  # no hit, no attempt
+        # same tenant on B: the fetch fires
+        engB.set_tenant_quota("alice", max_pages=1)
+        with pytest.raises(TenantQuotaExceededError):
+            engB.generate(p, 6, tenant="alice")
+        engB.set_tenant_quota("alice", max_pages=None)
+        np.testing.assert_array_equal(
+            engB.generate(p, 6, tenant="alice"), exp)
+        assert engB.stats()["prefix_fetches"] >= 1
+    finally:
+        engA.shutdown()
+        engB.shutdown()
+
+
+def test_clear_retracts_published_chains(net):
+    """A cleared cache (weight swap, shutdown) retracts its directory
+    entries synchronously — no stale holder attracting fetches."""
+    p = _prompt(21, seed=17)
+    d = PrefixDirectory()
+    eng = _engine(net)
+    eng.bind_prefix_directory(d, "a")
+    try:
+        eng.generate(p, 6)
+        assert d.stats()["directory_entries"] == 2
+        with eng._cond:
+            eng._prefix_cache.clear()
+        assert d.stats()["directory_entries"] == 0
+        assert d.best_holder(p) is None
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------- disagg delta + affinity
+
+
+def test_disagg_prefix_cluster_delta_and_affinity(net):
+    """DisaggCoordinator with the cluster cache on: identical outputs,
+    delta handoffs skip decode-resident pages, repeats of a shared
+    prefix affinity-route to the warm prefill server, zero fallbacks."""
+    gen = {"n_slots": 2, "max_len": 40, "prompt_buckets": (8, 16, 24),
+           "page_size": 8, "prefill_chunk": 8, "prefix_cache": True}
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, VOCAB, 17).astype(np.int32)
+    prompts = [np.concatenate(
+        [shared, rng.integers(0, VOCAB, 3).astype(np.int32)])
+        for _ in range(3)]
+    expected = generate(net, np.stack(prompts), 6, temperature=0.0)
+    co = DisaggCoordinator(net, prefill_replicas=2,
+                           server_kwargs={"generation": gen},
+                           prefix_cluster=True, frame_pages=1)
+    try:
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(
+                co.generate(p, 6, timeout=120.0), expected[i])
+        st = co.stats()
+        assert st["prefix_cluster"] is True
+        assert st["handoffs"] == 3 and st["fallbacks"] == 0
+        assert st["affinity_routes"] >= 1
+        assert st["delta_pages_skipped"] >= 1
+        assert st["directory_entries"] >= 1
+    finally:
+        co.shutdown()
+
+
+def test_disagg_prefix_cluster_off_is_unchanged(net):
+    """Default-off pin: without prefix_cluster the coordinator keeps
+    the PR-17 single-shot fetch_handoff path and ships no directory."""
+    gen = {"n_slots": 2, "max_len": 32, "prompt_buckets": (8,)}
+    p = _prompt(5, seed=2)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    co = DisaggCoordinator(net, server_kwargs={"generation": gen})
+    try:
+        np.testing.assert_array_equal(
+            co.generate(p, 6, timeout=120.0), exp)
+        st = co.stats()
+        assert st["prefix_cluster"] is False
+        assert st["affinity_routes"] == 0
+        assert st["delta_pages_skipped"] == 0
+        assert co.prefix_directory is None
+    finally:
+        co.shutdown()
+
+
+def test_pool_affinity_routing_and_directory_stats(net):
+    """ReplicaPool with a bound directory: repeats of a warm prompt
+    steer to the holder within the affinity margin; eviction drops the
+    holder's entries so it stops attracting routes."""
+    gen = {"n_slots": 2, "max_len": 40, "prompt_buckets": (8, 16, 24),
+           "page_size": 8, "prefill_chunk": 8, "prefix_cache": True}
+    d = PrefixDirectory()
+    pool = ReplicaPool.from_net(
+        net, 2, server_kwargs={"generation": gen},
+        prefix_directory=d, affinity_margin=2)
+    p = _prompt(21, seed=23)
+    exp = generate(net, p[None], 6, temperature=0.0)[0]
+    try:
+        for _ in range(3):
+            np.testing.assert_array_equal(
+                pool.generate(p, 6, timeout=120.0), exp)
+        st = pool.stats()
+        assert st["affinity_routes"] >= 2
+        assert st["directory_entries"] >= 2
+        events = {e["kind"] for e in pool.flight_record()["pool"]["events"]}
+        assert "affinity-route" in events
+        # eviction retracts the holder's entries wholesale
+        holders = d.best_holder(p)["holders"]
+        rid = int(holders[0].rsplit("-", 1)[1])
+        with pool._lock:
+            rep = next(r for r in pool._replicas if r.id == rid)
+            pool._evict_locked(rep, "test")
+        assert all(f"replica-{rid}" not in
+                   (d.best_holder(p) or {"holders": []})["holders"]
+                   for _ in range(1))
+    finally:
+        pool.shutdown()

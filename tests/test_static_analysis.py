@@ -45,7 +45,8 @@ RULE_FIXTURES = {
     # sub-check, incl. the KV-transfer edges (page fetch, lease
     # commit, frame shipping) added with the disagg/migration plane
     # and the exactly-once edges (journal append/replay, claim)
-    "typed-error": ("typed_error", 15),
+    # and the cluster-prefix edges (export, frame drain, publish)
+    "typed-error": ("typed_error", 18),
     "rng-reuse": ("rng", 3),
 }
 
